@@ -1,0 +1,39 @@
+"""hbbft_tpu — a TPU-native Honey Badger BFT consensus framework.
+
+A from-scratch re-design of the capabilities of ``poanetwork/hbbft``
+(the Rust Honey Badger Byzantine Fault Tolerant consensus library) for
+TPU hardware: deterministic sans-IO protocol state machines on the host,
+with the per-epoch threshold cryptography (BLS12-381 share operations,
+Reed-Solomon erasure coding, SHA-256 Merkle hashing) executing as
+batched JAX kernels behind a ``CryptoBackend`` seam.
+
+Layer map (mirrors SURVEY.md §1):
+- ``core``      — Step/Target/DistAlgorithm/FaultLog/NetworkInfo (L1)
+- ``crypto``    — BLS12-381, threshold schemes, RS, Merkle (L0, CPU path)
+- ``ops``       — batched JAX/TPU kernels for the L0 hot ops
+- ``parallel``  — device-mesh sharding of the batched kernels
+- ``protocols`` — Broadcast, CommonCoin, Agreement, CommonSubset,
+                  HoneyBadger, SyncKeyGen, DynamicHoneyBadger,
+                  QueueingHoneyBadger (L2–L4)
+- ``harness``   — adversarial test network + virtual-time simulator (L5)
+"""
+
+__version__ = "0.1.0"
+
+from .core.algorithm import DistAlgorithm, HbbftError
+from .core.fault import Fault, FaultKind, FaultLog
+from .core.network_info import NetworkInfo
+from .core.step import SourcedMessage, Step, Target, TargetedMessage
+
+__all__ = [
+    "DistAlgorithm",
+    "HbbftError",
+    "Fault",
+    "FaultKind",
+    "FaultLog",
+    "NetworkInfo",
+    "SourcedMessage",
+    "Step",
+    "Target",
+    "TargetedMessage",
+]

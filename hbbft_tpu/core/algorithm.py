@@ -1,0 +1,72 @@
+"""The universal distributed-algorithm interface.
+
+Reference: ``src/messaging.rs:186-218`` (``DistAlgorithm`` trait) and
+``src/lib.rs:140-155`` (blanket trait aliases).
+
+Every protocol in the framework — Broadcast, CommonCoin, Agreement,
+CommonSubset, HoneyBadger, DynamicHoneyBadger, QueueingHoneyBadger — is
+a deterministic, single-threaded state machine implementing this
+interface.  It owns no threads, sockets or clocks: the caller feeds it
+inputs and sourced messages, and it returns a :class:`~hbbft_tpu.core.step.Step`
+whose messages the caller must deliver.
+
+This sans-IO design is deliberately preserved from the reference because
+it is what makes (a) adversarial in-process network simulation possible
+without a cluster and (b) TPU co-simulation of thousands of instances
+possible — the state machines are pure, so their crypto workload can be
+collected and flushed to the device in fused batches.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Generic, Hashable, TypeVar
+
+from .step import Step
+
+NodeId = TypeVar("NodeId", bound=Hashable)
+Input = TypeVar("Input")
+Output = TypeVar("Output")
+Message = TypeVar("Message")
+
+
+class DistAlgorithm(abc.ABC, Generic[NodeId, Input, Output, Message]):
+    """A distributed algorithm that defines a message flow.
+
+    Associated types of the reference trait map to the generic
+    parameters ``NodeId / Input / Output / Message``; errors are raised
+    as exceptions (subclasses of :class:`HbbftError`).
+    """
+
+    @abc.abstractmethod
+    def handle_input(self, input: Input) -> Step[Output, Message]:
+        """Handle user input and return the resulting step.
+
+        (Reference ``DistAlgorithm::input``; renamed because ``input`` is
+        a Python builtin.)
+        """
+
+    @abc.abstractmethod
+    def handle_message(self, sender_id: NodeId, message: Message) -> Step[Output, Message]:
+        """Handle a message received from ``sender_id``."""
+
+    @abc.abstractmethod
+    def terminated(self) -> bool:
+        """Whether the algorithm has terminated (no further input/messages)."""
+
+    @abc.abstractmethod
+    def our_id(self) -> NodeId:
+        """This node's own identifier."""
+
+
+class HbbftError(Exception):
+    """Base class for protocol errors (unrecoverable local conditions —
+    Byzantine *remote* behaviour is reported via FaultLog, never raised)."""
+
+
+class UnknownSenderError(HbbftError):
+    pass
+
+
+class CryptoError(HbbftError):
+    pass

@@ -1,0 +1,109 @@
+"""Byzantine-fault evidence log.
+
+Every protocol handler attributes observed protocol violations to the
+offending node with a typed reason and returns them in its ``Step``;
+fault logs propagate up through the protocol stack unchanged, so the
+embedding application always learns *who* misbehaved and *how*.
+
+Reference: ``src/fault_log.rs`` (17-variant ``FaultKind``, ``Fault``,
+``FaultLog`` with append/extend/merge semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Iterator, List
+
+
+class FaultKind(enum.Enum):
+    """Typed reasons a node can be flagged as faulty.
+
+    Mirrors the reference's fault taxonomy (``src/fault_log.rs:10-49``)
+    so fault attribution is feature-complete; names are framework-local.
+    """
+
+    # Threshold decryption (HoneyBadger)
+    UNVERIFIED_DECRYPTION_SHARE_SENDER = "sent a decryption share while we have no ciphertext to check it against"
+    INVALID_DECRYPTION_SHARE = "sent an invalid threshold-decryption share"
+    INVALID_CIPHERTEXT = "proposed an invalid ciphertext"
+    SHARE_DECRYPTION_FAILED = "contribution could not be decrypted from combined shares"
+    BATCH_DESERIALIZATION_FAILED = "batch contribution failed to deserialize"
+    # Common coin
+    UNVERIFIED_SIGNATURE_SHARE_SENDER = "sent a signature share before we could verify it"
+    INVALID_SIGNATURE_SHARE = "sent an invalid threshold-signature share"
+    # Broadcast
+    INVALID_PROOF = "sent an Echo or Value with an invalid Merkle proof"
+    RECEIVED_VALUE_FROM_NON_PROPOSER = "sent a Value although not the proposer"
+    MULTIPLE_VALUES = "sent more than one Value"
+    MULTIPLE_ECHOS = "sent more than one Echo"
+    MULTIPLE_READYS = "sent more than one Ready"
+    BROADCAST_DECODING_FAILED = "broadcast value could not be reconstructed"
+    # Agreement
+    DUPLICATE_BVAL = "sent a duplicate BVal"
+    DUPLICATE_AUX = "sent a duplicate Aux"
+    DUPLICATE_CONF = "sent a duplicate Conf"
+    DUPLICATE_TERM = "sent a duplicate Term"
+    AGREEMENT_EPOCH_BEHIND = "sent an Agreement message for an expired epoch"
+    # Common subset
+    UNEXPECTED_PROPOSER = "referred to an unknown proposer"
+    # Dynamic honey badger / DKG
+    INVALID_VOTE_SIGNATURE = "sent a vote with an invalid signature"
+    INVALID_KEY_GEN_MESSAGE_SIGNATURE = "sent a key-gen message with an invalid signature"
+    INVALID_PART = "committed an invalid DKG Part"
+    INVALID_ACK = "committed an invalid DKG Ack"
+    MULTIPLE_PARTS = "committed more than one DKG Part"
+    UNEXPECTED_KEY_GEN_MESSAGE = "committed an unexpected key-gen message"
+    KEY_GEN_MESSAGE_SPAM = "exceeded the key-gen message cap"
+    # Generic protocol violations
+    INVALID_MESSAGE = "sent a malformed or undecodable message"
+    EPOCH_OUT_OF_RANGE = "sent a message for an epoch out of the accepted window"
+
+    def __repr__(self) -> str:  # keep logs compact
+        return f"FaultKind.{self.name}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One attributed protocol violation (reference ``fault_log.rs:51-64``)."""
+
+    node_id: Any
+    kind: FaultKind
+
+    def __repr__(self) -> str:
+        return f"Fault({self.node_id!r}, {self.kind.name})"
+
+
+class FaultLog:
+    """Append-only list of :class:`Fault` (reference ``fault_log.rs:66-108``)."""
+
+    __slots__ = ("_faults",)
+
+    def __init__(self, faults: List[Fault] | None = None):
+        self._faults: List[Fault] = list(faults) if faults else []
+
+    @classmethod
+    def init(cls, node_id: Any, kind: FaultKind) -> "FaultLog":
+        return cls([Fault(node_id, kind)])
+
+    def append(self, fault: Fault) -> None:
+        self._faults.append(fault)
+
+    def add(self, node_id: Any, kind: FaultKind) -> None:
+        self._faults.append(Fault(node_id, kind))
+
+    def merge(self, other: "FaultLog") -> None:
+        """Drain ``other`` into self (reference ``merge_into``)."""
+        self._faults.extend(other._faults)
+
+    def is_empty(self) -> bool:
+        return not self._faults
+
+    def __len__(self) -> int:
+        return len(self._faults)
+
+    def __iter__(self) -> Iterator[Fault]:
+        return iter(self._faults)
+
+    def __repr__(self) -> str:
+        return f"FaultLog({self._faults!r})"

@@ -1,0 +1,19 @@
+"""Readable hex helpers for logs (reference ``src/fmt.rs``)."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def hex_bytes(data: bytes, max_len: int = 6) -> str:
+    """Truncated hex rendering: full if short, ``aabbcc..ddee`` otherwise
+    (reference ``fmt.rs:5-24``)."""
+    if len(data) <= max_len:
+        return data.hex()
+    head = data[: max_len - 2].hex()
+    tail = data[-2:].hex()
+    return f"{head}..{tail}"
+
+
+def hex_list(items: Iterable[bytes]) -> str:
+    return "[" + ", ".join(hex_bytes(b) for b in items) + "]"

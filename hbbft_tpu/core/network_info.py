@@ -1,0 +1,178 @@
+"""Shared per-node network and crypto context.
+
+Reference: ``NetworkInfo`` (``src/messaging.rs:220-401``) — the object
+every protocol instance holds (via an immutable shared reference) that
+answers "who are the validators, what is f, and which keys do we hold".
+
+This is the seam where the crypto backend is injected (SURVEY §2.1):
+every sign/verify/combine/encrypt call in every protocol goes through
+values handed out here, and the ``ops`` attribute carries the
+batched-operations backend (CPU reference or TPU kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Generic, List, Optional, TypeVar
+
+from ..crypto import mock as M
+from ..crypto import threshold as T
+from ..crypto.backend import default_backend
+
+N = TypeVar("N")
+
+
+class NetworkInfo(Generic[N]):
+    """Immutable network/crypto context shared by all protocol instances
+    of one node."""
+
+    def __init__(
+        self,
+        our_id: N,
+        secret_key_share: Any,
+        secret_key: Any,
+        public_key_set: Any,
+        public_keys: Dict[N, Any],
+        ops: Any = None,
+    ):
+        if not public_keys:
+            raise ValueError("validator set must be non-empty")
+        self._our_id = our_id
+        self._secret_key_share = secret_key_share
+        self._secret_key = secret_key
+        self._public_key_set = public_key_set
+        self._public_keys = dict(public_keys)
+        self._all_ids: List[N] = sorted(public_keys)
+        self._node_indices: Dict[N, int] = {
+            nid: i for i, nid in enumerate(self._all_ids)
+        }
+        self._is_validator = our_id in self._node_indices
+        self._public_key_shares: Dict[N, Any] = {
+            nid: public_key_set.public_key_share(i)
+            for nid, i in self._node_indices.items()
+        }
+        self.ops = ops if ops is not None else default_backend()
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def our_id(self) -> N:
+        return self._our_id
+
+    @property
+    def our_index(self) -> Optional[int]:
+        return self._node_indices.get(self._our_id)
+
+    @property
+    def is_validator(self) -> bool:
+        """Reference ``messaging.rs:348`` — non-validators (observers)
+        handle all messages but send nothing."""
+        return self._is_validator
+
+    # -- topology ----------------------------------------------------------
+
+    @property
+    def all_ids(self) -> List[N]:
+        return self._all_ids
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._all_ids)
+
+    @property
+    def num_faulty(self) -> int:
+        """f = ⌊(N−1)/3⌋ (reference ``messaging.rs:258``)."""
+        return (len(self._all_ids) - 1) // 3
+
+    @property
+    def num_correct(self) -> int:
+        """N − f (reference ``messaging.rs:292-294``)."""
+        return len(self._all_ids) - self.num_faulty
+
+    def node_index(self, nid: N) -> Optional[int]:
+        return self._node_indices.get(nid)
+
+    def is_node_validator(self, nid: N) -> bool:
+        return nid in self._node_indices
+
+    # -- keys --------------------------------------------------------------
+
+    @property
+    def secret_key_share(self) -> Any:
+        return self._secret_key_share
+
+    @property
+    def secret_key(self) -> Any:
+        return self._secret_key
+
+    @property
+    def public_key_set(self) -> Any:
+        return self._public_key_set
+
+    def public_key_share(self, nid: N) -> Any:
+        return self._public_key_shares.get(nid)
+
+    def public_key(self, nid: N) -> Any:
+        return self._public_keys.get(nid)
+
+    @property
+    def public_key_map(self) -> Dict[N, Any]:
+        return dict(self._public_keys)
+
+    def invocation_id(self) -> bytes:
+        """Unique id of this protocol invocation = master public key bytes
+        (reference ``messaging.rs:342-344``); bound into coin nonces."""
+        return self._public_key_set.public_key().to_bytes()
+
+    # -- test key dealing --------------------------------------------------
+
+    @staticmethod
+    def generate_map(
+        ids, rng, mock: bool = False, ops: Any = None
+    ) -> Dict[N, "NetworkInfo[N]"]:
+        """Deal threshold + individual keys for all nodes centrally
+        (reference ``messaging.rs:359-400``; testing/benchmarks only —
+        production uses the dealerless DKG in
+        ``hbbft_tpu/protocols/sync_key_gen.py``).
+
+        With ``mock=True`` the insecure fast mock crypto is dealt instead
+        (protocol-logic tests)."""
+        ids = sorted(ids)
+        num_faulty = (len(ids) - 1) // 3
+        if mock:
+            sk_set = M.MockSecretKeySet.random(num_faulty, rng)
+            sec_keys = {nid: M.MockSecretKey.random(rng) for nid in ids}
+        else:
+            sk_set = T.SecretKeySet.random(num_faulty, rng)
+            sec_keys = {nid: T.SecretKey.random(rng) for nid in ids}
+        pk_set = sk_set.public_keys()
+        pub_keys = {nid: sk.public_key() for nid, sk in sec_keys.items()}
+        return {
+            nid: NetworkInfo(
+                nid,
+                sk_set.secret_key_share(i),
+                sec_keys[nid],
+                pk_set,
+                pub_keys,
+                ops=ops,
+            )
+            for i, nid in enumerate(ids)
+        }
+
+    def observer_view(self, observer_id: N, secret_key: Any = None) -> "NetworkInfo[N]":
+        """A non-validator view of the same network (observers verify
+        everything but hold no key share; reference test harness
+        ``tests/network/mod.rs:402-420``)."""
+        return NetworkInfo(
+            observer_id,
+            None,
+            secret_key,
+            self._public_key_set,
+            self._public_keys,
+            ops=self.ops,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"NetworkInfo(our_id={self._our_id!r}, n={self.num_nodes}, "
+            f"f={self.num_faulty}, validator={self.is_validator})"
+        )

@@ -1,0 +1,209 @@
+"""Canonical deterministic serialization.
+
+The reference uses ``bincode`` (``Cargo.toml:16``) for every signed or
+encrypted payload: HoneyBadger contributions (``honey_badger.rs:115``),
+votes (``votes.rs:52``), and DKG rows/values (``sync_key_gen.rs:294,344``).
+Because votes and DKG messages are *signed over their serialization*,
+the codec must be canonical and deterministic across hosts.
+
+This module provides a compact, self-describing, canonical binary codec:
+
+- fixed tag byte per type;
+- integers as sign byte + big-endian magnitude with minimal length;
+- maps sorted by encoded key bytes (canonical ordering);
+- registered dataclasses encode as ``tag || field values`` so protocol
+  messages and crypto objects round-trip for transports and benchmarks.
+
+Everything is host-side; device code never sees serialized bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Callable, Dict, Tuple, Type
+
+_TAG_NONE = b"\x00"
+_TAG_FALSE = b"\x01"
+_TAG_TRUE = b"\x02"
+_TAG_INT_POS = b"\x03"
+_TAG_INT_NEG = b"\x04"
+_TAG_BYTES = b"\x05"
+_TAG_STR = b"\x06"
+_TAG_LIST = b"\x07"
+_TAG_DICT = b"\x08"
+_TAG_OBJ = b"\x09"
+_TAG_TUPLE = b"\x0a"
+
+
+class SerializationError(Exception):
+    pass
+
+
+# registry: class -> (name, to_fields, from_fields)
+_BY_CLASS: Dict[type, Tuple[str, Callable[[Any], tuple], Callable[..., Any]]] = {}
+_BY_NAME: Dict[str, Tuple[type, Callable[..., Any]]] = {}
+
+
+def wire(name: str):
+    """Class decorator registering a type for canonical serialization.
+
+    For dataclasses the fields are used directly; other classes must
+    provide ``_wire_fields(self) -> tuple`` and ``_from_wire(cls, *fields)``.
+    """
+
+    def deco(cls):
+        if dataclasses.is_dataclass(cls):
+            field_names = [f.name for f in dataclasses.fields(cls)]
+
+            def to_fields(obj, _names=tuple(field_names)):
+                return tuple(getattr(obj, n) for n in _names)
+
+            def from_fields(*vals):
+                return cls(*vals)
+
+        else:
+            if not hasattr(cls, "_wire_fields") or not hasattr(cls, "_from_wire"):
+                raise TypeError(
+                    f"{cls.__name__} must be a dataclass or define _wire_fields/_from_wire"
+                )
+
+            def to_fields(obj):
+                return obj._wire_fields()
+
+            def from_fields(*vals):
+                return cls._from_wire(*vals)
+
+        if name in _BY_NAME:
+            raise ValueError(f"wire tag {name!r} already registered")
+        _BY_CLASS[cls] = (name, to_fields, from_fields)
+        _BY_NAME[name] = (cls, from_fields)
+        return cls
+
+    return deco
+
+
+def _enc_len(n: int) -> bytes:
+    if n < 0xFF:
+        return bytes([n])
+    return b"\xff" + struct.pack(">Q", n)
+
+
+def _dec_len(buf: bytes, pos: int) -> Tuple[int, int]:
+    b0 = buf[pos]
+    if b0 < 0xFF:
+        return b0, pos + 1
+    return struct.unpack_from(">Q", buf, pos + 1)[0], pos + 9
+
+
+def _encode(obj: Any, out: list) -> None:
+    if obj is None:
+        out.append(_TAG_NONE)
+    elif obj is True:
+        out.append(_TAG_TRUE)
+    elif obj is False:
+        out.append(_TAG_FALSE)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            mag = obj.to_bytes((obj.bit_length() + 7) // 8 or 1, "big")
+            out.append(_TAG_INT_POS + _enc_len(len(mag)) + mag)
+        else:
+            m = -obj
+            mag = m.to_bytes((m.bit_length() + 7) // 8 or 1, "big")
+            out.append(_TAG_INT_NEG + _enc_len(len(mag)) + mag)
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        b = bytes(obj)
+        out.append(_TAG_BYTES + _enc_len(len(b)) + b)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(_TAG_STR + _enc_len(len(b)) + b)
+    elif isinstance(obj, (list, tuple)):
+        tag = _TAG_LIST if isinstance(obj, list) else _TAG_TUPLE
+        out.append(tag + _enc_len(len(obj)))
+        for item in obj:
+            _encode(item, out)
+    elif isinstance(obj, dict):
+        items = []
+        for k, v in obj.items():
+            items.append((dumps(k), v))
+        items.sort(key=lambda kv: kv[0])
+        out.append(_TAG_DICT + _enc_len(len(items)))
+        for kb, v in items:
+            out.append(kb)
+            _encode(v, out)
+    else:
+        reg = _BY_CLASS.get(type(obj))
+        if reg is None:
+            raise SerializationError(f"unserializable type: {type(obj).__name__}")
+        name, to_fields, _ = reg
+        nb = name.encode("ascii")
+        fields = to_fields(obj)
+        out.append(_TAG_OBJ + _enc_len(len(nb)) + nb + _enc_len(len(fields)))
+        for f in fields:
+            _encode(f, out)
+
+
+def dumps(obj: Any) -> bytes:
+    """Serialize ``obj`` to canonical bytes (deterministic: equal objects
+    always yield equal bytes — safe to sign)."""
+    out: list = []
+    _encode(obj, out)
+    return b"".join(out)
+
+
+def _decode(buf: bytes, pos: int) -> Tuple[Any, int]:
+    tag = buf[pos : pos + 1]
+    pos += 1
+    if tag == _TAG_NONE:
+        return None, pos
+    if tag == _TAG_TRUE:
+        return True, pos
+    if tag == _TAG_FALSE:
+        return False, pos
+    if tag in (_TAG_INT_POS, _TAG_INT_NEG):
+        n, pos = _dec_len(buf, pos)
+        mag = int.from_bytes(buf[pos : pos + n], "big")
+        return (mag if tag == _TAG_INT_POS else -mag), pos + n
+    if tag == _TAG_BYTES:
+        n, pos = _dec_len(buf, pos)
+        return bytes(buf[pos : pos + n]), pos + n
+    if tag == _TAG_STR:
+        n, pos = _dec_len(buf, pos)
+        return buf[pos : pos + n].decode("utf-8"), pos + n
+    if tag in (_TAG_LIST, _TAG_TUPLE):
+        n, pos = _dec_len(buf, pos)
+        items = []
+        for _ in range(n):
+            item, pos = _decode(buf, pos)
+            items.append(item)
+        return (items if tag == _TAG_LIST else tuple(items)), pos
+    if tag == _TAG_DICT:
+        n, pos = _dec_len(buf, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _decode(buf, pos)
+            v, pos = _decode(buf, pos)
+            d[k] = v
+        return d, pos
+    if tag == _TAG_OBJ:
+        n, pos = _dec_len(buf, pos)
+        name = buf[pos : pos + n].decode("ascii")
+        pos += n
+        nf, pos = _dec_len(buf, pos)
+        reg = _BY_NAME.get(name)
+        if reg is None:
+            raise SerializationError(f"unknown wire tag {name!r}")
+        _, from_fields = reg
+        fields = []
+        for _ in range(nf):
+            f, pos = _decode(buf, pos)
+            fields.append(f)
+        return from_fields(*fields), pos
+    raise SerializationError(f"bad tag byte {tag!r} at {pos - 1}")
+
+
+def loads(buf: bytes) -> Any:
+    obj, pos = _decode(buf, 0)
+    if pos != len(buf):
+        raise SerializationError(f"trailing bytes after position {pos}")
+    return obj

@@ -1,0 +1,1 @@
+"""hbbft_tpu.crypto subpackage."""

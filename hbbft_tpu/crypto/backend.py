@@ -1,0 +1,63 @@
+"""The ``CryptoBackend`` seam — where device acceleration plugs in.
+
+SURVEY §7's architecture stance: every batchable crypto operation the
+protocols need (share verification, RS coding, Merkle hashing) routes
+through an ops-backend object carried by ``NetworkInfo``, so the TPU
+implementation can replace the heavy math without touching any protocol
+state machine.
+
+Three implementations:
+- :class:`CpuBackend` — pure-Python/NumPy reference (correctness oracle);
+- ``TpuBackend`` (``hbbft_tpu/ops/backend_tpu.py``) — batched JAX
+  kernels, same results bit-for-bit;
+- a *batched façade* (``hbbft_tpu/harness/batching.py``) that queues
+  requests from thousands of co-simulated nodes and flushes them as one
+  fused device launch per simulation round.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .curve import G1, G2
+from .hashing import sha256
+from .merkle import MerkleProof, MerkleTree
+from .rs import ReedSolomon
+from . import threshold as T
+
+
+class CpuBackend:
+    """Pure host-side ops backend (the correctness oracle)."""
+
+    name = "cpu"
+
+    # -- hashing / merkle -------------------------------------------------
+
+    def sha256_many(self, items: Sequence[bytes]) -> List[bytes]:
+        return [sha256(b) for b in items]
+
+    def merkle_tree(self, values: List[bytes]) -> MerkleTree:
+        return MerkleTree(values)
+
+    # -- erasure coding ---------------------------------------------------
+
+    def rs_codec(self, data_shards: int, parity_shards: int) -> ReedSolomon:
+        return ReedSolomon(data_shards, parity_shards)
+
+    # -- batched share verification --------------------------------------
+
+    def batch_verify_shares(
+        self,
+        shares: Sequence[G1],
+        pks: Sequence[G2],
+        base: G1,
+        context: bytes = b"",
+    ) -> bool:
+        return T.batch_verify_shares(shares, pks, base, context)
+
+
+_DEFAULT = CpuBackend()
+
+
+def default_backend() -> CpuBackend:
+    return _DEFAULT

@@ -1,0 +1,232 @@
+"""Insecure hash-based mock crypto — fast protocol-logic testing.
+
+Drop-in interface twins of the real threshold types in
+``hbbft_tpu/crypto/threshold.py`` with identical *functional* semantics:
+
+- combining any > t verified shares yields the same deterministic result
+  (like Lagrange interpolation does);
+- forged or wrong shares fail share verification (so fault attribution
+  paths behave exactly as with real BLS);
+- threshold encryption round-trips and ``Ciphertext.verify`` rejects
+  tampered ciphertexts.
+
+None of the security: every key object carries the group seed.  This
+exists so the adversarial protocol sweeps (reference test strategy,
+SURVEY §4 — dozens of full network simulations per test file) run in
+milliseconds, while the real-BLS path is exercised by dedicated crypto
+tests and smaller real-crypto integration runs.  **Never use outside
+tests/benchmarks.**
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from .hashing import sha256, xor_stream
+from ..core.serialize import dumps, wire
+
+
+def _tag(*parts: bytes) -> bytes:
+    out = []
+    for p in parts:
+        out.append(len(p).to_bytes(4, "big"))
+        out.append(p)
+    return sha256(b"".join(out))
+
+
+def _idx(i: int) -> bytes:
+    return i.to_bytes(8, "big")
+
+
+@wire("MockSig")
+@dataclasses.dataclass(frozen=True)
+class MockSignature:
+    tag: bytes
+
+    def parity(self) -> bool:
+        return bool(self.tag[0] & 1)
+
+    def to_bytes(self) -> bytes:
+        return self.tag
+
+
+@wire("MockSigShare")
+@dataclasses.dataclass(frozen=True)
+class MockSignatureShare:
+    tag: bytes
+    combined: bytes  # the group signature this share contributes to
+
+    def to_bytes(self) -> bytes:
+        return self.tag + self.combined
+
+
+@wire("MockDecShare")
+@dataclasses.dataclass(frozen=True)
+class MockDecryptionShare:
+    tag: bytes
+    key: bytes  # the symmetric key this share contributes to
+
+    def to_bytes(self) -> bytes:
+        return self.tag + self.key
+
+
+@wire("MockCiphertext")
+@dataclasses.dataclass(frozen=True)
+class MockCiphertext:
+    seed_id: bytes
+    nonce: bytes
+    v: bytes
+    mac: bytes
+
+    def verify(self) -> bool:
+        return self.mac == _tag(b"CTMAC", self.seed_id, self.nonce, self.v)
+
+    def to_bytes(self) -> bytes:
+        return dumps(self)
+
+
+@wire("MockPublicKey")
+@dataclasses.dataclass(frozen=True)
+class MockPublicKey:
+    seed: bytes
+
+    def verify(self, sig: MockSignature, msg: bytes) -> bool:
+        return sig.tag == _tag(b"SIG", self.seed, msg)
+
+    def encrypt(self, msg: bytes, rng) -> MockCiphertext:
+        nonce = rng.randrange(2**128).to_bytes(16, "big")
+        seed_id = _tag(b"SEEDID", self.seed)
+        v = xor_stream(_tag(b"KEY", self.seed, nonce), msg)
+        return MockCiphertext(
+            seed_id, nonce, v, _tag(b"CTMAC", seed_id, nonce, v)
+        )
+
+    def to_bytes(self) -> bytes:
+        return self.seed
+
+
+@wire("MockSecretKey")
+@dataclasses.dataclass(frozen=True)
+class MockSecretKey:
+    seed: bytes
+
+    @classmethod
+    def random(cls, rng) -> "MockSecretKey":
+        return cls(rng.randrange(2**256).to_bytes(32, "big"))
+
+    def public_key(self) -> MockPublicKey:
+        return MockPublicKey(self.seed)
+
+    def sign(self, msg: bytes) -> MockSignature:
+        return MockSignature(_tag(b"SIG", self.seed, msg))
+
+    def decrypt(self, ct: MockCiphertext) -> Optional[bytes]:
+        if not ct.verify():
+            return None
+        return xor_stream(_tag(b"KEY", self.seed, ct.nonce), ct.v)
+
+
+@wire("MockSecretKeyShare")
+@dataclasses.dataclass(frozen=True)
+class MockSecretKeyShare:
+    seed: bytes
+    index: int
+
+    def sign(self, msg: bytes) -> MockSignatureShare:
+        combined = _tag(b"SIG", self.seed, msg)
+        return MockSignatureShare(
+            _tag(b"SIGSHARE", self.seed, _idx(self.index), combined), combined
+        )
+
+    def decrypt_share(self, ct: MockCiphertext) -> Optional[MockDecryptionShare]:
+        if not ct.verify():
+            return None
+        return self.decrypt_share_no_verify(ct)
+
+    def decrypt_share_no_verify(self, ct: MockCiphertext) -> MockDecryptionShare:
+        key = _tag(b"KEY", self.seed, ct.nonce)
+        return MockDecryptionShare(
+            _tag(b"DECSHARE", self.seed, _idx(self.index), key), key
+        )
+
+
+@wire("MockPublicKeyShare")
+@dataclasses.dataclass(frozen=True)
+class MockPublicKeyShare:
+    seed: bytes
+    index: int
+
+    def verify_signature_share(self, share: MockSignatureShare, msg: bytes) -> bool:
+        combined = _tag(b"SIG", self.seed, msg)
+        return share.combined == combined and share.tag == _tag(
+            b"SIGSHARE", self.seed, _idx(self.index), combined
+        )
+
+    def verify_decryption_share(
+        self, share: MockDecryptionShare, ct: MockCiphertext
+    ) -> bool:
+        key = _tag(b"KEY", self.seed, ct.nonce)
+        return share.key == key and share.tag == _tag(
+            b"DECSHARE", self.seed, _idx(self.index), key
+        )
+
+    def to_bytes(self) -> bytes:
+        return self.seed + _idx(self.index)
+
+
+@wire("MockPublicKeySet")
+@dataclasses.dataclass(frozen=True)
+class MockPublicKeySet:
+    seed: bytes
+    threshold_: int
+
+    @property
+    def threshold(self) -> int:
+        return self.threshold_
+
+    def public_key(self) -> MockPublicKey:
+        return MockPublicKey(self.seed)
+
+    def public_key_share(self, i: int) -> MockPublicKeyShare:
+        return MockPublicKeyShare(self.seed, i)
+
+    def combine_signatures(
+        self, shares: Dict[int, MockSignatureShare]
+    ) -> MockSignature:
+        if len(shares) <= self.threshold_:
+            raise ValueError("not enough signature shares")
+        # Deterministic, subset-independent — mirrors Lagrange combine.
+        first = shares[sorted(shares)[0]]
+        return MockSignature(first.combined)
+
+    def combine_decryption_shares(
+        self, shares: Dict[int, MockDecryptionShare], ct: MockCiphertext
+    ) -> bytes:
+        if len(shares) <= self.threshold_:
+            raise ValueError("not enough decryption shares")
+        first = shares[sorted(shares)[0]]
+        return xor_stream(first.key, ct.v)
+
+    def verify_signature(self, sig: MockSignature, msg: bytes) -> bool:
+        return sig.tag == _tag(b"SIG", self.seed, msg)
+
+
+@dataclasses.dataclass(frozen=True)
+class MockSecretKeySet:
+    seed: bytes
+    threshold_: int
+
+    @classmethod
+    def random(cls, threshold: int, rng) -> "MockSecretKeySet":
+        return cls(rng.randrange(2**256).to_bytes(32, "big"), threshold)
+
+    @property
+    def threshold(self) -> int:
+        return self.threshold_
+
+    def secret_key_share(self, i: int) -> MockSecretKeyShare:
+        return MockSecretKeyShare(self.seed, i)
+
+    def public_keys(self) -> MockPublicKeySet:
+        return MockPublicKeySet(self.seed, self.threshold_)

@@ -1,0 +1,233 @@
+"""Polynomials over Fr and group commitments — the Shamir layer.
+
+Replaces ``threshold_crypto``'s ``poly`` module (used by the DKG at
+``sync_key_gen.rs:164-166``: ``Poly``, ``BivarPoly``, ``BivarCommitment``)
+and the Lagrange machinery behind ``combine_signatures`` / ``decrypt``.
+
+Commitments live in G2 (public-key group); the bivariate polynomial is
+symmetric, which is what lets DKG participants cross-verify each other's
+rows (value at (i, j) equals value at (j, i)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+from . import fields as F
+from .curve import G2, G2_GEN, g2_multi_exp
+from ..core.serialize import wire
+
+R = F.R
+
+
+def _rand_fr(rng) -> int:
+    return rng.randrange(R)
+
+
+# ---------------------------------------------------------------------------
+# Lagrange interpolation at zero
+# ---------------------------------------------------------------------------
+
+
+def lagrange_coefficients_at_zero(xs: Sequence[int]) -> List[int]:
+    """λᵢ = Π_{j≠i} xⱼ/(xⱼ−xᵢ) mod r, for interpolation at x=0.
+
+    ``xs`` must be distinct and nonzero (we use index+1 as evaluation
+    points, mirroring the reference's convention)."""
+    lams = []
+    for i, xi in enumerate(xs):
+        num, den = 1, 1
+        for j, xj in enumerate(xs):
+            if i == j:
+                continue
+            num = num * xj % R
+            den = den * (xj - xi) % R
+        lams.append(num * pow(den, -1, R) % R)
+    return lams
+
+
+def interpolate_at_zero(points: Sequence[Tuple[int, int]]) -> int:
+    """Interpolate scalar shares (x, y) at 0 over Fr."""
+    xs = [x for x, _ in points]
+    lams = lagrange_coefficients_at_zero(xs)
+    return sum(lam * y for lam, (_, y) in zip(lams, points)) % R
+
+
+# ---------------------------------------------------------------------------
+# Univariate polynomials
+# ---------------------------------------------------------------------------
+
+
+@wire("Poly")
+@dataclasses.dataclass
+class Poly:
+    """Univariate polynomial over Fr, coefficient order low→high."""
+
+    coeffs: List[int]
+
+    @classmethod
+    def random(cls, degree: int, rng) -> "Poly":
+        return cls([_rand_fr(rng) for _ in range(degree + 1)])
+
+    @classmethod
+    def constant(cls, c: int) -> "Poly":
+        return cls([c % R])
+
+    @property
+    def degree(self) -> int:
+        return len(self.coeffs) - 1
+
+    def evaluate(self, x: int) -> int:
+        acc = 0
+        for c in reversed(self.coeffs):
+            acc = (acc * x + c) % R
+        return acc
+
+    def __add__(self, other: "Poly") -> "Poly":
+        n = max(len(self.coeffs), len(other.coeffs))
+        a = self.coeffs + [0] * (n - len(self.coeffs))
+        b = other.coeffs + [0] * (n - len(other.coeffs))
+        return Poly([(x + y) % R for x, y in zip(a, b)])
+
+    def commitment(self) -> "Commitment":
+        return Commitment([G2_GEN * c for c in self.coeffs])
+
+
+@wire("Commitment")
+@dataclasses.dataclass
+class Commitment:
+    """Coefficient-wise G2 commitment of a :class:`Poly`."""
+
+    coeffs: List[G2]
+
+    @property
+    def degree(self) -> int:
+        return len(self.coeffs) - 1
+
+    def evaluate(self, x: int) -> G2:
+        acc = G2.infinity()
+        for c in reversed(self.coeffs):
+            acc = acc * x + c
+        return acc
+
+    def __add__(self, other: "Commitment") -> "Commitment":
+        n = max(len(self.coeffs), len(other.coeffs))
+        a = self.coeffs + [G2.infinity()] * (n - len(self.coeffs))
+        b = other.coeffs + [G2.infinity()] * (n - len(other.coeffs))
+        return Commitment([x + y for x, y in zip(a, b)])
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Commitment) and all(
+            a == b for a, b in zip(self.coeffs, other.coeffs)
+        ) and len(self.coeffs) == len(other.coeffs)
+
+
+# ---------------------------------------------------------------------------
+# Symmetric bivariate polynomials (DKG dealing)
+# ---------------------------------------------------------------------------
+
+
+@wire("BivarPoly")
+@dataclasses.dataclass
+class BivarPoly:
+    """Symmetric bivariate polynomial p(x, y) of degree ≤ t in each
+    variable; ``coeffs[i][j]`` with coeffs[i][j] == coeffs[j][i].
+
+    Reference: ``threshold_crypto``'s BivarPoly as used by
+    ``sync_key_gen.rs:268-299`` for dealing.
+    """
+
+    coeffs: List[List[int]]  # (t+1) x (t+1), symmetric
+
+    @classmethod
+    def random(cls, degree: int, rng) -> "BivarPoly":
+        t = degree
+        c = [[0] * (t + 1) for _ in range(t + 1)]
+        for i in range(t + 1):
+            for j in range(i, t + 1):
+                v = _rand_fr(rng)
+                c[i][j] = v
+                c[j][i] = v
+        return cls(c)
+
+    @property
+    def degree(self) -> int:
+        return len(self.coeffs) - 1
+
+    def evaluate(self, x: int, y: int) -> int:
+        acc = 0
+        for row in reversed(self.coeffs):
+            inner = 0
+            for c in reversed(row):
+                inner = (inner * y + c) % R
+            acc = (acc * x + inner) % R
+        return acc
+
+    def row(self, x: int) -> Poly:
+        """The univariate polynomial q(y) = p(x, y)."""
+        t = self.degree
+        out = []
+        for j in range(t + 1):
+            acc = 0
+            for i in reversed(range(t + 1)):
+                acc = (acc * x + self.coeffs[i][j]) % R
+            out.append(acc)
+        return Poly(out)
+
+    def commitment(self) -> "BivarCommitment":
+        return BivarCommitment(
+            [[G2_GEN * c for c in row] for row in self.coeffs]
+        )
+
+
+@wire("BivarCommitment")
+@dataclasses.dataclass
+class BivarCommitment:
+    """G2 commitment matrix of a symmetric :class:`BivarPoly`."""
+
+    coeffs: List[List[G2]]
+
+    @property
+    def degree(self) -> int:
+        return len(self.coeffs) - 1
+
+    def evaluate(self, x: int, y: int) -> G2:
+        acc = G2.infinity()
+        for row in reversed(self.coeffs):
+            inner = G2.infinity()
+            for c in reversed(row):
+                inner = inner * y + c
+            acc = acc * x + inner
+        return acc
+
+    def row(self, x: int) -> Commitment:
+        """Commitment of the row polynomial p(x, ·)."""
+        t = self.degree
+        out = []
+        for j in range(t + 1):
+            acc = G2.infinity()
+            for i in reversed(range(t + 1)):
+                acc = acc * x + self.coeffs[i][j]
+            out.append(acc)
+        return Commitment(out)
+
+    def is_symmetric(self) -> bool:
+        t = self.degree
+        if any(len(row) != t + 1 for row in self.coeffs):
+            return False
+        return all(
+            self.coeffs[i][j] == self.coeffs[j][i]
+            for i in range(t + 1)
+            for j in range(i + 1, t + 1)
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BivarCommitment)
+            and len(self.coeffs) == len(other.coeffs)
+            and all(
+                len(r1) == len(r2) and all(a == b for a, b in zip(r1, r2))
+                for r1, r2 in zip(self.coeffs, other.coeffs)
+            )
+        )

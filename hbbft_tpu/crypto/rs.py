@@ -1,0 +1,177 @@
+"""Systematic Reed-Solomon erasure coding over GF(2^8).
+
+Replaces the ``reed-solomon-erasure`` crate (``Cargo.toml:26``; encode at
+``broadcast.rs:365-367``, reconstruct at ``broadcast.rs:643-656``).
+
+Encoding is a GF(2^8) matrix multiply — the representation is chosen so
+the TPU path (``ops/gf256_jax.py``) runs the *same* systematic matrix as
+one batched log/antilog-table matmul.  The systematic generator matrix is
+a Vandermonde matrix normalised so the top k×k block is the identity
+(Backblaze/Plank construction, matching the reference crate's family).
+
+The f = 0 edge case (single data shard per node, no parity) mirrors the
+reference's ``Coding::Trivial`` fallback (``broadcast.rs:596-658``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# --- GF(2^8) tables, primitive polynomial 0x11d, generator 3 ----------------
+
+_EXP = np.zeros(512, dtype=np.uint8)
+_LOG = np.zeros(256, dtype=np.int32)
+
+
+def _build_tables() -> None:
+    x = 1
+    for i in range(255):
+        _EXP[i] = x
+        _LOG[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= 0x11D
+    for i in range(255, 512):
+        _EXP[i] = _EXP[i - 255]
+
+
+_build_tables()
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(_EXP[int(_LOG[a]) + int(_LOG[b])])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(256) inverse of 0")
+    return int(_EXP[255 - int(_LOG[a])])
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(m,k)·(k,n) GF(2^8) matrix product, fully vectorised."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    la = _LOG[a]  # (m, k)
+    lb = _LOG[b]  # (k, n)
+    prod = _EXP[(la[:, :, None] + lb[None, :, :])]
+    prod = np.where((a[:, :, None] == 0) | (b[None, :, :] == 0), 0, prod)
+    return np.bitwise_xor.reduce(prod, axis=1).astype(np.uint8)
+
+
+def _gf_mat_inv(m: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(2^8)."""
+    n = m.shape[0]
+    aug = np.concatenate([m.astype(np.uint8), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if aug[row, col] != 0:
+                pivot = row
+                break
+        if pivot is None:
+            raise ValueError("matrix not invertible over GF(256)")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        inv_p = gf_inv(int(aug[col, col]))
+        # scale pivot row
+        row_vals = aug[col]
+        scaled = np.where(
+            row_vals == 0, 0, _EXP[_LOG[row_vals] + _LOG[inv_p]]
+        ).astype(np.uint8)
+        aug[col] = scaled
+        for row in range(n):
+            if row != col and aug[row, col] != 0:
+                factor = int(aug[row, col])
+                mult = np.where(
+                    aug[col] == 0, 0, _EXP[_LOG[aug[col]] + _LOG[factor]]
+                ).astype(np.uint8)
+                aug[row] ^= mult
+    return aug[:, n:]
+
+
+_MATRIX_CACHE: dict = {}
+
+
+def _systematic_matrix(k: int, n: int) -> np.ndarray:
+    """n×k systematic generator matrix (top k×k = identity)."""
+    key = (k, n)
+    cached = _MATRIX_CACHE.get(key)
+    if cached is not None:
+        return cached
+    # Vandermonde rows: row i = [1, aᵢ, aᵢ², …] with distinct aᵢ = i.
+    # Any k rows are linearly independent, so after normalisation any k
+    # shards suffice for reconstruction.
+    vand = np.zeros((n, k), dtype=np.uint8)
+    for i in range(n):
+        v = 1
+        for j in range(k):
+            vand[i, j] = v
+            v = gf_mul(v, i)
+    # normalise: M = V · (top k×k)^-1  → systematic
+    top_inv = _gf_mat_inv(vand[:k, :k].copy())
+    mat = gf_matmul(vand, top_inv)
+    _MATRIX_CACHE[key] = mat
+    return mat
+
+
+class ReedSolomon:
+    """Systematic RS codec: k data shards, n total (n−k parity).
+
+    Same interface shape as the reference's ``Coding`` wrapper
+    (``broadcast.rs:596-658``): ``encode`` fills parity from data,
+    ``reconstruct`` recovers all shards from any k of them.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards < 1:
+            raise ValueError("need at least one data shard")
+        if data_shards + parity_shards > 256:
+            raise ValueError("GF(256) supports at most 256 shards")
+        self.k = data_shards
+        self.m = parity_shards
+        self.n = data_shards + parity_shards
+        self.matrix = (
+            _systematic_matrix(self.k, self.n) if parity_shards > 0 else None
+        )
+
+    def encode(self, data: Sequence[bytes]) -> List[bytes]:
+        """data: k equal-length shards → n shards (data ++ parity)."""
+        if len(data) != self.k:
+            raise ValueError(f"expected {self.k} data shards")
+        if self.m == 0:
+            return list(data)
+        arr = np.frombuffer(b"".join(data), dtype=np.uint8).reshape(
+            self.k, -1
+        )
+        parity = gf_matmul(self.matrix[self.k :], arr)
+        return list(data) + [p.tobytes() for p in parity]
+
+    def reconstruct(self, shards: List[Optional[bytes]]) -> List[bytes]:
+        """Recover all n shards; ``shards[i] is None`` marks an erasure.
+        Raises ValueError with fewer than k present."""
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} shard slots")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.k:
+            raise ValueError("not enough shards to reconstruct")
+        if self.m == 0:
+            return [s for s in shards]  # type: ignore[misc]
+        use = present[: self.k]
+        sub = self.matrix[use, :]
+        dec = _gf_mat_inv(sub.copy())
+        avail = np.stack(
+            [np.frombuffer(shards[i], dtype=np.uint8) for i in use]
+        )
+        data = gf_matmul(dec, avail)
+        full = gf_matmul(self.matrix, data)
+        out: List[bytes] = []
+        for i in range(self.n):
+            out.append(
+                shards[i] if shards[i] is not None else full[i].tobytes()
+            )
+        return out

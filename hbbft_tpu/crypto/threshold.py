@@ -1,0 +1,344 @@
+"""Threshold BLS crypto — signatures, coin shares, hybrid encryption.
+
+Replaces the ``threshold_crypto`` crate (``Cargo.toml:29``), the heart
+of the reference's security: unique threshold signatures drive the
+common coin (``common_coin.rs:142-207``) and threshold decryption makes
+HoneyBadger censorship-resistant (``honey_badger.rs:101-444``).
+
+Scheme (re-designed TPU-first — every hot object lives in G1 where the
+batched limb kernels operate; G2 appears only in public keys):
+
+- *Signatures / coin shares*: min-sig BLS.  σᵢ = skᵢ·H₁(m) ∈ G1,
+  pkᵢ = skᵢ·P₂ ∈ G2.  Verify: e(σᵢ, P₂) == e(H₁(m), pkᵢ).
+- *Threshold encryption* (Baek–Zheng style hybrid): U = r·P₁,
+  K = SHA-256(r·Y₁) with master key Y₁ = s·P₁ ∈ G1, V = m ⊕ stream(K),
+  plus a Schnorr proof-of-knowledge of r replacing the reference's
+  W = r·H(U,V) validity element — same plaintext-awareness role
+  (``Ciphertext::verify``) without needing hash-to-G2.
+- *Decryption shares*: dᵢ = skᵢ·U ∈ G1; verify e(dᵢ, P₂) == e(U, pkᵢ);
+  combine by Lagrange in the exponent at x=0 (x-coords are index+1).
+- *Batch verification*: k shares verify with ONE product-pairing check
+  via deterministic (Fiat–Shamir) random linear combination — the 2k
+  pairings collapse to 2, and the Σrᵢ·Pᵢ MSMs are exactly the kernels
+  the TPU backend executes (``ops/g1_jax.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import fields as F
+from .curve import G1, G1_GEN, G2, G2_GEN, g1_multi_exp, g2_multi_exp
+from .hashing import DST_ENC, DST_POK, DST_SIG, hash_to_fr, hash_to_g1, sha256, xor_stream
+from .pairing import pairing_check
+from .poly import Commitment, Poly, lagrange_coefficients_at_zero
+from ..core.serialize import dumps, wire
+
+R = F.R
+
+
+def _rand_fr(rng) -> int:
+    k = rng.randrange(R - 1) + 1
+    return k
+
+
+# ---------------------------------------------------------------------------
+# Signatures
+# ---------------------------------------------------------------------------
+
+
+@wire("Sig")
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    """A (combined) BLS signature in G1."""
+
+    point: G1
+
+    def parity(self) -> bool:
+        """Deterministic unpredictable bit — the common-coin value
+        (reference ``Signature::parity``)."""
+        return bool(sha256(self.point.to_bytes())[0] & 1)
+
+    def to_bytes(self) -> bytes:
+        return self.point.to_bytes()
+
+
+@wire("SigShare")
+@dataclasses.dataclass(frozen=True)
+class SignatureShare:
+    point: G1
+
+    def to_bytes(self) -> bytes:
+        return self.point.to_bytes()
+
+
+@wire("DecShare")
+@dataclasses.dataclass(frozen=True)
+class DecryptionShare:
+    point: G1
+
+    def to_bytes(self) -> bytes:
+        return self.point.to_bytes()
+
+
+# ---------------------------------------------------------------------------
+# Ciphertext
+# ---------------------------------------------------------------------------
+
+
+@wire("Ciphertext")
+@dataclasses.dataclass(frozen=True)
+class Ciphertext:
+    """Hybrid threshold ciphertext (U, V, Schnorr PoK (c, z)).
+
+    ``verify()`` plays the role of the reference's
+    ``Ciphertext::verify`` (``honey_badger.rs:371``): it proves the
+    encryptor knew the randomness r, giving plaintext-awareness.
+    """
+
+    u: G1
+    v: bytes
+    c: int
+    z: int
+
+    def verify(self) -> bool:
+        if self.u.is_infinity():
+            return False
+        if not (0 <= self.c < R and 0 <= self.z < R):
+            return False
+        a = G1_GEN * self.z - self.u * self.c
+        c2 = hash_to_fr(
+            DST_POK + self.u.to_bytes() + sha256(self.v) + a.to_bytes()
+        )
+        return c2 == self.c
+
+    def to_bytes(self) -> bytes:
+        return dumps(self)
+
+
+# ---------------------------------------------------------------------------
+# Individual keys (used for votes + DKG row encryption)
+# ---------------------------------------------------------------------------
+
+
+@wire("PublicKey")
+@dataclasses.dataclass(frozen=True)
+class PublicKey:
+    """Individual public key; pk1 = sk·P₁ (encryption target),
+    pk2 = sk·P₂ (signature verification)."""
+
+    pk1: G1
+    pk2: G2
+
+    def verify(self, sig: Signature, msg: bytes) -> bool:
+        h = hash_to_g1(msg, DST_SIG)
+        return pairing_check([(sig.point, G2_GEN), (-h, self.pk2)])
+
+    def encrypt(self, msg: bytes, rng) -> Ciphertext:
+        r = _rand_fr(rng)
+        u = G1_GEN * r
+        key = sha256(DST_ENC + (self.pk1 * r).to_bytes())
+        v = xor_stream(key, msg)
+        a_r = _rand_fr(rng)
+        a = G1_GEN * a_r
+        c = hash_to_fr(DST_POK + u.to_bytes() + sha256(v) + a.to_bytes())
+        z = (a_r + c * r) % R
+        return Ciphertext(u, v, c, z)
+
+    def to_bytes(self) -> bytes:
+        return self.pk1.to_bytes() + self.pk2.to_bytes()
+
+
+@wire("SecretKey")
+@dataclasses.dataclass(frozen=True)
+class SecretKey:
+    """Individual secret key (vote signing ``votes.rs:45-61``, DKG row
+    encryption ``sync_key_gen.rs:294``)."""
+
+    scalar: int
+
+    @classmethod
+    def random(cls, rng) -> "SecretKey":
+        return cls(_rand_fr(rng))
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(G1_GEN * self.scalar, G2_GEN * self.scalar)
+
+    def sign(self, msg: bytes) -> Signature:
+        return Signature(hash_to_g1(msg, DST_SIG) * self.scalar)
+
+    def decrypt(self, ct: Ciphertext) -> Optional[bytes]:
+        if not ct.verify():
+            return None
+        key = sha256(DST_ENC + (ct.u * self.scalar).to_bytes())
+        return xor_stream(key, ct.v)
+
+
+# ---------------------------------------------------------------------------
+# Threshold keys
+# ---------------------------------------------------------------------------
+
+
+@wire("SecretKeyShare")
+@dataclasses.dataclass(frozen=True)
+class SecretKeyShare:
+    """One node's share of the master secret (poly evaluated at idx+1)."""
+
+    scalar: int
+
+    def sign(self, msg: bytes) -> SignatureShare:
+        return SignatureShare(hash_to_g1(msg, DST_SIG) * self.scalar)
+
+    def sign_g1(self, h: G1) -> SignatureShare:
+        return SignatureShare(h * self.scalar)
+
+    def decrypt_share(self, ct: Ciphertext) -> Optional[DecryptionShare]:
+        if not ct.verify():
+            return None
+        return DecryptionShare(ct.u * self.scalar)
+
+    def decrypt_share_no_verify(self, ct: Ciphertext) -> DecryptionShare:
+        """Reference ``honey_badger.rs:400-403`` — ciphertext was already
+        verified when the contribution was accepted."""
+        return DecryptionShare(ct.u * self.scalar)
+
+
+@wire("PublicKeyShare")
+@dataclasses.dataclass(frozen=True)
+class PublicKeyShare:
+    point: G2  # skᵢ·P₂
+
+    def verify_signature_share(self, share: SignatureShare, msg: bytes) -> bool:
+        h = hash_to_g1(msg, DST_SIG)
+        return self.verify_signature_share_g1(share, h)
+
+    def verify_signature_share_g1(self, share: SignatureShare, h: G1) -> bool:
+        return pairing_check([(share.point, G2_GEN), (-h, self.point)])
+
+    def verify_decryption_share(self, share: DecryptionShare, ct: Ciphertext) -> bool:
+        return pairing_check([(share.point, G2_GEN), (-ct.u, self.point)])
+
+    def to_bytes(self) -> bytes:
+        return self.point.to_bytes()
+
+
+@wire("PublicKeySet")
+@dataclasses.dataclass(frozen=True)
+class PublicKeySet:
+    """Master public key material: G2 coefficient commitment (yields all
+    public key shares) + the G1 master key (encryption target).
+
+    Reference ``threshold_crypto::PublicKeySet`` as held by
+    ``NetworkInfo`` (``messaging.rs:222-401``).
+    """
+
+    commitment: Commitment
+    master_g1: G1
+
+    @property
+    def threshold(self) -> int:
+        return self.commitment.degree
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(self.master_g1, self.commitment.evaluate(0))
+
+    def public_key_share(self, i: int) -> PublicKeyShare:
+        return PublicKeyShare(self.commitment.evaluate(i + 1))
+
+    # -- combination ------------------------------------------------------
+
+    def combine_signatures(
+        self, shares: Dict[int, SignatureShare]
+    ) -> Signature:
+        """Lagrange-combine > threshold shares; deterministic share-subset
+        rule: lowest t+1 indices (bit-identity across CPU/TPU paths)."""
+        idxs = sorted(shares)[: self.threshold + 1]
+        if len(idxs) <= self.threshold:
+            raise ValueError("not enough signature shares")
+        xs = [i + 1 for i in idxs]
+        lams = lagrange_coefficients_at_zero(xs)
+        return Signature(
+            g1_multi_exp([shares[i].point for i in idxs], lams)
+        )
+
+    def combine_decryption_shares(
+        self, shares: Dict[int, DecryptionShare], ct: Ciphertext
+    ) -> bytes:
+        idxs = sorted(shares)[: self.threshold + 1]
+        if len(idxs) <= self.threshold:
+            raise ValueError("not enough decryption shares")
+        xs = [i + 1 for i in idxs]
+        lams = lagrange_coefficients_at_zero(xs)
+        s = g1_multi_exp([shares[i].point for i in idxs], lams)
+        key = sha256(DST_ENC + s.to_bytes())
+        return xor_stream(key, ct.v)
+
+    def verify_signature(self, sig: Signature, msg: bytes) -> bool:
+        h = hash_to_g1(msg, DST_SIG)
+        return pairing_check(
+            [(sig.point, G2_GEN), (-h, self.commitment.evaluate(0))]
+        )
+
+
+@wire("SecretKeySet")
+@dataclasses.dataclass(frozen=True)
+class SecretKeySet:
+    """Trusted-dealer secret polynomial (test key dealing — the DKG
+    replaces this in production; reference ``messaging.rs:359-400``)."""
+
+    poly: Poly
+
+    @classmethod
+    def random(cls, threshold: int, rng) -> "SecretKeySet":
+        return cls(Poly.random(threshold, rng))
+
+    @property
+    def threshold(self) -> int:
+        return self.poly.degree
+
+    def secret_key_share(self, i: int) -> SecretKeyShare:
+        return SecretKeyShare(self.poly.evaluate(i + 1))
+
+    def public_keys(self) -> PublicKeySet:
+        return PublicKeySet(
+            self.poly.commitment(), G1_GEN * self.poly.coeffs[0]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Batched verification (host orchestration of the TPU MSM kernels)
+# ---------------------------------------------------------------------------
+
+
+def _rlc_coeffs(context: bytes, items: Sequence[bytes]) -> List[int]:
+    """Deterministic 128-bit random-linear-combination coefficients
+    (Fiat–Shamir over all inputs) — reproducible across backends."""
+    seed = sha256(context + b"".join(items))
+    return [
+        int.from_bytes(sha256(seed + i.to_bytes(4, "big"))[:16], "big") | 1
+        for i in range(len(items))
+    ]
+
+
+def batch_verify_shares(
+    shares: Sequence[G1],
+    pks: Sequence[G2],
+    base: G1,
+    context: bytes = b"",
+) -> bool:
+    """Check e(shareᵢ, P₂) == e(base, pkᵢ) for all i with one product
+    pairing: e(Σrᵢ·shareᵢ, P₂) · e(−base, Σrᵢ·pkᵢ) == 1.
+
+    This is the hot verification path of the whole framework (N² share
+    verifies per HoneyBadger epoch, ``honey_badger.rs:422-444``); the
+    MSMs are what the TPU backend offloads.
+    """
+    if not shares:
+        return True
+    coeffs = _rlc_coeffs(
+        context, [s.to_bytes() for s in shares] + [p.to_bytes() for p in pks]
+    )
+    agg_share = g1_multi_exp(shares, coeffs)
+    agg_pk = g2_multi_exp(pks, coeffs)
+    return pairing_check([(agg_share, G2_GEN), (-base, agg_pk)])

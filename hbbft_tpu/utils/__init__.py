@@ -1,0 +1,1 @@
+"""hbbft_tpu.utils subpackage."""

@@ -1,0 +1,25 @@
+"""Test configuration.
+
+JAX must be forced onto a virtual 8-device CPU mesh *before* it is
+imported anywhere, so multi-chip sharding tests (``tests/test_parallel.py``,
+``__graft_entry__.dryrun_multichip``) can validate pjit/shard_map layouts
+without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import random
+
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return random.Random(0x4242)

@@ -1,0 +1,93 @@
+"""Core L1 runtime tests: Step combinators, FaultLog, NetworkInfo."""
+
+import random
+
+import pytest
+
+from hbbft_tpu import (
+    Fault,
+    FaultKind,
+    FaultLog,
+    NetworkInfo,
+    Step,
+    Target,
+    TargetedMessage,
+)
+
+
+class TestTarget:
+    def test_all_vs_node(self):
+        assert Target.all().is_all
+        assert not Target.to(3).is_all
+        assert Target.to(3) == Target.to(3)
+        assert Target.to(3) != Target.to(4)
+        with pytest.raises(ValueError):
+            Target.to(None)
+
+    def test_message_map(self):
+        tm = Target.to(1).message(("Echo", b"x"))
+        tm2 = tm.map(lambda m: ("Wrapped", m))
+        assert tm2.target == Target.to(1)
+        assert tm2.message == ("Wrapped", ("Echo", b"x"))
+
+
+class TestStep:
+    def test_extend_with_wraps_messages(self):
+        child = Step(output=["out"])
+        child.send_all("inner")
+        child.add_fault(9, FaultKind.INVALID_PROOF)
+        parent: Step = Step()
+        outputs = parent.extend_with(child, lambda m: ("wrap", m))
+        assert outputs == ["out"]
+        assert parent.messages[0].message == ("wrap", "inner")
+        assert len(parent.fault_log) == 1
+
+    def test_extend_merges(self):
+        a = Step(output=[1])
+        b = Step(output=[2])
+        b.send_to(5, "m")
+        a.extend(b)
+        assert a.output == [1, 2]
+        assert len(a.messages) == 1
+
+    def test_is_empty(self):
+        assert Step().is_empty()
+        assert not Step.with_output(1).is_empty()
+        assert not Step.from_fault(1, FaultKind.MULTIPLE_ECHOS).is_empty()
+
+
+class TestFaultLog:
+    def test_merge(self):
+        a = FaultLog.init(1, FaultKind.DUPLICATE_BVAL)
+        b = FaultLog.init(2, FaultKind.DUPLICATE_AUX)
+        a.merge(b)
+        assert len(a) == 2
+        assert {f.node_id for f in a} == {1, 2}
+
+
+class TestNetworkInfo:
+    def test_basic_topology(self):
+        rng = random.Random(1)
+        nis = NetworkInfo.generate_map(range(7), rng, mock=True)
+        ni = nis[3]
+        assert ni.num_nodes == 7
+        assert ni.num_faulty == 2
+        assert ni.num_correct == 5
+        assert ni.node_index(0) == 0 and ni.node_index(6) == 6
+        assert ni.is_validator
+        assert ni.invocation_id() == nis[0].invocation_id()
+
+    def test_observer(self):
+        rng = random.Random(2)
+        nis = NetworkInfo.generate_map(range(4), rng, mock=True)
+        obs = nis[0].observer_view("observer")
+        assert not obs.is_validator
+        assert obs.our_index is None
+        assert obs.num_nodes == 4
+        assert obs.public_key_share(2) is not None
+
+    def test_f_bound_small_networks(self):
+        rng = random.Random(3)
+        for n, f in [(1, 0), (2, 0), (3, 0), (4, 1), (7, 2), (10, 3)]:
+            nis = NetworkInfo.generate_map(range(n), rng, mock=True)
+            assert nis[0].num_faulty == f

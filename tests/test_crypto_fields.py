@@ -1,0 +1,162 @@
+"""Field-tower and pairing unit tests (crypto L0)."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import fields as F
+from hbbft_tpu.crypto.curve import G1, G2, G1_GEN, G2_GEN
+from hbbft_tpu.crypto.pairing import (
+    final_exponentiation,
+    miller_loop,
+    pairing,
+    pairing_check,
+    pairings_equal,
+)
+
+rng = random.Random(7)
+
+
+def rand_fq2():
+    return (rng.randrange(F.P), rng.randrange(F.P))
+
+
+def rand_fq6():
+    return (rand_fq2(), rand_fq2(), rand_fq2())
+
+
+def rand_fq12():
+    return (rand_fq6(), rand_fq6())
+
+
+class TestFieldTower:
+    def test_fq2_inverse(self):
+        for _ in range(10):
+            a = rand_fq2()
+            assert F.fq2_mul(a, F.fq2_inv(a)) == F.FQ2_ONE
+
+    def test_fq2_sqrt(self):
+        for _ in range(10):
+            a = rand_fq2()
+            sq = F.fq2_sq(a)
+            r = F.fq2_sqrt(sq)
+            assert r is not None and F.fq2_sq(r) == sq
+
+    def test_fq2_nonresidue_sqrt_fails_half_the_time(self):
+        found_none = False
+        for _ in range(20):
+            if F.fq2_sqrt(rand_fq2()) is None:
+                found_none = True
+                break
+        assert found_none
+
+    def test_fq6_inverse(self):
+        for _ in range(5):
+            a = rand_fq6()
+            assert F.fq6_mul(a, F.fq6_inv(a)) == F.FQ6_ONE
+
+    def test_fq6_mul_by_v_consistent(self):
+        v = (F.FQ2_ZERO, F.FQ2_ONE, F.FQ2_ZERO)
+        for _ in range(5):
+            a = rand_fq6()
+            assert F.fq6_mul_by_v(a) == F.fq6_mul(a, v)
+
+    def test_fq12_inverse(self):
+        for _ in range(5):
+            a = rand_fq12()
+            assert F.fq12_mul(a, F.fq12_inv(a)) == F.FQ12_ONE
+
+    def test_fq12_frobenius_is_p_power(self):
+        a = rand_fq12()
+        assert F.fq12_frobenius(a) == F.fq12_pow(a, F.P)
+
+    def test_fq12_mul_associative_commutative(self):
+        a, b, c = rand_fq12(), rand_fq12(), rand_fq12()
+        assert F.fq12_mul(a, b) == F.fq12_mul(b, a)
+        assert F.fq12_mul(F.fq12_mul(a, b), c) == F.fq12_mul(
+            a, F.fq12_mul(b, c)
+        )
+
+
+class TestCurve:
+    def test_generator_order(self):
+        assert G1_GEN.in_subgroup()
+        assert G2_GEN.in_subgroup()
+        assert not (G1_GEN * 1).is_infinity()
+
+    def test_group_laws_g1(self):
+        a, b = rng.randrange(F.R), rng.randrange(F.R)
+        assert G1_GEN * a + G1_GEN * b == G1_GEN * ((a + b) % F.R)
+        assert (G1_GEN * a) * b == G1_GEN * (a * b % F.R)
+        assert G1_GEN * a - G1_GEN * a == G1.infinity()
+
+    def test_group_laws_g2(self):
+        a, b = rng.randrange(F.R), rng.randrange(F.R)
+        assert G2_GEN * a + G2_GEN * b == G2_GEN * ((a + b) % F.R)
+        assert G2_GEN * a - G2_GEN * a == G2.infinity()
+
+    def test_serde_roundtrip(self):
+        for k in [1, 2, 12345, F.R - 1]:
+            p = G1_GEN * k
+            assert G1.from_bytes(p.to_bytes()) == p
+            q = G2_GEN * k
+            assert G2.from_bytes(q.to_bytes()) == q
+        assert G1.from_bytes(G1.infinity().to_bytes()).is_infinity()
+        assert G2.from_bytes(G2.infinity().to_bytes()).is_infinity()
+
+    def test_serde_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            G1.from_bytes(b"\x00" * 48)
+        with pytest.raises(ValueError):
+            G1.from_bytes(b"\xff" * 48)
+        with pytest.raises(ValueError):
+            G2.from_bytes(b"\xff" * 96)
+
+    def test_rejects_non_subgroup_point(self):
+        x = 0
+        while True:
+            x += 1
+            y = F.fq_sqrt((x**3 + 4) % F.P)
+            if y is None:
+                continue
+            p = G1.from_affine((x, y))
+            if not p.in_subgroup():
+                with pytest.raises(ValueError):
+                    G1.from_bytes(p.to_bytes())
+                return
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        a, b = 1234567, 7654321
+        assert pairing(G1_GEN * a, G2_GEN * b) == pairing(
+            G1_GEN, G2_GEN * (a * b)
+        )
+        assert pairing(G1_GEN * a, G2_GEN * b) == pairing(
+            G1_GEN * (a * b), G2_GEN
+        )
+
+    def test_non_degenerate(self):
+        assert pairing(G1_GEN, G2_GEN) != F.FQ12_ONE
+
+    def test_infinity_pairs_to_one(self):
+        assert pairing(G1.infinity(), G2_GEN) == F.FQ12_ONE
+        assert pairing(G1_GEN, G2.infinity()) == F.FQ12_ONE
+
+    def test_inverse_relation(self):
+        e = pairing(G1_GEN, G2_GEN)
+        e_neg = pairing(-G1_GEN, G2_GEN)
+        assert F.fq12_mul(e, e_neg) == F.FQ12_ONE
+
+    def test_pairing_check_product(self):
+        a, b = 99, 313
+        assert pairings_equal(G1_GEN * a, G2_GEN * b, G1_GEN * b, G2_GEN * a)
+        assert not pairings_equal(
+            G1_GEN * a, G2_GEN * b, G1_GEN * b, G2_GEN * (a + 1)
+        )
+        assert pairing_check([])
+
+    def test_pairing_value_in_cyclotomic_subgroup(self):
+        e = pairing(G1_GEN * 5, G2_GEN * 9)
+        # order divides r: e^r == 1
+        assert F.fq12_pow(e, F.R) == F.FQ12_ONE

@@ -1,0 +1,241 @@
+"""Threshold crypto, RS, Merkle, mock-equivalence, serialization tests."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.core.serialize import dumps, loads
+from hbbft_tpu.crypto import mock as M
+from hbbft_tpu.crypto import threshold as T
+from hbbft_tpu.crypto.curve import G1, G2_GEN
+from hbbft_tpu.crypto.hashing import hash_to_g1
+from hbbft_tpu.crypto.merkle import MerkleProof, MerkleTree
+from hbbft_tpu.crypto.poly import (
+    BivarPoly,
+    Poly,
+    interpolate_at_zero,
+    lagrange_coefficients_at_zero,
+)
+from hbbft_tpu.crypto.rs import ReedSolomon
+from hbbft_tpu.crypto import fields as F
+
+
+@pytest.fixture(params=["real", "mock"], ids=["bls", "mock"])
+def keyset(request):
+    rng = random.Random(33)
+    if request.param == "real":
+        sks = T.SecretKeySet.random(1, rng)
+    else:
+        sks = M.MockSecretKeySet.random(1, rng)
+    return sks, sks.public_keys(), rng
+
+
+class TestThresholdSignatures:
+    def test_sign_verify_combine_subset_independent(self, keyset):
+        sks, pkset, rng = keyset
+        msg = b"epoch-7-coin"
+        shares = {i: sks.secret_key_share(i).sign(msg) for i in range(4)}
+        for i, s in shares.items():
+            assert pkset.public_key_share(i).verify_signature_share(s, msg)
+            assert not pkset.public_key_share(i).verify_signature_share(
+                s, msg + b"!"
+            )
+        sig_a = pkset.combine_signatures({i: shares[i] for i in (0, 1)})
+        sig_b = pkset.combine_signatures({i: shares[i] for i in (2, 3)})
+        assert sig_a == sig_b
+        assert pkset.verify_signature(sig_a, msg)
+        assert not pkset.verify_signature(sig_a, b"other")
+        assert isinstance(sig_a.parity(), bool)
+
+    def test_combine_requires_threshold(self, keyset):
+        sks, pkset, rng = keyset
+        share = {0: sks.secret_key_share(0).sign(b"m")}
+        with pytest.raises(ValueError):
+            pkset.combine_signatures(share)
+
+    def test_wrong_index_share_rejected(self, keyset):
+        sks, pkset, rng = keyset
+        msg = b"m"
+        s0 = sks.secret_key_share(0).sign(msg)
+        assert not pkset.public_key_share(1).verify_signature_share(s0, msg)
+
+
+class TestThresholdEncryption:
+    def test_roundtrip_and_validity(self, keyset):
+        sks, pkset, rng = keyset
+        pk = pkset.public_key()
+        ct = pk.encrypt(b"contribution", rng)
+        assert ct.verify()
+        shares = {
+            i: sks.secret_key_share(i).decrypt_share_no_verify(ct)
+            for i in range(4)
+        }
+        for i, d in shares.items():
+            assert pkset.public_key_share(i).verify_decryption_share(d, ct)
+        m1 = pkset.combine_decryption_shares(
+            {i: shares[i] for i in (0, 3)}, ct
+        )
+        m2 = pkset.combine_decryption_shares(
+            {i: shares[i] for i in (1, 2)}, ct
+        )
+        assert m1 == m2 == b"contribution"
+
+    def test_tampered_ciphertext_fails_verify(self, keyset):
+        sks, pkset, rng = keyset
+        ct = pkset.public_key().encrypt(b"data", rng)
+        if isinstance(ct, T.Ciphertext):
+            bad = T.Ciphertext(ct.u, ct.v + b"x", ct.c, ct.z)
+        else:
+            bad = M.MockCiphertext(ct.seed_id, ct.nonce, ct.v + b"x", ct.mac)
+        assert not bad.verify()
+
+    def test_faulty_share_detected(self, keyset):
+        sks, pkset, rng = keyset
+        ct = pkset.public_key().encrypt(b"data", rng)
+        good = sks.secret_key_share(0).decrypt_share_no_verify(ct)
+        # share from wrong index presented as index 1
+        assert not pkset.public_key_share(1).verify_decryption_share(good, ct)
+
+
+class TestIndividualKeys:
+    def test_sign_verify(self, keyset):
+        sks, pkset, rng = keyset
+        cls = T.SecretKey if isinstance(sks, T.SecretKeySet) else M.MockSecretKey
+        sk = cls.random(rng)
+        sig = sk.sign(b"vote:Remove(2)")
+        assert sk.public_key().verify(sig, b"vote:Remove(2)")
+        assert not sk.public_key().verify(sig, b"vote:Remove(3)")
+
+    def test_encrypt_decrypt(self, keyset):
+        sks, pkset, rng = keyset
+        cls = T.SecretKey if isinstance(sks, T.SecretKeySet) else M.MockSecretKey
+        sk = cls.random(rng)
+        ct = sk.public_key().encrypt(b"dkg row bytes", rng)
+        assert sk.decrypt(ct) == b"dkg row bytes"
+
+
+class TestBatchVerification:
+    def test_batch_accepts_good_rejects_bad(self):
+        rng = random.Random(5)
+        sks = T.SecretKeySet.random(1, rng)
+        pkset = sks.public_keys()
+        msg = b"batched"
+        h = hash_to_g1(msg)
+        shares = [sks.secret_key_share(i).sign(msg) for i in range(4)]
+        pks = [pkset.public_key_share(i).point for i in range(4)]
+        pts = [s.point for s in shares]
+        assert T.batch_verify_shares(pts, pks, h)
+        bad = list(pts)
+        bad[1] = pts[0]
+        assert not T.batch_verify_shares(bad, pks, h)
+        assert T.batch_verify_shares([], [], h)
+
+
+class TestPolynomials:
+    def test_interpolation_recovers_secret(self):
+        rng = random.Random(9)
+        p = Poly.random(3, rng)
+        pts = [(x, p.evaluate(x)) for x in (1, 5, 7, 9)]
+        assert interpolate_at_zero(pts) == p.coeffs[0]
+
+    def test_lagrange_coefficients_sum_property(self):
+        lams = lagrange_coefficients_at_zero([1, 2, 3])
+        # interpolating the constant-1 polynomial gives 1
+        assert sum(lams) % F.R == 1
+
+    def test_commitment_matches_evaluation(self):
+        rng = random.Random(10)
+        p = Poly.random(2, rng)
+        c = p.commitment()
+        for x in (0, 1, 4):
+            assert c.evaluate(x) == G2_GEN * p.evaluate(x)
+
+    def test_bivar_symmetry_and_rows(self):
+        rng = random.Random(11)
+        bp = BivarPoly.random(2, rng)
+        for (x, y) in [(1, 2), (3, 5), (0, 4)]:
+            assert bp.evaluate(x, y) == bp.evaluate(y, x)
+        row3 = bp.row(3)
+        for y in (0, 1, 2, 6):
+            assert row3.evaluate(y) == bp.evaluate(3, y)
+
+    def test_bivar_commitment_consistency(self):
+        rng = random.Random(12)
+        bp = BivarPoly.random(1, rng)
+        bc = bp.commitment()
+        assert bc.is_symmetric()
+        assert bc.evaluate(2, 3) == G2_GEN * bp.evaluate(2, 3)
+        assert bc.row(2).evaluate(3) == G2_GEN * bp.evaluate(2, 3)
+
+
+class TestReedSolomon:
+    @pytest.mark.parametrize("k,m", [(1, 2), (4, 6), (8, 4), (3, 0)])
+    def test_roundtrip(self, k, m):
+        rng = random.Random(k * 100 + m)
+        rs = ReedSolomon(k, m)
+        data = [bytes(rng.randrange(256) for _ in range(24)) for _ in range(k)]
+        shards = rs.encode(data)
+        assert shards[:k] == data
+        for _ in range(5):
+            erased: list = list(shards)
+            for i in rng.sample(range(k + m), m):
+                erased[i] = None
+            assert rs.reconstruct(erased) == shards
+
+    def test_insufficient_shards(self):
+        rs = ReedSolomon(4, 2)
+        shards = rs.encode([b"aaaa"] * 4)
+        lost = [None, None, None] + list(shards[3:])
+        with pytest.raises(ValueError):
+            rs.reconstruct(lost)
+
+    def test_all_equal_leaves(self):
+        # reference edge case tests/broadcast.rs:141-149
+        rs = ReedSolomon(2, 4)
+        shards = rs.encode([b"\x2a" * 8, b"\x2a" * 8])
+        erased: list = [None, None, None, None] + list(shards[4:])
+        assert rs.reconstruct(erased) == shards
+
+
+class TestMerkle:
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+    def test_proofs_validate(self, n):
+        vals = [bytes([i]) * 7 for i in range(n)]
+        t = MerkleTree(vals)
+        for i in range(n):
+            p = t.proof(i)
+            assert p.validate(n)
+            assert not MerkleProof(
+                p.value + b"z", p.index, p.lemma, p.root_hash
+            ).validate(n)
+
+    def test_duplicate_leaves_distinct(self):
+        # the reference needed an index-byte workaround
+        # (broadcast.rs:371-377); our leaf hash binds the index directly.
+        t = MerkleTree([b"same"] * 4)
+        assert t.proof(0).validate(4) and t.proof(3).validate(4)
+        p0 = t.proof(0)
+        moved = MerkleProof(p0.value, 1, p0.lemma, p0.root_hash)
+        assert not moved.validate(4)
+
+
+class TestSerialization:
+    def test_roundtrip_primitives(self):
+        obj = {
+            "a": [1, -5, 2**200, b"\x00bytes", "str", True, None],
+            "b": (1, 2),
+        }
+        assert loads(dumps(obj)) == obj
+
+    def test_deterministic_dict_order(self):
+        assert dumps({"x": 1, "y": 2}) == dumps({"y": 2, "x": 1})
+
+    def test_crypto_objects_roundtrip(self):
+        rng = random.Random(3)
+        sks = T.SecretKeySet.random(1, rng)
+        pkset = sks.public_keys()
+        ct = pkset.public_key().encrypt(b"m", rng)
+        assert loads(dumps(ct)) == ct
+        sig = sks.secret_key_share(0).sign(b"m")
+        assert loads(dumps(sig)) == sig
+        assert loads(dumps(pkset)) == pkset
