@@ -1,0 +1,316 @@
+"""Adversarial in-process network simulator — the protocol test fixture.
+
+Re-design of the reference's shared test harness
+(``tests/network/mod.rs``): algorithms are sans-IO state machines, so a
+dict of instances plus message queues *is* a network — multi-node
+without a cluster.  The adversary controls scheduling (starvation
+forbidden), sees every message addressed to corrupted nodes, and may
+inject arbitrary forged messages.  An observer node (non-validator)
+exercises the observer code path in every test.
+
+Differences from the reference (deliberate):
+- all randomness flows from one seeded ``random.Random`` — every run is
+  reproducible from its seed (this also matches the determinism
+  requirement for TPU co-simulation bit-identity checks);
+- fault logs are accumulated per node and exposed for assertions.
+"""
+
+from __future__ import annotations
+
+import abc
+import collections
+from typing import Any, Callable, Dict, Generic, List, Optional, Tuple, TypeVar
+
+from ..core.network_info import NetworkInfo
+from ..core.step import Step, Target, TargetedMessage
+
+D = TypeVar("D")
+
+
+class TestNode:
+    """A node running one algorithm instance (reference ``TestNode``,
+    ``tests/network/mod.rs:16-81``)."""
+
+    __test__ = False  # not a pytest class
+
+    def __init__(self, algo, initial_step: Optional[Step] = None):
+        self.id = algo.our_id()
+        self.algo = algo
+        self.queue: collections.deque = collections.deque()
+        self.outputs: List[Any] = []
+        self.messages: collections.deque = collections.deque()
+        self.faults: List[Any] = []
+        if initial_step is not None:
+            self._absorb(initial_step)
+
+    def _absorb(self, step: Step) -> None:
+        self.outputs.extend(step.output)
+        self.messages.extend(step.messages)
+        self.faults.extend(step.fault_log)
+
+    def handle_input(self, value) -> None:
+        self._absorb(self.algo.handle_input(value))
+
+    def handle_message(self) -> None:
+        sender_id, msg = self.queue.popleft()
+        self._absorb(self.algo.handle_message(sender_id, msg))
+
+    @property
+    def is_idle(self) -> bool:
+        return not self.queue
+
+    def terminated(self) -> bool:
+        return self.algo.terminated()
+
+    @property
+    def instance(self):
+        return self.algo
+
+
+class MessageScheduler:
+    """Random / First scheduling strategies (reference ``:84-116``)."""
+
+    RANDOM = "random"
+    FIRST = "first"
+
+    def __init__(self, kind: str, rng):
+        assert kind in (self.RANDOM, self.FIRST)
+        self.kind = kind
+        self.rng = rng
+
+    def pick_node(self, nodes: Dict[Any, TestNode]) -> Any:
+        busy = [nid for nid, node in sorted(nodes.items()) if not node.is_idle]
+        if not busy:
+            raise RuntimeError("no more messages in any queue")
+        if self.kind == self.FIRST:
+            return busy[0]
+        return self.rng.choice(busy)
+
+
+class MessageWithSender:
+    __slots__ = ("sender", "tm")
+
+    def __init__(self, sender, tm: TargetedMessage):
+        self.sender = sender
+        self.tm = tm
+
+
+class Adversary(abc.ABC):
+    """Byzantine adversary API (reference ``tests/network/mod.rs:151-173``).
+
+    Capabilities: (1) decide which node makes progress next (no
+    starvation), (2) observe every message sent to corrupted nodes,
+    (3) emit arbitrary messages originating from corrupted nodes.
+    """
+
+    def init(
+        self,
+        all_nodes: Dict[Any, TestNode],
+        adv_netinfos: Dict[Any, NetworkInfo],
+    ) -> None:
+        pass
+
+    @abc.abstractmethod
+    def pick_node(self, nodes: Dict[Any, TestNode]) -> Any: ...
+
+    @abc.abstractmethod
+    def push_message(self, sender_id, tm: TargetedMessage) -> None: ...
+
+    @abc.abstractmethod
+    def step(self) -> List[MessageWithSender]: ...
+
+
+class SilentAdversary(Adversary):
+    """Corrupted nodes say nothing (reference ``:176-199``)."""
+
+    def __init__(self, scheduler: MessageScheduler):
+        self.scheduler = scheduler
+
+    def pick_node(self, nodes):
+        return self.scheduler.pick_node(nodes)
+
+    def push_message(self, sender_id, tm):
+        pass
+
+    def step(self):
+        return []
+
+
+class RandomAdversary(Adversary):
+    """Replay/injection fuzzer (reference ``:221-344``): unicasts to
+    corrupted nodes are probabilistically re-sent to random recipients,
+    and generator-produced garbage messages are injected."""
+
+    def __init__(
+        self,
+        p_replay: float,
+        p_inject: float,
+        generator: Callable[[], TargetedMessage],
+        rng,
+    ):
+        assert p_inject < 0.95, "injections repeat; p_inject must be < 0.95"
+        self.p_replay = p_replay
+        self.p_inject = p_inject
+        self.generator = generator
+        self.rng = rng
+        self.scheduler = MessageScheduler(MessageScheduler.RANDOM, rng)
+        self.known_node_ids: List[Any] = []
+        self.known_adv_ids: List[Any] = []
+        self.outgoing: List[MessageWithSender] = []
+
+    def init(self, all_nodes, adv_netinfos):
+        self.known_node_ids = sorted(all_nodes)
+        self.known_adv_ids = sorted(adv_netinfos)
+
+    def pick_node(self, nodes):
+        return self.scheduler.pick_node(nodes)
+
+    def push_message(self, sender_id, tm):
+        if not self.known_node_ids:
+            return
+        if self.rng.random() > self.p_replay:
+            return
+        if tm.target.is_all:
+            return
+        # replay to a random (wrong) recipient, originating from the
+        # corrupted original target
+        new_target = self.rng.choice(self.known_node_ids)
+        self.outgoing.append(
+            MessageWithSender(
+                tm.target.node, TargetedMessage(Target.to(new_target), tm.message)
+            )
+        )
+
+    def step(self):
+        out, self.outgoing = self.outgoing, []
+        while self.rng.random() <= self.p_inject:
+            if self.known_adv_ids:
+                sender = self.rng.choice(self.known_adv_ids)
+                out.append(MessageWithSender(sender, self.generator()))
+        return out
+
+
+class TestNetwork:
+    """A network of ``TestNode`` with adversary-controlled scheduling
+    (reference ``tests/network/mod.rs:359-541``).
+
+    ``new_algo(netinfo) -> algo | (algo, Step)`` builds each node's
+    instance; nodes ``0..good_num`` are honest, the next ``adv_num`` are
+    adversarial, and one extra observer node (non-validator) receives
+    every broadcast.
+    """
+
+    __test__ = False  # not a pytest class
+
+    OBSERVER_ID = "observer"
+
+    def __init__(
+        self,
+        good_num: int,
+        adv_num: int,
+        adversary_factory: Callable[[Dict[Any, NetworkInfo]], Adversary],
+        new_algo: Callable[[NetworkInfo], Any],
+        rng,
+        mock_crypto: bool = True,
+        ops: Any = None,
+    ):
+        n = good_num + adv_num
+        netinfos = NetworkInfo.generate_map(
+            list(range(n)), rng, mock=mock_crypto, ops=ops
+        )
+        self.rng = rng
+        self.adv_netinfos = {i: netinfos[i] for i in range(good_num, n)}
+        obs_netinfo = netinfos[0].observer_view(self.OBSERVER_ID)
+
+        def build(ni):
+            result = new_algo(ni)
+            if isinstance(result, tuple):
+                return TestNode(result[0], result[1])
+            return TestNode(result)
+
+        self.nodes: Dict[Any, TestNode] = {
+            i: build(netinfos[i]) for i in range(good_num)
+        }
+        self.observer = build(obs_netinfo)
+        self.adversary = adversary_factory(self.adv_netinfos)
+        self.adversary.init(self.nodes, self.adv_netinfos)
+
+        for mws in self.adversary.step():
+            self.dispatch_messages(mws.sender, [mws.tm])
+        for nid in sorted(self.nodes):
+            node = self.nodes[nid]
+            msgs = list(node.messages)
+            node.messages.clear()
+            self.dispatch_messages(nid, msgs)
+
+    # ------------------------------------------------------------------
+
+    def dispatch_messages(self, sender_id, msgs) -> None:
+        """Route messages to queues; observer drains synchronously
+        (reference ``:447-481``)."""
+        for tm in msgs:
+            if tm.target.is_all:
+                for nid, node in self.nodes.items():
+                    if nid != sender_id:
+                        node.queue.append((sender_id, tm.message))
+                self.observer.queue.append((sender_id, tm.message))
+                self.adversary.push_message(sender_id, tm)
+            else:
+                to_id = tm.target.node
+                if to_id in self.adv_netinfos:
+                    self.adversary.push_message(sender_id, tm)
+                elif to_id in self.nodes:
+                    self.nodes[to_id].queue.append((sender_id, tm.message))
+                elif to_id == self.OBSERVER_ID:
+                    self.observer.queue.append((sender_id, tm.message))
+                # unknown recipients are dropped (reference warns only)
+        while self.observer.queue:
+            self.observer.handle_message()
+            msgs_obs = list(self.observer.messages)
+            self.observer.messages.clear()
+            # observers are not validators; they send nothing, but if an
+            # algorithm misbehaves we surface it rather than hide it
+            assert not msgs_obs, "observer attempted to send messages"
+
+    def step(self) -> Any:
+        """One network iteration: adversary injects, then the adversary
+        picks one non-idle honest node to handle one message
+        (reference ``:490-518``)."""
+        for mws in self.adversary.step():
+            self.dispatch_messages(mws.sender, [mws.tm])
+        nid = self.adversary.pick_node(self.nodes)
+        node = self.nodes[nid]
+        assert not node.is_idle, "adversary illegally picked an idle node"
+        node.handle_message()
+        msgs = list(node.messages)
+        node.messages.clear()
+        self.dispatch_messages(nid, msgs)
+        return nid
+
+    def input(self, nid, value) -> None:
+        node = self.nodes[nid]
+        node.handle_input(value)
+        msgs = list(node.messages)
+        node.messages.clear()
+        self.dispatch_messages(nid, msgs)
+
+    def input_all(self, value) -> None:
+        for nid in sorted(self.nodes):
+            self.input(nid, value)
+
+    # -- helpers for test predicates --------------------------------------
+
+    def any_busy(self) -> bool:
+        return any(not n.is_idle for n in self.nodes.values())
+
+    def step_until(self, predicate, max_steps: int = 1_000_000) -> None:
+        steps = 0
+        while not predicate():
+            if not self.any_busy():
+                raise RuntimeError(
+                    "network went idle before predicate was satisfied"
+                )
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("step limit exceeded")

@@ -1,0 +1,79 @@
+"""Binary Agreement tests (mirrors ``tests/agreement.rs``).
+
+Properties asserted (reference header ``tests/agreement.rs:7-13``):
+- Agreement: all correct nodes output the same value;
+- Termination: every correct node terminates;
+- Validity: if all correct nodes input v, every correct node outputs v.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols.agreement import Agreement, InputNotAccepted
+
+
+def run_agreement(rng, size, inputs, mock=True, scheduler=MessageScheduler.RANDOM):
+    """inputs: per-node bool, or None for random per node."""
+    f = (size - 1) // 3
+    good = size - f
+    net = TestNetwork(
+        good,
+        f,
+        lambda adv: SilentAdversary(MessageScheduler(scheduler, rng)),
+        lambda ni: Agreement(ni, 0, 0),
+        rng,
+        mock_crypto=mock,
+    )
+    for nid in sorted(net.nodes):
+        v = inputs if inputs is not None else bool(rng.randrange(2))
+        net.input(nid, v)
+    net.step_until(
+        lambda: all(n.terminated() for n in net.nodes.values())
+    )
+    outputs = {tuple(n.outputs) for n in net.nodes.values()}
+    assert len(outputs) == 1, f"outputs diverged: {outputs}"
+    (decided,) = outputs
+    assert len(decided) == 1
+    # observer agrees
+    assert net.observer.outputs == list(decided)
+    return decided[0]
+
+
+@pytest.mark.parametrize("inputs", [True, False, None], ids=["true", "false", "random"])
+def test_agreement_sizes_mock(inputs):
+    rng = random.Random(20)
+    for size in (1, 2, 3, 4, 7, 10):
+        decided = run_agreement(rng, size, inputs)
+        if inputs is not None:
+            assert decided == inputs, "validity violated"
+
+
+def test_agreement_first_scheduler():
+    rng = random.Random(21)
+    for size in (4, 7):
+        run_agreement(rng, size, None, scheduler=MessageScheduler.FIRST)
+
+
+def test_agreement_real_bls_small():
+    # real threshold coin path: adversarial random inputs force real
+    # coin flips in epochs ≡ 2 mod 3
+    rng = random.Random(22)
+    for trial in range(3):
+        run_agreement(rng, 4, None, mock=False)
+
+
+def test_agreement_rejects_late_input():
+    rng = random.Random(23)
+    from hbbft_tpu.core.network_info import NetworkInfo
+
+    nis = NetworkInfo.generate_map(range(4), rng, mock=True)
+    ag = Agreement(nis[0], 0, 0)
+    ag.handle_input(True)
+    with pytest.raises(InputNotAccepted):
+        ag.handle_input(False)

@@ -1,0 +1,187 @@
+"""Reliable-broadcast integration tests (mirrors ``tests/broadcast.rs``).
+
+Correctness: every good node and the observer output the proposed value
+exactly once, under silent, proposing-equivocator, and random-fuzz
+adversaries across network sizes with f = (N−1)/3 corrupted nodes.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.harness.network import (
+    Adversary,
+    MessageScheduler,
+    MessageWithSender,
+    RandomAdversary,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.core.network_info import NetworkInfo
+from hbbft_tpu.protocols.broadcast import Broadcast, random_message
+
+
+def new_broadcast(netinfo):
+    return Broadcast(netinfo, 0)
+
+
+class ProposeAdversary(Adversary):
+    """A corrupt node injects a conflicting broadcast mid-protocol
+    (reference ``tests/broadcast.rs:31-91``)."""
+
+    def __init__(self, scheduler, rng):
+        self.scheduler = scheduler
+        self.rng = rng
+        self.has_sent = False
+        self.adv_netinfos = {}
+
+    def init(self, all_nodes, adv_netinfos):
+        self.adv_netinfos = adv_netinfos
+
+    def pick_node(self, nodes):
+        return self.scheduler.pick_node(nodes)
+
+    def push_message(self, sender_id, tm):
+        pass
+
+    def step(self):
+        if self.has_sent or not self.adv_netinfos:
+            return []
+        self.has_sent = True
+        adv_id = sorted(self.adv_netinfos)[0]
+        # the corrupt node runs its own broadcast instance claiming to
+        # propose, and leaks those messages into the network
+        bc = Broadcast(self.adv_netinfos[adv_id], adv_id)
+        step = bc.handle_input(b"Fake news")
+        return [MessageWithSender(adv_id, tm) for tm in step.messages]
+
+
+def run_broadcast(network: TestNetwork, proposed: bytes):
+    network.input(0, proposed)
+    network.step_until(
+        lambda: all(n.terminated() for n in network.nodes.values())
+    )
+    for node in network.nodes.values():
+        assert node.outputs == [proposed], node.id
+    assert network.observer.outputs == [proposed]
+
+
+def sweep_sizes(new_adversary, proposed: bytes, seed: int, sizes=None):
+    rng = random.Random(seed)
+    if sizes is None:
+        sizes = list(range(1, 7)) + [rng.randrange(8, 16)]
+    for size in sizes:
+        f = (size - 1) // 3
+        good = size - f
+        net = TestNetwork(
+            good,
+            f,
+            lambda adv_nis: new_adversary(good, f, rng),
+            new_broadcast,
+            rng,
+            mock_crypto=True,
+        )
+        run_broadcast(net, proposed)
+
+
+def test_broadcast_random_delivery_silent():
+    sweep_sizes(
+        lambda g, f, rng: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        b"Foo",
+        seed=1,
+    )
+
+
+def test_broadcast_first_delivery_silent():
+    sweep_sizes(
+        lambda g, f, rng: SilentAdversary(
+            MessageScheduler(MessageScheduler.FIRST, rng)
+        ),
+        b"Foo",
+        seed=2,
+    )
+
+
+def test_broadcast_random_delivery_adv_propose():
+    sweep_sizes(
+        lambda g, f, rng: ProposeAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng), rng
+        ),
+        b"Foo",
+        seed=3,
+    )
+
+
+def test_broadcast_random_adversary():
+    rng = random.Random(4)
+
+    def gen():
+        from hbbft_tpu.core.step import Target
+
+        msg = random_message(rng)
+        target = (
+            Target.all()
+            if rng.random() < 0.5
+            else Target.to(rng.randrange(4))
+        )
+        return Target.all().message(msg) if target.is_all else target.message(msg)
+
+    sweep_sizes(
+        lambda g, f, rng_: RandomAdversary(0.2, 0.2, gen, rng_),
+        b"RandomFoo",
+        seed=5,
+        sizes=[4, 7],
+    )
+
+
+def test_broadcast_equal_leaves():
+    # 32 spaces -> all shards equal; the index-bound leaf hashes must
+    # still produce valid distinct proofs (reference
+    # ``test_8_broadcast_equal_leaves_silent``).
+    rng = random.Random(6)
+    net = TestNetwork(
+        8,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        new_broadcast,
+        rng,
+    )
+    run_broadcast(net, b" " * 32)
+
+
+def test_broadcast_large_value_medium_network():
+    rng = random.Random(7)
+    net = TestNetwork(
+        9,
+        4,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        new_broadcast,
+        rng,
+    )
+    run_broadcast(net, bytes(rng.randrange(256) for _ in range(10_000)))
+
+
+def test_non_proposer_cannot_input():
+    rng = random.Random(8)
+    nis = NetworkInfo.generate_map(range(4), rng, mock=True)
+    bc = Broadcast(nis[1], 0)
+    with pytest.raises(Exception):
+        bc.handle_input(b"nope")
+
+
+def test_faulty_proof_attributed():
+    rng = random.Random(9)
+    nis = NetworkInfo.generate_map(range(4), rng, mock=True)
+    bc = Broadcast(nis[1], 0)
+    garbage = random_message(rng, 4)
+    step = bc.handle_message(2, garbage)
+    # whatever the message type, node 2 is either ignored or flagged;
+    # flagged faults must name node 2
+    for fault in step.fault_log:
+        assert fault.node_id == 2

@@ -1,0 +1,65 @@
+"""Common-coin tests (mirrors ``tests/common_coin.rs``): every good node
+and the observer get the same value; repeated fresh-nonce flips approach
+a fair distribution."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols.common_coin import CommonCoin
+
+
+def flip(rng, size: int, nonce: bytes, mock: bool, scheduler_kind) -> bool:
+    f = (size - 1) // 3
+    good = size - f
+    net = TestNetwork(
+        good,
+        f,
+        lambda adv: SilentAdversary(MessageScheduler(scheduler_kind, rng)),
+        lambda ni: CommonCoin(ni, nonce),
+        rng,
+        mock_crypto=mock,
+    )
+    net.input_all(None)
+    # the observer wants the coin too (it cannot contribute a share)
+    net.observer.handle_input(None)
+    assert not net.observer.messages
+    net.step_until(
+        lambda: all(n.outputs for n in net.nodes.values())
+    )
+    values = {tuple(n.outputs) for n in net.nodes.values()}
+    assert len(values) == 1, "coin values diverged"
+    (out,) = values
+    assert len(out) == 1
+    # observer cannot sign but must still learn the coin
+    assert net.observer.outputs == list(out)
+    return out[0]
+
+
+@pytest.mark.parametrize("kind", [MessageScheduler.RANDOM, MessageScheduler.FIRST])
+def test_coin_mock_distribution(kind):
+    rng = random.Random(10)
+    results = [
+        flip(rng, 4, b"flip-%d" % i, True, kind) for i in range(64)
+    ]
+    trues = sum(results)
+    # binomial(64, 0.5): P(<16 or >48) < 1e-4
+    assert 16 <= trues <= 48, trues
+
+
+def test_coin_mock_sizes():
+    rng = random.Random(11)
+    for size in (1, 2, 4, 7, 10, 13):
+        flip(rng, size, b"size-%d" % size, True, MessageScheduler.RANDOM)
+
+
+def test_coin_real_bls_consistency():
+    rng = random.Random(12)
+    seen = {flip(rng, 4, b"real-%d" % i, False, MessageScheduler.RANDOM)
+            for i in range(4)}
+    assert seen <= {True, False}

@@ -1,0 +1,89 @@
+"""Asynchronous Common Subset tests (mirrors ``tests/common_subset.rs``):
+the output map is identical at all correct nodes, contains ≥ N−f
+proposals, and every entry matches what its proposer actually input."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols.common_subset import CommonSubset
+
+
+def run_common_subset(rng, size, proposals, mock=True):
+    """proposals: {node_id: bytes} — only these nodes propose."""
+    f = (size - 1) // 3
+    good = size - f
+    net = TestNetwork(
+        good,
+        f,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        lambda ni: CommonSubset(ni, 0),
+        rng,
+        mock_crypto=mock,
+    )
+    for nid, value in sorted(proposals.items()):
+        if nid in net.nodes:
+            net.input(nid, value)
+    net.step_until(
+        lambda: all(n.outputs for n in net.nodes.values())
+    )
+    outs = [n.outputs for n in net.nodes.values()]
+    assert all(len(o) == 1 for o in outs)
+    first = outs[0][0]
+    for o in outs[1:]:
+        assert o[0] == first, "common subsets diverged"
+    assert net.observer.outputs and net.observer.outputs[0] == first
+    # every entry matches the proposer's actual input
+    for pid, value in first.items():
+        assert proposals.get(pid) == value
+    # at least N - f entries
+    assert len(first) >= size - f
+    return first
+
+
+def test_common_subset_all_propose():
+    rng = random.Random(30)
+    for size in (1, 2, 4, 7):
+        proposals = {
+            i: b"value-%d" % i for i in range(size)
+        }
+        run_common_subset(rng, size, proposals)
+
+
+def test_common_subset_3_out_of_4():
+    # reference: tests/common_subset.rs — 3 of 4 nodes propose
+    rng = random.Random(31)
+    result = run_common_subset(
+        rng, 4, {0: b"A", 1: b"B", 2: b"C"}
+    )
+    assert set(result) <= {0, 1, 2}
+    assert len(result) >= 3
+
+
+def test_common_subset_5_distinct_values():
+    rng = random.Random(32)
+    run_common_subset(
+        rng,
+        5,
+        {i: bytes([65 + i]) * (i + 1) for i in range(5)},
+    )
+
+
+def test_common_subset_single_node():
+    rng = random.Random(33)
+    result = run_common_subset(rng, 1, {0: b"solo"})
+    assert result == {0: b"solo"}
+
+
+def test_common_subset_real_bls():
+    rng = random.Random(34)
+    run_common_subset(
+        rng, 4, {i: b"real-%d" % i for i in range(4)}, mock=False
+    )

@@ -1,0 +1,189 @@
+"""HoneyBadger integration tests (mirrors ``tests/honey_badger.rs``).
+
+Key invariant (reference ``verify_output_sequence``, ``:163-186``):
+every correct node (and the observer) outputs the *identical sequence
+of batches*, and all input transactions are eventually committed."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.core.step import Target
+from hbbft_tpu.harness.network import (
+    Adversary,
+    MessageScheduler,
+    MessageWithSender,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    HbDecryptionShare,
+    HoneyBadger,
+    HoneyBadgerMessage,
+)
+
+
+def new_hb(netinfo, seed=0):
+    return HoneyBadger(netinfo, rng=random.Random(f"{netinfo.our_id}-{seed}"))
+
+
+class FaultyShareAdversary(Adversary):
+    """Sends well-formed but wrong decryption shares for every proposer
+    in early epochs (reference ``tests/honey_badger.rs:38-124``)."""
+
+    def __init__(self, scheduler, rng, num_epochs=2):
+        self.scheduler = scheduler
+        self.rng = rng
+        self.num_epochs = num_epochs
+        self.adv_ids = []
+        self.all_ids = []
+        self.sent = False
+
+    def init(self, all_nodes, adv_netinfos):
+        self.adv_ids = sorted(adv_netinfos)
+        self.all_ids = sorted(all_nodes)
+        self.adv_netinfos = adv_netinfos
+
+    def pick_node(self, nodes):
+        return self.scheduler.pick_node(nodes)
+
+    def push_message(self, sender_id, tm):
+        pass
+
+    def step(self):
+        if self.sent or not self.adv_ids:
+            return []
+        self.sent = True
+        out = []
+        for adv_id in self.adv_ids:
+            ni = self.adv_netinfos[adv_id]
+            # craft syntactically valid but cryptographically wrong shares
+            from hbbft_tpu.crypto.mock import MockDecryptionShare
+
+            for epoch in range(self.num_epochs):
+                for proposer in self.all_ids:
+                    bogus = MockDecryptionShare(
+                        self.rng.randrange(2**256).to_bytes(32, "big"),
+                        self.rng.randrange(2**256).to_bytes(32, "big"),
+                    )
+                    msg = HoneyBadgerMessage(
+                        epoch, HbDecryptionShare(proposer, bogus)
+                    )
+                    out.append(
+                        MessageWithSender(adv_id, Target.all().message(msg))
+                    )
+        return out
+
+
+def run_honey_badger(
+    rng,
+    size,
+    txs_per_node=6,
+    batch_contrib=2,
+    adversary_factory=None,
+    mock=True,
+    max_batches=50,
+):
+    f = (size - 1) // 3
+    good = size - f
+    if adversary_factory is None:
+        adversary_factory = lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        )
+    net = TestNetwork(
+        good, f, adversary_factory, lambda ni: new_hb(ni), rng,
+        mock_crypto=mock,
+    )
+    # per-node transaction queues
+    queues = {
+        nid: [b"tx-%d-%d" % (nid, i) for i in range(txs_per_node)]
+        for nid in net.nodes
+    }
+    all_txs = {tx for q in queues.values() for tx in q}
+
+    def committed(node):
+        return {
+            tx for batch in node.outputs for tx in batch.tx_iter()
+        }
+
+    def done():
+        return all(committed(n) >= all_txs for n in net.nodes.values())
+
+    guard = 0
+    while not done():
+        guard += 1
+        assert guard < 100_000, "HoneyBadger failed to commit all txs"
+        # randomly interleave proposing and stepping
+        if rng.random() < 0.1 or not net.any_busy():
+            nid = rng.choice(sorted(net.nodes))
+            node = net.nodes[nid]
+            if not node.instance.has_input():
+                q = queues[nid]
+                contrib = [tx for tx in q if tx not in committed(node)][
+                    :batch_contrib
+                ]
+                node.handle_input(contrib)
+                msgs = list(node.messages)
+                node.messages.clear()
+                net.dispatch_messages(nid, msgs)
+                continue
+        if net.any_busy():
+            net.step()
+
+    # identical batch sequences at all nodes (common prefix)
+    seqs = [
+        [(b.epoch, tuple(sorted((k, tuple(v)) for k, v in b.contributions.items())))
+         for b in n.outputs]
+        for n in net.nodes.values()
+    ]
+    min_len = min(len(s) for s in seqs)
+    assert min_len > 0
+    for s in seqs[1:]:
+        assert s[:min_len] == seqs[0][:min_len], "batch sequences diverged"
+    # observer sees the same sequence prefix
+    obs_seq = [
+        (b.epoch, tuple(sorted((k, tuple(v)) for k, v in b.contributions.items())))
+        for b in net.observer.outputs
+    ]
+    k = min(len(obs_seq), min_len)
+    assert obs_seq[:k] == seqs[0][:k]
+    return net
+
+
+def test_honey_badger_silent_sizes():
+    rng = random.Random(40)
+    for size in (1, 2, 4, 7):
+        run_honey_badger(rng, size, txs_per_node=4)
+
+
+def test_honey_badger_first_scheduler():
+    rng = random.Random(41)
+    run_honey_badger(
+        rng,
+        4,
+        adversary_factory=lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.FIRST, rng)
+        ),
+    )
+
+
+def test_honey_badger_faulty_shares():
+    rng = random.Random(42)
+    net = run_honey_badger(
+        rng,
+        7,
+        adversary_factory=lambda adv: FaultyShareAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng), rng
+        ),
+    )
+    # bogus shares must be attributed to adversarial senders
+    flagged = {
+        f.node_id for n in net.nodes.values() for f in n.faults
+    }
+    assert flagged <= {5, 6}, flagged
+
+
+def test_honey_badger_real_bls():
+    rng = random.Random(43)
+    run_honey_badger(rng, 4, txs_per_node=2, batch_contrib=2, mock=False)
