@@ -1,0 +1,38 @@
+"""Pending-transaction queue with random batch sampling.
+
+Reference: ``src/transaction_queue.rs`` (35 LoC).  ``choose`` samples
+``amount`` transactions uniformly from the first ``batch_size`` queued —
+random disjoint-ish per-node contributions give expected O(1) duplicate
+redundancy across proposers (``queueing_honey_badger.rs:13-23``).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Iterable, List
+
+
+class TransactionQueue:
+    def __init__(self, txs: Iterable = ()):  # FIFO of pending transactions
+        self.queue: Deque = collections.deque(txs)
+
+    def push(self, tx) -> None:
+        self.queue.append(tx)
+
+    def remove_all(self, txs: Iterable) -> None:
+        tx_set = set(txs)
+        self.queue = collections.deque(
+            tx for tx in self.queue if tx not in tx_set
+        )
+
+    def choose(self, amount: int, batch_size: int, rng) -> List:
+        """Random sample of ``amount`` from the first ``batch_size``
+        entries; the queue is unchanged."""
+        limit = min(batch_size, len(self.queue))
+        head = [self.queue[i] for i in range(limit)]
+        if len(head) <= amount:
+            return head
+        return rng.sample(head, amount)
+
+    def __len__(self) -> int:
+        return len(self.queue)

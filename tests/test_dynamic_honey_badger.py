@@ -1,0 +1,179 @@
+"""DynamicHoneyBadger tests (mirrors ``tests/dynamic_honey_badger.rs``):
+a full Remove(0) → Add(0) membership cycle while transactions are being
+committed, with prefix-equality of batch sequences across nodes."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols import change as C
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    ChangeInput,
+    DynamicHoneyBadger,
+    DynamicHoneyBadgerBuilder,
+    UserInput,
+)
+
+
+def batch_key(batch):
+    return (
+        batch.epoch,
+        tuple(
+            sorted(
+                (str(k), tuple(v)) for k, v in batch.contributions.items()
+            )
+        ),
+        repr(batch.change),
+    )
+
+
+def test_dynamic_honey_badger_remove_then_add():
+    rng = random.Random(80)
+    size = 4
+    net = TestNetwork(
+        size,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        lambda ni: DynamicHoneyBadger(
+            ni, rng=random.Random(f"dhb-{ni.our_id}")
+        ),
+        rng,
+        mock_crypto=True,
+    )
+    queues = {
+        nid: [b"tx-%d-%d" % (nid, i) for i in range(4)]
+        for nid in net.nodes
+    }
+    all_txs = {tx for q in queues.values() for tx in q}
+    node0_pk = net.nodes[0].instance.netinfo.public_key(0)
+
+    # Phase 1: everyone votes to remove node 0
+    for nid in sorted(net.nodes):
+        net.input(nid, ChangeInput(C.Remove(0)))
+
+    state = {"removed": False, "added": False}
+
+    def committed(node):
+        return {tx for b in node.outputs for tx in b.tx_iter()}
+
+    def changes_seen(node):
+        return [
+            b.change
+            for b in node.outputs
+            if not isinstance(b.change, C.NoChange)
+        ]
+
+    def done():
+        if not state["added"]:
+            return False
+        return all(committed(n) >= all_txs for n in net.nodes.values())
+
+    guard = 0
+    while not done():
+        guard += 1
+        assert guard < 200_000, (
+            "DHB churn test did not complete; "
+            f"state={state}, outputs={[len(n.outputs) for n in net.nodes.values()]}"
+        )
+        # when the removal completes at every node, vote to add node 0 back
+        if not state["removed"] and all(
+            any(
+                isinstance(ch, C.Complete) and isinstance(ch.change, C.Remove)
+                for ch in changes_seen(n)
+            )
+            for n in net.nodes.values()
+        ):
+            state["removed"] = True
+            for nid in sorted(net.nodes):
+                if net.nodes[nid].instance.netinfo.is_validator:
+                    net.input(nid, ChangeInput(C.Add(0, node0_pk)))
+        if not state["added"] and all(
+            any(
+                isinstance(ch, C.Complete) and isinstance(ch.change, C.Add)
+                for ch in changes_seen(n)
+            )
+            for n in net.nodes.values()
+        ):
+            state["added"] = True
+
+        # propose pending txs on free validators
+        if rng.random() < 0.2 or not net.any_busy():
+            nid = rng.choice(sorted(net.nodes))
+            node = net.nodes[nid]
+            inst = node.instance
+            if inst.netinfo.is_validator and not inst.has_input():
+                remaining = [
+                    tx for tx in queues[nid] if tx not in committed(node)
+                ][:2]
+                node.handle_input(UserInput(remaining))
+                msgs = list(node.messages)
+                node.messages.clear()
+                net.dispatch_messages(nid, msgs)
+                continue
+        if net.any_busy():
+            net.step()
+
+    # prefix equality of batch sequences
+    seqs = [
+        [batch_key(b) for b in n.outputs] for n in net.nodes.values()
+    ]
+    min_len = min(len(s) for s in seqs)
+    for s in seqs[1:]:
+        assert s[:min_len] == seqs[0][:min_len], "batch sequences diverged"
+    # the membership cycle actually happened
+    assert state["removed"] and state["added"]
+
+
+def test_dhb_join_plan_roundtrip():
+    """A change-bearing batch yields a JoinPlan a fresh node can join from."""
+    rng = random.Random(81)
+    builder = DynamicHoneyBadgerBuilder()
+    dhb = builder.build_first_node("solo", mock=True)
+    assert dhb.netinfo.num_nodes == 1
+    step = dhb.handle_input(UserInput([b"t1"]))
+    batches = [o for o in step.output]
+    assert batches and b"t1" in set(batches[0].tx_iter())
+
+
+def test_vote_counter_supersede_and_winner():
+    from hbbft_tpu.core.network_info import NetworkInfo
+    from hbbft_tpu.protocols.votes import VoteCounter
+
+    rng = random.Random(82)
+    nis = NetworkInfo.generate_map(range(4), rng, mock=True)
+    counters = {i: VoteCounter(nis[i], 0) for i in range(4)}
+    # node 0 votes remove(3), then changes its mind to remove(2)
+    sv1 = counters[0].sign_vote_for(C.Remove(3))
+    sv2 = counters[0].sign_vote_for(C.Remove(2))
+    assert sv2.vote.num > sv1.vote.num
+    c = counters[1]
+    assert c.add_pending_vote(0, sv1).is_empty()
+    assert c.add_pending_vote(0, sv2).is_empty()
+    pend = list(c.pending_votes())
+    assert len(pend) == 1 and pend[0].vote.change == C.Remove(2)
+    # commit votes from f+1 = 2 voters for the same change -> winner
+    svx = counters[2].sign_vote_for(C.Remove(2))
+    assert c.add_committed_vote(1, sv2).is_empty()
+    assert c.compute_winner() is None
+    assert c.add_committed_vote(1, svx).is_empty()
+    assert c.compute_winner() == C.Remove(2)
+
+
+def test_vote_counter_rejects_bad_signature():
+    from hbbft_tpu.core.network_info import NetworkInfo
+    from hbbft_tpu.protocols.votes import SignedVote, Vote, VoteCounter
+
+    rng = random.Random(83)
+    nis = NetworkInfo.generate_map(range(4), rng, mock=True)
+    counter = VoteCounter(nis[0], 0)
+    legit = VoteCounter(nis[1], 0).sign_vote_for(C.Remove(3))
+    forged = SignedVote(Vote(C.Remove(2), 0, 5), legit.voter, legit.sig)
+    faults = counter.add_pending_vote(1, forged)
+    assert not faults.is_empty()

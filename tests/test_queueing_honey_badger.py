@@ -1,0 +1,137 @@
+"""QueueingHoneyBadger tests (mirrors ``tests/queueing_honey_badger.rs``):
+the built-in queue drives proposals automatically; a Remove(0)→Add(0)
+churn happens mid-stream with the second half of transactions input only
+after the removal completes."""
+
+import random
+
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols import change as C
+from hbbft_tpu.protocols.dynamic_honey_badger import ChangeInput, DynamicHoneyBadger
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+
+
+def new_qhb(netinfo):
+    rng = random.Random(f"qhb-{netinfo.our_id}")
+    dhb = DynamicHoneyBadger(netinfo, rng=rng)
+    qhb = QueueingHoneyBadger(dhb, batch_size=8, rng=rng)
+    return qhb
+
+
+def test_queueing_honey_badger_txs_and_churn():
+    rng = random.Random(90)
+    size = 4
+    net = TestNetwork(
+        size,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        new_qhb,
+        rng,
+        mock_crypto=True,
+    )
+    first_half = [b"tx-a-%d" % i for i in range(8)]
+    second_half = [b"tx-b-%d" % i for i in range(8)]
+    node0_pk = net.nodes[0].instance.dyn_hb.netinfo.public_key(0)
+
+    # queue the first half everywhere and vote to remove node 0
+    for nid in sorted(net.nodes):
+        for tx in first_half:
+            net.input(nid, tx)
+    for nid in sorted(net.nodes):
+        net.input(nid, ChangeInput(C.Remove(0)))
+
+    def committed(node):
+        return {tx for b in node.outputs for tx in b.tx_iter()}
+
+    def has_complete(node, change_cls):
+        return any(
+            isinstance(b.change, C.Complete)
+            and isinstance(b.change.change, change_cls)
+            for b in node.outputs
+        )
+
+    state = {"removed": False, "added": False}
+    guard = 0
+    while True:
+        guard += 1
+        assert guard < 200_000, f"QHB churn stalled: {state}"
+        if not state["removed"] and all(
+            has_complete(n, C.Remove) for n in net.nodes.values()
+        ):
+            state["removed"] = True
+            # now input the second half and vote node 0 back in
+            for nid in sorted(net.nodes):
+                inst = net.nodes[nid].instance
+                if inst.dyn_hb.netinfo.is_validator:
+                    for tx in second_half:
+                        net.input(nid, tx)
+                    net.input(nid, ChangeInput(C.Add(0, node0_pk)))
+        if not state["added"] and all(
+            has_complete(n, C.Add) for n in net.nodes.values()
+        ):
+            state["added"] = True
+        if state["added"] and all(
+            committed(n) >= set(first_half) | set(second_half)
+            for n in net.nodes.values()
+        ):
+            break
+        if net.any_busy():
+            net.step()
+        else:
+            # kick any idle validator that can propose
+            progressed = False
+            for nid in sorted(net.nodes):
+                node = net.nodes[nid]
+                step = node.instance.propose()
+                if not step.is_empty():
+                    node._absorb(step)
+                    msgs = list(node.messages)
+                    node.messages.clear()
+                    net.dispatch_messages(nid, msgs)
+                    progressed = True
+            assert progressed or net.any_busy(), "network wedged"
+
+    # batch sequences have equal prefixes
+    def key(b):
+        return (
+            b.epoch,
+            tuple(sorted((str(k), tuple(v)) for k, v in b.contributions.items())),
+            repr(b.change),
+        )
+
+    seqs = [[key(b) for b in n.outputs] for n in net.nodes.values()]
+    min_len = min(len(s) for s in seqs)
+    for s in seqs[1:]:
+        assert s[:min_len] == seqs[0][:min_len]
+    assert state["removed"] and state["added"]
+
+
+def test_qhb_builder_and_auto_propose():
+    rng = random.Random(91)
+    net = TestNetwork(
+        4,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        new_qhb,
+        rng,
+        mock_crypto=True,
+    )
+    txs = [b"solo-%d" % i for i in range(4)]
+    for nid in sorted(net.nodes):
+        for tx in txs:
+            net.input(nid, tx)
+    net.step_until(
+        lambda: all(
+            {t for b in n.outputs for t in b.tx_iter()} >= set(txs)
+            for n in net.nodes.values()
+        ),
+        max_steps=100_000,
+    )

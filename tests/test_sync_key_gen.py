@@ -1,0 +1,140 @@
+"""DKG tests (mirrors ``tests/sync_key_gen.rs``): run the dealerless
+key generation fully in memory — handling only t+1 Parts and 2t+1 Acks
+per part — then verify the generated threshold keys actually work
+(sign/combine/verify and encrypt/decrypt round-trips)."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import mock as M
+from hbbft_tpu.crypto import threshold as T
+from hbbft_tpu.protocols.sync_key_gen import Ack, Part, SyncKeyGen
+
+
+def run_dkg(n: int, mock: bool, rng, handle_parts=None):
+    threshold = (n - 1) // 3
+    key_cls = M.MockSecretKey if mock else T.SecretKey
+    sec_keys = {i: key_cls.random(rng) for i in range(n)}
+    pub_keys = {i: sk.public_key() for i, sk in sec_keys.items()}
+    nodes = {
+        i: SyncKeyGen(i, sec_keys[i], pub_keys, threshold, rng)
+        for i in range(n)
+    }
+    # handle only the first `handle_parts` parts (default: t+1 — the
+    # minimum for security), mirroring the reference test
+    k = handle_parts if handle_parts is not None else threshold + 1
+    proposers = list(range(k))
+    acks = []  # (acker, ack)
+    for proposer in proposers:
+        part = nodes[proposer].our_part
+        for i in range(n):
+            ack, faults = nodes[i].handle_part(proposer, part, rng)
+            assert faults.is_empty()
+            if i < 2 * threshold + 1:  # only 2t+1 nodes ack
+                assert ack is not None
+                acks.append((i, ack))
+    for acker, ack in acks:
+        for i in range(n):
+            faults = nodes[i].handle_ack(acker, ack)
+            assert faults.is_empty()
+    for i in range(n):
+        assert nodes[i].is_ready()
+    results = {i: nodes[i].generate() for i in range(n)}
+    return results, nodes
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 7])
+def test_dkg_mock(n):
+    rng = random.Random(50 + n)
+    results, _ = run_dkg(n, True, rng)
+    # everyone derives the same public key set
+    pk_sets = {id(None): None}
+    first_pk = results[0][0]
+    for i, (pk_set, sks) in results.items():
+        assert pk_set == first_pk
+        assert sks is not None
+    # and the keys work
+    shares = {i: results[i][1].sign(b"msg") for i in range(n)}
+    sig = first_pk.combine_signatures(shares)
+    assert first_pk.public_key().verify(sig, b"msg")
+
+
+@pytest.mark.parametrize("n", [1, 4])
+def test_dkg_real_bls(n):
+    rng = random.Random(60 + n)
+    results, _ = run_dkg(n, False, rng)
+    threshold = (n - 1) // 3
+    first_pk = results[0][0]
+    for i, (pk_set, sks) in results.items():
+        assert pk_set.commitment == first_pk.commitment
+        assert pk_set.master_g1 == first_pk.master_g1
+    # threshold signature round trip (reference tests/sync_key_gen.rs:37-81)
+    msg = b"Test message!"
+    shares = {i: results[i][1].sign(msg) for i in range(n)}
+    for i in range(n):
+        assert first_pk.public_key_share(i).verify_signature_share(
+            shares[i], msg
+        ), i
+    sig = first_pk.combine_signatures(
+        {i: shares[i] for i in list(range(n))[: threshold + 1]}
+    )
+    assert first_pk.verify_signature(sig, msg)
+    # threshold encryption round trip against the DKG'd master key
+    ct = first_pk.public_key().encrypt(b"post-dkg secret", rng)
+    assert ct.verify()
+    dec = {
+        i: results[i][1].decrypt_share_no_verify(ct)
+        for i in range(threshold + 1)
+    }
+    for i, d in dec.items():
+        assert first_pk.public_key_share(i).verify_decryption_share(d, ct)
+    assert (
+        first_pk.combine_decryption_shares(dec, ct) == b"post-dkg secret"
+    )
+
+
+def test_dkg_observer_gets_public_keys():
+    rng = random.Random(70)
+    n, threshold = 4, 1
+    sec_keys = {i: T.SecretKey.random(rng) for i in range(n)}
+    pub_keys = {i: sk.public_key() for i, sk in sec_keys.items()}
+    nodes = {
+        i: SyncKeyGen(i, sec_keys[i], pub_keys, threshold, rng)
+        for i in range(n)
+    }
+    obs = SyncKeyGen("observer", T.SecretKey.random(rng), pub_keys, threshold, rng)
+    assert obs.our_part is None
+    acks = []
+    for proposer in range(threshold + 1):
+        part = nodes[proposer].our_part
+        o_ack, faults = obs.handle_part(proposer, part, rng)
+        assert o_ack is None and faults.is_empty()
+        for i in range(n):
+            ack, _ = nodes[i].handle_part(proposer, part, rng)
+            acks.append((i, ack))
+    for acker, ack in acks:
+        obs.handle_ack(acker, ack)
+        for i in range(n):
+            nodes[i].handle_ack(acker, ack)
+    assert obs.is_ready()
+    pk_obs, sks_obs = obs.generate()
+    pk_0, _ = nodes[0].generate()
+    assert sks_obs is None
+    assert pk_obs.commitment == pk_0.commitment
+
+
+def test_dkg_faulty_dealer_detected():
+    rng = random.Random(71)
+    n, threshold = 4, 1
+    sec_keys = {i: T.SecretKey.random(rng) for i in range(n)}
+    pub_keys = {i: sk.public_key() for i, sk in sec_keys.items()}
+    node = SyncKeyGen(1, sec_keys[1], pub_keys, threshold, rng)
+    good = SyncKeyGen(0, sec_keys[0], pub_keys, threshold, rng)
+    part = good.our_part
+    # tamper: swap two encrypted rows so node 1 decrypts the wrong row
+    rows = list(part.rows)
+    rows[1], rows[2] = rows[2], rows[1]
+    bad = Part(part.commit, tuple(rows), part.master_g1)
+    ack, faults = node.handle_part(0, bad, rng)
+    assert ack is None and not faults.is_empty()
