@@ -1,0 +1,320 @@
+"""Virtual-time benchmark simulator.
+
+Re-design of the reference's timed network simulator
+(``examples/simulation.rs``, 451 LoC): an event-driven simulated network
+where each node has a hardware profile ``HwQuality`` (latency, inverse
+bandwidth, CPU factor).  Real wall-clock time spent inside
+``handle_message`` is measured and scaled by the CPU factor
+(``simulation.rs:183-196``); upstream bandwidth adds a serialization
+delay per byte (``:199-223``); the node with the earliest next event
+handles one message per step (``:312-332``).  Per-epoch statistics
+(Epoch, Min/Max time-to-batch, Txs, cumulative Msgs/Node, Size/Node)
+match the reference's output table (``:352-385``).
+
+This is the harness the TPU batched-crypto backend plugs into (SURVEY
+§5.8): the sequential step loop is the reference semantics; the batched
+mode collects every node whose next event is ready and flushes their
+crypto in one device launch per virtual-time round, preserving
+bit-identical outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.network_info import NetworkInfo
+from ..core.serialize import dumps
+from ..core.step import Step
+
+
+@dataclasses.dataclass(frozen=True)
+class HwQuality:
+    """Per-node hardware/network profile (reference ``:107-114``).
+
+    latency: seconds added to every message;
+    inv_bw: seconds per byte of upstream serialization;
+    cpu_factor: percent CPU speed relative to the simulating host
+        (100 = same speed; 50 = twice as slow)."""
+
+    latency: float = 0.1
+    inv_bw: float = 8_000 / (2_000_000)  # 2000 kbit/s in s/byte
+    cpu_factor: float = 100.0
+
+    @classmethod
+    def from_flags(
+        cls, lag_ms: float = 100.0, bw_kbit_s: float = 2000.0, cpu_pct: float = 100.0
+    ) -> "HwQuality":
+        return cls(
+            latency=lag_ms / 1000.0,
+            inv_bw=8.0 / (bw_kbit_s * 1000.0),
+            cpu_factor=cpu_pct,
+        )
+
+
+class SimNode:
+    """A simulated node with its own virtual clock (reference
+    ``TestNode``, ``simulation.rs:117-255``)."""
+
+    def __init__(self, algo, initial_step: Optional[Step], hw: HwQuality, dead: bool = False):
+        self.id = algo.our_id()
+        self.algo = algo
+        self.hw = hw
+        self.dead = dead
+        self.time = 0.0  # simulated CPU clock
+        self.sent_time = 0.0  # last upstream-send completion
+        self.in_queue: List[Tuple[float, int, Any, Any, int]] = []  # heap
+        self._seq = 0
+        self.out_queue: List[Tuple[float, Any, Any, int]] = []
+        self.outputs: List[Tuple[float, Any]] = []
+        self.message_count = 0
+        self.message_size = 0
+        if initial_step is not None and not dead:
+            self._send_output_and_msgs(initial_step, 0.0)
+
+    # -- queue -------------------------------------------------------------
+
+    def add_message(self, arrival: float, sender_id, message, size: int) -> None:
+        if self.dead:
+            return
+        self._seq += 1
+        heapq.heappush(self.in_queue, (arrival, self._seq, sender_id, message, size))
+
+    def next_event_time(self) -> Optional[float]:
+        if self.dead or not self.in_queue:
+            return None
+        return max(self.in_queue[0][0], self.time)
+
+    # -- execution ---------------------------------------------------------
+
+    def handle_message(self) -> None:
+        arrival, _, sender_id, message, size = heapq.heappop(self.in_queue)
+        self.time = max(self.time, arrival)
+        self.message_count += 1
+        self.message_size += size
+        start = _time.perf_counter()
+        step = self.algo.handle_message(sender_id, message)
+        elapsed = _time.perf_counter() - start
+        self.time += elapsed * 100.0 / self.hw.cpu_factor
+        self._send_output_and_msgs(step, self.time)
+
+    def handle_input(self, value) -> None:
+        start = _time.perf_counter()
+        step = self.algo.handle_input(value)
+        elapsed = _time.perf_counter() - start
+        self.time += elapsed * 100.0 / self.hw.cpu_factor
+        self._send_output_and_msgs(step, self.time)
+
+    def _send_output_and_msgs(self, step: Step, now: float) -> None:
+        for out in step.output:
+            self.outputs.append((now, out))
+        self.sent_time = max(self.time, self.sent_time)
+        for tm in step.messages:
+            payload = dumps(tm.message)
+            self.sent_time += self.hw.inv_bw * len(payload)
+            self.out_queue.append(
+                (self.sent_time + self.hw.latency, tm.target, tm.message, len(payload))
+            )
+
+
+class SimNetwork:
+    """The virtual-time network (reference ``TestNetwork``,
+    ``simulation.rs:258-344``)."""
+
+    def __init__(
+        self,
+        num_nodes: int,
+        num_dead: int,
+        new_algo: Callable[[NetworkInfo], Any],
+        hw: HwQuality,
+        rng,
+        mock_crypto: bool = True,
+        ops: Any = None,
+    ):
+        netinfos = NetworkInfo.generate_map(
+            list(range(num_nodes)), rng, mock=mock_crypto, ops=ops
+        )
+        self.rng = rng
+        self.nodes: Dict[Any, SimNode] = {}
+        for nid in range(num_nodes):
+            result = new_algo(netinfos[nid])
+            algo, step = result if isinstance(result, tuple) else (result, None)
+            # the last `num_dead` nodes are crashed from the start
+            dead = nid >= num_nodes - num_dead
+            self.nodes[nid] = SimNode(algo, step, hw, dead=dead)
+        self._drain_out_queues()
+
+    def _drain_out_queues(self) -> None:
+        msgs = []
+        for node in self.nodes.values():
+            for item in node.out_queue:
+                msgs.append((node.id, item))
+            node.out_queue.clear()
+        for sender_id, (arrival, target, message, size) in msgs:
+            self._dispatch(sender_id, arrival, target, message, size)
+
+    def _dispatch(self, sender_id, arrival, target, message, size) -> None:
+        if target.is_all:
+            for nid, node in self.nodes.items():
+                if nid != sender_id:
+                    node.add_message(arrival, sender_id, message, size)
+        else:
+            node = self.nodes.get(target.node)
+            if node is not None:
+                node.add_message(arrival, sender_id, message, size)
+
+    def step(self) -> Optional[Any]:
+        """Advance the node with the earliest next event by one message."""
+        candidates = [
+            (t, nid)
+            for nid, node in self.nodes.items()
+            if (t := node.next_event_time()) is not None
+        ]
+        if not candidates:
+            return None
+        min_time = min(t for t, _ in candidates)
+        min_ids = [nid for t, nid in candidates if t == min_time]
+        next_id = self.rng.choice(sorted(min_ids))
+        node = self.nodes[next_id]
+        node.handle_message()
+        self._drain_out_queues()
+        return next_id
+
+    def input(self, nid, value) -> None:
+        self.nodes[nid].handle_input(value)
+        self._drain_out_queues()
+
+    def message_count(self) -> int:
+        return sum(n.message_count for n in self.nodes.values())
+
+    def message_size(self) -> int:
+        return sum(n.message_size for n in self.nodes.values())
+
+    def live_nodes(self) -> List[SimNode]:
+        return [n for n in self.nodes.values() if not n.dead]
+
+
+@dataclasses.dataclass
+class EpochRow:
+    """One row of the per-epoch statistics table (reference
+    ``EpochInfo::add``, ``simulation.rs:352-385``)."""
+
+    epoch: int
+    min_time: float
+    max_time: float
+    txs: int
+    msgs_per_node: int
+    bytes_per_node: int
+
+
+class EpochStats:
+    def __init__(self, network: SimNetwork):
+        self.network = network
+        self._per_epoch: Dict[int, Dict[Any, Tuple[float, Any]]] = {}
+        self.rows: List[EpochRow] = []
+        self._num_live = len(network.live_nodes())
+
+    def add(self, nid, time: float, batch) -> Optional[EpochRow]:
+        nodes = self._per_epoch.setdefault(batch.epoch, {})
+        if nid in nodes:
+            return None
+        nodes[nid] = (time, batch)
+        if len(nodes) < self._num_live:
+            return None
+        times = [t for t, _ in nodes.values()]
+        txs = len(set(batch.tx_iter()))
+        n = len(self.network.nodes)
+        row = EpochRow(
+            batch.epoch,
+            min(times),
+            max(times),
+            txs,
+            self.network.message_count() // n,
+            self.network.message_size() // n,
+        )
+        self.rows.append(row)
+        return row
+
+    def header(self) -> str:
+        return f"{'Epoch':>5} {'MinTime':>8} {'MaxTime':>8} {'Txs':>5} {'Msgs/Node':>9} {'Size/Node':>10}"
+
+    def format_row(self, row: EpochRow) -> str:
+        return (
+            f"{row.epoch:>5} {row.min_time*1000:>7.0f}ms {row.max_time*1000:>7.0f}ms "
+            f"{row.txs:>5} {row.msgs_per_node:>9} {row.bytes_per_node:>9}B"
+        )
+
+
+def simulate_queueing_honey_badger(
+    num_nodes: int = 10,
+    num_dead: int = 0,
+    num_txs: int = 1000,
+    batch_size: int = 100,
+    tx_size: int = 10,
+    lag_ms: float = 100.0,
+    bw_kbit_s: float = 2000.0,
+    cpu_pct: float = 100.0,
+    rng=None,
+    mock_crypto: bool = True,
+    ops: Any = None,
+    verbose: bool = False,
+    max_steps: int = 10_000_000,
+):
+    """Run the reference's headline benchmark scenario end-to-end:
+    ``num_txs`` transactions through QueueingHoneyBadger on a simulated
+    network.  Returns (EpochStats, wall_seconds, sim_seconds)."""
+    import random as _random
+
+    from ..protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from ..protocols.queueing_honey_badger import QueueingHoneyBadger
+
+    rng = rng if rng is not None else _random.Random(0)
+    txs = [
+        bytes(rng.randrange(256) for _ in range(tx_size))
+        for _ in range(num_txs)
+    ]
+
+    def new_algo(netinfo):
+        node_rng = _random.Random(f"sim-{netinfo.our_id}")
+        dhb = DynamicHoneyBadger(netinfo, rng=node_rng)
+        qhb, step = (
+            QueueingHoneyBadger.builder(dhb)
+            .batch_size(batch_size)
+            .rng(node_rng)
+            .build_with_transactions(list(txs))
+        )
+        return qhb, step
+
+    hw = HwQuality.from_flags(lag_ms, bw_kbit_s, cpu_pct)
+    net = SimNetwork(
+        num_nodes, num_dead, new_algo, hw, rng, mock_crypto=mock_crypto, ops=ops
+    )
+    stats = EpochStats(net)
+    all_txs = set(txs)
+    committed: Dict[Any, set] = {n.id: set() for n in net.live_nodes()}
+    seen_outputs: Dict[Any, int] = {n.id: 0 for n in net.live_nodes()}
+    if verbose:
+        print(stats.header())
+    wall_start = _time.perf_counter()
+    steps = 0
+    while True:
+        nid = net.step()
+        if nid is None:
+            break
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError("simulation step limit exceeded")
+        node = net.nodes[nid]
+        for t, batch in node.outputs[seen_outputs[nid] :]:
+            row = stats.add(nid, t, batch)
+            if row and verbose:
+                print(stats.format_row(row))
+            committed[nid].update(batch.tx_iter())
+        seen_outputs[nid] = len(node.outputs)
+        if all(c >= all_txs for c in committed.values()):
+            break
+    wall = _time.perf_counter() - wall_start
+    sim_time = max((n.time for n in net.live_nodes()), default=0.0)
+    return stats, wall, sim_time

@@ -1,0 +1,59 @@
+"""Virtual-time simulator tests (mirrors ``examples/simulation.rs``
+semantics: virtual clocks, bandwidth serialization delay, epoch table)."""
+
+import random
+
+from hbbft_tpu.harness.simulation import (
+    EpochStats,
+    HwQuality,
+    SimNetwork,
+    simulate_queueing_honey_badger,
+)
+
+
+def test_hw_quality_flags():
+    hw = HwQuality.from_flags(lag_ms=100, bw_kbit_s=2000, cpu_pct=50)
+    assert abs(hw.latency - 0.1) < 1e-9
+    assert abs(hw.inv_bw - 8.0 / 2_000_000) < 1e-12
+    assert hw.cpu_factor == 50
+
+
+def test_simulation_commits_all_txs():
+    stats, wall, sim = simulate_queueing_honey_badger(
+        num_nodes=5,
+        num_txs=40,
+        batch_size=20,
+        rng=random.Random(2),
+    )
+    assert stats.rows, "no epochs completed"
+    assert all(r.min_time <= r.max_time for r in stats.rows)
+    # virtual time advances monotonically across epochs
+    times = [r.max_time for r in stats.rows]
+    assert times == sorted(times)
+    # messages were accounted
+    assert stats.rows[-1].msgs_per_node > 0
+    assert stats.rows[-1].bytes_per_node > 0
+
+
+def test_simulation_with_dead_nodes():
+    # f dead nodes: the remaining N-f must still commit everything
+    stats, wall, sim = simulate_queueing_honey_badger(
+        num_nodes=4,
+        num_dead=1,
+        num_txs=20,
+        batch_size=10,
+        rng=random.Random(3),
+    )
+    assert stats.rows
+
+
+def test_latency_dominates_virtual_time():
+    # with 1s lag and tiny payloads, one epoch takes at least ~2 lags
+    stats, _, sim = simulate_queueing_honey_badger(
+        num_nodes=4,
+        num_txs=4,
+        batch_size=4,
+        lag_ms=1000.0,
+        rng=random.Random(4),
+    )
+    assert sim >= 2.0
