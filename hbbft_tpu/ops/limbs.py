@@ -1,0 +1,285 @@
+"""Fixed-prime big-integer limb arithmetic as JAX kernels.
+
+The device-side equivalent of the base-field layer of the reference's
+``pairing`` crate (``Cargo.toml:22``) — the foundation every batched
+BLS12-381 kernel builds on (share verify/combine MSMs of
+``common_coin.rs:142-207`` and ``honey_badger.rs:422-444``).
+
+Representation (chosen for the TPU's int32 vector lanes):
+
+- an element is a vector of ``L = 38`` limbs of ``LIMB_BITS = 11`` bits,
+  little-endian, stored in ``int32`` — 418 bits of capacity, 37 bits of
+  headroom above the 381-bit prime;
+- limbs are kept *redundant*: the invariant is ``limb < 2^12`` (one
+  slack bit), so a 38-term schoolbook convolution sum is bounded by
+  ``38·(2^12)^2 < 2^29.3 < 2^31`` — no multiplication or accumulation
+  ever overflows int32, and no double-width accumulator is needed
+  (TPUs have no 64-bit integer datapath);
+- values are *lazily reduced*: a limb vector represents a value
+  ``< 2^408`` merely congruent to the canonical residue mod p.
+  Reduction folds every limb at index ≥ B = 37 back via a precomputed
+  ``2^(11·(B+i)) mod p`` table (a tiny matmul).  The fold boundary sits
+  26 bits above p, so one (parallel-carry, fold) round already lands
+  any product back under ``2^408``, and the topmost limb of the stored
+  38-limb form is provably zero — fully branchless, scan-free,
+  batch-friendly reduction with no data-dependent control flow.
+- ``canon()`` produces the unique canonical form (for equality tests
+  and host export) via a fixed conditional-subtraction ladder; it is
+  off the hot path.
+
+Everything is shape-polymorphic over leading batch dimensions: all ops
+take ``[..., L]`` int32 arrays and broadcast, so ``vmap`` is never
+required (but composes fine).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 11
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def int_to_limbs(x: int, nlimbs: int) -> np.ndarray:
+    """Host-side: python int → little-endian limb vector."""
+    if x < 0:
+        raise ValueError("negative value")
+    out = np.zeros(nlimbs, dtype=np.int32)
+    for i in range(nlimbs):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value does not fit in limbs")
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host-side: limb vector → python int (limbs may be unnormalised)."""
+    arr = np.asarray(limbs)
+    acc = 0
+    for i in range(arr.shape[-1] - 1, -1, -1):
+        acc = (acc << LIMB_BITS) + int(arr[..., i])
+    return acc
+
+
+def _carry_round(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry round: [..., W] → [..., W+1].
+
+    Works on negative limbs too (arithmetic right shift = floor
+    division), as needed transiently inside subtraction.
+    """
+    lo = jnp.bitwise_and(x, LIMB_MASK)
+    hi = jnp.right_shift(x, LIMB_BITS)
+    zpad = jnp.zeros(x.shape[:-1] + (1,), dtype=x.dtype)
+    return jnp.concatenate([lo, zpad], axis=-1) + jnp.concatenate(
+        [zpad, hi], axis=-1
+    )
+
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray, L: int) -> jnp.ndarray:
+    """Schoolbook polynomial product [..., L]×[..., L] → [..., 2L−1]
+    as L statically shifted multiply-adds (XLA fuses the stack+sum)."""
+    rows = a[..., :, None] * b[..., None, :]  # [..., L, L] ≤ 2^24 each
+    shifted = [
+        jnp.pad(rows[..., i, :], [(0, 0)] * (rows.ndim - 2) + [(i, L - 1 - i)])
+        for i in range(L)
+    ]
+    return sum(shifted)
+
+
+class ModField:
+    """Limb-vector arithmetic mod a fixed prime ``p``.
+
+    One instance per prime (``fq()`` for the BLS12-381 base field); all
+    methods are pure jnp functions suitable for use inside ``jit``.
+    """
+
+    def __init__(self, p: int, nlimbs: int):
+        self.p = p
+        self.L = L = nlimbs
+        self.B = B = nlimbs - 1  # fold boundary: stored value < 2^(11·B+1)
+        self.bits = LIMB_BITS * nlimbs
+        if p.bit_length() > LIMB_BITS * B - 24:
+            raise ValueError("need ≥24 bits of headroom above p for lazy fold")
+
+        # fold[i] = limbs of 2^(11·(B+i)) mod p — reduces limb B+i.
+        # Sized for the widest intermediate (2L−1 product + carry limbs).
+        nfold = L + 5
+        self.fold = jnp.asarray(
+            np.stack(
+                [
+                    int_to_limbs(pow(2, LIMB_BITS * (B + i), p), B)
+                    for i in range(nfold)
+                ]
+            )
+        )  # [nfold, B]
+        # Subtraction pad: smallest multiple of p ≥ 2^(11·B+2), covering
+        # any invariant-respecting minuend; a + pad − b is non-negative.
+        pad = ((1 << (LIMB_BITS * B + 2)) // p + 1) * p
+        self.sub_pad = jnp.asarray(int_to_limbs(pad, L + 1))
+        # canon(): conditional subtraction of (2^k)·p, largest k first.
+        ks: List[int] = []
+        k = 1
+        while k * p < (1 << (self.bits + 2)):
+            ks.append(k)
+            k <<= 1
+        self.canon_steps = jnp.asarray(
+            np.stack([int_to_limbs(k * p, L + 1) for k in reversed(ks)])
+        )  # [n_steps, L+1]
+        self.zero = jnp.zeros(L, dtype=jnp.int32)
+        self.one = jnp.asarray(int_to_limbs(1, L))
+
+    # -- host conversion ---------------------------------------------------
+
+    def to_limbs(self, x: int) -> np.ndarray:
+        return int_to_limbs(x % self.p, self.L)
+
+    def to_limbs_batch(self, xs: Sequence[int]) -> np.ndarray:
+        return np.stack([self.to_limbs(x) for x in xs]) if len(xs) else np.zeros(
+            (0, self.L), dtype=np.int32
+        )
+
+    def from_limbs(self, limbs) -> int:
+        return limbs_to_int(limbs) % self.p
+
+    # -- normalisation -----------------------------------------------------
+
+    def _fold_high(self, x: jnp.ndarray) -> jnp.ndarray:
+        """[..., W] (W > B, limbs < 2^12) → [..., B]: fold every limb at
+        index ≥ B back via its 2^(11·(B+i)) mod p table row."""
+        W = x.shape[-1]
+        high = x[..., self.B :]
+        folded = jnp.einsum(
+            "...h,hl->...l",
+            high,
+            self.fold[: W - self.B],
+            preferred_element_type=jnp.int32,
+        )
+        return x[..., : self.B] + folded
+
+    def normalize(self, wide: jnp.ndarray, rounds: int = 2) -> jnp.ndarray:
+        """[..., W] limbs (W ≥ B, any int32 magnitudes, non-negative
+        value) → [..., L] limbs < 2^12 each, value < 2^408.
+
+        Each round: two parallel carry passes (limbs → < 2^12 + ε) then
+        a fold of every limb ≥ B = L−1.  The low part is ≤ 1.02·2^407
+        and the fold adds ≤ (#high)·2^12·p < 2^399, so a single round
+        already lands under 2^408; the second is safety margin.  The
+        final two carry passes then provably cannot ripple past limb
+        L−1 (a limb at index L would imply value ≥ 2^418), making the
+        closing slice exact.
+        """
+        x = wide
+        for _ in range(rounds):
+            x = _carry_round(_carry_round(x))
+            if x.shape[-1] > self.B:
+                x = self._fold_high(x)
+        x = _carry_round(_carry_round(x))
+        return x[..., : self.L]
+
+    # -- ring ops ----------------------------------------------------------
+
+    def add(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return self.normalize(a + b)
+
+    def sub(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        a, b = jnp.broadcast_arrays(a, b)
+        zpad = jnp.zeros(a.shape[:-1] + (1,), dtype=jnp.int32)
+        wide = (
+            jnp.concatenate([a, zpad], axis=-1)
+            + self.sub_pad
+            - jnp.concatenate([b, zpad], axis=-1)
+        )
+        return self.normalize(wide)
+
+    def neg(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.sub(jnp.broadcast_to(self.zero, a.shape), a)
+
+    def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        a, b = jnp.broadcast_arrays(a, b)
+        return self.normalize(_conv(a, b, self.L))
+
+    def sq(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.mul(a, a)
+
+    def mul_small(self, a: jnp.ndarray, k: int) -> jnp.ndarray:
+        """Multiply by a small non-negative int (k·2^12 must stay well
+        inside int32, i.e. k ≤ ~2^17)."""
+        return self.normalize(a * k)
+
+    # -- canonical form (off the hot path) ---------------------------------
+
+    def canon(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Unique canonical residue in [0, p): conditional-subtraction
+        ladder over (2^k)·p, largest first.  Needs exact borrow
+        propagation, done with a ``lax.scan`` along the limb axis."""
+        zpad = jnp.zeros(a.shape[:-1] + (1,), dtype=jnp.int32)
+        x = jnp.concatenate([a, zpad], axis=-1)  # [..., L+1]
+
+        def cond_sub(x, kp):
+            diff = jnp.moveaxis(x - kp, -1, 0)
+
+            def step(borrow, d):
+                t = d + borrow
+                return t >> LIMB_BITS, t & LIMB_MASK
+
+            borrow, limbs = jax.lax.scan(
+                step, jnp.zeros_like(diff[0]), diff
+            )
+            limbs = jnp.moveaxis(limbs, 0, -1)
+            keep = (borrow < 0)[..., None]  # underflow → keep x
+            return jnp.where(keep, x, limbs), None
+
+        # First make limbs exact (the ladder compares bit patterns).
+        x = jnp.moveaxis(x, -1, 0)
+
+        def carry_step(c, xi):
+            t = xi + c
+            return t >> LIMB_BITS, t & LIMB_MASK
+
+        _, xex = jax.lax.scan(carry_step, jnp.zeros_like(x[0]), x)
+        x = jnp.moveaxis(xex, 0, -1)
+        x, _ = jax.lax.scan(cond_sub, x, self.canon_steps)
+        return x[..., : self.L]
+
+    def eq(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        """Batched equality mod p → bool[...]."""
+        return jnp.all(self.canon(a) == self.canon(b), axis=-1)
+
+    def is_zero(self, a: jnp.ndarray) -> jnp.ndarray:
+        return jnp.all(self.canon(a) == 0, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# The BLS12-381 base field instance
+# ---------------------------------------------------------------------------
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+FQ_LIMBS = 38  # 418-bit capacity for the 381-bit field (37 bits headroom)
+
+
+@functools.lru_cache(maxsize=None)
+def fq() -> ModField:
+    return ModField(P, FQ_LIMBS)
+
+
+def scalar_to_bits(k: int, nbits: int = 255) -> np.ndarray:
+    """Host-side: scalar (reduced mod r) → msb-first bit vector for the
+    fixed-length double-and-add scan (protocol scalars live in Fr)."""
+    k %= R
+    return np.asarray(
+        [(k >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=np.int32
+    )
+
+
+def scalars_to_bits(ks: Sequence[int], nbits: int = 255) -> np.ndarray:
+    if not len(ks):
+        return np.zeros((0, nbits), dtype=np.int32)
+    return np.stack([scalar_to_bits(k, nbits) for k in ks])

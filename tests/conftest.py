@@ -8,16 +8,24 @@ without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import random
+# Some environments inject a TPU plugin via sitecustomize that calls
+# ``jax.config.update("jax_platforms", ...)`` — which silently outranks
+# the env var.  Re-assert CPU *after* importing jax so the virtual
+# 8-device CPU mesh is what tests actually run on.
+import jax  # noqa: E402
 
-import pytest
+jax.config.update("jax_platforms", "cpu")
+
+import random  # noqa: E402
+
+import pytest  # noqa: E402
 
 
 @pytest.fixture
