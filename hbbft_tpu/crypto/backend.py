@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-from .curve import G1, G2
+from .curve import G1, G2, g1_multi_exp, g2_multi_exp
 from .hashing import sha256
 from .merkle import MerkleProof, MerkleTree
 from .rs import ReedSolomon
@@ -43,6 +43,14 @@ class CpuBackend:
 
     def rs_codec(self, data_shards: int, parity_shards: int) -> ReedSolomon:
         return ReedSolomon(data_shards, parity_shards)
+
+    # -- group MSMs -------------------------------------------------------
+
+    def g1_msm(self, points: Sequence[G1], scalars: Sequence[int]) -> G1:
+        return g1_multi_exp(points, scalars)
+
+    def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
+        return g2_multi_exp(points, scalars)
 
     # -- batched share verification --------------------------------------
 

@@ -1,1 +1,14 @@
-"""hbbft_tpu.ops subpackage."""
+"""Device kernels: batched crypto math as JAX/TPU programs.
+
+Modules
+-------
+- ``limbs``       — 381-bit modular arithmetic on int32 limb vectors
+- ``ec_jax``      — complete-formula G1/G2 point ops, scalar mul, MSM
+- ``sha256_jax``  — batched SHA-256 + level-parallel Merkle builds
+- ``gf256_jax``   — bit-sliced GF(2^8) matmuls, Reed-Solomon codec
+- ``backend_tpu`` — the ``CryptoBackend`` implementation wiring these
+  into the protocol stack (``NetworkInfo.ops``)
+
+Import of heavy deps is lazy at module granularity: importing
+``hbbft_tpu`` never pulls in jax; importing ``hbbft_tpu.ops.*`` does.
+"""

@@ -1,0 +1,116 @@
+"""The TPU ops backend — drop-in for ``crypto.backend.CpuBackend``.
+
+Implements the ``CryptoBackend`` seam (SURVEY §7 architecture stance)
+with batched device kernels:
+
+- SHA-256 / Merkle levels  → ``ops/sha256_jax.py`` (uniform batches);
+- Reed-Solomon coding      → ``ops/gf256_jax.py`` (bit-sliced matmul);
+- share-verification MSMs  → ``ops/ec_jax.py`` (complete-formula EC);
+- Lagrange combining MSMs  → same EC kernels.
+
+Only the two final pairings of a batch verification stay host-side
+(they are O(1) per *batch*, not per share — the random-linear-
+combination trick of ``crypto.threshold.batch_verify_shares``).
+
+Everything returns bit-identical results to the CPU backend; the
+protocols cannot tell which backend they run on (asserted in
+``tests/test_backend_tpu.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..crypto.backend import CpuBackend
+from ..crypto.curve import G1, G2, G1_GEN, G2_GEN
+from ..crypto.hashing import sha256
+from ..crypto.merkle import MerkleTree
+from ..crypto.pairing import pairing_check
+from ..crypto import threshold as T
+from . import ec_jax, gf256_jax, sha256_jax
+
+# Below this many leaves/shards the device round-trip costs more than
+# the host hash; stay on CPU (same results either way).
+_MIN_DEVICE_BATCH = 8
+
+
+class _DeviceMerkleTree(MerkleTree):
+    """MerkleTree whose levels were hashed on device (same layout)."""
+
+    def __init__(self, values: List[bytes], levels: List[List[bytes]]):
+        self.values = list(values)
+        self.levels = levels
+
+
+class TpuBackend(CpuBackend):
+    """Batched JAX/TPU ops backend (bit-identical to ``CpuBackend``)."""
+
+    name = "tpu"
+
+    # -- hashing / merkle -------------------------------------------------
+
+    def sha256_many(self, items: Sequence[bytes]) -> List[bytes]:
+        items = list(items)
+        if (
+            len(items) >= _MIN_DEVICE_BATCH
+            and len({len(i) for i in items}) == 1
+        ):
+            return sha256_jax.sha256_many(items)
+        return [sha256(b) for b in items]
+
+    def merkle_tree(self, values: List[bytes]) -> MerkleTree:
+        vals = list(values)
+        if len(vals) < _MIN_DEVICE_BATCH or len({len(v) for v in vals}) != 1:
+            return MerkleTree(vals)
+        levels = sha256_jax.merkle_levels_device(vals)
+        return _DeviceMerkleTree(vals, levels)
+
+    # -- erasure coding ---------------------------------------------------
+
+    def rs_codec(self, data_shards: int, parity_shards: int):
+        if parity_shards == 0:
+            return super().rs_codec(data_shards, parity_shards)
+        return gf256_jax.ReedSolomonDevice(data_shards, parity_shards)
+
+    # -- group MSMs --------------------------------------------------------
+
+    def g1_msm(self, points: Sequence[G1], scalars: Sequence[int]) -> G1:
+        return ec_jax.g1_msm(list(points), list(scalars))
+
+    def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
+        return ec_jax.g2_msm(list(points), list(scalars))
+
+    # -- batched share verification ---------------------------------------
+
+    def batch_verify_shares(
+        self,
+        shares: Sequence[G1],
+        pks: Sequence[G2],
+        base: G1,
+        context: bytes = b"",
+    ) -> bool:
+        """Identical math to ``threshold.batch_verify_shares`` with the
+        two MSMs on device: e(Σrᵢ·σᵢ, P₂)·e(−base, Σrᵢ·pkᵢ) == 1."""
+        shares = list(shares)
+        pks = list(pks)
+        if not shares:
+            return True
+        coeffs = T._rlc_coeffs(
+            context,
+            [s.to_bytes() for s in shares] + [p.to_bytes() for p in pks],
+        )[: len(shares)]  # one rᵢ per (shareᵢ, pkᵢ) pair, as on CPU
+        agg_share = self.g1_msm(shares, coeffs)
+        agg_pk = self.g2_msm(pks, coeffs)
+        return pairing_check([(agg_share, G2_GEN), (-base, agg_pk)])
+
+
+_DEFAULT_TPU = None
+
+
+def tpu_backend() -> TpuBackend:
+    global _DEFAULT_TPU
+    if _DEFAULT_TPU is None:
+        _DEFAULT_TPU = TpuBackend()
+    return _DEFAULT_TPU

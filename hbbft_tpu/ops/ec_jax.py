@@ -174,8 +174,16 @@ class PointKernel:
         — the complete formulas make every iteration branch-free.
         """
         bits_t = jnp.moveaxis(bits, -1, 0)  # [nbits, ...]
-        # trailing point axes: 1 (X/Y/Z) + el_ndim (field element axes)
-        acc0 = self.identity(p.shape[: -(1 + self.f.el_ndim)] or bits.shape[:-1])
+        # Initial accumulator = identity, built *from the inputs* (p·0 +
+        # bits·0) so it inherits their batch shape and — under
+        # shard_map — their device-varying axes (a plain constant would
+        # fail lax.scan's carry typing inside a sharded region).
+        extra = 1 + self.f.el_ndim  # X/Y/Z axis + field element axes
+        bz = (jnp.sum(bits, axis=-1) * 0).reshape(
+            bits.shape[:-1] + (1,) * extra
+        )
+        pt = jnp.stack([self.f.zero(), self.f.one(), self.f.zero()])
+        acc0 = p * 0 + bz + pt
 
         def step(acc, b):
             acc = self.add(acc, acc)

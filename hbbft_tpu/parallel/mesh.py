@@ -1,0 +1,160 @@
+"""Device-mesh sharding for the batched crypto plane.
+
+The reference scales by running N independent per-proposer instances
+(SURVEY §2.5: `common_subset.rs:126-154`) and its only "backend" is the
+`Target` abstraction (§2.6) — delivery is the host's job.  The TPU
+framework keeps that: the *protocol plane* stays host-side, while the
+*crypto plane* (share MSMs, RS, hashing — the per-epoch N² work) is a
+tensor program that shards over a ``jax.sharding.Mesh``:
+
+- the **validator/share axis** is the data-parallel axis: each device
+  scalar-multiplies its slice of the share batch (``shard_map``);
+- the per-device partial sums meet in an ``all_gather`` over ICI and a
+  replicated log-tree of complete adds — the consensus-domain analogue
+  of a gradient all-reduce (point addition is the reduction op, which
+  XLA's ``psum`` cannot express — hence gather + tree);
+- hash/RS batches shard the same axis with no cross-device traffic.
+
+The same functions run on 1 device (mesh collapses), 8 virtual CPU
+devices (tests, ``xla_force_host_platform_device_count``), or a real
+multi-chip TPU slice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax ≥ 0.6 exports shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+
+def shard_map(fn=None, **kw):
+    """shard_map with the replication check off: our out-replication
+    comes from `all_gather` + identical per-device reduction, which the
+    static varying-axis analysis cannot prove."""
+    for flag in ("check_vma", "check_rep"):
+        try:
+            if fn is None:
+                return _shard_map(**kw, **{flag: False})
+            return _shard_map(fn, **kw, **{flag: False})
+        except TypeError:
+            continue
+    return _shard_map(fn, **kw) if fn is not None else _shard_map(**kw)
+
+from ..ops import ec_jax, limbs as LB
+
+AXIS = "shards"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D device mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(set --xla_force_host_platform_device_count for CPU tests)"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (AXIS,))
+
+
+def _pad_to_multiple(
+    pts: jnp.ndarray, bits: jnp.ndarray, n: int, kernel
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pad the share axis with identity points / zero scalars so it
+    splits evenly across the mesh (identity is absorbing — complete
+    formulas make the padding free of special cases)."""
+    k = pts.shape[0]
+    rem = (-k) % n
+    if rem:
+        pad_pts = kernel.identity((rem,))
+        pts = jnp.concatenate([pts, pad_pts.astype(pts.dtype)], axis=0)
+        bits = jnp.concatenate(
+            [bits, jnp.zeros((rem, bits.shape[1]), dtype=bits.dtype)], axis=0
+        )
+    return pts, bits
+
+
+def sharded_msm_fn(mesh: Mesh, g2: bool = False):
+    """Build the sharded MSM: shares sharded over the mesh, partial
+    sums all-gathered over ICI, replicated tree reduction."""
+    kernel = ec_jax.g2_kernel() if g2 else ec_jax.g1_kernel()
+    el = (2, LB.fq().L) if g2 else (LB.fq().L,)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=P(),
+    )
+    def _sharded(pts, bits):
+        local = kernel.tree_sum(kernel.scalar_mul(pts, bits))  # [3, *el]
+        partials = jax.lax.all_gather(local, AXIS)  # [n_dev, 3, *el]
+        return kernel.tree_sum(partials)
+
+    def run(pts: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
+        n = mesh.devices.size
+        pts, bits = _pad_to_multiple(pts, bits, n, kernel)
+        return jax.jit(_sharded)(pts, bits)
+
+    return run
+
+
+def sharded_epoch_crypto_fn(mesh: Mesh):
+    """The framework's 'training step': one epoch's batched crypto,
+    sharded over the validator axis — the program the driver dry-runs
+    multi-chip and the simulator flushes per round.
+
+    Inputs (pre-padded to multiples of the mesh size):
+      share_pts  [k, 3, L]    G1 signature/decryption shares
+      share_bits [k, nbits]   RLC coefficients (bit-decomposed)
+      pk_pts     [k, 3, 2, L] G2 public key shares
+      digests_in [k, 16]      one SHA-256 block per validator lane
+
+    Returns (agg_share [3, L], agg_pk [3, 2, L], digests [k, 8]):
+    the two MSM aggregates of the batched verification equation
+    e(Σrᵢσᵢ, P₂)·e(−H, Σrᵢpkᵢ) and the batch of digests.
+    """
+    g1k = ec_jax.g1_kernel()
+    g2k = ec_jax.g2_kernel()
+    from ..ops import sha256_jax
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(), P(), P(AXIS)),
+    )
+    def _step(share_pts, share_bits, pk_pts, digests_in):
+        g1_local = g1k.tree_sum(g1k.scalar_mul(share_pts, share_bits))
+        g2_local = g2k.tree_sum(g2k.scalar_mul(pk_pts, share_bits))
+        agg1 = g1k.tree_sum(jax.lax.all_gather(g1_local, AXIS))
+        agg2 = g2k.tree_sum(jax.lax.all_gather(g2_local, AXIS))
+        digests = sha256_jax.sha256_device(digests_in[:, None, :])
+        return agg1, agg2, digests
+
+    return jax.jit(_step)
+
+
+def sharded_g1_msm(
+    points: Sequence, scalars: Sequence[int], mesh: Optional[Mesh] = None
+):
+    """Host-facing sharded MSM over hbbft_tpu G1 points."""
+    if not points:
+        from ..crypto.curve import G1
+
+        return G1.infinity()
+    mesh = mesh or make_mesh()
+    run = sharded_msm_fn(mesh)
+    pts = jnp.asarray(ec_jax.g1_to_limbs(list(points)))
+    bits = jnp.asarray(LB.scalars_to_bits(list(scalars)))
+    return ec_jax.g1_from_limbs(run(pts, bits))
