@@ -34,6 +34,10 @@ class CpuBackend:
     # -- hashing / merkle -------------------------------------------------
 
     def sha256_many(self, items: Sequence[bytes]) -> List[bytes]:
+        from .. import native as _native
+
+        if _native.available():
+            return _native.sha256_many(list(items))
         return [sha256(b) for b in items]
 
     def merkle_tree(self, values: List[bytes]) -> MerkleTree:

@@ -78,14 +78,23 @@ def _tree_depth(n_leaves: int) -> int:
 
 
 class MerkleTree:
-    """Breadth-first SHA-256 Merkle tree over a list of byte values."""
+    """Breadth-first SHA-256 Merkle tree over a list of byte values.
+
+    Uses the C++ native builder (``native/hbbft_native.cpp``) when the
+    shared library is available; the pure-Python path below is the
+    fallback and the semantics oracle."""
 
     def __init__(self, values: List[bytes]):
         if not values:
             raise ValueError("empty Merkle tree")
         self.values = list(values)
+        from .. import native as _native
+
+        if _native.available():
+            self.levels: List[List[bytes]] = _native.merkle_levels(values)
+            return
         level = [leaf_hash(i, v) for i, v in enumerate(values)]
-        self.levels: List[List[bytes]] = [level]
+        self.levels = [level]
         while len(level) > 1:
             if len(level) & 1:
                 level = level + [level[-1]]

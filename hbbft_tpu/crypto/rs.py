@@ -94,6 +94,24 @@ def _gf_mat_inv(m: np.ndarray) -> np.ndarray:
     return aug[:, n:]
 
 
+def _matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF(2^8) matmul via the C++ native library when loaded, else the
+    vectorised NumPy path (the semantics oracle)."""
+    from .. import native as _native
+
+    if _native.available():
+        return _native.gf_matmul(a, b)
+    return gf_matmul(a, b)
+
+
+def _mat_inv(m: np.ndarray) -> np.ndarray:
+    from .. import native as _native
+
+    if _native.available():
+        return _native.gf_mat_inv(m)
+    return _gf_mat_inv(m)
+
+
 _MATRIX_CACHE: dict = {}
 
 
@@ -148,7 +166,7 @@ class ReedSolomon:
         arr = np.frombuffer(b"".join(data), dtype=np.uint8).reshape(
             self.k, -1
         )
-        parity = gf_matmul(self.matrix[self.k :], arr)
+        parity = _matmul(self.matrix[self.k :], arr)
         return list(data) + [p.tobytes() for p in parity]
 
     def reconstruct(self, shards: List[Optional[bytes]]) -> List[bytes]:
@@ -163,12 +181,12 @@ class ReedSolomon:
             return [s for s in shards]  # type: ignore[misc]
         use = present[: self.k]
         sub = self.matrix[use, :]
-        dec = _gf_mat_inv(sub.copy())
+        dec = _mat_inv(sub.copy())
         avail = np.stack(
             [np.frombuffer(shards[i], dtype=np.uint8) for i in use]
         )
-        data = gf_matmul(dec, avail)
-        full = gf_matmul(self.matrix, data)
+        data = _matmul(dec, avail)
+        full = _matmul(self.matrix, data)
         out: List[bytes] = []
         for i in range(self.n):
             out.append(

@@ -1,0 +1,159 @@
+"""ctypes loader for the C++ native host library (``native/``).
+
+The reference's compute-heavy host work lives in native crates
+(``ring`` SHA-256, ``merkle``, ``reed-solomon-erasure`` —
+SURVEY.md §2.4); ours lives in ``native/hbbft_native.cpp`` built as
+``libhbbft_native.so``.  This module loads it lazily (building it with
+``make`` on first use if a compiler is present) and exposes typed
+wrappers.  Every caller must tolerate :data:`lib` being ``None`` and
+fall back to the pure-Python path — CI environments without a
+toolchain still work, just slower.
+
+Set ``HBBFT_TPU_NO_NATIVE=1`` to force the pure-Python path; the flag
+is consulted on every :func:`available` call, so tests may toggle it
+with ``monkeypatch.setenv`` to cross-check both implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "libhbbft_native.so"
+
+lib: Optional[ctypes.CDLL] = None
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("HBBFT_TPU_NO_NATIVE"):
+        return None
+    if (_NATIVE_DIR / "Makefile").exists():
+        # Run make unconditionally (no-op when up to date) so edits to
+        # the .cpp are never shadowed by a stale .so.  An fcntl lock
+        # serialises concurrent builders (pytest-xdist workers); the
+        # Makefile writes via a temp file + rename so a reader never
+        # maps a half-written library.
+        try:
+            import fcntl
+
+            with open(_NATIVE_DIR / ".build.lock", "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+        except Exception:
+            pass
+    if not _SO_PATH.exists():
+        return None
+    try:
+        cdll = ctypes.CDLL(str(_SO_PATH))
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    cdll.hb_sha256_many.argtypes = [u8p, u64p, ctypes.c_uint64, u8p]
+    cdll.hb_sha256_many.restype = None
+    cdll.hb_merkle_total_hashes.argtypes = [ctypes.c_uint64]
+    cdll.hb_merkle_total_hashes.restype = ctypes.c_uint64
+    cdll.hb_merkle_build.argtypes = [u8p, u64p, ctypes.c_uint64, u8p]
+    cdll.hb_merkle_build.restype = None
+    cdll.hb_gf_matmul.argtypes = [
+        u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    cdll.hb_gf_matmul.restype = None
+    cdll.hb_gf_mat_inv.argtypes = [u8p, u8p, ctypes.c_int]
+    cdll.hb_gf_mat_inv.restype = ctypes.c_int
+    return cdll
+
+
+lib = _try_load()
+
+
+def available() -> bool:
+    return lib is not None and not os.environ.get("HBBFT_TPU_NO_NATIVE")
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _as_u64p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _concat_with_offsets(items: Sequence[bytes]):
+    offsets = np.zeros(len(items) + 1, dtype=np.uint64)
+    total = 0
+    for i, it in enumerate(items):
+        total += len(it)
+        offsets[i + 1] = total
+    data = np.frombuffer(b"".join(items), dtype=np.uint8) if total else np.zeros(1, dtype=np.uint8)
+    return np.ascontiguousarray(data), offsets
+
+
+def sha256_many(items: Sequence[bytes]) -> List[bytes]:
+    """Batched SHA-256 (native).  Caller guarantees lib is loaded."""
+    data, offsets = _concat_with_offsets(items)
+    out = np.empty(32 * len(items), dtype=np.uint8)
+    lib.hb_sha256_many(
+        _as_u8p(data), _as_u64p(offsets), len(items), _as_u8p(out)
+    )
+    raw = out.tobytes()
+    return [raw[32 * i : 32 * i + 32] for i in range(len(items))]
+
+
+def merkle_levels(values: Sequence[bytes]) -> List[List[bytes]]:
+    """Build every level of the Merkle tree natively; returns the same
+    ``levels`` structure as :class:`hbbft_tpu.crypto.merkle.MerkleTree`
+    (bottom level first, odd levels already duplicated)."""
+    n = len(values)
+    data, offsets = _concat_with_offsets(values)
+    total = int(lib.hb_merkle_total_hashes(n))
+    out = np.empty(32 * total, dtype=np.uint8)
+    lib.hb_merkle_build(_as_u8p(data), _as_u64p(offsets), n, _as_u8p(out))
+    raw = out.tobytes()
+    levels: List[List[bytes]] = []
+    pos = 0
+    length = n
+    while True:
+        if length > 1 and (length & 1):
+            length += 1
+        levels.append(
+            [raw[32 * (pos + i) : 32 * (pos + i + 1)] for i in range(length)]
+        )
+        pos += length
+        if length <= 1:
+            break
+        length //= 2
+    return levels
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(a, dtype=np.uint8)
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"shape mismatch: ({m},{k}) @ ({k2},{n})")
+    out = np.empty((m, n), dtype=np.uint8)
+    lib.hb_gf_matmul(_as_u8p(a), _as_u8p(b), _as_u8p(out), m, k, n)
+    return out
+
+
+def gf_mat_inv(m: np.ndarray) -> np.ndarray:
+    m = np.ascontiguousarray(m, dtype=np.uint8)
+    n = m.shape[0]
+    out = np.empty((n, n), dtype=np.uint8)
+    rc = lib.hb_gf_mat_inv(_as_u8p(m), _as_u8p(out), n)
+    if rc != 0:
+        raise ValueError("matrix not invertible over GF(256)")
+    return out

@@ -58,7 +58,7 @@ class TpuBackend(CpuBackend):
             and len({len(i) for i in items}) == 1
         ):
             return sha256_jax.sha256_many(items)
-        return [sha256(b) for b in items]
+        return super().sha256_many(items)
 
     def merkle_tree(self, values: List[bytes]) -> MerkleTree:
         vals = list(values)

@@ -1,0 +1,415 @@
+// hbbft_tpu native host library.
+//
+// Host-side fast paths for the three native dependencies of the
+// reference (SURVEY.md §2.4): `ring` SHA-256 (broadcast.rs:161),
+// the `merkle` crate (broadcast.rs:381-392), and
+// `reed-solomon-erasure` (broadcast.rs:365, :643-656).  The TPU
+// kernels in hbbft_tpu/ops/ are the device path; this library is the
+// native host path used by the CPU reference backend so the
+// correctness oracle itself runs at native speed.
+//
+// Exposed as a plain C ABI consumed via ctypes
+// (hbbft_tpu/native/__init__.py).  Semantics are bit-identical to the
+// pure-Python implementations in hbbft_tpu/crypto/{hashing,merkle,rs}.py
+// — the bit-identity tests in tests/test_native.py enforce this.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(__x86_64__)
+#include <cpuid.h>
+#include <immintrin.h>
+#endif
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+#if defined(__x86_64__)
+// SHA-NI one-block-at-a-time compression (x86 SHA extensions).  This
+// is what makes the native Merkle/hash path beat OpenSSL-backed
+// hashlib: same hardware instructions, no per-call Python overhead.
+__attribute__((target("sha,sse4.1,ssse3"))) void sha256_compress_shani(
+    uint32_t s[8], const uint8_t* data, size_t nblk) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i TMP = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&s[0]));
+  __m128i STATE1 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&s[4]));
+  TMP = _mm_shuffle_epi32(TMP, 0xB1);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);
+  __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);
+
+  while (nblk--) {
+    __m128i ABEF_SAVE = STATE0, CDGH_SAVE = STATE1;
+    __m128i MSG, MSG0, MSG1, MSG2, MSG3;
+
+    MSG0 = _mm_shuffle_epi8(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(data + 0)), MASK);
+    MSG = _mm_add_epi32(
+        MSG0, _mm_set_epi64x(0xE9B5DBA5B5C0FBCFULL, 0x71374491428A2F98ULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+    MSG1 = _mm_shuffle_epi8(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(data + 16)), MASK);
+    MSG = _mm_add_epi32(
+        MSG1, _mm_set_epi64x(0xAB1C5ED5923F82A4ULL, 0x59F111F13956C25BULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+
+    MSG2 = _mm_shuffle_epi8(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(data + 32)), MASK);
+    MSG = _mm_add_epi32(
+        MSG2, _mm_set_epi64x(0x550C7DC3243185BEULL, 0x12835B01D807AA98ULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+
+    MSG3 = _mm_shuffle_epi8(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(data + 48)), MASK);
+    MSG = _mm_add_epi32(
+        MSG3, _mm_set_epi64x(0xC19BF1749BDC06A7ULL, 0x80DEB1FE72BE5D74ULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    TMP = _mm_alignr_epi8(MSG3, MSG2, 4);
+    MSG0 = _mm_add_epi32(MSG0, TMP);
+    MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+    MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+
+    for (int i = 16; i < 64; i += 4) {
+      MSG = _mm_add_epi32(MSG0,
+                          _mm_set_epi32(int(K[i + 3]), int(K[i + 2]),
+                                        int(K[i + 1]), int(K[i])));
+      STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+      MSG = _mm_shuffle_epi32(MSG, 0x0E);
+      STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+      TMP = _mm_alignr_epi8(MSG0, MSG3, 4);
+      MSG1 = _mm_add_epi32(MSG1, TMP);
+      MSG1 = _mm_sha256msg2_epu32(MSG1, MSG0);
+      MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+      __m128i rot = MSG0;
+      MSG0 = MSG1;
+      MSG1 = MSG2;
+      MSG2 = MSG3;
+      MSG3 = rot;
+    }
+
+    STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+    STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+    data += 64;
+  }
+
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&s[0]), STATE0);
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&s[4]), STATE1);
+}
+
+bool have_shani() {
+  static const bool ok = [] {
+    unsigned eax = 7, ebx, ecx = 0, edx;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    return (ebx & (1u << 29)) != 0;  // SHA bit
+  }();
+  return ok;
+}
+#else
+bool have_shani() { return false; }
+#endif
+
+struct Sha256 {
+  uint32_t h[8];
+  uint8_t buf[64];
+  uint64_t total;
+  size_t fill;
+
+  Sha256() { reset(); }
+
+  void reset() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(h));
+    total = 0;
+    fill = 0;
+  }
+
+  void compress(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g;
+      g = f;
+      f = e;
+      e = d + t1;
+      d = c;
+      c = b;
+      b = a;
+      a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    if (fill) {
+      size_t take = 64 - fill;
+      if (take > len) take = len;
+      std::memcpy(buf + fill, data, take);
+      fill += take;
+      data += take;
+      len -= take;
+      if (fill == 64) {
+        compress_n(buf, 1);
+        fill = 0;
+      }
+    }
+    if (len >= 64) {
+      size_t nblk = len / 64;
+      compress_n(data, nblk);
+      data += nblk * 64;
+      len -= nblk * 64;
+    }
+    if (len) {
+      std::memcpy(buf, data, len);
+      fill = len;
+    }
+  }
+
+  void compress_n(const uint8_t* data, size_t nblk) {
+#if defined(__x86_64__)
+    if (have_shani()) {
+      sha256_compress_shani(h, data, nblk);
+      return;
+    }
+#endif
+    for (size_t i = 0; i < nblk; ++i) compress(data + 64 * i);
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (fill != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void sha256_one(const uint8_t* data, size_t len, uint8_t out[32]) {
+  Sha256 s;
+  s.update(data, len);
+  s.final(out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Hash `count` messages stored concatenated in `data`; message i spans
+// [offsets[i], offsets[i+1]).  Writes 32*count bytes to `out`.
+void hb_sha256_many(const uint8_t* data, const uint64_t* offsets,
+                    uint64_t count, uint8_t* out) {
+  for (uint64_t i = 0; i < count; ++i) {
+    sha256_one(data + offsets[i], size_t(offsets[i + 1] - offsets[i]),
+               out + 32 * i);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Merkle tree (matches hbbft_tpu/crypto/merkle.py exactly):
+//   leaf  = SHA256(0x00 || index_be64 || value)
+//   node  = SHA256(0x01 || left || right)
+//   odd levels duplicate the trailing hash before pairing.
+// ---------------------------------------------------------------------------
+
+// Total number of 32-byte hashes across all levels, including
+// duplicated trailing hashes (so Python can pre-allocate and split).
+uint64_t hb_merkle_total_hashes(uint64_t n) {
+  uint64_t total = 0;
+  uint64_t len = n;
+  for (;;) {
+    if (len > 1 && (len & 1)) len += 1;
+    total += len;
+    if (len <= 1) break;
+    len /= 2;
+  }
+  return total;
+}
+
+// Build the full tree.  Leaves are concatenated in `data` with
+// `offsets` (n+1 entries).  `out` receives every level's hashes
+// back-to-back, bottom level (after odd-duplication) first.
+void hb_merkle_build(const uint8_t* data, const uint64_t* offsets,
+                     uint64_t n, uint8_t* out) {
+  uint8_t* level = out;
+  // leaf level
+  for (uint64_t i = 0; i < n; ++i) {
+    Sha256 s;
+    uint8_t prefix[9];
+    prefix[0] = 0x00;
+    for (int b = 0; b < 8; ++b) prefix[1 + b] = uint8_t(i >> (56 - 8 * b));
+    s.update(prefix, 9);
+    s.update(data + offsets[i], size_t(offsets[i + 1] - offsets[i]));
+    s.final(level + 32 * i);
+  }
+  uint64_t len = n;
+  for (;;) {
+    if (len > 1 && (len & 1)) {
+      std::memcpy(level + 32 * len, level + 32 * (len - 1), 32);
+      len += 1;
+    }
+    if (len <= 1) break;
+    uint8_t* next = level + 32 * len;
+    for (uint64_t i = 0; i < len; i += 2) {
+      Sha256 s;
+      uint8_t prefix = 0x01;
+      s.update(&prefix, 1);
+      s.update(level + 32 * i, 64);
+      s.final(next + 16 * i);  // 32 * (i/2)
+    }
+    level = next;
+    len /= 2;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) arithmetic + systematic Reed-Solomon
+// (matches hbbft_tpu/crypto/rs.py: primitive polynomial 0x11d).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint8_t GF_EXP[512];
+int32_t GF_LOG[256];
+uint8_t GF_MUL[256][256];
+
+struct GfInit {
+  GfInit() {
+    int x = 1;
+    for (int i = 0; i < 255; ++i) {
+      GF_EXP[i] = uint8_t(x);
+      GF_LOG[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= 0x11d;
+    }
+    for (int i = 255; i < 512; ++i) GF_EXP[i] = GF_EXP[i - 255];
+    GF_LOG[0] = 0;
+    for (int a = 0; a < 256; ++a)
+      for (int b = 0; b < 256; ++b)
+        GF_MUL[a][b] = (a && b) ? GF_EXP[GF_LOG[a] + GF_LOG[b]] : 0;
+  }
+} gf_init_once;
+
+inline uint8_t gf_mul(uint8_t a, uint8_t b) { return GF_MUL[a][b]; }
+
+inline uint8_t gf_inv(uint8_t a) { return GF_EXP[255 - GF_LOG[a]]; }
+
+// out[r] ^= c * in[r]  over a row of `len` bytes — the RS inner loop.
+inline void gf_mul_xor_row(uint8_t* out, const uint8_t* in, uint8_t c,
+                           uint64_t len) {
+  const uint8_t* mul = GF_MUL[c];
+  for (uint64_t i = 0; i < len; ++i) out[i] ^= mul[in[i]];
+}
+
+}  // namespace
+
+// C = A(m×k) · B(k×n) over GF(2^8).
+void hb_gf_matmul(const uint8_t* a, const uint8_t* b, uint8_t* c, int m,
+                  int k, int n) {
+  std::memset(c, 0, size_t(m) * n);
+  for (int i = 0; i < m; ++i)
+    for (int j = 0; j < k; ++j) {
+      uint8_t aij = a[i * k + j];
+      if (aij) gf_mul_xor_row(c + size_t(i) * n, b + size_t(j) * n, aij, n);
+    }
+}
+
+// Gauss-Jordan inverse over GF(2^8).  Returns 0 on success, -1 if
+// singular.  `m` is n×n row-major; `out` receives the inverse.
+int hb_gf_mat_inv(const uint8_t* m, uint8_t* out, int n) {
+  std::vector<uint8_t> aug(size_t(n) * 2 * n, 0);
+  for (int i = 0; i < n; ++i) {
+    std::memcpy(&aug[size_t(i) * 2 * n], m + size_t(i) * n, n);
+    aug[size_t(i) * 2 * n + n + i] = 1;
+  }
+  int w = 2 * n;
+  for (int col = 0; col < n; ++col) {
+    int pivot = -1;
+    for (int row = col; row < n; ++row)
+      if (aug[size_t(row) * w + col]) {
+        pivot = row;
+        break;
+      }
+    if (pivot < 0) return -1;
+    if (pivot != col)
+      for (int j = 0; j < w; ++j)
+        std::swap(aug[size_t(col) * w + j], aug[size_t(pivot) * w + j]);
+    uint8_t inv_p = gf_inv(aug[size_t(col) * w + col]);
+    for (int j = 0; j < w; ++j)
+      aug[size_t(col) * w + j] = gf_mul(aug[size_t(col) * w + j], inv_p);
+    for (int row = 0; row < n; ++row) {
+      if (row == col) continue;
+      uint8_t factor = aug[size_t(row) * w + col];
+      if (!factor) continue;
+      const uint8_t* mul = GF_MUL[factor];
+      for (int j = 0; j < w; ++j)
+        aug[size_t(row) * w + j] ^= mul[aug[size_t(col) * w + j]];
+    }
+  }
+  for (int i = 0; i < n; ++i)
+    std::memcpy(out + size_t(i) * n, &aug[size_t(i) * w + n], n);
+  return 0;
+}
+
+}  // extern "C"
