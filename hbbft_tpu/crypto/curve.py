@@ -18,6 +18,17 @@ from typing import Any, Callable, Tuple
 from . import fields as F
 from ..core.serialize import wire
 
+
+def _native():
+    """The C++ BLS backend (native/bls12_381.cpp) or None.
+
+    Dispatch happens at call sites — not in the op tables — so the
+    pure-Python oracle below stays importable and testable with
+    ``HBBFT_TPU_NO_NATIVE=1``."""
+    from .. import native as NT
+
+    return NT.backend()
+
 # ---------------------------------------------------------------------------
 # Generic Jacobian arithmetic over a field given by its op table
 # ---------------------------------------------------------------------------
@@ -208,6 +219,9 @@ class _Point:
         return type(self)(self.ops["neg"](self.jac))
 
     def __mul__(self, k: int):
+        nt = _native()
+        if nt is not None:
+            return self._native_mul(nt, int(k) % F.R)
         return type(self)(self.ops["mul"](self.jac, k))
 
     __rmul__ = __mul__
@@ -241,6 +255,9 @@ class _Point:
     def in_subgroup(self) -> bool:
         # Unreduced multiply-by-r (mul_scalar reduces mod r and would be
         # vacuous here).
+        nt = _native()
+        if nt is not None:
+            return self._native_mul_raw(nt, F.R).is_infinity()
         return self.ops["is_inf"](self.ops["mul_raw"](self.jac, F.R))
 
     def __repr__(self) -> str:
@@ -267,6 +284,11 @@ class G1(_Point):
             return True
         # Y² = X³ + 4·Z⁶
         return (Y * Y - (X**3 + B1 * pow(Zc, 6, F.P))) % F.P == 0
+
+    def _native_mul(self, nt, k: int) -> "G1":
+        return nt.g1_unwire(nt.g1_mul(nt.g1_wire(self), k), G1)
+
+    _native_mul_raw = _native_mul
 
     def to_bytes(self) -> bytes:
         aff = self.affine()
@@ -320,6 +342,11 @@ class G2(_Point):
         rhs = F.fq2_add(F.fq2_mul(F.fq2_sq(X), X), F.fq2_mul(B2, z6))
         return F.fq2_sq(Y) == rhs
 
+    def _native_mul(self, nt, k: int) -> "G2":
+        return nt.g2_unwire(nt.g2_mul(nt.g2_wire(self), k), G2)
+
+    _native_mul_raw = _native_mul
+
     def to_bytes(self) -> bytes:
         aff = self.affine()
         if aff is None:
@@ -364,7 +391,16 @@ G2_GEN = G2.from_affine((_G2_X, _G2_Y))
 
 
 def g1_multi_exp(points, scalars) -> G1:
-    """Σ kᵢ·Pᵢ — naive host-side MSM (the TPU path lives in ops/g1_jax.py)."""
+    """Σ kᵢ·Pᵢ — Pippenger on the native host path when available,
+    naive double-and-add otherwise (the TPU path lives in ops/ec_jax.py)."""
+    points = list(points)
+    scalars = list(scalars)
+    nt = _native()
+    if nt is not None and points:
+        return nt.g1_unwire(
+            nt.g1_msm([nt.g1_wire(p) for p in points], [int(k) % F.R for k in scalars]),
+            G1,
+        )
     acc = G1.infinity()
     for p, k in zip(points, scalars):
         acc = acc + p * k
@@ -372,6 +408,14 @@ def g1_multi_exp(points, scalars) -> G1:
 
 
 def g2_multi_exp(points, scalars) -> G2:
+    points = list(points)
+    scalars = list(scalars)
+    nt = _native()
+    if nt is not None and points:
+        return nt.g2_unwire(
+            nt.g2_msm([nt.g2_wire(p) for p in points], [int(k) % F.R for k in scalars]),
+            G2,
+        )
     acc = G2.infinity()
     for p, k in zip(points, scalars):
         acc = acc + p * k

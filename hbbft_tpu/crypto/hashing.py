@@ -42,6 +42,11 @@ def hash_to_fr(data: bytes) -> int:
 def hash_to_g1(msg: bytes, dst: bytes = DST_SIG) -> G1:
     """Deterministic hash onto the G1 subgroup (try-and-increment +
     cofactor clearing).  Expected 2 iterations; bounded at 256."""
+    from .. import native as NT
+
+    nt = NT.backend()
+    if nt is not None:
+        return nt.g1_unwire(nt.hash_to_g1_bytes(msg, dst), G1)
     for ctr in range(256):
         x = hash_to_fq(dst + len(dst).to_bytes(1, "big") + msg + bytes([ctr]))
         y = F.fq_sqrt((x * x % F.P * x + 4) % F.P)

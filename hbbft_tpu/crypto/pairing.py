@@ -182,8 +182,29 @@ def final_exponentiation(f: F.Fq12) -> F.Fq12:
 # ---------------------------------------------------------------------------
 
 
+def _native():
+    from .. import native as NT
+
+    return NT.backend()
+
+
+def _fq12_from_bytes(raw: bytes) -> F.Fq12:
+    v = [int.from_bytes(raw[i * 48 : (i + 1) * 48], "big") for i in range(12)]
+    return (
+        ((v[0], v[1]), (v[2], v[3]), (v[4], v[5])),
+        ((v[6], v[7]), (v[8], v[9]), (v[10], v[11])),
+    )
+
+
 def pairing(p: G1, q: G2) -> F.Fq12:
-    """e(P, Q)³ — bilinear, non-degenerate; canonical for equality checks."""
+    """e(P, Q)³ — bilinear, non-degenerate; canonical for equality checks.
+
+    The native path returns byte-identical Fq12 values (its projective
+    Miller-loop lines differ from the affine ones here only by Fq2*
+    factors, which the final exponentiation kills)."""
+    nt = _native()
+    if nt is not None:
+        return _fq12_from_bytes(nt.pairing_bytes(nt.g1_wire(p), nt.g2_wire(q)))
     return final_exponentiation(miller_loop(p, q))
 
 
@@ -194,6 +215,12 @@ def pairing_check(pairs: Iterable[Tuple[G1, G2]]) -> bool:
     this is what makes batched (random-linear-combination) share
     verification cheap on the host side.
     """
+    pairs = list(pairs)
+    nt = _native()
+    if nt is not None:
+        return nt.pairing_check(
+            [nt.g1_wire(p) for p, _ in pairs], [nt.g2_wire(q) for _, q in pairs]
+        )
     acc = FQ12_ONE
     for p, q in pairs:
         acc = fq12_mul(acc, miller_loop(p, q))

@@ -106,10 +106,22 @@ class Commitment:
         return len(self.coeffs) - 1
 
     def evaluate(self, x: int) -> G2:
-        acc = G2.infinity()
-        for c in reversed(self.coeffs):
-            acc = acc * x + c
-        return acc
+        from .curve import _native
+
+        if _native() is None:
+            # Horner keeps scalars small on the pure-Python path
+            acc = G2.infinity()
+            for c in reversed(self.coeffs):
+                acc = acc * x + c
+            return acc
+        # One MSM over [1, x, x², …] beats Horner's per-step scalar mul
+        # (a single native Pippenger call vs degree+1 full G2 muls).
+        x = x % R
+        powers, acc = [], 1
+        for _ in self.coeffs:
+            powers.append(acc)
+            acc = acc * x % R
+        return g2_multi_exp(self.coeffs, powers)
 
     def __add__(self, other: "Commitment") -> "Commitment":
         n = max(len(self.coeffs), len(other.coeffs))
@@ -193,23 +205,54 @@ class BivarCommitment:
         return len(self.coeffs) - 1
 
     def evaluate(self, x: int, y: int) -> G2:
-        acc = G2.infinity()
-        for row in reversed(self.coeffs):
-            inner = G2.infinity()
-            for c in reversed(row):
-                inner = inner * y + c
-            acc = acc * x + inner
-        return acc
+        from .curve import _native
+
+        if _native() is None:
+            acc = G2.infinity()
+            for row in reversed(self.coeffs):
+                inner = G2.infinity()
+                for c in reversed(row):
+                    inner = inner * y + c
+                acc = acc * x + inner
+            return acc
+        # Σᵢⱼ xⁱyʲ·Cᵢⱼ as one flattened MSM.
+        x, y = x % R, y % R
+        t = self.degree
+        xp, acc = [], 1
+        for _ in range(t + 1):
+            xp.append(acc)
+            acc = acc * x % R
+        yp, acc = [], 1
+        for _ in range(t + 1):
+            yp.append(acc)
+            acc = acc * y % R
+        pts = [c for row in self.coeffs for c in row]
+        scalars = [xp[i] * yp[j] % R for i in range(t + 1) for j in range(t + 1)]
+        return g2_multi_exp(pts, scalars)
 
     def row(self, x: int) -> Commitment:
         """Commitment of the row polynomial p(x, ·)."""
+        from .curve import _native
+
         t = self.degree
+        if _native() is None:
+            out = []
+            for j in range(t + 1):
+                acc = G2.infinity()
+                for i in reversed(range(t + 1)):
+                    acc = acc * x + self.coeffs[i][j]
+                out.append(acc)
+            return Commitment(out)
+        x = x % R
+        xp, acc = [], 1
+        for _ in range(t + 1):
+            xp.append(acc)
+            acc = acc * x % R
         out = []
         for j in range(t + 1):
-            acc = G2.infinity()
-            for i in reversed(range(t + 1)):
-                acc = acc * x + self.coeffs[i][j]
-            out.append(acc)
+            out.append(
+                g2_multi_exp([self.coeffs[i][j] for i in range(t + 1)], xp)
+            )
         return Commitment(out)
 
     def is_symmetric(self) -> bool:

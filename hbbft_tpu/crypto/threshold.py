@@ -244,7 +244,16 @@ class PublicKeySet:
         return PublicKey(self.master_g1, self.commitment.evaluate(0))
 
     def public_key_share(self, i: int) -> PublicKeyShare:
-        return PublicKeyShare(self.commitment.evaluate(i + 1))
+        # Commitment evaluation is an MSM; every protocol message
+        # verification hits this, so memoize per index (frozen
+        # dataclass → side-table via object.__setattr__).
+        cache = getattr(self, "_pks_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_pks_cache", cache)
+        if i not in cache:
+            cache[i] = PublicKeyShare(self.commitment.evaluate(i + 1))
+        return cache[i]
 
     # -- combination ------------------------------------------------------
 
@@ -338,7 +347,7 @@ def batch_verify_shares(
         return True
     coeffs = _rlc_coeffs(
         context, [s.to_bytes() for s in shares] + [p.to_bytes() for p in pks]
-    )
+    )[: len(shares)]  # one rᵢ per (shareᵢ, pkᵢ) pair; Fiat–Shamir binds all inputs
     agg_share = g1_multi_exp(shares, coeffs)
     agg_pk = g2_multi_exp(pks, coeffs)
     return pairing_check([(agg_share, G2_GEN), (-base, agg_pk)])

@@ -72,6 +72,22 @@ def _try_load() -> Optional[ctypes.CDLL]:
     cdll.hb_gf_matmul.restype = None
     cdll.hb_gf_mat_inv.argtypes = [u8p, u8p, ctypes.c_int]
     cdll.hb_gf_mat_inv.restype = ctypes.c_int
+    # BLS12-381 (native/bls12_381.cpp)
+    b = ctypes.c_char_p
+    cdll.hb_g1_mul.argtypes = [b, b, u8p]
+    cdll.hb_g1_mul.restype = None
+    cdll.hb_g2_mul.argtypes = [b, b, u8p]
+    cdll.hb_g2_mul.restype = None
+    cdll.hb_g1_msm.argtypes = [ctypes.c_uint64, b, b, u8p]
+    cdll.hb_g1_msm.restype = None
+    cdll.hb_g2_msm.argtypes = [ctypes.c_uint64, b, b, u8p]
+    cdll.hb_g2_msm.restype = None
+    cdll.hb_pairing_check.argtypes = [ctypes.c_uint64, b, b]
+    cdll.hb_pairing_check.restype = ctypes.c_int
+    cdll.hb_pairing.argtypes = [b, b, u8p]
+    cdll.hb_pairing.restype = None
+    cdll.hb_hash_to_g1.argtypes = [b, ctypes.c_uint64, b, ctypes.c_uint64, u8p]
+    cdll.hb_hash_to_g1.restype = None
     return cdll
 
 
@@ -80,6 +96,15 @@ lib = _try_load()
 
 def available() -> bool:
     return lib is not None and not os.environ.get("HBBFT_TPU_NO_NATIVE")
+
+
+def backend():
+    """This module when the native library is usable, else None — the
+    single dispatch gate for all crypto fast paths."""
+    import sys
+
+    mod = sys.modules[__name__]
+    return mod if available() else None
 
 
 def _as_u8p(arr: np.ndarray):
@@ -157,3 +182,119 @@ def gf_mat_inv(m: np.ndarray) -> np.ndarray:
     if rc != 0:
         raise ValueError("matrix not invertible over GF(256)")
     return out
+
+
+# ---------------------------------------------------------------------------
+# BLS12-381 wire helpers + wrappers (native/bls12_381.cpp)
+#
+# Raw affine big-endian wire format (not the compressed public format):
+#   G1: 96 bytes x||y, all-zero = infinity
+#   G2: 192 bytes x.c0||x.c1||y.c0||y.c1, all-zero = infinity
+# Scalars: 32-byte big-endian (callers reduce mod r first).
+# ---------------------------------------------------------------------------
+
+_G1_INF = b"\x00" * 96
+_G2_INF = b"\x00" * 192
+
+
+def g1_wire(pt) -> bytes:
+    a = pt.affine()
+    if a is None:
+        return _G1_INF
+    return a[0].to_bytes(48, "big") + a[1].to_bytes(48, "big")
+
+
+def g1_unwire(raw: bytes, cls):
+    if raw == _G1_INF:
+        return cls.infinity()
+    return cls(
+        (
+            int.from_bytes(raw[:48], "big"),
+            int.from_bytes(raw[48:96], "big"),
+            1,
+        )
+    )
+
+
+def g2_wire(pt) -> bytes:
+    a = pt.affine()
+    if a is None:
+        return _G2_INF
+    (x0, x1), (y0, y1) = a
+    return (
+        x0.to_bytes(48, "big")
+        + x1.to_bytes(48, "big")
+        + y0.to_bytes(48, "big")
+        + y1.to_bytes(48, "big")
+    )
+
+
+def g2_unwire(raw: bytes, cls):
+    if raw == _G2_INF:
+        return cls.infinity()
+    v = [int.from_bytes(raw[i * 48 : (i + 1) * 48], "big") for i in range(4)]
+    return cls(((v[0], v[1]), (v[2], v[3]), (1, 0)))
+
+
+def g1_mul(pt_wire: bytes, k: int) -> bytes:
+    out = np.empty(96, dtype=np.uint8)
+    lib.hb_g1_mul(pt_wire, k.to_bytes(32, "big"), _as_u8p(out))
+    return out.tobytes()
+
+
+def g2_mul(pt_wire: bytes, k: int) -> bytes:
+    out = np.empty(192, dtype=np.uint8)
+    lib.hb_g2_mul(pt_wire, k.to_bytes(32, "big"), _as_u8p(out))
+    return out.tobytes()
+
+
+def g1_msm(pts_wire: Sequence[bytes], scalars: Sequence[int]) -> bytes:
+    if len(pts_wire) != len(scalars):
+        raise ValueError(
+            f"msm length mismatch: {len(pts_wire)} points, {len(scalars)} scalars"
+        )
+    out = np.empty(96, dtype=np.uint8)
+    lib.hb_g1_msm(
+        len(pts_wire),
+        b"".join(pts_wire),
+        b"".join(k.to_bytes(32, "big") for k in scalars),
+        _as_u8p(out),
+    )
+    return out.tobytes()
+
+
+def g2_msm(pts_wire: Sequence[bytes], scalars: Sequence[int]) -> bytes:
+    if len(pts_wire) != len(scalars):
+        raise ValueError(
+            f"msm length mismatch: {len(pts_wire)} points, {len(scalars)} scalars"
+        )
+    out = np.empty(192, dtype=np.uint8)
+    lib.hb_g2_msm(
+        len(pts_wire),
+        b"".join(pts_wire),
+        b"".join(k.to_bytes(32, "big") for k in scalars),
+        _as_u8p(out),
+    )
+    return out.tobytes()
+
+
+def pairing_check(g1s_wire: Sequence[bytes], g2s_wire: Sequence[bytes]) -> bool:
+    return bool(
+        lib.hb_pairing_check(len(g1s_wire), b"".join(g1s_wire), b"".join(g2s_wire))
+    )
+
+
+def pairing_bytes(g1_wire_: bytes, g2_wire_: bytes) -> bytes:
+    """e(P,Q)³ as 576 canonical bytes (12 Fq coeffs, Python tuple order)."""
+    out = np.empty(576, dtype=np.uint8)
+    lib.hb_pairing(g1_wire_, g2_wire_, _as_u8p(out))
+    return out.tobytes()
+
+
+def hash_to_g1_bytes(msg: bytes, dst: bytes) -> bytes:
+    if len(dst) > 255:
+        # the oracle encodes len(dst) as one byte and raises past 255
+        raise OverflowError("domain separation tag longer than 255 bytes")
+    out = np.empty(96, dtype=np.uint8)
+    lib.hb_hash_to_g1(msg, len(msg), dst, len(dst), _as_u8p(out))
+    return out.tobytes()

@@ -1,0 +1,1061 @@
+// BLS12-381 host-native arithmetic for hbbft_tpu.
+//
+// Native host path for the reference's `pairing` + `threshold_crypto`
+// crates (SURVEY.md §2.4): G1/G2 scalar multiplication, Pippenger
+// multi-scalar multiplication, the optimal ate pairing, product-pairing
+// checks, and hash-to-G1 — the operations behind every signature-share
+// sign/verify/combine (common_coin.rs:142-207), decryption-share
+// verify/combine (honey_badger.rs:422-444, :340) and DKG value check
+// (sync_key_gen.rs:449).
+//
+// Semantics are identical to the pure-Python oracle in
+// hbbft_tpu/crypto/{fields,curve,pairing,hashing}.py: same tower
+// (Fq2 = Fq[u]/(u²+1), Fq6 = Fq2[v]/(v³-ξ), ξ=1+u, Fq12 = Fq6[w]/(w²-v)),
+// same final-exponentiation decomposition (pairing value = e(P,Q)³),
+// same try-and-increment hash-to-G1.  The Miller loop here runs T in
+// Jacobian coordinates with polynomial line coefficients (the Python
+// oracle uses affine T); each line differs from the affine one only by
+// a factor in Fq2*, which the final exponentiation kills, so pairing
+// outputs are byte-identical.  tests/test_native_bls.py enforces this.
+//
+// Wire formats (all big-endian):
+//   Fq element   : 48 bytes
+//   G1 affine    : 96 bytes (x||y); all-zero = infinity
+//   G2 affine    : 192 bytes (x.c0||x.c1||y.c0||y.c1); all-zero = infinity
+//   scalar       : 32 bytes
+//   Fq12         : 576 bytes (c0.c0.c0, c0.c0.c1, c0.c1.c0, ... row-major
+//                  over the Python tuple nesting)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace bls {
+
+// ---------------------------------------------------------------------------
+// Fp: 381-bit base field, 6x64-bit limbs, Montgomery form (R = 2^384)
+// ---------------------------------------------------------------------------
+
+typedef unsigned __int128 u128;
+
+struct Fp {
+  uint64_t l[6];
+};
+
+static const Fp MOD = {{0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL,
+                        0x6730d2a0f6b0f624ULL, 0x64774b84f38512bfULL,
+                        0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL}};
+static const Fp R2 = {{0xf4df1f341c341746ULL, 0x0a76e6a609d104f1ULL,
+                       0x8de5476c4c95b6d5ULL, 0x67eb88a9939d83c0ULL,
+                       0x9a793e85b519952dULL, 0x11988fe592cae3aaULL}};
+static const uint64_t PINV = 0x89f3fffcfffcfffdULL;
+static const Fp FP_ONE = {{0x760900000002fffdULL, 0xebf4000bc40c0002ULL,
+                           0x5f48985753c758baULL, 0x77ce585370525745ULL,
+                           0x5c071a97a256ec6dULL, 0x15f65ec3fa80e493ULL}};
+static const Fp FP_ZERO = {{0, 0, 0, 0, 0, 0}};
+
+// Exponents (plain integers, little-endian limbs)
+static const uint64_t EXP_PM2[6] = {0xb9feffffffffaaa9ULL, 0x1eabfffeb153ffffULL,
+                                    0x6730d2a0f6b0f624ULL, 0x64774b84f38512bfULL,
+                                    0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL};
+static const uint64_t EXP_SQRT[6] = {0xee7fbfffffffeaabULL, 0x07aaffffac54ffffULL,
+                                     0xd9cc34a83dac3d89ULL, 0xd91dd2e13ce144afULL,
+                                     0x92c6e9ed90d2eb35ULL, 0x0680447a8e5ff9a6ULL};
+static const uint64_t EXP_FROB16[6] = {0x49aa7ffffffff1c7ULL, 0x051caaaa72e35555ULL,
+                                       0xe688231ad3c82906ULL, 0xe613e1eb7deb831fULL,
+                                       0x0c849bf3b5e1f223ULL, 0x045582fc5eeaa66fULL};
+static const uint64_t EXP_FROB13[6] = {0x9354ffffffffe38eULL, 0x0a395554e5c6aaaaULL,
+                                       0xcd104635a790520cULL, 0xcc27c3d6fbd7063fULL,
+                                       0x190937e76bc3e447ULL, 0x08ab05f8bdd54cdeULL};
+static const uint64_t EXP_FROB23[6] = {0x26a9ffffffffc71cULL, 0x1472aaa9cb8d5555ULL,
+                                       0x9a208c6b4f20a418ULL, 0x984f87adf7ae0c7fULL,
+                                       0x32126fced787c88fULL, 0x11560bf17baa99bcULL};
+// G1 cofactor h1 = (x-1)^2/3, 126 bits
+static const uint64_t H1_LIMBS[2] = {0x8c00aaab0000aaabULL, 0x396c8c005555e156ULL};
+// |x| (BLS parameter), 64 bits
+static const uint64_t Z_PARAM = 0xD201000000010000ULL;
+
+static inline bool fp_is_zero(const Fp& a) {
+  uint64_t acc = 0;
+  for (int i = 0; i < 6; i++) acc |= a.l[i];
+  return acc == 0;
+}
+
+static inline bool fp_eq(const Fp& a, const Fp& b) {
+  uint64_t acc = 0;
+  for (int i = 0; i < 6; i++) acc |= a.l[i] ^ b.l[i];
+  return acc == 0;
+}
+
+// a + b mod p
+static inline Fp fp_add(const Fp& a, const Fp& b) {
+  Fp r;
+  u128 carry = 0;
+  for (int i = 0; i < 6; i++) {
+    carry += (u128)a.l[i] + b.l[i];
+    r.l[i] = (uint64_t)carry;
+    carry >>= 64;
+  }
+  // subtract p if >= p
+  Fp s;
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)r.l[i] - MOD.l[i] - borrow;
+    s.l[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+  if (carry || !borrow) return s;
+  return r;
+}
+
+static inline Fp fp_sub(const Fp& a, const Fp& b) {
+  Fp r;
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)a.l[i] - b.l[i] - borrow;
+    r.l[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+  if (borrow) {
+    u128 carry = 0;
+    for (int i = 0; i < 6; i++) {
+      carry += (u128)r.l[i] + MOD.l[i];
+      r.l[i] = (uint64_t)carry;
+      carry >>= 64;
+    }
+  }
+  return r;
+}
+
+static inline Fp fp_neg(const Fp& a) {
+  if (fp_is_zero(a)) return a;
+  return fp_sub(FP_ZERO, a);
+}
+
+static inline Fp fp_dbl(const Fp& a) { return fp_add(a, a); }
+
+// Montgomery multiplication (CIOS)
+static Fp fp_mul(const Fp& a, const Fp& b) {
+  uint64_t t[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 6; i++) {
+    u128 carry = 0;
+    for (int j = 0; j < 6; j++) {
+      carry += (u128)t[j] + (u128)a.l[i] * b.l[j];
+      t[j] = (uint64_t)carry;
+      carry >>= 64;
+    }
+    carry += t[6];
+    t[6] = (uint64_t)carry;
+    t[7] = (uint64_t)(carry >> 64);
+    uint64_t m = t[0] * PINV;
+    carry = (u128)t[0] + (u128)m * MOD.l[0];
+    carry >>= 64;
+    for (int j = 1; j < 6; j++) {
+      carry += (u128)t[j] + (u128)m * MOD.l[j];
+      t[j - 1] = (uint64_t)carry;
+      carry >>= 64;
+    }
+    carry += t[6];
+    t[5] = (uint64_t)carry;
+    t[6] = t[7] + (uint64_t)(carry >> 64);
+  }
+  Fp r;
+  // final reduce: t[0..5] (+ t[6] overflow bit) mod p
+  Fp s;
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)t[i] - MOD.l[i] - borrow;
+    s.l[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+  if (t[6] || !borrow) {
+    for (int i = 0; i < 6; i++) r.l[i] = s.l[i];
+  } else {
+    for (int i = 0; i < 6; i++) r.l[i] = t[i];
+  }
+  return r;
+}
+
+static inline Fp fp_sq(const Fp& a) { return fp_mul(a, a); }
+
+// exponentiation by a plain little-endian limb exponent
+static Fp fp_pow(const Fp& a, const uint64_t* e, int nlimbs) {
+  Fp result = FP_ONE;
+  Fp base = a;
+  for (int i = 0; i < nlimbs; i++) {
+    uint64_t w = e[i];
+    for (int b = 0; b < 64; b++) {
+      if (w & 1) result = fp_mul(result, base);
+      base = fp_sq(base);
+      w >>= 1;
+    }
+  }
+  return result;
+}
+
+static inline Fp fp_inv(const Fp& a) { return fp_pow(a, EXP_PM2, 6); }
+
+static void fp_from_be(const uint8_t* in, Fp* out) {
+  Fp plain;
+  for (int i = 0; i < 6; i++) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; j++) v = (v << 8) | in[(5 - i) * 8 + j];
+    plain.l[i] = v;
+  }
+  *out = fp_mul(plain, R2);  // to Montgomery form
+}
+
+// out of Montgomery form into plain limbs
+static void fp_plain(const Fp& a, uint64_t out[6]) {
+  Fp one_scaled = {{1, 0, 0, 0, 0, 0}};
+  Fp plain = fp_mul(a, one_scaled);
+  for (int i = 0; i < 6; i++) out[i] = plain.l[i];
+}
+
+static void fp_to_be(const Fp& a, uint8_t* out) {
+  uint64_t plain[6];
+  fp_plain(a, plain);
+  for (int i = 0; i < 6; i++) {
+    uint64_t v = plain[5 - i];
+    for (int j = 0; j < 8; j++) out[i * 8 + j] = (uint8_t)(v >> (56 - 8 * j));
+  }
+}
+
+// lexicographic compare of standard-form values: a < b
+static bool fp_std_less(const Fp& a, const Fp& b) {
+  uint64_t pa[6], pb[6];
+  fp_plain(a, pa);
+  fp_plain(b, pb);
+  for (int i = 5; i >= 0; i--) {
+    if (pa[i] != pb[i]) return pa[i] < pb[i];
+  }
+  return false;
+}
+
+// sqrt for p ≡ 3 mod 4: a^((p+1)/4); returns false if non-residue
+static bool fp_sqrt(const Fp& a, Fp* out) {
+  Fp r = fp_pow(a, EXP_SQRT, 6);
+  if (!fp_eq(fp_sq(r), a)) return false;
+  *out = r;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Fp2 = Fp[u]/(u²+1)
+// ---------------------------------------------------------------------------
+
+struct Fp2 {
+  Fp c0, c1;
+};
+
+static const Fp2 FP2_ZERO = {FP_ZERO, FP_ZERO};
+static const Fp2 FP2_ONE = {FP_ONE, FP_ZERO};
+
+static inline bool fp2_is_zero(const Fp2& a) {
+  return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+static inline bool fp2_eq(const Fp2& a, const Fp2& b) {
+  return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+static inline Fp2 fp2_add(const Fp2& a, const Fp2& b) {
+  return {fp_add(a.c0, b.c0), fp_add(a.c1, b.c1)};
+}
+static inline Fp2 fp2_sub(const Fp2& a, const Fp2& b) {
+  return {fp_sub(a.c0, b.c0), fp_sub(a.c1, b.c1)};
+}
+static inline Fp2 fp2_neg(const Fp2& a) { return {fp_neg(a.c0), fp_neg(a.c1)}; }
+static inline Fp2 fp2_dbl(const Fp2& a) { return {fp_dbl(a.c0), fp_dbl(a.c1)}; }
+
+static inline Fp2 fp2_mul(const Fp2& a, const Fp2& b) {
+  Fp t0 = fp_mul(a.c0, b.c0);
+  Fp t1 = fp_mul(a.c1, b.c1);
+  Fp s = fp_mul(fp_add(a.c0, a.c1), fp_add(b.c0, b.c1));
+  return {fp_sub(t0, t1), fp_sub(fp_sub(s, t0), t1)};
+}
+
+static inline Fp2 fp2_sq(const Fp2& a) {
+  Fp t0 = fp_mul(fp_add(a.c0, a.c1), fp_sub(a.c0, a.c1));
+  Fp t1 = fp_dbl(fp_mul(a.c0, a.c1));
+  return {t0, t1};
+}
+
+static inline Fp2 fp2_scalar_fp(const Fp2& a, const Fp& k) {
+  return {fp_mul(a.c0, k), fp_mul(a.c1, k)};
+}
+
+static inline Fp2 fp2_conj(const Fp2& a) { return {a.c0, fp_neg(a.c1)}; }
+
+static inline Fp2 fp2_inv(const Fp2& a) {
+  Fp d = fp_inv(fp_add(fp_sq(a.c0), fp_sq(a.c1)));
+  return {fp_mul(a.c0, d), fp_neg(fp_mul(a.c1, d))};
+}
+
+// multiply by ξ = 1+u
+static inline Fp2 fp2_mul_xi(const Fp2& a) {
+  return {fp_sub(a.c0, a.c1), fp_add(a.c0, a.c1)};
+}
+
+static Fp2 fp2_pow(const Fp2& a, const uint64_t* e, int nlimbs) {
+  Fp2 result = FP2_ONE;
+  Fp2 base = a;
+  for (int i = 0; i < nlimbs; i++) {
+    uint64_t w = e[i];
+    for (int b = 0; b < 64; b++) {
+      if (w & 1) result = fp2_mul(result, base);
+      base = fp2_sq(base);
+      w >>= 1;
+    }
+  }
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// Fp6 = Fp2[v]/(v³ − ξ)
+// ---------------------------------------------------------------------------
+
+struct Fp6 {
+  Fp2 c0, c1, c2;
+};
+
+static const Fp6 FP6_ZERO = {FP2_ZERO, FP2_ZERO, FP2_ZERO};
+static const Fp6 FP6_ONE = {FP2_ONE, FP2_ZERO, FP2_ZERO};
+
+static inline Fp6 fp6_add(const Fp6& a, const Fp6& b) {
+  return {fp2_add(a.c0, b.c0), fp2_add(a.c1, b.c1), fp2_add(a.c2, b.c2)};
+}
+static inline Fp6 fp6_sub(const Fp6& a, const Fp6& b) {
+  return {fp2_sub(a.c0, b.c0), fp2_sub(a.c1, b.c1), fp2_sub(a.c2, b.c2)};
+}
+static inline Fp6 fp6_neg(const Fp6& a) {
+  return {fp2_neg(a.c0), fp2_neg(a.c1), fp2_neg(a.c2)};
+}
+
+static Fp6 fp6_mul(const Fp6& a, const Fp6& b) {
+  Fp2 t0 = fp2_mul(a.c0, b.c0);
+  Fp2 t1 = fp2_mul(a.c1, b.c1);
+  Fp2 t2 = fp2_mul(a.c2, b.c2);
+  Fp2 c0 = fp2_add(
+      t0, fp2_mul_xi(fp2_sub(
+              fp2_sub(fp2_mul(fp2_add(a.c1, a.c2), fp2_add(b.c1, b.c2)), t1),
+              t2)));
+  Fp2 c1 = fp2_add(
+      fp2_sub(fp2_sub(fp2_mul(fp2_add(a.c0, a.c1), fp2_add(b.c0, b.c1)), t0),
+              t1),
+      fp2_mul_xi(t2));
+  Fp2 c2 = fp2_add(
+      fp2_sub(fp2_sub(fp2_mul(fp2_add(a.c0, a.c2), fp2_add(b.c0, b.c2)), t0),
+              t2),
+      t1);
+  return {c0, c1, c2};
+}
+
+static inline Fp6 fp6_sq(const Fp6& a) { return fp6_mul(a, a); }
+
+static inline Fp6 fp6_mul_by_v(const Fp6& a) {
+  return {fp2_mul_xi(a.c2), a.c0, a.c1};
+}
+
+static Fp6 fp6_inv(const Fp6& a) {
+  Fp2 t0 = fp2_sub(fp2_sq(a.c0), fp2_mul_xi(fp2_mul(a.c1, a.c2)));
+  Fp2 t1 = fp2_sub(fp2_mul_xi(fp2_sq(a.c2)), fp2_mul(a.c0, a.c1));
+  Fp2 t2 = fp2_sub(fp2_sq(a.c1), fp2_mul(a.c0, a.c2));
+  Fp2 d = fp2_add(fp2_mul(a.c0, t0),
+                  fp2_mul_xi(fp2_add(fp2_mul(a.c1, t2), fp2_mul(a.c2, t1))));
+  Fp2 dinv = fp2_inv(d);
+  return {fp2_mul(t0, dinv), fp2_mul(t1, dinv), fp2_mul(t2, dinv)};
+}
+
+// Frobenius constants (computed at load time)
+static Fp2 FROB_G1C;   // ξ^((p-1)/6)
+static Fp2 FROB6_C1;   // ξ^((p-1)/3)
+static Fp2 FROB6_C2;   // ξ^(2(p-1)/3)
+
+static Fp6 fp6_frobenius(const Fp6& a) {
+  return {fp2_conj(a.c0), fp2_mul(fp2_conj(a.c1), FROB6_C1),
+          fp2_mul(fp2_conj(a.c2), FROB6_C2)};
+}
+
+static Fp6 fp6_scale_fp2(const Fp6& a, const Fp2& s) {
+  return {fp2_mul(a.c0, s), fp2_mul(a.c1, s), fp2_mul(a.c2, s)};
+}
+
+// ---------------------------------------------------------------------------
+// Fp12 = Fp6[w]/(w² − v)
+// ---------------------------------------------------------------------------
+
+struct Fp12 {
+  Fp6 c0, c1;
+};
+
+static const Fp12 FP12_ONE = {FP6_ONE, FP6_ZERO};
+
+static inline bool fp12_eq(const Fp12& a, const Fp12& b) {
+  return fp2_eq(a.c0.c0, b.c0.c0) && fp2_eq(a.c0.c1, b.c0.c1) &&
+         fp2_eq(a.c0.c2, b.c0.c2) && fp2_eq(a.c1.c0, b.c1.c0) &&
+         fp2_eq(a.c1.c1, b.c1.c1) && fp2_eq(a.c1.c2, b.c1.c2);
+}
+
+static Fp12 fp12_mul(const Fp12& a, const Fp12& b) {
+  Fp6 t0 = fp6_mul(a.c0, b.c0);
+  Fp6 t1 = fp6_mul(a.c1, b.c1);
+  Fp6 c0 = fp6_add(t0, fp6_mul_by_v(t1));
+  Fp6 c1 =
+      fp6_sub(fp6_sub(fp6_mul(fp6_add(a.c0, a.c1), fp6_add(b.c0, b.c1)), t0), t1);
+  return {c0, c1};
+}
+
+static Fp12 fp12_sq(const Fp12& a) {
+  Fp6 t = fp6_mul(a.c0, a.c1);
+  Fp6 c0 = fp6_sub(
+      fp6_sub(fp6_mul(fp6_add(a.c0, a.c1), fp6_add(a.c0, fp6_mul_by_v(a.c1))),
+              t),
+      fp6_mul_by_v(t));
+  return {c0, fp6_add(t, t)};
+}
+
+static inline Fp12 fp12_conj(const Fp12& a) { return {a.c0, fp6_neg(a.c1)}; }
+
+static Fp12 fp12_inv(const Fp12& a) {
+  Fp6 d = fp6_sub(fp6_sq(a.c0), fp6_mul_by_v(fp6_sq(a.c1)));
+  Fp6 dinv = fp6_inv(d);
+  return {fp6_mul(a.c0, dinv), fp6_neg(fp6_mul(a.c1, dinv))};
+}
+
+static Fp12 fp12_frobenius(const Fp12& a) {
+  return {fp6_frobenius(a.c0), fp6_scale_fp2(fp6_frobenius(a.c1), FROB_G1C)};
+}
+
+static Fp12 fp12_frobenius2(const Fp12& a) {
+  return fp12_frobenius(fp12_frobenius(a));
+}
+
+// ---------------------------------------------------------------------------
+// Curve points (Jacobian), generic over Fp (G1) and Fp2 (G2)
+// ---------------------------------------------------------------------------
+
+template <class F>
+struct FieldOps;
+
+template <>
+struct FieldOps<Fp> {
+  static Fp zero() { return FP_ZERO; }
+  static Fp one() { return FP_ONE; }
+  static Fp add(const Fp& a, const Fp& b) { return fp_add(a, b); }
+  static Fp sub(const Fp& a, const Fp& b) { return fp_sub(a, b); }
+  static Fp neg(const Fp& a) { return fp_neg(a); }
+  static Fp mul(const Fp& a, const Fp& b) { return fp_mul(a, b); }
+  static Fp sq(const Fp& a) { return fp_sq(a); }
+  static Fp inv(const Fp& a) { return fp_inv(a); }
+  static bool is_zero(const Fp& a) { return fp_is_zero(a); }
+  static bool eq(const Fp& a, const Fp& b) { return fp_eq(a, b); }
+};
+
+template <>
+struct FieldOps<Fp2> {
+  static Fp2 zero() { return FP2_ZERO; }
+  static Fp2 one() { return FP2_ONE; }
+  static Fp2 add(const Fp2& a, const Fp2& b) { return fp2_add(a, b); }
+  static Fp2 sub(const Fp2& a, const Fp2& b) { return fp2_sub(a, b); }
+  static Fp2 neg(const Fp2& a) { return fp2_neg(a); }
+  static Fp2 mul(const Fp2& a, const Fp2& b) { return fp2_mul(a, b); }
+  static Fp2 sq(const Fp2& a) { return fp2_sq(a); }
+  static Fp2 inv(const Fp2& a) { return fp2_inv(a); }
+  static bool is_zero(const Fp2& a) { return fp2_is_zero(a); }
+  static bool eq(const Fp2& a, const Fp2& b) { return fp2_eq(a, b); }
+};
+
+template <class F>
+struct Jac {
+  F X, Y, Z;
+  bool is_inf() const { return FieldOps<F>::is_zero(Z); }
+};
+
+template <class F>
+struct Aff {
+  F x, y;
+  bool inf;
+};
+
+template <class F>
+static Jac<F> jac_infinity() {
+  return {FieldOps<F>::one(), FieldOps<F>::one(), FieldOps<F>::zero()};
+}
+
+template <class F>
+static Jac<F> jac_from_aff(const Aff<F>& a) {
+  if (a.inf) return jac_infinity<F>();
+  return {a.x, a.y, FieldOps<F>::one()};
+}
+
+template <class F>
+static Jac<F> jac_double(const Jac<F>& p) {
+  using O = FieldOps<F>;
+  if (p.is_inf()) return p;
+  F A = O::sq(p.X);
+  F B = O::sq(p.Y);
+  F C = O::sq(B);
+  F t = O::sq(O::add(p.X, B));
+  F D = O::add(O::sub(O::sub(t, A), C), O::sub(O::sub(t, A), C));  // 2(..)
+  F E = O::add(O::add(A, A), A);
+  F Fv = O::sq(E);
+  F X3 = O::sub(Fv, O::add(D, D));
+  F eightC = O::add(O::add(O::add(C, C), O::add(C, C)),
+                    O::add(O::add(C, C), O::add(C, C)));
+  F Y3 = O::sub(O::mul(E, O::sub(D, X3)), eightC);
+  F Z3 = O::add(O::mul(p.Y, p.Z), O::mul(p.Y, p.Z));
+  return {X3, Y3, Z3};
+}
+
+// mixed addition: p (Jacobian) + q (affine, not infinity)
+template <class F>
+static Jac<F> jac_madd(const Jac<F>& p, const Aff<F>& q) {
+  using O = FieldOps<F>;
+  if (q.inf) return p;
+  if (p.is_inf()) return jac_from_aff(q);
+  F Z1Z1 = O::sq(p.Z);
+  F U2 = O::mul(q.x, Z1Z1);
+  F S2 = O::mul(O::mul(q.y, p.Z), Z1Z1);
+  if (O::eq(U2, p.X)) {
+    if (O::eq(S2, p.Y)) return jac_double(p);
+    return jac_infinity<F>();
+  }
+  F H = O::sub(U2, p.X);
+  F HH = O::sq(H);
+  F HHH = O::mul(H, HH);
+  F rr = O::sub(S2, p.Y);
+  F V = O::mul(p.X, HH);
+  F X3 = O::sub(O::sub(O::sq(rr), HHH), O::add(V, V));
+  F Y3 = O::sub(O::mul(rr, O::sub(V, X3)), O::mul(p.Y, HHH));
+  F Z3 = O::mul(p.Z, H);
+  return {X3, Y3, Z3};
+}
+
+// full Jacobian addition
+template <class F>
+static Jac<F> jac_add(const Jac<F>& p, const Jac<F>& q) {
+  using O = FieldOps<F>;
+  if (p.is_inf()) return q;
+  if (q.is_inf()) return p;
+  F Z1Z1 = O::sq(p.Z);
+  F Z2Z2 = O::sq(q.Z);
+  F U1 = O::mul(p.X, Z2Z2);
+  F U2 = O::mul(q.X, Z1Z1);
+  F S1 = O::mul(O::mul(p.Y, q.Z), Z2Z2);
+  F S2 = O::mul(O::mul(q.Y, p.Z), Z1Z1);
+  if (O::eq(U1, U2)) {
+    if (O::eq(S1, S2)) return jac_double(p);
+    return jac_infinity<F>();
+  }
+  F H = O::sub(U2, U1);
+  F HH = O::sq(H);
+  F HHH = O::mul(H, HH);
+  F rr = O::sub(S2, S1);
+  F V = O::mul(U1, HH);
+  F X3 = O::sub(O::sub(O::sq(rr), HHH), O::add(V, V));
+  F Y3 = O::sub(O::mul(rr, O::sub(V, X3)), O::mul(S1, HHH));
+  F Z3 = O::mul(O::mul(p.Z, q.Z), H);
+  return {X3, Y3, Z3};
+}
+
+template <class F>
+static Aff<F> jac_to_aff(const Jac<F>& p) {
+  using O = FieldOps<F>;
+  if (p.is_inf()) return {O::zero(), O::zero(), true};
+  F zinv = O::inv(p.Z);
+  F zinv2 = O::sq(zinv);
+  F zinv3 = O::mul(zinv2, zinv);
+  return {O::mul(p.X, zinv2), O::mul(p.Y, zinv3), false};
+}
+
+// scalar multiplication, scalar as big-endian bytes
+template <class F>
+static Jac<F> jac_mul_be(const Aff<F>& p, const uint8_t* k, size_t klen) {
+  Jac<F> acc = jac_infinity<F>();
+  bool started = false;
+  for (size_t i = 0; i < klen; i++) {
+    for (int b = 7; b >= 0; b--) {
+      if (started) acc = jac_double(acc);
+      if ((k[i] >> b) & 1) {
+        acc = jac_madd(acc, p);
+        started = true;
+      }
+    }
+  }
+  return acc;
+}
+
+// scalar multiplication by little-endian limb scalar
+template <class F>
+static Jac<F> jac_mul_limbs(const Jac<F>& p, const uint64_t* k, int nlimbs) {
+  Jac<F> acc = jac_infinity<F>();
+  int top = nlimbs * 64 - 1;
+  while (top >= 0 && !((k[top / 64] >> (top % 64)) & 1)) top--;
+  for (int i = top; i >= 0; i--) {
+    acc = jac_double(acc);
+    if ((k[i / 64] >> (i % 64)) & 1) acc = jac_add(acc, p);
+  }
+  return acc;
+}
+
+// ---------------------------------------------------------------------------
+// Pippenger MSM
+// ---------------------------------------------------------------------------
+
+template <class F>
+static Jac<F> msm(const std::vector<Aff<F>>& pts,
+                  const std::vector<std::vector<uint8_t>>& scalars) {
+  size_t n = pts.size();
+  if (n == 0) return jac_infinity<F>();
+  // window size minimizing ceil(256/c)·(n + 2^c + 2^c) point adds
+  int c = 2;
+  double best = 1e300;
+  for (int w = 2; w <= 14; w++) {
+    double cost = ((256 + w - 1) / w) * ((double)n + 2.0 * (1u << w));
+    if (cost < best) {
+      best = cost;
+      c = w;
+    }
+  }
+  const int nbits = 256;
+  int nwin = (nbits + c - 1) / c;
+  Jac<F> total = jac_infinity<F>();
+  std::vector<Jac<F>> buckets((size_t)1 << c);
+  for (int w = nwin - 1; w >= 0; w--) {
+    if (!total.is_inf()) {
+      for (int i = 0; i < c; i++) total = jac_double(total);
+    }
+    size_t nbkt = ((size_t)1 << c) - 1;
+    for (size_t i = 0; i <= nbkt; i++) buckets[i] = jac_infinity<F>();
+    int lo = w * c;
+    for (size_t i = 0; i < n; i++) {
+      if (pts[i].inf) continue;
+      // extract bits [lo, lo+c) of the big-endian scalar
+      uint32_t idx = 0;
+      for (int b = c - 1; b >= 0; b--) {
+        int bit = lo + b;
+        if (bit >= nbits) continue;
+        int byte = 31 - bit / 8;
+        idx = (idx << 1) | ((scalars[i][byte] >> (bit % 8)) & 1);
+      }
+      if (idx) buckets[idx] = jac_madd(buckets[idx], pts[i]);
+    }
+    Jac<F> running = jac_infinity<F>();
+    Jac<F> sum = jac_infinity<F>();
+    for (size_t b = nbkt; b >= 1; b--) {
+      running = jac_add(running, buckets[b]);
+      sum = jac_add(sum, running);
+    }
+    total = jac_add(total, sum);
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// Pairing
+// ---------------------------------------------------------------------------
+
+struct LineEval {
+  Fp2 a0, a1, b1;  // line = (a0 + a1·v) + (b1·v)·w, all pre-scaled
+};
+
+// sparse Fq6 multiplications (mirror pairing.py)
+static Fp6 fp6_mul_by_01(const Fp6& c, const Fp2& s0, const Fp2& s1) {
+  return {fp2_add(fp2_mul(c.c0, s0), fp2_mul_xi(fp2_mul(c.c2, s1))),
+          fp2_add(fp2_mul(c.c0, s1), fp2_mul(c.c1, s0)),
+          fp2_add(fp2_mul(c.c1, s1), fp2_mul(c.c2, s0))};
+}
+
+static Fp6 fp6_mul_by_1(const Fp6& c, const Fp2& s1) {
+  return {fp2_mul_xi(fp2_mul(c.c2, s1)), fp2_mul(c.c0, s1), fp2_mul(c.c1, s1)};
+}
+
+static Fp12 mul_by_line(const Fp12& f, const LineEval& l) {
+  Fp6 t0 = fp6_mul_by_01(f.c0, l.a0, l.a1);
+  Fp6 t1 = fp6_mul_by_1(f.c1, l.b1);
+  Fp6 fs = fp6_add(f.c0, f.c1);
+  Fp6 c1 = fp6_sub(fp6_sub(fp6_mul_by_01(fs, l.a0, fp2_add(l.a1, l.b1)), t0), t1);
+  Fp6 c0 = fp6_add(t0, fp6_mul_by_v(t1));
+  return {c0, c1};
+}
+
+// Doubling step with Jacobian T on the twist; line scaled by 2YZ³ ∈ Fq2*
+// (the scale factor lies in a subfield and is killed by the final
+// exponentiation, so pairing values match the affine Python oracle).
+static LineEval line_dbl(Jac<Fp2>& T, const Fp& xP, const Fp& yP) {
+  Fp2 A = fp2_sq(T.X);             // X²
+  Fp2 B = fp2_sq(T.Y);             // Y²
+  Fp2 C = fp2_sq(B);               // Y⁴
+  Fp2 t = fp2_sq(fp2_add(T.X, B));
+  Fp2 D2 = fp2_sub(fp2_sub(t, A), C);
+  Fp2 D = fp2_add(D2, D2);         // 2·2XY² = 4XY²... D = 2((X+B)²−A−C)
+  Fp2 E = fp2_add(fp2_add(A, A), A);  // 3X²
+  Fp2 Fv = fp2_sq(E);
+  Fp2 Zsq = fp2_sq(T.Z);
+  Fp2 X3 = fp2_sub(Fv, fp2_add(D, D));
+  Fp2 eightC = fp2_dbl(fp2_dbl(fp2_dbl(C)));
+  Fp2 Y3 = fp2_sub(fp2_mul(E, fp2_sub(D, X3)), eightC);
+  Fp2 Z3 = fp2_dbl(fp2_mul(T.Y, T.Z));
+  LineEval l;
+  l.a0 = fp2_sub(fp2_mul(E, T.X), fp2_dbl(B));      // 3X³ − 2Y²
+  l.a1 = fp2_scalar_fp(fp2_neg(fp2_mul(E, Zsq)), xP);  // −3X²Z²·xP
+  l.b1 = fp2_scalar_fp(fp2_mul(Z3, Zsq), yP);       // 2YZ³·yP
+  T = {X3, Y3, Z3};
+  return l;
+}
+
+// Addition step (T += Q, Q affine); line scaled by Z·H = Z3 ∈ Fq2*
+static LineEval line_add(Jac<Fp2>& T, const Aff<Fp2>& Q, const Fp& xP,
+                         const Fp& yP) {
+  Fp2 Z1Z1 = fp2_sq(T.Z);
+  Fp2 U2 = fp2_mul(Q.x, Z1Z1);
+  Fp2 S2 = fp2_mul(fp2_mul(Q.y, T.Z), Z1Z1);
+  Fp2 H = fp2_sub(U2, T.X);
+  Fp2 rr = fp2_sub(S2, T.Y);
+  Fp2 HH = fp2_sq(H);
+  Fp2 HHH = fp2_mul(H, HH);
+  Fp2 V = fp2_mul(T.X, HH);
+  Fp2 X3 = fp2_sub(fp2_sub(fp2_sq(rr), HHH), fp2_add(V, V));
+  Fp2 Y3 = fp2_sub(fp2_mul(rr, fp2_sub(V, X3)), fp2_mul(T.Y, HHH));
+  Fp2 Z3 = fp2_mul(T.Z, H);
+  LineEval l;
+  l.a0 = fp2_sub(fp2_mul(rr, Q.x), fp2_mul(Z3, Q.y));  // r·xQ − ZH·yQ
+  l.a1 = fp2_scalar_fp(fp2_neg(rr), xP);
+  l.b1 = fp2_scalar_fp(Z3, yP);
+  T = {X3, Y3, Z3};
+  return l;
+}
+
+static Fp12 miller_loop(const Aff<Fp>& p, const Aff<Fp2>& q) {
+  if (p.inf || q.inf) return FP12_ONE;
+  Jac<Fp2> T = jac_from_aff(q);
+  Fp12 f = FP12_ONE;
+  // iterate bits of Z_PARAM from the second-most-significant down
+  int top = 63;
+  while (top >= 0 && !((Z_PARAM >> top) & 1)) top--;
+  for (int i = top - 1; i >= 0; i--) {
+    f = fp12_sq(f);
+    LineEval l = line_dbl(T, p.x, p.y);
+    f = mul_by_line(f, l);
+    if ((Z_PARAM >> i) & 1) {
+      LineEval l2 = line_add(T, q, p.x, p.y);
+      f = mul_by_line(f, l2);
+    }
+  }
+  return fp12_conj(f);  // parameter x < 0
+}
+
+static Fp12 exp_by_z(const Fp12& m) {
+  Fp12 result = m;
+  int top = 63;
+  while (top >= 0 && !((Z_PARAM >> top) & 1)) top--;
+  for (int i = top - 1; i >= 0; i--) {
+    result = fp12_sq(result);
+    if ((Z_PARAM >> i) & 1) result = fp12_mul(result, m);
+  }
+  return result;
+}
+
+static Fp12 exp_by_x(const Fp12& m) { return fp12_conj(exp_by_z(m)); }
+
+static Fp12 final_exponentiation(const Fp12& f0) {
+  // easy part: f^((p^6−1)(p^2+1))
+  Fp12 f = fp12_mul(fp12_conj(f0), fp12_inv(f0));
+  f = fp12_mul(fp12_frobenius2(f), f);
+  Fp12 m = f;
+  // hard part ×3 (matches pairing.py exactly)
+  Fp12 t0 = fp12_mul(exp_by_x(m), fp12_conj(m));
+  t0 = fp12_mul(exp_by_x(t0), fp12_conj(t0));
+  Fp12 t1 = fp12_mul(exp_by_x(t0), fp12_frobenius(t0));
+  Fp12 t3 = exp_by_x(exp_by_x(t1));
+  Fp12 out = fp12_mul(fp12_mul(t3, fp12_frobenius2(t1)), fp12_conj(t1));
+  return fp12_mul(out, fp12_mul(m, fp12_sq(m)));
+}
+
+// ---------------------------------------------------------------------------
+// SHA-512 (for hash_to_fq / hash_to_g1)
+// ---------------------------------------------------------------------------
+
+static const uint64_t K512[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+static void sha512(const uint8_t* data, size_t len, uint8_t out[64]) {
+  uint64_t h[8] = {0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+                   0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+                   0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+                   0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+  size_t total = len;
+  size_t padded = ((len + 17 + 127) / 128) * 128;
+  std::vector<uint8_t> buf(padded, 0);
+  memcpy(buf.data(), data, len);
+  buf[len] = 0x80;
+  u128 bits = (u128)total * 8;
+  for (int i = 0; i < 16; i++)
+    buf[padded - 1 - i] = (uint8_t)(bits >> (8 * i));
+  for (size_t blk = 0; blk < padded; blk += 128) {
+    uint64_t w[80];
+    for (int i = 0; i < 16; i++) {
+      uint64_t v = 0;
+      for (int j = 0; j < 8; j++) v = (v << 8) | buf[blk + i * 8 + j];
+      w[i] = v;
+    }
+    for (int i = 16; i < 80; i++) {
+      uint64_t s0 = rotr64(w[i - 15], 1) ^ rotr64(w[i - 15], 8) ^ (w[i - 15] >> 7);
+      uint64_t s1 = rotr64(w[i - 2], 19) ^ rotr64(w[i - 2], 61) ^ (w[i - 2] >> 6);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint64_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 80; i++) {
+      uint64_t S1 = rotr64(e, 14) ^ rotr64(e, 18) ^ rotr64(e, 41);
+      uint64_t ch = (e & f) ^ (~e & g);
+      uint64_t t1 = hh + S1 + ch + K512[i] + w[i];
+      uint64_t S0 = rotr64(a, 28) ^ rotr64(a, 34) ^ rotr64(a, 39);
+      uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint64_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1; d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+  for (int i = 0; i < 8; i++)
+    for (int j = 0; j < 8; j++) out[i * 8 + j] = (uint8_t)(h[i] >> (56 - 8 * j));
+}
+
+// reduce a 512-bit big-endian value mod p (shift-subtract; plain limbs)
+static void reduce512_mod_p(const uint8_t in[64], uint64_t out[6]) {
+  uint64_t r[7] = {0, 0, 0, 0, 0, 0, 0};
+  for (int byte = 0; byte < 64; byte++) {
+    for (int bit = 7; bit >= 0; bit--) {
+      // r = 2r + next bit
+      uint64_t carry = (in[byte] >> bit) & 1;
+      for (int i = 0; i < 7; i++) {
+        uint64_t nc = r[i] >> 63;
+        r[i] = (r[i] << 1) | carry;
+        carry = nc;
+      }
+      // if r >= p: r -= p
+      bool ge = r[6] != 0;
+      if (!ge) {
+        ge = true;
+        for (int i = 5; i >= 0; i--) {
+          if (r[i] != MOD.l[i]) {
+            ge = r[i] > MOD.l[i];
+            break;
+          }
+        }
+      }
+      if (ge) {
+        u128 borrow = 0;
+        for (int i = 0; i < 6; i++) {
+          u128 d = (u128)r[i] - MOD.l[i] - borrow;
+          r[i] = (uint64_t)d;
+          borrow = (d >> 64) & 1;
+        }
+        r[6] -= (uint64_t)borrow;  // borrow out of low 6 limbs
+      }
+    }
+  }
+  for (int i = 0; i < 6; i++) out[i] = r[i];
+}
+
+// ---------------------------------------------------------------------------
+// Wire conversion helpers
+// ---------------------------------------------------------------------------
+
+static bool buf_is_zero(const uint8_t* b, size_t n) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < n; i++) acc |= b[i];
+  return acc == 0;
+}
+
+static Aff<Fp> g1_from_wire(const uint8_t in[96]) {
+  if (buf_is_zero(in, 96)) return {FP_ZERO, FP_ZERO, true};
+  Aff<Fp> a;
+  a.inf = false;
+  fp_from_be(in, &a.x);
+  fp_from_be(in + 48, &a.y);
+  return a;
+}
+
+static void g1_to_wire(const Aff<Fp>& a, uint8_t out[96]) {
+  if (a.inf) {
+    memset(out, 0, 96);
+    return;
+  }
+  fp_to_be(a.x, out);
+  fp_to_be(a.y, out + 48);
+}
+
+static Aff<Fp2> g2_from_wire(const uint8_t in[192]) {
+  if (buf_is_zero(in, 192)) return {FP2_ZERO, FP2_ZERO, true};
+  Aff<Fp2> a;
+  a.inf = false;
+  fp_from_be(in, &a.x.c0);
+  fp_from_be(in + 48, &a.x.c1);
+  fp_from_be(in + 96, &a.y.c0);
+  fp_from_be(in + 144, &a.y.c1);
+  return a;
+}
+
+static void g2_to_wire(const Aff<Fp2>& a, uint8_t out[192]) {
+  if (a.inf) {
+    memset(out, 0, 192);
+    return;
+  }
+  fp_to_be(a.x.c0, out);
+  fp_to_be(a.x.c1, out + 48);
+  fp_to_be(a.y.c0, out + 96);
+  fp_to_be(a.y.c1, out + 144);
+}
+
+static void fp12_to_wire(const Fp12& f, uint8_t out[576]) {
+  const Fp2* cs[6] = {&f.c0.c0, &f.c0.c1, &f.c0.c2, &f.c1.c0, &f.c1.c1, &f.c1.c2};
+  for (int i = 0; i < 6; i++) {
+    fp_to_be(cs[i]->c0, out + i * 96);
+    fp_to_be(cs[i]->c1, out + i * 96 + 48);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Init (Frobenius constants) — runs at library load
+// ---------------------------------------------------------------------------
+
+static const Fp2 XI = {FP_ONE, FP_ONE};  // ξ = 1 + u
+
+struct BlsInit {
+  BlsInit() {
+    FROB_G1C = fp2_pow(XI, EXP_FROB16, 6);
+    FROB6_C1 = fp2_pow(XI, EXP_FROB13, 6);
+    FROB6_C2 = fp2_pow(XI, EXP_FROB23, 6);
+  }
+};
+static BlsInit _init;
+
+}  // namespace bls
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+using namespace bls;
+
+extern "C" {
+
+void hb_g1_mul(const uint8_t* p, const uint8_t* k, uint8_t* out) {
+  Aff<Fp> a = g1_from_wire(p);
+  Jac<Fp> r = jac_mul_be(a, k, 32);
+  g1_to_wire(jac_to_aff(r), out);
+}
+
+void hb_g2_mul(const uint8_t* p, const uint8_t* k, uint8_t* out) {
+  Aff<Fp2> a = g2_from_wire(p);
+  Jac<Fp2> r = jac_mul_be(a, k, 32);
+  g2_to_wire(jac_to_aff(r), out);
+}
+
+void hb_g1_msm(uint64_t n, const uint8_t* pts, const uint8_t* ks, uint8_t* out) {
+  std::vector<Aff<Fp>> apts(n);
+  std::vector<std::vector<uint8_t>> scalars(n);
+  for (uint64_t i = 0; i < n; i++) {
+    apts[i] = g1_from_wire(pts + 96 * i);
+    scalars[i].assign(ks + 32 * i, ks + 32 * i + 32);
+  }
+  g1_to_wire(jac_to_aff(msm(apts, scalars)), out);
+}
+
+void hb_g2_msm(uint64_t n, const uint8_t* pts, const uint8_t* ks, uint8_t* out) {
+  std::vector<Aff<Fp2>> apts(n);
+  std::vector<std::vector<uint8_t>> scalars(n);
+  for (uint64_t i = 0; i < n; i++) {
+    apts[i] = g2_from_wire(pts + 192 * i);
+    scalars[i].assign(ks + 32 * i, ks + 32 * i + 32);
+  }
+  g2_to_wire(jac_to_aff(msm(apts, scalars)), out);
+}
+
+// Π e(Pᵢ, Qᵢ) == 1 ?  (one shared final exponentiation)
+int hb_pairing_check(uint64_t n, const uint8_t* g1s, const uint8_t* g2s) {
+  Fp12 acc = FP12_ONE;
+  for (uint64_t i = 0; i < n; i++) {
+    Aff<Fp> p = g1_from_wire(g1s + 96 * i);
+    Aff<Fp2> q = g2_from_wire(g2s + 192 * i);
+    acc = fp12_mul(acc, miller_loop(p, q));
+  }
+  return fp12_eq(final_exponentiation(acc), FP12_ONE) ? 1 : 0;
+}
+
+// e(P, Q)³ — canonical pairing value, byte-identical to the Python oracle
+void hb_pairing(const uint8_t* p, const uint8_t* q, uint8_t* out) {
+  Aff<Fp> pa = g1_from_wire(p);
+  Aff<Fp2> qa = g2_from_wire(q);
+  fp12_to_wire(final_exponentiation(miller_loop(pa, qa)), out);
+}
+
+// try-and-increment hash to the G1 subgroup, matching
+// hbbft_tpu/crypto/hashing.py::hash_to_g1 byte-for-byte.
+void hb_hash_to_g1(const uint8_t* msg, uint64_t msg_len, const uint8_t* dst,
+                   uint64_t dst_len, uint8_t* out) {
+  std::vector<uint8_t> buf(dst_len + 1 + msg_len + 1);
+  memcpy(buf.data(), dst, dst_len);
+  buf[dst_len] = (uint8_t)dst_len;
+  memcpy(buf.data() + dst_len + 1, msg, msg_len);
+  for (int ctr = 0; ctr < 256; ctr++) {
+    buf[buf.size() - 1] = (uint8_t)ctr;
+    uint8_t digest[64];
+    sha512(buf.data(), buf.size(), digest);
+    uint64_t xplain[6];
+    reduce512_mod_p(digest, xplain);
+    Fp x;
+    {
+      Fp tmp;
+      for (int i = 0; i < 6; i++) tmp.l[i] = xplain[i];
+      x = fp_mul(tmp, R2);
+    }
+    // y² = x³ + 4
+    Fp four = fp_dbl(fp_dbl(FP_ONE));
+    Fp rhs = fp_add(fp_mul(fp_sq(x), x), four);
+    Fp y;
+    if (!fp_sqrt(rhs, &y)) continue;
+    Fp ny = fp_neg(y);
+    if (fp_std_less(ny, y)) y = ny;  // canonical smaller root
+    // clear cofactor
+    Jac<Fp> pt = {x, y, FP_ONE};
+    Jac<Fp> cleared = jac_mul_limbs(pt, H1_LIMBS, 2);
+    if (cleared.is_inf()) continue;
+    g1_to_wire(jac_to_aff(cleared), out);
+    return;
+  }
+  memset(out, 0, 96);  // unreachable (probability ~2^-256)
+}
+
+}  // extern "C"

@@ -1,0 +1,192 @@
+"""Bit-identity tests: native C++ BLS12-381 vs the pure-Python oracle.
+
+The native library (``native/bls12_381.cpp``) must be byte-identical to
+``hbbft_tpu/crypto/{fields,curve,pairing,hashing}.py`` — including the
+pairing *value* (the projective Miller loop's line scalings lie in Fq2*
+and are killed by the final exponentiation).  These tests toggle
+``HBBFT_TPU_NO_NATIVE`` to compute both sides.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu import native as NT
+from hbbft_tpu.crypto import fields as F
+from hbbft_tpu.crypto.curve import (
+    G1,
+    G1_GEN,
+    G2,
+    G2_GEN,
+    g1_multi_exp,
+    g2_multi_exp,
+)
+
+pytestmark = pytest.mark.skipif(
+    not NT.available(), reason="native library unavailable"
+)
+
+
+@pytest.fixture
+def no_native(monkeypatch):
+    monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+
+
+def _rand_scalar(rng):
+    return rng.randrange(1, F.R)
+
+
+class TestGroupOps:
+    def test_g1_mul_matches_python(self, rng, monkeypatch):
+        p = G1_GEN * _rand_scalar(rng)
+        for _ in range(4):
+            k = _rand_scalar(rng)
+            nat = p * k
+            monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+            ref = p * k
+            monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
+            assert nat == ref
+            assert nat.to_bytes() == ref.to_bytes()
+
+    def test_g2_mul_matches_python(self, rng, monkeypatch):
+        p = G2_GEN * _rand_scalar(rng)
+        k = _rand_scalar(rng)
+        nat = p * k
+        monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+        ref = p * k
+        monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
+        assert nat == ref
+
+    def test_mul_edge_cases(self, rng):
+        p = G1_GEN * _rand_scalar(rng)
+        assert (p * 0).is_infinity()
+        assert p * 1 == p
+        assert p * (F.R - 1) == -p
+        assert (G1.infinity() * 5).is_infinity()
+        q = G2_GEN * 3
+        assert q * (F.R + 2) == q * 2  # scalar reduced mod r
+
+    def test_g1_msm_matches_naive(self, rng, monkeypatch):
+        pts = [G1_GEN * _rand_scalar(rng) for _ in range(17)]
+        ks = [rng.randrange(F.R) for _ in range(17)]
+        nat = g1_multi_exp(pts, ks)
+        monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+        ref = g1_multi_exp(pts, ks)
+        monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
+        assert nat == ref
+
+    def test_g2_msm_matches_naive(self, rng, monkeypatch):
+        pts = [G2_GEN * _rand_scalar(rng) for _ in range(9)]
+        ks = [rng.randrange(F.R) for _ in range(9)]
+        nat = g2_multi_exp(pts, ks)
+        monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+        ref = g2_multi_exp(pts, ks)
+        monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
+        assert nat == ref
+
+    def test_msm_with_infinity_and_zero_scalars(self, rng):
+        pts = [G1_GEN * 5, G1.infinity(), G1_GEN * 7]
+        ks = [3, 9, 0]
+        assert g1_multi_exp(pts, ks) == G1_GEN * 15
+
+    def test_msm_empty(self):
+        assert g1_multi_exp([], []).is_infinity()
+
+    def test_in_subgroup_via_native(self, rng):
+        assert (G1_GEN * _rand_scalar(rng)).in_subgroup()
+        # (0, 2) is on the curve but not in the r-torsion subgroup
+        assert not G1.from_affine((0, 2)).in_subgroup()
+
+
+class TestPairing:
+    def test_pairing_value_byte_identical(self, rng, monkeypatch):
+        from hbbft_tpu.crypto.pairing import pairing
+
+        p = G1_GEN * 5
+        q = G2_GEN * 7
+        nat = pairing(p, q)
+        monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+        ref = pairing(p, q)
+        monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
+        assert nat == ref
+
+    def test_bilinearity(self):
+        from hbbft_tpu.crypto.pairing import pairing
+
+        assert pairing(G1_GEN * 6, G2_GEN) == pairing(G1_GEN * 2, G2_GEN * 3)
+
+    def test_pairing_check_share_verify(self, rng):
+        from hbbft_tpu.crypto.hashing import hash_to_g1
+        from hbbft_tpu.crypto.pairing import pairing_check
+
+        sk = _rand_scalar(rng)
+        h = hash_to_g1(b"some message")
+        sig = h * sk
+        pk = G2_GEN * sk
+        assert pairing_check([(sig, G2_GEN), (-h, pk)])
+        assert not pairing_check([(h * (sk + 1), G2_GEN), (-h, pk)])
+
+    def test_pairing_check_empty_and_infinity(self):
+        from hbbft_tpu.crypto.pairing import pairing_check
+
+        assert pairing_check([])
+        assert pairing_check([(G1.infinity(), G2_GEN)])
+
+
+class TestHashToG1:
+    def test_matches_python(self, monkeypatch):
+        from hbbft_tpu.crypto import hashing as H
+
+        for msg in [b"", b"a", b"hello world", bytes(range(100))]:
+            nat = H.hash_to_g1(msg)
+            monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+            ref = H.hash_to_g1(msg)
+            monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
+            assert nat == ref, msg
+
+    def test_dst_separation(self):
+        from hbbft_tpu.crypto import hashing as H
+
+        assert H.hash_to_g1(b"m", H.DST_SIG) != H.hash_to_g1(b"m", H.DST_ENC)
+
+    def test_output_in_subgroup(self):
+        from hbbft_tpu.crypto import hashing as H
+
+        assert H.hash_to_g1(b"subgroup test").in_subgroup()
+
+
+class TestThresholdEndToEnd:
+    def test_sign_combine_verify_native(self, rng):
+        from hbbft_tpu.crypto.threshold import SecretKeySet, batch_verify_shares
+        from hbbft_tpu.crypto.hashing import hash_to_g1
+
+        sks = SecretKeySet.random(2, rng)
+        pks = sks.public_keys()
+        h = hash_to_g1(b"coin nonce")
+        shares = {i: sks.secret_key_share(i).sign_g1(h) for i in range(7)}
+        for i in range(7):
+            assert pks.public_key_share(i).verify_signature_share_g1(
+                shares[i], h
+            )
+        sig = pks.combine_signatures(shares)
+        assert pks.verify_signature(sig, b"coin nonce")
+        assert batch_verify_shares(
+            [shares[i].point for i in range(7)],
+            [pks.public_key_share(i).point for i in range(7)],
+            h,
+            b"ctx",
+        )
+
+    def test_combine_matches_pure_python(self, rng, monkeypatch):
+        from hbbft_tpu.crypto.threshold import SecretKeySet
+        from hbbft_tpu.crypto.hashing import hash_to_g1
+
+        sks = SecretKeySet.random(1, rng)
+        pks = sks.public_keys()
+        h = hash_to_g1(b"m")
+        shares = {i: sks.secret_key_share(i).sign_g1(h) for i in range(4)}
+        nat = pks.combine_signatures(shares)
+        monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+        ref = pks.combine_signatures(shares)
+        monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
+        assert nat.to_bytes() == ref.to_bytes()
