@@ -56,6 +56,22 @@ class CpuBackend:
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         return g2_multi_exp(points, scalars)
 
+    # -- share verification ------------------------------------------------
+    # Every protocol-level share check routes through these two methods
+    # (``common_coin.py``, ``honey_badger.py``) so a batching façade can
+    # prefetch thousands of them in one fused device launch
+    # (``harness/batching.py``) without touching protocol logic.
+
+    def verify_sig_share(self, pk_share, share, msg: bytes) -> bool:
+        """Verify one threshold-signature share (reference
+        ``common_coin.rs:149-161``)."""
+        return pk_share.verify_signature_share(share, msg)
+
+    def verify_dec_share(self, pk_share, share, ciphertext) -> bool:
+        """Verify one threshold-decryption share (reference
+        ``honey_badger.rs:222-233``)."""
+        return pk_share.verify_decryption_share(share, ciphertext)
+
     # -- batched share verification --------------------------------------
 
     def batch_verify_shares(

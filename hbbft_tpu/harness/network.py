@@ -219,6 +219,10 @@ class TestNetwork:
             list(range(n)), rng, mock=mock_crypto, ops=ops
         )
         self.rng = rng
+        self.ops = ops
+        # batching backends get a prefetch pass every ~n steps
+        self.prefetch_every = n if ops is not None and hasattr(ops, "prefetch") else 0
+        self._steps = 0
         self.adv_netinfos = {i: netinfos[i] for i in range(good_num, n)}
         obs_netinfo = netinfos[0].observer_view(self.OBSERVER_ID)
 
@@ -272,10 +276,30 @@ class TestNetwork:
             # algorithm misbehaves we surface it rather than hide it
             assert not msgs_obs, "observer attempted to send messages"
 
+    # -- batched crypto prefetch (harness/batching.py) ---------------------
+
+    def prefetch_crypto(self) -> None:
+        """Flush all queued share verifications as one batch into the
+        backend's cache (bit-identical outcomes, see
+        ``harness/batching.py``)."""
+        from .batching import crypto_obligations
+
+        # (the observer queue is always drained synchronously by
+        # dispatch_messages, so only validator queues can hold work)
+        obs = []
+        for node in self.nodes.values():
+            for sender_id, message in node.queue:
+                obs.extend(crypto_obligations(node.algo, sender_id, message))
+        self.ops.prefetch(obs)
+
     def step(self) -> Any:
         """One network iteration: adversary injects, then the adversary
         picks one non-idle honest node to handle one message
         (reference ``:490-518``)."""
+        if self.prefetch_every:
+            if self._steps % self.prefetch_every == 0:
+                self.prefetch_crypto()
+            self._steps += 1
         for mws in self.adversary.step():
             self.dispatch_messages(mws.sender, [mws.tm])
         nid = self.adversary.pick_node(self.nodes)

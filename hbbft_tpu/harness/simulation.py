@@ -165,6 +165,28 @@ class SimNetwork:
             if node is not None:
                 node.add_message(arrival, sender_id, message, size)
 
+    # -- batched crypto prefetch (harness/batching.py) ---------------------
+
+    def queued_obligations(self) -> List[Any]:
+        """Scan every queued message for pending share verifications —
+        the batched-launch planning pass (SURVEY §5.8)."""
+        from .batching import crypto_obligations
+
+        obs: List[Any] = []
+        for node in self.nodes.values():
+            if node.dead:
+                continue
+            for _, _, sender_id, message, _ in node.in_queue:
+                obs.extend(crypto_obligations(node.algo, sender_id, message))
+        return obs
+
+    def prefetch_crypto(self, backend) -> None:
+        """Flush all currently-queued share verifications as one batch
+        into ``backend``'s cache.  Protocol decisions are bit-identical
+        to the inline path; the virtual-time stats then measure the
+        *accelerated* per-message cost (see ``harness/batching.py``)."""
+        backend.prefetch(self.queued_obligations())
+
     def step(self) -> Optional[Any]:
         """Advance the node with the earliest next event by one message."""
         candidates = [
@@ -297,9 +319,14 @@ def simulate_queueing_honey_badger(
     seen_outputs: Dict[Any, int] = {n.id: 0 for n in net.live_nodes()}
     if verbose:
         print(stats.header())
+    # Batching backends get a prefetch pass every ~N steps: one fused
+    # device launch covers the round's queued share verifications.
+    prefetch_every = num_nodes if ops is not None and hasattr(ops, "prefetch") else 0
     wall_start = _time.perf_counter()
     steps = 0
     while True:
+        if prefetch_every and steps % prefetch_every == 0:
+            net.prefetch_crypto(ops)
         nid = net.step()
         if nid is None:
             break

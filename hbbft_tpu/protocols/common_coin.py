@@ -82,7 +82,7 @@ class CommonCoin(DistAlgorithm):
         if sender_id in self.received_shares:
             return Step()
         try:
-            ok = pk_share.verify_signature_share(share, self.nonce)
+            ok = self.netinfo.ops.verify_sig_share(pk_share, share, self.nonce)
         except Exception:
             ok = False
         if not ok:

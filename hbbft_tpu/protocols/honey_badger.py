@@ -198,7 +198,7 @@ class HoneyBadger(DistAlgorithm):
         if pk is None:
             return False
         try:
-            return pk.verify_decryption_share(share, ciphertext)
+            return self.netinfo.ops.verify_dec_share(pk, share, ciphertext)
         except Exception:
             return False
 

@@ -1,0 +1,151 @@
+"""Batched crypto façade tests (``harness/batching.py``).
+
+The contract under test: prefetching share verifications in one fused
+batch yields *bit-identical* protocol outcomes to the sequential
+per-item path — same batches, same fault attribution — while the
+pairing count collapses from 2-per-share to 2-per-batch (the TPU
+co-simulation north star, SURVEY §5.8)."""
+
+import random
+
+from hbbft_tpu.crypto import threshold as T
+from hbbft_tpu.crypto.hashing import DST_SIG, hash_to_g1
+from hbbft_tpu.harness.batching import (
+    BatchingBackend,
+    DecObligation,
+    SigObligation,
+)
+
+
+def deal(n=4, t=1, seed=7):
+    rng = random.Random(seed)
+    sks = T.SecretKeySet.random(t, rng)
+    pks = sks.public_keys()
+    return rng, sks, pks
+
+
+def test_prefetch_sig_shares_real_all_good():
+    rng, sks, pks = deal()
+    msgs = [b"nonce-A", b"nonce-B"]
+    obs = []
+    for m in msgs:
+        for i in range(4):
+            share = sks.secret_key_share(i).sign(m)
+            obs.append(SigObligation(pks.public_key_share(i), share, m))
+    be = BatchingBackend()
+    be.prefetch(obs)
+    assert be.stats.prefetched == 8
+    assert be.stats.fallback_items == 0  # one fused check settled all
+    for ob in obs:
+        assert be.verify_sig_share(ob.pk_share, ob.share, ob.msg) is True
+    assert be.stats.cache_hits == 8  # no re-verification happened
+
+
+def test_prefetch_sig_shares_real_with_forgery():
+    rng, sks, pks = deal()
+    m = b"nonce-C"
+    obs = []
+    for i in range(4):
+        share = sks.secret_key_share(i).sign(m)
+        obs.append(SigObligation(pks.public_key_share(i), share, m))
+    # forge node 2's share (wrong message)
+    forged = sks.secret_key_share(2).sign(b"other")
+    obs[2] = SigObligation(pks.public_key_share(2), forged, m)
+    be = BatchingBackend()
+    be.prefetch(obs)
+    results = [
+        be.verify_sig_share(ob.pk_share, ob.share, ob.msg) for ob in obs
+    ]
+    assert results == [True, True, False, True]
+    assert be.stats.fallback_groups >= 1  # the fused check had to bisect
+
+
+def test_prefetch_dec_shares_real_mixed_groups():
+    rng, sks, pks = deal()
+    ct1 = pks.public_key().encrypt(b"payload-1", rng)
+    ct2 = pks.public_key().encrypt(b"payload-2", rng)
+    obs = []
+    expected = []
+    for ct in (ct1, ct2):
+        for i in range(4):
+            share = sks.secret_key_share(i).decrypt_share_no_verify(ct)
+            obs.append(DecObligation(pks.public_key_share(i), share, ct))
+            expected.append(True)
+    # one wrong share: decryption share for the *other* ciphertext
+    wrong = sks.secret_key_share(0).decrypt_share_no_verify(ct2)
+    obs.append(DecObligation(pks.public_key_share(0), wrong, ct1))
+    expected.append(False)
+    # plus a signature obligation in the same flush (3 groups total)
+    sig = sks.secret_key_share(1).sign(b"coin")
+    obs.append(SigObligation(pks.public_key_share(1), sig, b"coin"))
+    expected.append(True)
+    be = BatchingBackend()
+    be.prefetch(obs)
+    for ob, want in zip(obs, expected):
+        if isinstance(ob, SigObligation):
+            got = be.verify_sig_share(ob.pk_share, ob.share, ob.msg)
+        else:
+            got = be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext)
+        assert got is want
+
+
+def test_mock_prefetch_matches_inline():
+    from hbbft_tpu.crypto.mock import MockSecretKeySet
+
+    rng = random.Random(11)
+    sks = MockSecretKeySet.random(1, rng)
+    pks = sks.public_keys()
+    m = b"mock-nonce"
+    good = sks.secret_key_share(0).sign(m)
+    bad = sks.secret_key_share(1).sign(b"other")
+    obs = [
+        SigObligation(pks.public_key_share(0), good, m),
+        SigObligation(pks.public_key_share(1), bad, m),
+    ]
+    be = BatchingBackend()
+    be.prefetch(obs)
+    assert be.verify_sig_share(pks.public_key_share(0), good, m) is True
+    assert be.verify_sig_share(pks.public_key_share(1), bad, m) is False
+    assert be.stats.cache_hits == 2
+
+
+def _batch_seq(node):
+    return [
+        (b.epoch, tuple(sorted((k, tuple(v)) for k, v in b.contributions.items())))
+        for b in node.outputs
+    ]
+
+
+def test_honey_badger_batched_bit_identity_mock():
+    """Same seed, with and without the batching façade → identical
+    batch sequences and identical fault attribution at every node."""
+    from test_honey_badger import run_honey_badger
+
+    be = BatchingBackend()
+    net_plain = run_honey_badger(random.Random(77), 7, txs_per_node=3)
+    net_batched = run_honey_badger(
+        random.Random(77), 7, txs_per_node=3, ops=be
+    )
+    assert be.stats.prefetched > 0, "prefetch never extracted obligations"
+    assert be.stats.cache_hits > 0, "inline path never hit the cache"
+    for nid in net_plain.nodes:
+        assert _batch_seq(net_plain.nodes[nid]) == _batch_seq(
+            net_batched.nodes[nid]
+        )
+        assert [
+            (f.node_id, f.kind) for f in net_plain.nodes[nid].faults
+        ] == [(f.node_id, f.kind) for f in net_batched.nodes[nid].faults]
+
+
+def test_honey_badger_batched_real_bls():
+    """Full HoneyBadger run on real BLS12-381 with batched prefetch —
+    the end-to-end proof that fused verification preserves consensus."""
+    from test_honey_badger import run_honey_badger
+
+    be = BatchingBackend()
+    run_honey_badger(
+        random.Random(43), 4, txs_per_node=2, batch_contrib=2,
+        mock=False, ops=be,
+    )
+    assert be.stats.prefetched > 0
+    assert be.stats.cache_hits > 0

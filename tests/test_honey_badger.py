@@ -84,6 +84,7 @@ def run_honey_badger(
     adversary_factory=None,
     mock=True,
     max_batches=50,
+    ops=None,
 ):
     f = (size - 1) // 3
     good = size - f
@@ -93,7 +94,7 @@ def run_honey_badger(
         )
     net = TestNetwork(
         good, f, adversary_factory, lambda ni: new_hb(ni), rng,
-        mock_crypto=mock,
+        mock_crypto=mock, ops=ops,
     )
     # per-node transaction queues
     queues = {
