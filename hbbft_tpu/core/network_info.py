@@ -171,6 +171,22 @@ class NetworkInfo(Generic[N]):
             ops=self.ops,
         )
 
+    # -- checkpointing -----------------------------------------------------
+    # The ops backend is a process-local resource (it may hold compiled
+    # device executables); snapshots carry only the plain-data state and
+    # the backend is re-injected on restore (harness/checkpoint.py).
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("ops", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        from ..crypto.backend import restore_backend
+
+        self.ops = restore_backend()
+
     def __repr__(self) -> str:
         return (
             f"NetworkInfo(our_id={self._our_id!r}, n={self.num_nodes}, "

@@ -17,7 +17,7 @@ Three implementations:
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 from .curve import G1, G2, g1_multi_exp, g2_multi_exp
 from .hashing import sha256
@@ -89,3 +89,35 @@ _DEFAULT = CpuBackend()
 
 def default_backend() -> CpuBackend:
     return _DEFAULT
+
+
+# -- checkpoint restore hook -------------------------------------------------
+# Snapshots never serialize a backend (it may hold compiled device
+# executables); ``harness/checkpoint.py`` sets this override while
+# unpickling so restored ``NetworkInfo`` objects rebind to the caller's
+# backend of choice.
+
+_RESTORE_OPS: Any = None
+
+
+def restore_backend() -> Any:
+    return _RESTORE_OPS if _RESTORE_OPS is not None else _DEFAULT
+
+
+class restore_ops:
+    """Context manager: backend to inject into NetworkInfo instances
+    restored from a checkpoint within the scope."""
+
+    def __init__(self, ops):
+        self.ops = ops
+
+    def __enter__(self):
+        global _RESTORE_OPS
+        self._prev = _RESTORE_OPS
+        _RESTORE_OPS = self.ops
+        return self
+
+    def __exit__(self, *exc):
+        global _RESTORE_OPS
+        _RESTORE_OPS = self._prev
+        return False

@@ -120,8 +120,12 @@ class BatchingBackend:
 
     def __getattr__(self, name):
         # everything not overridden (rs_codec, merkle_tree, msm, ...)
-        # routes to the wrapped backend
-        return getattr(self.inner, name)
+        # routes to the wrapped backend; guard against lookups during
+        # unpickling, before ``inner`` exists
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
 
     # -- generational cache ------------------------------------------------
 

@@ -1,0 +1,72 @@
+"""Checkpoint / resume — full-state snapshots of nodes and networks.
+
+The reference has no disk persistence; its nearest mechanism is the
+``JoinPlan`` (``dynamic_honey_badger/mod.rs:136-145``), a *partial*
+snapshot that lets an observer join at an epoch boundary.  Because every
+algorithm in this framework is a sans-IO state machine over plain data
+(SURVEY §5.4), we generalize: the **entire** protocol state — a node's
+full algorithm tree (QueueingHoneyBadger down to every Broadcast /
+Agreement instance, queues, RNG state) or a whole simulated network —
+snapshots to bytes and restores to a bit-identical continuation.  This
+is first-class because long TPU co-simulation runs need mid-run
+save/resume.
+
+Two deliberate properties:
+
+- **Backends are never serialized.**  The ops backend may hold compiled
+  device executables; ``NetworkInfo.__getstate__`` strips it and restore
+  re-injects the caller's backend (``crypto.backend.restore_ops``), so a
+  checkpoint taken on a TPU host restores cleanly on a CPU-only host and
+  vice versa.
+- **Object sharing is preserved within one snapshot** (one ``dumps``):
+  all sub-protocol instances of a node share its ``NetworkInfo``; a
+  network snapshot keeps nodes' queues and the scheduler RNG consistent,
+  so a restored run continues *exactly* where the original left off
+  (asserted in ``tests/test_checkpoint.py``).
+
+Format: Python pickle (protocol 5).  Checkpoints are trusted local
+state — like any pickle, never load one from an untrusted source; the
+*wire* serialization for signed protocol messages remains the canonical
+codec in ``core/serialize.py``.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+from ..crypto.backend import restore_ops
+
+_PROTOCOL = 5
+
+
+def save(obj: Any) -> bytes:
+    """Snapshot any sans-IO state object (an algorithm instance, a
+    ``TestNetwork``, a ``SimNetwork``) to bytes."""
+    return pickle.dumps(obj, protocol=_PROTOCOL)
+
+
+def load(data: bytes, ops: Any = None) -> Any:
+    """Restore a snapshot.  ``ops``: the crypto backend to re-inject
+    into every restored ``NetworkInfo`` (default: the CPU backend)."""
+    with restore_ops(ops):
+        return pickle.loads(data)
+
+
+def save_file(obj: Any, path: str) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(obj, f, protocol=_PROTOCOL)
+
+
+def load_file(path: str, ops: Any = None) -> Any:
+    with restore_ops(ops):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+def clone(obj: Any, ops: Any = None) -> Any:
+    """Snapshot + restore in one step — a deep, backend-free copy.
+    Used by tests to fork a running network into two identical
+    continuations."""
+    return load(save(obj), ops=ops)

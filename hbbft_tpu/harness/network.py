@@ -276,6 +276,26 @@ class TestNetwork:
             # algorithm misbehaves we surface it rather than hide it
             assert not msgs_obs, "observer attempted to send messages"
 
+    # -- checkpointing -----------------------------------------------------
+    # Like NetworkInfo, the harness never serializes the ops backend;
+    # restore rebinds to the backend injected via
+    # ``crypto.backend.restore_ops`` (see harness/checkpoint.py).
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("ops", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        from ..crypto.backend import restore_backend
+
+        self.ops = restore_backend()
+        # recompute from the restored backend — prefetch capability is a
+        # property of the injected ops, not of the saved run
+        n = len(self.nodes) + len(self.adv_netinfos)
+        self.prefetch_every = n if hasattr(self.ops, "prefetch") else 0
+
     # -- batched crypto prefetch (harness/batching.py) ---------------------
 
     def prefetch_crypto(self) -> None:

@@ -1,0 +1,152 @@
+"""Checkpoint/resume tests (``harness/checkpoint.py``).
+
+Contract: a restored snapshot is a bit-identical continuation — same
+future batches, same fault attribution — and restores across backend
+boundaries (snapshot taken with one ops backend, resumed with another).
+"""
+
+import random
+
+from hbbft_tpu.harness import checkpoint as CK
+from hbbft_tpu.harness.batching import BatchingBackend
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.harness.simulation import simulate_queueing_honey_badger
+from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.protocols.honey_badger import HoneyBadger
+
+
+def _mk_hb_net(seed, ops=None):
+    rng = random.Random(seed)
+    net = TestNetwork(
+        5,
+        1,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        lambda ni: HoneyBadger(ni, rng=random.Random(f"{ni.our_id}-ck")),
+        rng,
+        ops=ops,
+    )
+    return net
+
+
+def _outputs(net):
+    return {
+        nid: [
+            (b.epoch, tuple(sorted((k, tuple(v)) for k, v in b.contributions.items())))
+            for b in node.outputs
+        ]
+        for nid, node in net.nodes.items()
+    }
+
+
+def test_fork_mid_run_identical_continuation():
+    """Run HoneyBadger halfway, snapshot the whole network, continue
+    the original and the restored copy — identical batch sequences."""
+    net = _mk_hb_net(90)
+    for nid in sorted(net.nodes):
+        net.input(nid, [b"ck-%d" % nid])
+    for _ in range(40):
+        if net.any_busy():
+            net.step()
+    forked = CK.clone(net)
+
+    def finish(n):
+        guard = 0
+        while n.any_busy() and guard < 20_000:
+            n.step()
+            guard += 1
+        return _outputs(n)
+
+    out_a = finish(net)
+    out_b = finish(forked)
+    assert out_a == out_b
+    assert any(len(s) > 0 for s in out_a.values())
+
+
+def test_restore_rebinds_backend():
+    """A snapshot never carries an ops backend; restore injects the
+    caller's choice."""
+    be = BatchingBackend()
+    net = _mk_hb_net(91, ops=be)
+    for nid in sorted(net.nodes):
+        net.input(nid, [b"x-%d" % nid])
+    for _ in range(10):
+        if net.any_busy():
+            net.step()
+    data = CK.save(net)
+    be2 = BatchingBackend()
+    restored = CK.load(data, ops=be2)
+    ni = restored.nodes[0].algo.netinfo
+    assert ni.ops is be2
+    # sub-instances share the rebound NetworkInfo
+    for cs in restored.nodes[0].algo.common_subsets.values():
+        assert cs.netinfo is ni
+    # default restore falls back to the CPU backend
+    restored_cpu = CK.load(data)
+    assert restored_cpu.nodes[0].algo.netinfo.ops.name == "cpu"
+
+
+def test_single_node_roundtrip_broadcast(rng):
+    """Node-level snapshot: a Broadcast instance mid-protocol restores
+    and finishes with the same output."""
+    net_rng = random.Random(92)
+    net = TestNetwork(
+        6,
+        2,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, net_rng)
+        ),
+        lambda ni: Broadcast(ni, 0),
+        net_rng,
+    )
+    payload = bytes(rng.randrange(256) for _ in range(512))
+    net.input(0, payload)
+    for _ in range(15):
+        if net.any_busy():
+            net.step()
+    # snapshot node 3's algorithm alone and swap it into the live network
+    node = net.nodes[3]
+    node.algo = CK.load(CK.save(node.algo))
+    net.step_until(lambda: all(n.terminated() for n in net.nodes.values()))
+    assert node.outputs == [payload]
+
+
+def test_simulation_network_roundtrip():
+    """A virtual-time SimNetwork snapshots and resumes to completion
+    (timing statistics are measured, so only protocol results are
+    asserted — all transactions commit on every live node)."""
+    import hbbft_tpu.harness.simulation as S
+
+    rng = random.Random(93)
+    txs = [b"sim-tx-%02d" % i for i in range(20)]
+
+    from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+
+    def new_algo(netinfo):
+        node_rng = random.Random(f"ckpt-{netinfo.our_id}")
+        dhb = DynamicHoneyBadger(netinfo, rng=node_rng)
+        return (
+            QueueingHoneyBadger.builder(dhb)
+            .batch_size(10)
+            .rng(node_rng)
+            .build_with_transactions(list(txs))
+        )
+
+    net = S.SimNetwork(4, 0, new_algo, S.HwQuality(), rng, mock_crypto=True)
+    for _ in range(60):
+        if net.step() is None:
+            break
+    net = CK.load(CK.save(net))  # mid-run snapshot + restore
+    guard = 0
+    while net.step() is not None and guard < 200_000:
+        guard += 1
+    want = set(txs)
+    for node in net.live_nodes():
+        got = {tx for _, b in node.outputs for tx in b.tx_iter()}
+        assert got >= want
