@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""A real TCP consensus node running Reliable Broadcast.
+
+Re-design of the reference's ``examples/consensus-node.rs`` (71 LoC +
+its ``examples/network/`` transport): every process binds an address,
+connects to its peers, and the node whose address sorts *first* among
+all participants proposes ``--value``; every node prints the agreed
+value.  Node identity is the socket address; placeholder (INSECURE)
+keys are derived deterministically from the sorted address list, as in
+the reference (``node.rs:105-118``).
+
+Example — three shells:
+
+    python examples/consensus_node.py --bind-address=127.0.0.1:5000 \
+        --remote-address=127.0.0.1:5001 --remote-address=127.0.0.1:5002 \
+        --value=foo
+    python examples/consensus_node.py --bind-address=127.0.0.1:5001 \
+        --remote-address=127.0.0.1:5000 --remote-address=127.0.0.1:5002
+    python examples/consensus_node.py --bind-address=127.0.0.1:5002 \
+        --remote-address=127.0.0.1:5000 --remote-address=127.0.0.1:5001
+"""
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.transport.tcp import TcpNode
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--bind-address", required=True, metavar="HOST:PORT")
+    p.add_argument(
+        "--remote-address",
+        action="append",
+        default=[],
+        metavar="HOST:PORT",
+        help="peer address (repeat once per peer)",
+    )
+    p.add_argument("--value", default=None, help="value to propose")
+    args = p.parse_args()
+
+    addrs = sorted(set(args.remote_address) | {args.bind_address})
+    proposer = addrs[0]
+    node = TcpNode(
+        args.bind_address,
+        args.remote_address,
+        lambda ni: Broadcast(ni, proposer),
+    )
+    print(f"[{args.bind_address}] connecting to {len(node.peer_addrs)} peers...")
+    await node.start()
+    print(f"[{args.bind_address}] mesh up; proposer is {proposer}")
+    if args.bind_address == proposer:
+        if args.value is None:
+            p.error("this node is the proposer; --value is required")
+        await node.input(args.value.encode())
+    outputs = await node.run(timeout=60.0)
+    print(f"[{args.bind_address}] agreed value: {outputs[0]!r}")
+    await node.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

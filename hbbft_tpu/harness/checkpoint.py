@@ -32,7 +32,6 @@ codec in ``core/serialize.py``.
 
 from __future__ import annotations
 
-import io
 import pickle
 from typing import Any, Optional
 

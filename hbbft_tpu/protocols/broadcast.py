@@ -79,6 +79,22 @@ class Broadcast(DistAlgorithm):
         self.echos: Dict[Any, MerkleProof] = {}
         self.readys: Dict[Any, bytes] = {}
 
+    # -- checkpointing -----------------------------------------------------
+    # The codec is derived from the ops backend (it may wrap device
+    # executables); snapshots carry only the shard counts and restore
+    # rebuilds it from the re-injected backend (harness/checkpoint.py).
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("coding", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.coding = self.netinfo.ops.rs_codec(
+            self.data_shard_num, self.parity_shard_num
+        )
+
     # -- DistAlgorithm -----------------------------------------------------
 
     def handle_input(self, value: bytes) -> Step:

@@ -1,0 +1,3 @@
+"""Real-network transports for the sans-IO protocol stack."""
+
+from .tcp import TcpNode, generate_keys_for  # noqa: F401

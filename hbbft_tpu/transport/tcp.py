@@ -1,0 +1,241 @@
+"""asyncio TCP transport — running the protocols over real sockets.
+
+Re-design of the reference's example transport
+(``examples/network/{connection,commst,messaging,node}.rs``, 528 LoC of
+thread-per-connection Rust): same capabilities, idiomatic asyncio.
+
+Design kept from the reference:
+
+- **Node identity = socket address**, and the validator set is the
+  *sorted* address list, so every node derives the identical set without
+  coordination (``connection.rs:20-47``).
+- **Deterministic connect/accept split**: for each peer pair, the
+  lexicographically *smaller* address dials and the larger accepts —
+  exactly one connection per pair, no tie-breaking races.
+- **Routing hub**: the algorithm's ``Step.messages`` are routed by
+  ``Target.{all,to}`` onto per-peer links (``messaging.rs:89-148``).
+
+Deviations (deliberate):
+
+- Frames are length-prefixed (4-byte big-endian) canonical-codec bytes
+  (``core/serialize.py``) — the reference streams length-free bincode,
+  which cannot resynchronize after a bad frame.
+- One event loop replaces the reference's thread-per-connection +
+  crossbeam channel mesh; the algorithm remains single-threaded by
+  construction, matching the library's sans-IO contract.
+
+The reference example runs a single ``Broadcast`` with placeholder keys
+(``node.rs:105-118``); :func:`generate_keys_for` reproduces that spirit:
+each node independently deals the *same* deterministic (INSECURE) key
+set from the sorted address list.  Production deployments bootstrap real
+keys via the dealerless DKG (``protocols/sync_key_gen.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.network_info import NetworkInfo
+from ..core.serialize import SerializationError, dumps, loads
+from ..core.step import Step
+
+_LEN_BYTES = 4
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+def generate_keys_for(addresses: List[str], our_addr: str) -> NetworkInfo:
+    """Placeholder key dealing (INSECURE — demo/test only, like the
+    reference's placeholder keys): every node derives the identical
+    mock key set deterministically from the sorted address list."""
+    ids = sorted(addresses)
+    rng = random.Random("hbbft_tpu-tcp|" + "|".join(ids))
+    netinfos = NetworkInfo.generate_map(ids, rng, mock=True)
+    return netinfos[our_addr]
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN_BYTES)
+    length = int.from_bytes(header, "big")
+    if length > _MAX_FRAME:
+        raise ConnectionError(f"oversized frame: {length} bytes")
+    return loads(await reader.readexactly(length))
+
+
+def _frame(message: Any) -> bytes:
+    payload = dumps(message)
+    return len(payload).to_bytes(_LEN_BYTES, "big") + payload
+
+
+class TcpNode:
+    """One consensus node: an algorithm instance wired to its peers over
+    TCP (reference ``Node::run``, ``node.rs:60-137``)."""
+
+    def __init__(
+        self,
+        our_addr: str,
+        peer_addrs: List[str],
+        new_algo: Callable[[NetworkInfo], Any],
+        netinfo: Optional[NetworkInfo] = None,
+        dial_retries: int = 50,
+    ):
+        self.our_addr = our_addr
+        self.dial_retries = dial_retries
+        self.peer_addrs = sorted(set(peer_addrs) - {our_addr})
+        self.all_addrs = sorted(self.peer_addrs + [our_addr])
+        self.netinfo = netinfo or generate_keys_for(self.all_addrs, our_addr)
+        self.algo = new_algo(self.netinfo)
+        self.outputs: List[Any] = []
+        self.faults: List[Any] = []
+        self._writers: Dict[str, asyncio.StreamWriter] = {}
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._tasks: List[asyncio.Task] = []
+        self._connected = asyncio.Event()
+
+    # -- connection management --------------------------------------------
+
+    async def start(self) -> None:
+        """Bind our listener, dial every larger-address peer (the
+        smaller address always dials — one connection per pair), and
+        block until the full mesh is up."""
+        host, port = self.our_addr.rsplit(":", 1)
+        self._server = await asyncio.start_server(
+            self._on_accept, host, int(port)
+        )
+        # we dial every peer with a larger address; they dial us
+        for peer in self.peer_addrs:
+            if self.our_addr < peer:
+                self._tasks.append(
+                    asyncio.ensure_future(self._dial(peer))
+                )
+        # wait for the mesh, surfacing dial failures instead of hanging
+        waiter = asyncio.ensure_future(self._connected.wait())
+        pending = set(self._tasks)
+        try:
+            while not self._connected.is_set():
+                done, _ = await asyncio.wait(
+                    {waiter} | pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    if t is waiter:
+                        continue
+                    pending.discard(t)
+                    exc = t.exception()
+                    if exc is not None:
+                        raise exc
+        finally:
+            if not waiter.done():
+                waiter.cancel()
+
+    async def _dial(self, peer: str) -> None:
+        host, port = peer.rsplit(":", 1)
+        for attempt in range(self.dial_retries):
+            try:
+                reader, writer = await asyncio.open_connection(host, int(port))
+                break
+            except OSError:
+                await asyncio.sleep(0.05 * (attempt + 1))
+        else:
+            raise ConnectionError(f"could not reach peer {peer}")
+        # handshake: announce our address so the acceptor learns who we are
+        writer.write(_frame(self.our_addr))
+        await writer.drain()
+        self._register(peer, writer)
+        await self._recv_loop(peer, reader)
+
+    async def _on_accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            peer = await _read_frame(reader)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            SerializationError,
+        ):
+            writer.close()
+            return
+        if peer not in self.peer_addrs:
+            writer.close()
+            return
+        self._register(peer, writer)
+        await self._recv_loop(peer, reader)
+
+    def _register(self, peer: str, writer: asyncio.StreamWriter) -> None:
+        self._writers[peer] = writer
+        if len(self._writers) == len(self.peer_addrs):
+            self._connected.set()
+
+    async def _recv_loop(self, peer: str, reader: asyncio.StreamReader) -> None:
+        while True:
+            try:
+                message = await _read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                return  # peer closed; the protocol tolerates f silent nodes
+            except SerializationError:
+                continue  # malformed frame: drop it, the length-prefixed
+                # stream stays aligned on the next frame
+            await self._inbox.put((peer, message))
+
+    # -- the protocol pump --------------------------------------------------
+
+    async def _route(self, step: Step) -> None:
+        self.outputs.extend(step.output)
+        self.faults.extend(step.fault_log)
+        touched = []
+        for tm in step.messages:
+            if tm.target.is_all:
+                targets = self.peer_addrs
+            else:
+                targets = [tm.target.node] if tm.target.node != self.our_addr else []
+            frame = _frame(tm.message)
+            for peer in targets:
+                w = self._writers.get(peer)
+                if w is not None:
+                    w.write(frame)
+                    touched.append(w)
+        for w in touched:
+            try:
+                await w.drain()
+            except (ConnectionError, OSError):
+                pass
+
+    async def input(self, value: Any) -> None:
+        await self._route(self.algo.handle_input(value))
+
+    async def run(
+        self,
+        until: Optional[Callable[["TcpNode"], bool]] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Any]:
+        """Pump messages until ``until(self)`` (default: the algorithm
+        terminates).  Returns the collected outputs."""
+        done = until or (lambda node: node.algo.terminated())
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout if timeout is not None else None
+        while not done(self):
+            get = self._inbox.get()
+            if deadline is not None:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError("consensus did not finish")
+                sender, message = await asyncio.wait_for(get, remaining)
+            else:
+                sender, message = await get
+            try:
+                step = self.algo.handle_message(sender, message)
+            except Exception:
+                continue  # Byzantine garbage from a real socket: drop
+            await self._route(step)
+        return self.outputs
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for w in self._writers.values():
+            w.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

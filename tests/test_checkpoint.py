@@ -116,6 +116,39 @@ def test_single_node_roundtrip_broadcast(rng):
     assert node.outputs == [payload]
 
 
+def test_device_codec_stripped_from_snapshot():
+    """A Broadcast running on the TPU ops backend snapshots without the
+    device codec and restores onto the CPU backend (cross-host
+    portability of checkpoints)."""
+    from hbbft_tpu.ops.backend_tpu import TpuBackend
+    from hbbft_tpu.crypto.rs import ReedSolomon
+
+    net_rng = random.Random(94)
+    net = TestNetwork(
+        6,
+        2,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, net_rng)
+        ),
+        lambda ni: Broadcast(ni, 0),
+        net_rng,
+        ops=TpuBackend(),
+    )
+    payload = bytes(random.Random(95).randrange(256) for _ in range(2048))
+    net.input(0, payload)
+    for _ in range(10):
+        if net.any_busy():
+            net.step()
+    restored = CK.load(CK.save(net))  # default restore: CPU backend
+    for node in restored.nodes.values():
+        assert isinstance(node.algo.coding, ReedSolomon)
+    guard = 0
+    while restored.any_busy() and guard < 20_000:
+        restored.step()
+        guard += 1
+    assert all(n.outputs == [payload] for n in restored.nodes.values())
+
+
 def test_simulation_network_roundtrip():
     """A virtual-time SimNetwork snapshots and resumes to completion
     (timing statistics are measured, so only protocol results are
