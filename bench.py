@@ -1,80 +1,313 @@
-"""Headline benchmark: batched threshold-share verification throughput.
+"""Benchmarks — headline + the BASELINE.md measurement suite.
 
-The reference's per-epoch hot loop is N² BLS share verifications
-(``honey_badger.rs:422-444``: N proposers × N senders) plus combines —
-each a 2-pairing check in the ``threshold_crypto`` crate.  This bench
-measures our replacement: the random-linear-combination batch verify
-whose MSMs run as device kernels (``ops/ec_jax.py``) with exactly two
-pairings per *batch* (host-side).
+Default (no args): prints ONE JSON line, the driver contract —
+batched threshold-share verification throughput on the device backend:
 
-Prints ONE JSON line:
   {"metric": "share_verify_throughput", "value": <shares/sec>,
    "unit": "shares/s", "vs_baseline": <speedup over per-share CPU path>}
 
-vs_baseline compares against the sequential CPU reference path
-(per-share 2-pairing checks, the faithful stand-in for the reference's
-crate loop) measured on a sample in the same process.
+The reference's per-epoch hot loop is N² BLS share verifications
+(``honey_badger.rs:422-444``: N proposers × N senders) plus combines —
+each a 2-pairing check in the ``threshold_crypto`` crate.  The headline
+measures our replacement: the random-linear-combination batch verify
+whose MSMs run as device kernels (``ops/ec_jax.py``) with exactly two
+pairings per *batch* (host-side, native C++).  vs_baseline compares
+against the sequential per-share path (2 pairings each on the native
+C++ host backend — the faithful stand-in for the reference's Rust
+crate loop), measured on a sample in the same process.
+
+``--suite`` additionally runs the BASELINE.md measurement configs
+(SURVEY §6), one JSON line each:
+
+  1. sim_default   — reference simulation defaults (n=10, 1000 txs)
+  2. sim_batched   — same with the batched-prefetch façade
+  3. coin64        — 64-node CommonCoin flip, real BLS, batched
+  4. broadcast_1mb — 1 MB reliable broadcast (RS + Merkle hot path)
+  5. decshares     — batched decryption-share verify throughput
+  6. qhb_scale     — QueueingHoneyBadger co-simulation scaling
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import random
 import time
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+def _emit(metric, value, unit, vs_baseline=None, **extra):
+    row = {"metric": metric, "value": round(value, 3), "unit": unit}
+    if vs_baseline is not None:
+        row["vs_baseline"] = round(vs_baseline, 3)
+    row.update(extra)
+    print(json.dumps(row), flush=True)
+    return row
 
-    from hbbft_tpu.crypto.curve import G1_GEN, G2_GEN
+
+# ---------------------------------------------------------------------------
+# Headline: batched share verification on the device backend
+# ---------------------------------------------------------------------------
+
+
+def bench_headline(k: int = 128, iters: int = 3):
+    from hbbft_tpu.crypto.curve import G2_GEN
     from hbbft_tpu.crypto.hashing import hash_to_g1
-    from hbbft_tpu.crypto import threshold as T
-    from hbbft_tpu.ops import ec_jax, limbs as LB
+    from hbbft_tpu.crypto.threshold import PublicKeyShare, SignatureShare
+    from hbbft_tpu.ops import limbs as LB
     from hbbft_tpu.ops.backend_tpu import TpuBackend
 
     rng = random.Random(0xBEEF)
-    K = 128  # shares per batch (≈ one 128-validator epoch row)
-
     base = hash_to_g1(b"bench-epoch-nonce")
-    sks = [rng.randrange(1, LB.R) for _ in range(K)]
+    sks = [rng.randrange(1, LB.R) for _ in range(k)]
     shares = [base * sk for sk in sks]
     pks = [G2_GEN * sk for sk in sks]
 
     be = TpuBackend()
-
-    # -- device path: RLC batch verify (2 pairings total) -----------------
-    ok = be.batch_verify_shares(shares, pks, base, b"warmup")  # compile
-    assert ok
-    iters = 3
+    assert be.batch_verify_shares(shares, pks, base, b"warmup")  # compile
     t0 = time.perf_counter()
     for i in range(iters):
         assert be.batch_verify_shares(shares, pks, base, b"ctx%d" % i)
     dt = (time.perf_counter() - t0) / iters
-    device_rate = K / dt
+    device_rate = k / dt
 
-    # -- baseline: per-share pairing checks (CPU reference path) ----------
-    sample = 4
+    sample = 8
     t0 = time.perf_counter()
-    from hbbft_tpu.crypto.threshold import PublicKeyShare, SignatureShare
-
     for i in range(sample):
         assert PublicKeyShare(pks[i]).verify_signature_share_g1(
             SignatureShare(shares[i]), base
         )
-    cpu_per_share = (time.perf_counter() - t0) / sample
-    cpu_rate = 1.0 / cpu_per_share
-
-    print(
-        json.dumps(
-            {
-                "metric": "share_verify_throughput",
-                "value": round(device_rate, 2),
-                "unit": "shares/s",
-                "vs_baseline": round(device_rate / cpu_rate, 2),
-            }
-        )
+    cpu_rate = sample / (time.perf_counter() - t0)
+    return _emit(
+        "share_verify_throughput",
+        device_rate,
+        "shares/s",
+        vs_baseline=device_rate / cpu_rate,
     )
+
+
+# ---------------------------------------------------------------------------
+# Suite configs (BASELINE.md / SURVEY §6)
+# ---------------------------------------------------------------------------
+
+
+def bench_sim_default(batched: bool = False):
+    """Config 1: the reference simulation defaults
+    (``examples/simulation.rs:33-52``)."""
+    from hbbft_tpu.harness.batching import BatchingBackend
+    from hbbft_tpu.harness.simulation import simulate_queueing_honey_badger
+
+    ops = BatchingBackend() if batched else None
+    stats, wall, sim = simulate_queueing_honey_badger(
+        num_nodes=10,
+        num_txs=1000,
+        batch_size=100,
+        rng=random.Random(0),
+        ops=ops,
+    )
+    epochs = len(stats.rows)
+    return _emit(
+        "sim_batched_epochs_per_s" if batched else "sim_default_epochs_per_s",
+        epochs / wall,
+        "epochs/s",
+        epochs=epochs,
+        wall_s=round(wall, 2),
+        sim_s=round(sim, 2),
+    )
+
+
+def bench_coin64(flips: int = 3, nodes: int = 64):
+    """Config 2: 64-node common coin on real BLS12-381.  The batched
+    path amortizes the network-wide N² share verifies into prefetch
+    flushes; the baseline is the same run without the façade."""
+    from hbbft_tpu.harness.batching import BatchingBackend
+    from hbbft_tpu.harness.network import (
+        MessageScheduler,
+        SilentAdversary,
+        TestNetwork,
+    )
+    from hbbft_tpu.protocols.common_coin import CommonCoin
+
+    def one_flip(nonce, ops):
+        """Returns seconds for the flip itself (network construction /
+        key dealing excluded — it is identical for both paths)."""
+        rng = random.Random(nonce)
+        net = TestNetwork(
+            nodes,
+            0,
+            lambda adv: SilentAdversary(
+                MessageScheduler(MessageScheduler.RANDOM, rng)
+            ),
+            lambda ni: CommonCoin(ni, nonce),
+            rng,
+            mock_crypto=False,
+            ops=ops,
+        )
+        t0 = time.perf_counter()
+        net.input_all(None)
+        net.step_until(
+            lambda: all(n.terminated() for n in net.nodes.values())
+        )
+        dt = time.perf_counter() - t0
+        vals = {n.outputs[0] for n in net.nodes.values()}
+        assert len(vals) == 1, "coin values diverged"
+        return dt
+
+    be = BatchingBackend()
+    batched_dt = sum(
+        one_flip(b"bench-flip-%d" % i, be) for i in range(flips)
+    ) / flips
+    base_dt = one_flip(b"bench-flip-base", None)
+    return _emit(
+        "coin64_flips_per_s",
+        1.0 / batched_dt,
+        "flips/s",
+        vs_baseline=base_dt / batched_dt,
+        seq_s_per_flip=round(base_dt, 2),
+    )
+
+
+def bench_broadcast_1mb(nodes: int = 64):
+    """Config 3: 1 MB payload reliable broadcast (RS encode/decode +
+    Merkle build/verify dominate; reference ``broadcast.rs:332-404``)."""
+    from hbbft_tpu.harness.network import (
+        MessageScheduler,
+        SilentAdversary,
+        TestNetwork,
+    )
+    from hbbft_tpu.protocols.broadcast import Broadcast
+
+    rng = random.Random(0xB0)
+    payload = bytes(rng.randrange(256) for _ in range(1 << 20))
+    net = TestNetwork(
+        nodes - (nodes - 1) // 3,
+        (nodes - 1) // 3,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        lambda ni: Broadcast(ni, 0),
+        rng,
+    )
+    t0 = time.perf_counter()
+    net.input(0, payload)
+    net.step_until(lambda: all(n.terminated() for n in net.nodes.values()))
+    dt = time.perf_counter() - t0
+    assert all(n.outputs == [payload] for n in net.nodes.values())
+    return _emit(
+        "broadcast_1mb_s", dt, "s", nodes=nodes
+    )
+
+
+def bench_decshares(k: int = 1024):
+    """Config 4 (crypto plane): batched decryption-share verification —
+    the single hottest surface (N² per HoneyBadger epoch).  One
+    BatchingBackend flush of k real shares vs the per-share path."""
+    from hbbft_tpu.crypto import threshold as T
+    from hbbft_tpu.harness.batching import BatchingBackend, DecObligation
+    from hbbft_tpu.ops.backend_tpu import TpuBackend
+
+    rng = random.Random(0xD5)
+    t = 3
+    sks = T.SecretKeySet.random(t, rng)
+    pks = sks.public_keys()
+    n_nodes = 64
+
+    def make_obs(tag):
+        """k obligations over distinct ciphertexts (distinct groups
+        stress the multi-pairing path the way real epochs do)."""
+        cts = [
+            pks.public_key().encrypt(tag + b"%d" % g, rng)
+            for g in range(k // n_nodes)
+        ]
+        return [
+            DecObligation(
+                pks.public_key_share(i),
+                sks.secret_key_share(i).decrypt_share_no_verify(c),
+                c,
+            )
+            for c in cts
+            for i in range(n_nodes)
+        ]
+
+    be = BatchingBackend(inner=TpuBackend())
+    be.prefetch(make_obs(b"warm"))  # same shapes as the timed flush
+    obs = make_obs(b"c")
+    be2 = BatchingBackend(inner=TpuBackend())
+    t0 = time.perf_counter()
+    be2.prefetch(obs)
+    dt = time.perf_counter() - t0
+    assert all(
+        be2.verify_dec_share(o.pk_share, o.share, o.ciphertext) for o in obs
+    )
+
+    sample = 8
+    t0 = time.perf_counter()
+    for o in obs[:sample]:
+        assert o.pk_share.verify_decryption_share(o.share, o.ciphertext)
+    cpu_rate = sample / (time.perf_counter() - t0)
+    rate = len(obs) / dt
+    return _emit(
+        "decshare_verify_throughput",
+        rate,
+        "shares/s",
+        vs_baseline=rate / cpu_rate,
+        batch=len(obs),
+        groups=k // n_nodes,
+    )
+
+
+def bench_qhb_scale(nodes: int = 32, txs: int = 320, batch: int = 64):
+    """Config 5 proxy: QueueingHoneyBadger co-simulation throughput at
+    growing N (the full-stack protocol-plane cost, mock crypto)."""
+    from hbbft_tpu.harness.batching import BatchingBackend
+    from hbbft_tpu.harness.simulation import simulate_queueing_honey_badger
+
+    stats, wall, _ = simulate_queueing_honey_badger(
+        num_nodes=nodes,
+        num_txs=txs,
+        batch_size=batch,
+        rng=random.Random(3),
+        ops=BatchingBackend(),
+    )
+    return _emit(
+        "qhb_scale_epochs_per_s",
+        len(stats.rows) / wall,
+        "epochs/s",
+        nodes=nodes,
+        epochs=len(stats.rows),
+        wall_s=round(wall, 2),
+    )
+
+
+SUITE = {
+    "sim_default": lambda: bench_sim_default(batched=False),
+    "sim_batched": lambda: bench_sim_default(batched=True),
+    "coin64": bench_coin64,
+    "broadcast_1mb": bench_broadcast_1mb,
+    "decshares": bench_decshares,
+    "qhb_scale": bench_qhb_scale,
+}
+
+
+def main() -> None:
+    # the EC scan kernels are large XLA programs; cache compilations so
+    # repeated bench runs skip the multi-minute cold compile
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/hbbft_tpu_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--suite", action="store_true", help="run all configs")
+    p.add_argument("--config", choices=sorted(SUITE), help="run one config")
+    p.add_argument("--k", type=int, default=1024, help="headline batch size")
+    args = p.parse_args()
+    if args.config:
+        SUITE[args.config]()
+    elif args.suite:
+        for name in SUITE:
+            SUITE[name]()
+    else:
+        bench_headline(k=args.k)
 
 
 if __name__ == "__main__":

@@ -83,7 +83,12 @@ class MockCiphertext:
         return self.mac == _tag(b"CTMAC", self.seed_id, self.nonce, self.v)
 
     def to_bytes(self) -> bytes:
-        return dumps(self)
+        # memoized — the batching layer keys caches by these bytes
+        cached = getattr(self, "_bytes", None)
+        if cached is None:
+            cached = dumps(self)
+            object.__setattr__(self, "_bytes", cached)
+        return cached
 
 
 @wire("MockPublicKey")

@@ -114,7 +114,14 @@ class Ciphertext:
         return c2 == self.c
 
     def to_bytes(self) -> bytes:
-        return dumps(self)
+        # memoized: the batching layer keys caches by ciphertext bytes
+        # on every queued decryption share (frozen dataclass → side
+        # attribute)
+        cached = getattr(self, "_bytes", None)
+        if cached is None:
+            cached = dumps(self)
+            object.__setattr__(self, "_bytes", cached)
+        return cached
 
 
 # ---------------------------------------------------------------------------
@@ -330,6 +337,25 @@ def _rlc_coeffs(context: bytes, items: Sequence[bytes]) -> List[int]:
     ]
 
 
+def aggregate_by_point(points: Sequence, coeffs: Sequence[int]):
+    """Collapse duplicate points by summing their coefficients:
+    Σᵢ rᵢ·Pᵢ == Σ_distinct (Σ_{i: Pᵢ=P} rᵢ)·P.
+
+    A batch of one epoch's share verifications has K = N·N obligations
+    but only N distinct public keys (``honey_badger.rs:422-444``), so
+    this shrinks the expensive G2 MSM from K to ≤N points.  Sums are
+    *not* reduced mod r, keeping them ≤ ~128+log₂K bits so the device
+    MSM scan stays short (``ops/ec_jax._width``)."""
+    agg: Dict[bytes, int] = {}
+    first: Dict[bytes, Any] = {}
+    for p, c in zip(points, coeffs):
+        key = p.to_bytes()
+        agg[key] = agg.get(key, 0) + c
+        first.setdefault(key, p)
+    keys = list(agg)
+    return [first[k] for k in keys], [agg[k] for k in keys]
+
+
 def batch_verify_shares(
     shares: Sequence[G1],
     pks: Sequence[G2],
@@ -349,5 +375,6 @@ def batch_verify_shares(
         context, [s.to_bytes() for s in shares] + [p.to_bytes() for p in pks]
     )[: len(shares)]  # one rᵢ per (shareᵢ, pkᵢ) pair; Fiat–Shamir binds all inputs
     agg_share = g1_multi_exp(shares, coeffs)
-    agg_pk = g2_multi_exp(pks, coeffs)
+    u_pks, u_coeffs = aggregate_by_point(pks, coeffs)
+    agg_pk = g2_multi_exp(u_pks, u_coeffs)
     return pairing_check([(agg_share, G2_GEN), (-base, agg_pk)])

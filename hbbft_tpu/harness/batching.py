@@ -104,13 +104,19 @@ class BatchingBackend:
     seam later are never silently re-routed); protocol decisions are
     bit-identical to the wrapped backend's per-item checks.
 
-    The cache is generational: a flush rotates the previous generation
-    out, and entries untouched for two flush windows are dropped —
-    obligations are re-extracted from still-queued messages at every
-    flush, so nothing live is ever evicted, and a thousand-epoch
-    co-simulation cannot accumulate unbounded dead entries."""
+    The cache is generational and size-gated: once it exceeds
+    ``MAX_CACHE_ENTRIES``, the next flush rotates the old generation
+    out (touched entries are promoted), bounding a long co-simulation
+    at ~2× that many entries.  An entry evicted while its message still
+    waits in a queue merely costs one inline re-verification — results
+    are never wrong, only recomputed."""
 
     name = "batching"
+
+    # rotate generations only past this size: entries live at least
+    # until the flush window that extracted them has drained, and a
+    # long co-simulation stays bounded at ~2× this many entries
+    MAX_CACHE_ENTRIES = 1 << 18
 
     def __init__(self, inner=None):
         self.inner = inner if inner is not None else default_backend()
@@ -178,7 +184,8 @@ class BatchingBackend:
         the cache.  Real-BLS items go through the product-pairing path;
         anything else (mock crypto, malformed shares) is verified
         per-item exactly as the inline path would."""
-        self._rotate_cache()
+        if len(self._cache) > self.MAX_CACHE_ENTRIES:
+            self._rotate_cache()
         real: List[Tuple[Any, Any]] = []  # (cache_key, obligation)
         other: List[Tuple[Any, Any]] = []
         seen = set()
@@ -269,7 +276,8 @@ class BatchingBackend:
                     g_pks.append(ob.pk_share.point)
                     g_coeffs.append(coeffs[idx])
                     idx += 1
-                pairs.append((-base, self.g2_msm(g_pks, g_coeffs)))
+                u_pks, u_coeffs = T.aggregate_by_point(g_pks, g_coeffs)
+                pairs.append((-base, self.g2_msm(u_pks, u_coeffs)))
             agg_share = self.g1_msm(all_shares, all_coeffs)
             ok = pairing_check([(agg_share, G2_GEN)] + pairs)
         except Exception:

@@ -40,8 +40,16 @@ class TestNode:
         self.outputs: List[Any] = []
         self.messages: collections.deque = collections.deque()
         self.faults: List[Any] = []
+        # crypto obligations extracted at enqueue, drained by the
+        # batched prefetch (only populated under a batching backend)
+        self.pending_obs: List[Any] = []
         if initial_step is not None:
             self._absorb(initial_step)
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        # checkpoints from before the enqueue-time extraction change
+        self.__dict__.setdefault("pending_obs", [])
 
     def _absorb(self, step: Step) -> None:
         self.outputs.extend(step.output)
@@ -257,6 +265,7 @@ class TestNetwork:
                 for nid, node in self.nodes.items():
                     if nid != sender_id:
                         node.queue.append((sender_id, tm.message))
+                        self._note_obs(node, sender_id, tm.message)
                 self.observer.queue.append((sender_id, tm.message))
                 self.adversary.push_message(sender_id, tm)
             else:
@@ -264,7 +273,9 @@ class TestNetwork:
                 if to_id in self.adv_netinfos:
                     self.adversary.push_message(sender_id, tm)
                 elif to_id in self.nodes:
-                    self.nodes[to_id].queue.append((sender_id, tm.message))
+                    node = self.nodes[to_id]
+                    node.queue.append((sender_id, tm.message))
+                    self._note_obs(node, sender_id, tm.message)
                 elif to_id == self.OBSERVER_ID:
                     self.observer.queue.append((sender_id, tm.message))
                 # unknown recipients are dropped (reference warns only)
@@ -298,18 +309,25 @@ class TestNetwork:
 
     # -- batched crypto prefetch (harness/batching.py) ---------------------
 
+    def _note_obs(self, node: TestNode, sender_id, message) -> None:
+        """Extract the message's crypto obligations once, at enqueue
+        (re-scanning queues at every flush is quadratic)."""
+        if self.prefetch_every:
+            from .batching import crypto_obligations
+
+            node.pending_obs.extend(
+                crypto_obligations(node.algo, sender_id, message)
+            )
+
     def prefetch_crypto(self) -> None:
-        """Flush all queued share verifications as one batch into the
+        """Flush the enqueued share verifications as one batch into the
         backend's cache (bit-identical outcomes, see
         ``harness/batching.py``)."""
-        from .batching import crypto_obligations
-
-        # (the observer queue is always drained synchronously by
-        # dispatch_messages, so only validator queues can hold work)
         obs = []
         for node in self.nodes.values():
-            for sender_id, message in node.queue:
-                obs.extend(crypto_obligations(node.algo, sender_id, message))
+            if node.pending_obs:
+                obs.extend(node.pending_obs)
+                node.pending_obs.clear()
         self.ops.prefetch(obs)
 
     def step(self) -> Any:

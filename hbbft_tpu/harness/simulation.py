@@ -71,8 +71,17 @@ class SimNode:
         self.outputs: List[Tuple[float, Any]] = []
         self.message_count = 0
         self.message_size = 0
+        # crypto obligations extracted at enqueue time, drained by the
+        # batched prefetch (harness/batching.py); populated only when
+        # the network runs a batching backend
+        self.pending_obs: List[Any] = []
         if initial_step is not None and not dead:
             self._send_output_and_msgs(initial_step, 0.0)
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        # checkpoints from before the enqueue-time extraction change
+        self.__dict__.setdefault("pending_obs", [])
 
     # -- queue -------------------------------------------------------------
 
@@ -137,6 +146,9 @@ class SimNetwork:
             list(range(num_nodes)), rng, mock=mock_crypto, ops=ops
         )
         self.rng = rng
+        # extract crypto obligations at dispatch only when a batching
+        # backend will consume them
+        self._collect_obs = ops is not None and hasattr(ops, "prefetch")
         self.nodes: Dict[Any, SimNode] = {}
         for nid in range(num_nodes):
             result = new_algo(netinfos[nid])
@@ -160,24 +172,35 @@ class SimNetwork:
             for nid, node in self.nodes.items():
                 if nid != sender_id:
                     node.add_message(arrival, sender_id, message, size)
+                    self._note_obs(node, sender_id, message)
         else:
             node = self.nodes.get(target.node)
             if node is not None:
                 node.add_message(arrival, sender_id, message, size)
+                self._note_obs(node, sender_id, message)
+
+    def _note_obs(self, node: SimNode, sender_id, message) -> None:
+        """Extract the message's crypto obligations once, at enqueue
+        (re-scanning queues at every flush is quadratic; obligations
+        whose inputs are not known yet — e.g. a decryption share
+        arriving before its ciphertext — simply verify inline later)."""
+        if self._collect_obs and not node.dead:
+            from .batching import crypto_obligations
+
+            node.pending_obs.extend(
+                crypto_obligations(node.algo, sender_id, message)
+            )
 
     # -- batched crypto prefetch (harness/batching.py) ---------------------
 
     def queued_obligations(self) -> List[Any]:
-        """Scan every queued message for pending share verifications —
-        the batched-launch planning pass (SURVEY §5.8)."""
-        from .batching import crypto_obligations
-
+        """Drain the share verifications extracted at enqueue — the
+        batched-launch planning pass (SURVEY §5.8)."""
         obs: List[Any] = []
         for node in self.nodes.values():
-            if node.dead:
-                continue
-            for _, _, sender_id, message, _ in node.in_queue:
-                obs.extend(crypto_obligations(node.algo, sender_id, message))
+            if node.pending_obs:
+                obs.extend(node.pending_obs)
+                node.pending_obs.clear()
         return obs
 
     def prefetch_crypto(self, backend) -> None:

@@ -75,12 +75,33 @@ class TpuBackend(CpuBackend):
         return gf256_jax.ReedSolomonDevice(data_shards, parity_shards)
 
     # -- group MSMs --------------------------------------------------------
+    # Routing is by measured capability (TPU v5e, see BASELINE.md):
+    # the VMEM-resident Pallas scalar-mul path scales nearly free with
+    # batch width (~31k pts/s at K=64k) while small MSMs are dominated
+    # by launch+compile latency, where the native C++ Pippenger host
+    # path (~40k pts/s) wins.  Without the native library the host
+    # fallback is pure Python (~100× slower), so the device takes
+    # everything it can.  All paths are exact — results are identical.
+
+    G1_DEVICE_MIN = 2048  # with native host lib; device always wins vs pure Python
+    G2_DEVICE_MIN = 1 << 30  # device G2 loses to native Pippenger at all sizes today
+
+    def _native_host(self) -> bool:
+        from .. import native as _native
+
+        return _native.available()
 
     def g1_msm(self, points: Sequence[G1], scalars: Sequence[int]) -> G1:
-        return ec_jax.g1_msm(list(points), list(scalars))
+        points, scalars = list(points), list(scalars)
+        if self._native_host() and len(points) < self.G1_DEVICE_MIN:
+            return super().g1_msm(points, scalars)
+        return ec_jax.g1_msm(points, scalars)
 
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
-        return ec_jax.g2_msm(list(points), list(scalars))
+        points, scalars = list(points), list(scalars)
+        if self._native_host() and len(points) < self.G2_DEVICE_MIN:
+            return super().g2_msm(points, scalars)
+        return ec_jax.g2_msm(points, scalars)
 
     # -- batched share verification ---------------------------------------
 
@@ -102,7 +123,8 @@ class TpuBackend(CpuBackend):
             [s.to_bytes() for s in shares] + [p.to_bytes() for p in pks],
         )[: len(shares)]  # one rᵢ per (shareᵢ, pkᵢ) pair, as on CPU
         agg_share = self.g1_msm(shares, coeffs)
-        agg_pk = self.g2_msm(pks, coeffs)
+        u_pks, u_coeffs = T.aggregate_by_point(pks, coeffs)
+        agg_pk = self.g2_msm(u_pks, u_coeffs)
         return pairing_check([(agg_share, G2_GEN), (-base, agg_pk)])
 
 

@@ -316,22 +316,58 @@ def g1_scalar_mul_device(pts: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
     return g1_kernel().scalar_mul(pts, bits)
 
 
-def g1_msm(points: Sequence[Any], scalars: Sequence[int]) -> Any:
+def _width(scalars: Sequence[int], nbits: Optional[int]) -> int:
+    """Scan depth for a scalar batch.  The kernels are latency-bound by
+    the bit-serial scan, so shorter known-width scalars (128-bit RLC
+    coefficients vs full 255-bit Fr) halve the MSM latency.  Widths are
+    bucketed to keep the number of compiled variants small."""
+    if nbits is not None:
+        return nbits
+    m = max((s.bit_length() for s in scalars), default=1)
+    for w in (128, 160, 255):
+        if m <= w:
+            return w
+    raise ValueError(f"scalar wider than the group order: {m} bits")
+
+
+def _use_pallas(k: int) -> bool:
+    """The Pallas VMEM-resident scalar-mul kernel wins beyond ~512
+    points on real TPU hardware (the XLA scan goes HBM-bound; measured
+    3.6× at K=64k) and compiles ~5× faster.  Interpret mode on CPU is
+    only for correctness tests, so stay with XLA there."""
+    import os
+
+    if os.environ.get("HBBFT_TPU_NO_PALLAS"):
+        return False
+    return k >= 512 and jax.default_backend() == "tpu"
+
+
+def g1_msm(
+    points: Sequence[Any], scalars: Sequence[int], nbits: Optional[int] = None
+) -> Any:
     """Host-facing MSM: G1 points × Fr scalars → G1 (device compute)."""
     if not points:
         from ..crypto.curve import G1
 
         return G1.infinity()
+    w = _width(scalars, nbits)
+    if _use_pallas(len(points)):
+        from . import pallas_ec
+
+        return pallas_ec.g1_msm_pallas(points, scalars, nbits=w, interpret=False)
     pts = jnp.asarray(g1_to_limbs(points))
-    bits = jnp.asarray(LB.scalars_to_bits(scalars))
+    bits = jnp.asarray(LB.scalars_to_bits(scalars, w))
     return g1_from_limbs(g1_msm_device(pts, bits))
 
 
-def g2_msm(points: Sequence[Any], scalars: Sequence[int]) -> Any:
+def g2_msm(
+    points: Sequence[Any], scalars: Sequence[int], nbits: Optional[int] = None
+) -> Any:
     if not points:
         from ..crypto.curve import G2
 
         return G2.infinity()
+    w = _width(scalars, nbits)
     pts = jnp.asarray(g2_to_limbs(points))
-    bits = jnp.asarray(LB.scalars_to_bits(scalars))
+    bits = jnp.asarray(LB.scalars_to_bits(scalars, w))
     return g2_from_limbs(g2_msm_device(pts, bits))

@@ -1,0 +1,288 @@
+"""Pallas TPU kernel: G1 scalar multiplication fully resident in VMEM.
+
+The XLA path (``ec_jax.py``) runs the 255-step double-and-add as a
+``lax.scan`` whose carries round-trip HBM every step — measured
+HBM-bound beyond K≈2k points.  This kernel keeps the *entire* scan in
+VMEM: each grid program loads a tile of T=128 points + their scalar
+bits once, runs every double/add/select on-chip, and writes only the
+final points.  Layout is transposed for the VPU: limbs ride the
+sublane axis, the point batch rides the 128 lanes, so every field
+operation is a [limbs × 128] vector op.
+
+Field arithmetic mirrors ``ops/limbs.py`` line-for-line (same lazy
+11-bit redundant-limb algebra, same fold/carry schedule) so results
+are bit-identical to the XLA kernels and the host path — asserted in
+``tests/test_pallas_ec.py``.  The point formulas are the same complete
+RCB additions as ``ec_jax.PointKernel``.
+
+Used by ``ec_jax.g1_msm`` when the backend selects it (the MSM's
+tree reduction stays in XLA; the scalar-mul scan is ~99% of the work).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as LB
+
+TILE = 128  # points per grid program (the lane width)
+
+_f = None
+
+
+def _field():
+    global _f
+    if _f is None:
+        _f = LB.fq()
+    return _f
+
+
+# ---------------------------------------------------------------------------
+# In-kernel field ops — limb axis FIRST ([W, T] arrays), mirroring
+# limbs.ModField exactly (same schedules → bit-identical results).
+# ---------------------------------------------------------------------------
+
+
+def _carry(x: jnp.ndarray) -> jnp.ndarray:
+    """[W, T] → [W+1, T]: one parallel carry round."""
+    lo = jnp.bitwise_and(x, LB.LIMB_MASK)
+    hi = jnp.right_shift(x, LB.LIMB_BITS)
+    zpad = jnp.zeros((1,) + x.shape[1:], dtype=x.dtype)
+    return jnp.concatenate([lo, zpad], axis=0) + jnp.concatenate(
+        [zpad, hi], axis=0
+    )
+
+
+def _fold_high(x: jnp.ndarray, fold: jnp.ndarray, B: int) -> jnp.ndarray:
+    """[W, T] (W > B) → [B, T]: fold limbs ≥ B via the 2^(11·(B+i)) mod p
+    table (unrolled exact int32 FMAs — f32 MXU would lose bits)."""
+    W = x.shape[0]
+    acc = x[:B]
+    for h in range(W - B):
+        acc = acc + fold[h][:, None] * x[B + h][None, :]
+    return acc
+
+
+def _normalize(wide: jnp.ndarray, fold: jnp.ndarray, B: int, L: int):
+    """Mirror of ``ModField.normalize`` (rounds=2) in [W, T] layout."""
+    x = wide
+    for _ in range(2):
+        x = _carry(_carry(x))
+        if x.shape[0] > B:
+            x = _fold_high(x, fold, B)
+    x = _carry(_carry(x))
+    return x[:L]
+
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray, L: int) -> jnp.ndarray:
+    """Schoolbook product [L, T] × [L, T] → [2L−1, T] (L unrolled
+    shifted FMAs; every partial product < 2^24, sums < 2^30).  Shifts
+    are static zero-pads via concatenate — Mosaic has no scatter."""
+    T = a.shape[1]
+
+    def shifted(i):
+        rows = a[i][None, :] * b  # [L, T]
+        parts = []
+        if i:
+            parts.append(jnp.zeros((i, T), dtype=jnp.int32))
+        parts.append(rows)
+        if L - 1 - i:
+            parts.append(jnp.zeros((L - 1 - i, T), dtype=jnp.int32))
+        return jnp.concatenate(parts, axis=0)
+
+    acc = shifted(0)
+    for i in range(1, L):
+        acc = acc + shifted(i)
+    return acc
+
+
+class _KernelField:
+    """The _FieldOps equivalent for the in-kernel layout.  The fold
+    table and subtraction pad arrive as kernel *inputs* (Pallas
+    forbids captured constants)."""
+
+    def __init__(self, fold: jnp.ndarray, sub_pad: jnp.ndarray):
+        f = _field()
+        self.L = f.L
+        self.B = f.B
+        self.fold = fold  # [nfold, B]
+        self.sub_pad = sub_pad  # [L+1, 1]
+
+    def add(self, a, b):
+        return _normalize(a + b, self.fold, self.B, self.L)
+
+    def sub(self, a, b):
+        zpad = jnp.zeros((1,) + a.shape[1:], dtype=jnp.int32)
+        wide = (
+            jnp.concatenate([a, zpad], axis=0)
+            + self.sub_pad
+            - jnp.concatenate([b, zpad], axis=0)
+        )
+        return _normalize(wide, self.fold, self.B, self.L)
+
+    def mul(self, a, b):
+        return _normalize(_conv(a, b, self.L), self.fold, self.B, self.L)
+
+    def mul_b3(self, a):  # 3·b with b = 4 for G1
+        return _normalize(a * 12, self.fold, self.B, self.L)
+
+
+def _point_add(f: _KernelField, p, q):
+    """Complete addition (RCB 2015 Alg. 7, a = 0) on ([L,T],)*3 triples
+    — the same formula as ``ec_jax.PointKernel.add``."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    t0 = f.mul(X1, X2)
+    t1 = f.mul(Y1, Y2)
+    t2 = f.mul(Z1, Z2)
+    t3 = f.mul(f.add(X1, Y1), f.add(X2, Y2))
+    t3 = f.sub(t3, f.add(t0, t1))
+    t4 = f.mul(f.add(Y1, Z1), f.add(Y2, Z2))
+    t4 = f.sub(t4, f.add(t1, t2))
+    X3 = f.mul(f.add(X1, Z1), f.add(X2, Z2))
+    Y3 = f.sub(X3, f.add(t0, t2))
+    X3 = f.add(t0, t0)
+    t0 = f.add(X3, t0)
+    t2 = f.mul_b3(t2)
+    Z3 = f.add(t1, t2)
+    t1 = f.sub(t1, t2)
+    Y3 = f.mul_b3(Y3)
+    X3 = f.sub(f.mul(t3, t1), f.mul(t4, Y3))
+    Y3 = f.add(f.mul(t1, Z3), f.mul(Y3, t0))
+    Z3 = f.add(f.mul(Z3, t4), f.mul(t0, t3))
+    return (X3, Y3, Z3)
+
+
+def _select(mask_t, a, b):
+    """per-lane select between point triples; mask_t: [T] int."""
+    m = mask_t.astype(bool)[None, :]
+    return tuple(jnp.where(m, x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# The kernel
+# ---------------------------------------------------------------------------
+
+
+def _scalar_mul_kernel(pts_ref, bits_ref, fold_ref, pad_ref, out_ref):
+    """pts_ref [1, 3, L, T]; bits_ref [1, nbits, T]; fold_ref
+    [nfold, B]; pad_ref [L+1, 1]; out [1, 3, L, T].
+
+    Left-to-right double-and-add over all nbits, entirely in VMEM."""
+    f = _KernelField(fold_ref[:], pad_ref[:])
+    L = f.L
+    P = (pts_ref[0, 0], pts_ref[0, 1], pts_ref[0, 2])
+    T = P[0].shape[1]
+    nbits = bits_ref.shape[1]
+    one = jnp.concatenate(
+        [jnp.ones((1, T), dtype=jnp.int32), jnp.zeros((L - 1, T), dtype=jnp.int32)],
+        axis=0,
+    )
+    zero = jnp.zeros((L, T), dtype=jnp.int32)
+    acc0 = (zero, one, zero)  # the identity (0 : 1 : 0)
+
+    def body(i, acc):
+        acc = _point_add(f, acc, acc)
+        with_p = _point_add(f, acc, P)
+        return _select(bits_ref[0, i], with_p, acc)
+
+    X, Y, Z = jax.lax.fori_loop(0, nbits, body, acc0)
+    out_ref[0, 0] = X
+    out_ref[0, 1] = Y
+    out_ref[0, 2] = Z
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _scalar_mul_tiles(pts_t: jnp.ndarray, bits_t: jnp.ndarray, interpret: bool):
+    """pts_t [G, 3, L, T], bits_t [G, nbits, T] → [G, 3, L, T]."""
+    from jax.experimental import pallas as pl
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        vmem = pltpu.VMEM
+    except Exception:  # pragma: no cover - CPU-only environments
+        vmem = None
+    G, _, L, T = pts_t.shape
+    nbits = bits_t.shape[1]
+    f = _field()
+    fold = jnp.asarray(np.asarray(f.fold))  # [nfold, B]
+    pad = jnp.asarray(np.asarray(f.sub_pad).reshape(-1, 1))  # [L+1, 1]
+
+    def spec(block, tiled=True):
+        index_map = (
+            (lambda g: (g,) + (0,) * (len(block) - 1))
+            if tiled
+            else (lambda g: (0,) * len(block))
+        )
+        if vmem is None or interpret:
+            return pl.BlockSpec(block, index_map)
+        return pl.BlockSpec(block, index_map, memory_space=vmem)
+
+    return pl.pallas_call(
+        _scalar_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((G, 3, L, T), jnp.int32),
+        grid=(G,),
+        in_specs=[
+            spec((1, 3, L, T)),
+            spec((1, nbits, T)),
+            spec(tuple(fold.shape), tiled=False),
+            spec(tuple(pad.shape), tiled=False),
+        ],
+        out_specs=spec((1, 3, L, T)),
+        interpret=interpret,
+    )(pts_t, bits_t, fold, pad)
+
+
+def scalar_mul_pallas(
+    pts: np.ndarray, bits: np.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """Batched G1 scalar-mul: pts [K, 3, L] limbs × bits [K, nbits]
+    (msb-first) → [K, 3, L] limbs.  Pads K to the 128-lane tile and
+    transposes in/out of the kernel's [limbs, lanes] layout."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    K, _, L = pts.shape
+    nbits = bits.shape[1]
+    G = max(1, -(-K // TILE))
+    Kp = G * TILE
+    pts_p = np.zeros((Kp, 3, L), dtype=np.int32)
+    pts_p[:K] = np.asarray(pts)
+    pts_p[K:, 1, 0] = 1  # pad with the identity (0 : 1 : 0)
+    bits_p = np.zeros((Kp, nbits), dtype=np.int32)
+    bits_p[:K] = np.asarray(bits)
+    # [Kp, 3, L] → [G, T, 3, L] → [G, 3, L, T]
+    pts_t = jnp.asarray(
+        pts_p.reshape(G, TILE, 3, L).transpose(0, 2, 3, 1)
+    )
+    bits_t = jnp.asarray(
+        bits_p.reshape(G, TILE, nbits).transpose(0, 2, 1)
+    )
+    out_t = _scalar_mul_tiles(pts_t, bits_t, bool(interpret))
+    # [G, 3, L, T] → [Kp, 3, L] → [K, 3, L]
+    out = jnp.transpose(out_t, (0, 3, 1, 2)).reshape(Kp, 3, L)
+    return out[:K]
+
+
+def g1_msm_pallas(
+    points: Sequence[Any],
+    scalars: Sequence[int],
+    nbits: int = 255,
+    interpret: Optional[bool] = None,
+):
+    """Full MSM via the Pallas scalar-mul + the XLA tree reduction."""
+    from . import ec_jax
+
+    if not points:
+        from ..crypto.curve import G1
+
+        return G1.infinity()
+    pts = ec_jax.g1_to_limbs(points)
+    bits = LB.scalars_to_bits(scalars, nbits)
+    prods = scalar_mul_pallas(pts, bits, interpret=interpret)
+    return ec_jax.g1_from_limbs(ec_jax.g1_kernel().tree_sum(prods))

@@ -1,0 +1,67 @@
+"""Pallas G1 kernel tests (interpret mode on the CPU mesh).
+
+Bit-identity contract: the Pallas scalar-mul must produce exactly the
+same canonical points as the XLA kernel (``ec_jax``) and the host path
+(``crypto/curve.py``) — same limb algebra, same complete formulas.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.crypto.curve import G1, G1_GEN, g1_multi_exp
+from hbbft_tpu.ops import ec_jax as EC
+from hbbft_tpu.ops import limbs as LB
+from hbbft_tpu.ops import pallas_ec as PE
+
+
+@pytest.fixture(scope="module")
+def points(rng=None):
+    r = random.Random(0xA11)
+    return [G1_GEN * r.randrange(1, 1 << 64) for _ in range(6)] + [
+        G1.infinity()
+    ]
+
+
+def test_scalar_mul_matches_host(points):
+    r = random.Random(0xA12)
+    ks = [r.randrange(0, 1 << 64) for _ in points]
+    pts = EC.g1_to_limbs(points)
+    bits = LB.scalars_to_bits(ks, 64)
+    out = np.asarray(PE.scalar_mul_pallas(pts, bits, interpret=True))
+    for i, (p, k) in enumerate(zip(points, ks)):
+        assert EC.g1_from_limbs(out[i]) == p * k
+
+
+def test_scalar_mul_bit_identical_to_xla(points):
+    """Not just the same group elements — the same limb vectors."""
+    r = random.Random(0xA13)
+    ks = [r.randrange(0, 1 << 48) for _ in points]
+    pts = EC.g1_to_limbs(points)
+    bits = LB.scalars_to_bits(ks, 48)
+    out_pl = np.asarray(PE.scalar_mul_pallas(pts, bits, interpret=True))
+    out_xla = np.asarray(
+        EC.g1_kernel().scalar_mul(np.asarray(pts), np.asarray(bits))
+    )
+    assert (out_pl == out_xla).all()
+
+
+def test_msm_matches_host(points):
+    r = random.Random(0xA14)
+    ks = [r.randrange(1, LB.R) for _ in points]
+    got = PE.g1_msm_pallas(points, ks)
+    assert got == g1_multi_exp(points, ks)
+
+
+def test_padding_beyond_tile():
+    """K not a multiple of the 128-lane tile pads with identities."""
+    r = random.Random(0xA15)
+    points = [G1_GEN * r.randrange(1, 1 << 32) for _ in range(3)]
+    ks = [r.randrange(1, 1 << 32) for _ in range(3)]
+    pts = EC.g1_to_limbs(points)
+    bits = LB.scalars_to_bits(ks, 32)
+    out = np.asarray(PE.scalar_mul_pallas(pts, bits, interpret=True))
+    assert out.shape[0] == 3
+    for i in range(3):
+        assert EC.g1_from_limbs(out[i]) == points[i] * ks[i]
