@@ -76,14 +76,14 @@ class TpuBackend(CpuBackend):
 
     # -- group MSMs --------------------------------------------------------
     # Routing is by measured capability (TPU v5e, see BASELINE.md):
-    # the VMEM-resident Pallas scalar-mul path scales nearly free with
-    # batch width (~31k pts/s at K=64k) while small MSMs are dominated
-    # by launch+compile latency, where the native C++ Pippenger host
-    # path (~40k pts/s) wins.  Without the native library the host
-    # fallback is pure Python (~100× slower), so the device takes
-    # everything it can.  All paths are exact — results are identical.
+    # the VMEM-resident windowed Pallas kernel scales nearly free with
+    # batch width (45.7k pts/s at K=8k, 67.5k at K=64k — past the
+    # native C++ Pippenger host path's ~40k) while small MSMs are
+    # dominated by launch latency, where the host wins.  Without the
+    # native library the host fallback is pure Python (~100× slower),
+    # so the device takes everything it can.  All paths are exact.
 
-    G1_DEVICE_MIN = 2048  # with native host lib; device always wins vs pure Python
+    G1_DEVICE_MIN = 8192  # measured crossover vs native Pippenger
     G2_DEVICE_MIN = 1 << 30  # device G2 loses to native Pippenger at all sizes today
 
     def _native_host(self) -> bool:

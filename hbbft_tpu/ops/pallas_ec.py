@@ -164,6 +164,67 @@ def _select(mask_t, a, b):
     return tuple(jnp.where(m, x, y) for x, y in zip(a, b))
 
 
+def _select_entry(digits_t, table, L, T):
+    """Per-lane table lookup: digits_t [T] ∈ [0,16) × table (16 point
+    triples of [L,T]) → one point triple.  Exactly one mask is true per
+    lane, so a masked sum implements the gather (Mosaic has no per-lane
+    dynamic gather)."""
+    selX = jnp.zeros((L, T), dtype=jnp.int32)
+    selY = jnp.zeros((L, T), dtype=jnp.int32)
+    selZ = jnp.zeros((L, T), dtype=jnp.int32)
+    for j in range(16):
+        m = (digits_t == j)[None, :]
+        X, Y, Z = table[j]
+        selX = selX + jnp.where(m, X, 0)
+        selY = selY + jnp.where(m, Y, 0)
+        selZ = selZ + jnp.where(m, Z, 0)
+    return (selX, selY, selZ)
+
+
+def _windowed_kernel(pts_ref, digits_ref, fold_ref, pad_ref, out_ref):
+    """4-bit fixed-window scalar-mul: pts_ref [1, 3, L, T]; digits_ref
+    [1, nwin, T] (msb-first 4-bit digits); out [1, 3, L, T].
+
+    Per window: 4 doublings + 1 complete add of the table entry —
+    ~1.5× fewer sequential adds than the bit-serial scan.  The 16-entry
+    multiples table (934 KB for T=128) is built once in VMEM."""
+    f = _KernelField(fold_ref[:], pad_ref[:])
+    L = f.L
+    P = (pts_ref[0, 0], pts_ref[0, 1], pts_ref[0, 2])
+    T = P[0].shape[1]
+    nwin = digits_ref.shape[1]
+    one = jnp.concatenate(
+        [jnp.ones((1, T), dtype=jnp.int32), jnp.zeros((L - 1, T), dtype=jnp.int32)],
+        axis=0,
+    )
+    zero = jnp.zeros((L, T), dtype=jnp.int32)
+    ident = (zero, one, zero)
+    # table[j] = j·P (complete formulas make identity entries safe)
+    table = [ident, P]
+    for j in range(2, 16):
+        table.append(_point_add(f, table[j - 1], P))
+    tX = jnp.stack([t[0] for t in table])  # [16, L, T] — one carry into
+    tY = jnp.stack([t[1] for t in table])  # the loop instead of 16 locals
+    tZ = jnp.stack([t[2] for t in table])
+
+    def body(w, carry):
+        acc, tX, tY, tZ = carry
+        for _ in range(4):
+            acc = _point_add(f, acc, acc)
+        d = digits_ref[0, w]
+        entry = _select_entry(
+            d, [(tX[j], tY[j], tZ[j]) for j in range(16)], L, T
+        )
+        return (_point_add(f, acc, entry), tX, tY, tZ)
+
+    (X, Y, Z), _, _, _ = jax.lax.fori_loop(
+        0, nwin, body, (ident, tX, tY, tZ)
+    )
+    out_ref[0, 0] = X
+    out_ref[0, 1] = Y
+    out_ref[0, 2] = Z
+
+
 # ---------------------------------------------------------------------------
 # The kernel
 # ---------------------------------------------------------------------------
@@ -197,9 +258,9 @@ def _scalar_mul_kernel(pts_ref, bits_ref, fold_ref, pad_ref, out_ref):
     out_ref[0, 2] = Z
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def _scalar_mul_tiles(pts_t: jnp.ndarray, bits_t: jnp.ndarray, interpret: bool):
-    """pts_t [G, 3, L, T], bits_t [G, nbits, T] → [G, 3, L, T]."""
+def _run_tiles(kernel, pts_t: jnp.ndarray, aux_t: jnp.ndarray, interpret: bool):
+    """Shared pallas_call wrapper: pts_t [G, 3, L, T] + aux (bits or
+    digits) [G, n, T] + the field constants → [G, 3, L, T]."""
     from jax.experimental import pallas as pl
 
     try:
@@ -209,7 +270,7 @@ def _scalar_mul_tiles(pts_t: jnp.ndarray, bits_t: jnp.ndarray, interpret: bool):
     except Exception:  # pragma: no cover - CPU-only environments
         vmem = None
     G, _, L, T = pts_t.shape
-    nbits = bits_t.shape[1]
+    n = aux_t.shape[1]
     f = _field()
     fold = jnp.asarray(np.asarray(f.fold))  # [nfold, B]
     pad = jnp.asarray(np.asarray(f.sub_pad).reshape(-1, 1))  # [L+1, 1]
@@ -225,48 +286,90 @@ def _scalar_mul_tiles(pts_t: jnp.ndarray, bits_t: jnp.ndarray, interpret: bool):
         return pl.BlockSpec(block, index_map, memory_space=vmem)
 
     return pl.pallas_call(
-        _scalar_mul_kernel,
+        kernel,
         out_shape=jax.ShapeDtypeStruct((G, 3, L, T), jnp.int32),
         grid=(G,),
         in_specs=[
             spec((1, 3, L, T)),
-            spec((1, nbits, T)),
+            spec((1, n, T)),
             spec(tuple(fold.shape), tiled=False),
             spec(tuple(pad.shape), tiled=False),
         ],
         out_specs=spec((1, 3, L, T)),
         interpret=interpret,
-    )(pts_t, bits_t, fold, pad)
+    )(pts_t, aux_t, fold, pad)
 
 
-def scalar_mul_pallas(
-    pts: np.ndarray, bits: np.ndarray, interpret: Optional[bool] = None
-) -> jnp.ndarray:
-    """Batched G1 scalar-mul: pts [K, 3, L] limbs × bits [K, nbits]
-    (msb-first) → [K, 3, L] limbs.  Pads K to the 128-lane tile and
-    transposes in/out of the kernel's [limbs, lanes] layout."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+@functools.partial(jax.jit, static_argnums=(2,))
+def _scalar_mul_tiles(pts_t: jnp.ndarray, bits_t: jnp.ndarray, interpret: bool):
+    return _run_tiles(_scalar_mul_kernel, pts_t, bits_t, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _windowed_tiles(pts_t: jnp.ndarray, dig_t: jnp.ndarray, interpret: bool):
+    return _run_tiles(_windowed_kernel, pts_t, dig_t, interpret)
+
+
+def _tile_transpose(pts: np.ndarray, aux: np.ndarray):
+    """Pad K to the 128-lane tile and transpose into the kernel's
+    [limbs/windows, lanes] layout.  aux is bits or digits [K, n]."""
     K, _, L = pts.shape
-    nbits = bits.shape[1]
+    n = aux.shape[1]
     G = max(1, -(-K // TILE))
     Kp = G * TILE
     pts_p = np.zeros((Kp, 3, L), dtype=np.int32)
     pts_p[:K] = np.asarray(pts)
     pts_p[K:, 1, 0] = 1  # pad with the identity (0 : 1 : 0)
-    bits_p = np.zeros((Kp, nbits), dtype=np.int32)
-    bits_p[:K] = np.asarray(bits)
-    # [Kp, 3, L] → [G, T, 3, L] → [G, 3, L, T]
-    pts_t = jnp.asarray(
-        pts_p.reshape(G, TILE, 3, L).transpose(0, 2, 3, 1)
-    )
-    bits_t = jnp.asarray(
-        bits_p.reshape(G, TILE, nbits).transpose(0, 2, 1)
-    )
-    out_t = _scalar_mul_tiles(pts_t, bits_t, bool(interpret))
-    # [G, 3, L, T] → [Kp, 3, L] → [K, 3, L]
+    aux_p = np.zeros((Kp, n), dtype=np.int32)
+    aux_p[:K] = np.asarray(aux)
+    pts_t = jnp.asarray(pts_p.reshape(G, TILE, 3, L).transpose(0, 2, 3, 1))
+    aux_t = jnp.asarray(aux_p.reshape(G, TILE, n).transpose(0, 2, 1))
+    return pts_t, aux_t, G, Kp
+
+
+def _untile(out_t: jnp.ndarray, K: int, Kp: int, L: int) -> jnp.ndarray:
     out = jnp.transpose(out_t, (0, 3, 1, 2)).reshape(Kp, 3, L)
     return out[:K]
+
+
+def scalar_mul_pallas(
+    pts: np.ndarray, bits: np.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """Batched G1 scalar-mul (bit-serial scan): pts [K, 3, L] limbs ×
+    bits [K, nbits] (msb-first) → [K, 3, L] limbs.  Bit-identical to
+    the XLA scan (same op schedule)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    K, _, L = pts.shape
+    pts_t, bits_t, G, Kp = _tile_transpose(pts, bits)
+    out_t = _scalar_mul_tiles(pts_t, bits_t, bool(interpret))
+    return _untile(out_t, K, Kp, L)
+
+
+def bits_to_digits(bits: np.ndarray) -> np.ndarray:
+    """[K, nbits] msb-first bits → [K, ceil(nbits/4)] msb-first 4-bit
+    window digits (left-padded so the top window may be short)."""
+    K, nbits = bits.shape
+    nwin = -(-nbits // 4)
+    padded = np.zeros((K, nwin * 4), dtype=np.int32)
+    padded[:, nwin * 4 - nbits :] = bits
+    d = padded.reshape(K, nwin, 4)
+    return (d[..., 0] << 3) | (d[..., 1] << 2) | (d[..., 2] << 1) | d[..., 3]
+
+
+def scalar_mul_windowed(
+    pts: np.ndarray, bits: np.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """Batched G1 scalar-mul via the 4-bit fixed-window kernel — the
+    fast path (~1.5× over the bit-serial scan).  Canonically equal to
+    every other path (the redundant limb form may differ)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    K, _, L = pts.shape
+    digits = bits_to_digits(np.asarray(bits))
+    pts_t, dig_t, G, Kp = _tile_transpose(pts, digits)
+    out_t = _windowed_tiles(pts_t, dig_t, bool(interpret))
+    return _untile(out_t, K, Kp, L)
 
 
 def g1_msm_pallas(
@@ -284,5 +387,5 @@ def g1_msm_pallas(
         return G1.infinity()
     pts = ec_jax.g1_to_limbs(points)
     bits = LB.scalars_to_bits(scalars, nbits)
-    prods = scalar_mul_pallas(pts, bits, interpret=interpret)
+    prods = scalar_mul_windowed(pts, bits, interpret=interpret)
     return ec_jax.g1_from_limbs(ec_jax.g1_kernel().tree_sum(prods))

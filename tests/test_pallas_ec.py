@@ -54,6 +54,31 @@ def test_msm_matches_host(points):
     assert got == g1_multi_exp(points, ks)
 
 
+def test_windowed_matches_host(points):
+    """The 4-bit fixed-window kernel: canonically equal to the host
+    path for every point including the identity."""
+    r = random.Random(0xA16)
+    ks = [r.randrange(0, 1 << 64) for _ in points]
+    pts = EC.g1_to_limbs(points)
+    bits = LB.scalars_to_bits(ks, 64)
+    out = np.asarray(PE.scalar_mul_windowed(pts, bits, interpret=True))
+    for i, (p, k) in enumerate(zip(points, ks)):
+        assert EC.g1_from_limbs(out[i]) == p * k
+
+
+def test_bits_to_digits():
+    r = random.Random(0xA17)
+    ks = [r.randrange(0, 1 << 61) for _ in range(5)]  # 61 bits: short top window
+    bits = LB.scalars_to_bits(ks, 61)
+    digits = PE.bits_to_digits(bits)
+    assert digits.shape == (5, 16)
+    for k, row in zip(ks, digits):
+        got = 0
+        for d in row:
+            got = (got << 4) | int(d)
+        assert got == k % LB.R
+
+
 def test_padding_beyond_tile():
     """K not a multiple of the 128-lane tile pads with identities."""
     r = random.Random(0xA15)
