@@ -13,7 +13,16 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import logging
 from typing import Any, Iterator, List
+
+# The framework's observability channel (reference: `log` crate macros
+# throughout, enabled via RUST_LOG=hbbft=debug — here: configure
+# ``logging.getLogger("hbbft_tpu")`` with a handler + DEBUG level).
+# Every attributed Byzantine fault is logged as it is recorded; DEBUG
+# level keeps adversarial test sweeps (thousands of intended faults)
+# quiet by default.
+log = logging.getLogger("hbbft_tpu")
 
 
 class FaultKind(enum.Enum):
@@ -87,10 +96,12 @@ class FaultLog:
         return cls([Fault(node_id, kind)])
 
     def append(self, fault: Fault) -> None:
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("fault: node %r %s", fault.node_id, fault.kind.value)
         self._faults.append(fault)
 
     def add(self, node_id: Any, kind: FaultKind) -> None:
-        self._faults.append(Fault(node_id, kind))
+        self.append(Fault(node_id, kind))
 
     def merge(self, other: "FaultLog") -> None:
         """Drain ``other`` into self (reference ``merge_into``)."""

@@ -128,8 +128,67 @@ class _KernelField:
     def mul(self, a, b):
         return _normalize(_conv(a, b, self.L), self.fold, self.B, self.L)
 
+    def mul_small(self, a, k: int):
+        return _normalize(a * k, self.fold, self.B, self.L)
+
     def mul_b3(self, a):  # 3·b with b = 4 for G1
-        return _normalize(a * 12, self.fold, self.B, self.L)
+        return self.mul_small(a, 12)
+
+    def where(self, m, a, b):
+        return jnp.where(m, a, b)
+
+    def zero(self, T: int):
+        return jnp.zeros((self.L, T), dtype=jnp.int32)
+
+    def one(self, T: int):
+        return jnp.concatenate(
+            [
+                jnp.ones((1, T), dtype=jnp.int32),
+                jnp.zeros((self.L - 1, T), dtype=jnp.int32),
+            ],
+            axis=0,
+        )
+
+
+class _KernelField2:
+    """Fq2 = Fq[u]/(u²+1) over tuple elements (a0, a1) of [L, T] limb
+    arrays — the in-kernel mirror of ``ec_jax._fq2_ops`` (same
+    Karatsuba, same b3 = 12·(1+u) for the G2 twist curve)."""
+
+    def __init__(self, fq: _KernelField):
+        self.f = fq
+
+    def add(self, a, b):
+        return (self.f.add(a[0], b[0]), self.f.add(a[1], b[1]))
+
+    def sub(self, a, b):
+        return (self.f.sub(a[0], b[0]), self.f.sub(a[1], b[1]))
+
+    def mul(self, a, b):
+        f = self.f
+        t0 = f.mul(a[0], b[0])
+        t1 = f.mul(a[1], b[1])
+        cross = f.sub(
+            f.sub(f.mul(f.add(a[0], a[1]), f.add(b[0], b[1])), t0), t1
+        )
+        return (f.sub(t0, t1), cross)
+
+    def mul_b3(self, a):  # 3·b with b = 4(1+u) on the twist
+        f = self.f
+        return (
+            f.mul_small(f.sub(a[0], a[1]), 12),
+            f.mul_small(f.add(a[0], a[1]), 12),
+        )
+
+    def where(self, m, a, b):
+        return (jnp.where(m, a[0], b[0]), jnp.where(m, a[1], b[1]))
+
+    def zero(self, T: int):
+        z = self.f.zero(T)
+        return (z, z)
+
+    def one(self, T: int):
+        return (self.f.one(T), self.f.zero(T))
 
 
 def _point_add(f: _KernelField, p, q):
@@ -164,65 +223,60 @@ def _select(mask_t, a, b):
     return tuple(jnp.where(m, x, y) for x, y in zip(a, b))
 
 
-def _select_entry(digits_t, table, L, T):
-    """Per-lane table lookup: digits_t [T] ∈ [0,16) × table (16 point
-    triples of [L,T]) → one point triple.  Exactly one mask is true per
-    lane, so a masked sum implements the gather (Mosaic has no per-lane
-    dynamic gather)."""
-    selX = jnp.zeros((L, T), dtype=jnp.int32)
-    selY = jnp.zeros((L, T), dtype=jnp.int32)
-    selZ = jnp.zeros((L, T), dtype=jnp.int32)
-    for j in range(16):
-        m = (digits_t == j)[None, :]
-        X, Y, Z = table[j]
-        selX = selX + jnp.where(m, X, 0)
-        selY = selY + jnp.where(m, Y, 0)
-        selZ = selZ + jnp.where(m, Z, 0)
-    return (selX, selY, selZ)
+def _make_windowed_kernel(g2: bool):
+    """4-bit fixed-window scalar-mul kernel over G1 ([1,3,L,T] blocks)
+    or G2 ([1,3,2,L,T] blocks, Fq2 tuple elements).
+
+    Per window: 4 doublings + 1 complete add of a table entry selected
+    by a per-lane masked cascade (Mosaic has no per-lane gather) —
+    ~1.5× fewer sequential adds than the bit-serial scan.  The
+    16-entry multiples table (≈1–2 MB for T=128) lives in VMEM and
+    rides the ``fori_loop`` carry as a pytree."""
+
+    def kernel(pts_ref, digits_ref, fold_ref, pad_ref, out_ref):
+        fq = _KernelField(fold_ref[:], pad_ref[:])
+        f = _KernelField2(fq) if g2 else fq
+        if g2:
+            P = tuple(
+                (pts_ref[0, c, 0], pts_ref[0, c, 1]) for c in range(3)
+            )
+        else:
+            P = tuple(pts_ref[0, c] for c in range(3))
+        T = pts_ref.shape[-1]
+        nwin = digits_ref.shape[1]
+        ident = (f.zero(T), f.one(T), f.zero(T))
+        # table[j] = j·P (complete formulas make identity entries safe)
+        table = [ident, P]
+        for j in range(2, 16):
+            table.append(_point_add(f, table[j - 1], P))
+        table = tuple(table)
+
+        def body(w, carry):
+            acc, tab = carry
+            for _ in range(4):
+                acc = _point_add(f, acc, acc)
+            d = digits_ref[0, w]
+            sel = tab[0]
+            for j in range(1, 16):
+                m = (d == j)[None, :]
+                sel = tuple(
+                    f.where(m, cj, cs) for cj, cs in zip(tab[j], sel)
+                )
+            return (_point_add(f, acc, sel), tab)
+
+        (X, Y, Z), _ = jax.lax.fori_loop(0, nwin, body, (ident, table))
+        for c, el in enumerate((X, Y, Z)):
+            if g2:
+                out_ref[0, c, 0] = el[0]
+                out_ref[0, c, 1] = el[1]
+            else:
+                out_ref[0, c] = el
+
+    return kernel
 
 
-def _windowed_kernel(pts_ref, digits_ref, fold_ref, pad_ref, out_ref):
-    """4-bit fixed-window scalar-mul: pts_ref [1, 3, L, T]; digits_ref
-    [1, nwin, T] (msb-first 4-bit digits); out [1, 3, L, T].
-
-    Per window: 4 doublings + 1 complete add of the table entry —
-    ~1.5× fewer sequential adds than the bit-serial scan.  The 16-entry
-    multiples table (934 KB for T=128) is built once in VMEM."""
-    f = _KernelField(fold_ref[:], pad_ref[:])
-    L = f.L
-    P = (pts_ref[0, 0], pts_ref[0, 1], pts_ref[0, 2])
-    T = P[0].shape[1]
-    nwin = digits_ref.shape[1]
-    one = jnp.concatenate(
-        [jnp.ones((1, T), dtype=jnp.int32), jnp.zeros((L - 1, T), dtype=jnp.int32)],
-        axis=0,
-    )
-    zero = jnp.zeros((L, T), dtype=jnp.int32)
-    ident = (zero, one, zero)
-    # table[j] = j·P (complete formulas make identity entries safe)
-    table = [ident, P]
-    for j in range(2, 16):
-        table.append(_point_add(f, table[j - 1], P))
-    tX = jnp.stack([t[0] for t in table])  # [16, L, T] — one carry into
-    tY = jnp.stack([t[1] for t in table])  # the loop instead of 16 locals
-    tZ = jnp.stack([t[2] for t in table])
-
-    def body(w, carry):
-        acc, tX, tY, tZ = carry
-        for _ in range(4):
-            acc = _point_add(f, acc, acc)
-        d = digits_ref[0, w]
-        entry = _select_entry(
-            d, [(tX[j], tY[j], tZ[j]) for j in range(16)], L, T
-        )
-        return (_point_add(f, acc, entry), tX, tY, tZ)
-
-    (X, Y, Z), _, _, _ = jax.lax.fori_loop(
-        0, nwin, body, (ident, tX, tY, tZ)
-    )
-    out_ref[0, 0] = X
-    out_ref[0, 1] = Y
-    out_ref[0, 2] = Z
+_windowed_kernel = _make_windowed_kernel(g2=False)
+_windowed_kernel_g2 = _make_windowed_kernel(g2=True)
 
 
 # ---------------------------------------------------------------------------
@@ -259,8 +313,8 @@ def _scalar_mul_kernel(pts_ref, bits_ref, fold_ref, pad_ref, out_ref):
 
 
 def _run_tiles(kernel, pts_t: jnp.ndarray, aux_t: jnp.ndarray, interpret: bool):
-    """Shared pallas_call wrapper: pts_t [G, 3, L, T] + aux (bits or
-    digits) [G, n, T] + the field constants → [G, 3, L, T]."""
+    """Shared pallas_call wrapper: pts_t [G, 3, (2,) L, T] + aux (bits
+    or digits) [G, n, T] + the field constants → same point shape."""
     from jax.experimental import pallas as pl
 
     try:
@@ -269,7 +323,9 @@ def _run_tiles(kernel, pts_t: jnp.ndarray, aux_t: jnp.ndarray, interpret: bool):
         vmem = pltpu.VMEM
     except Exception:  # pragma: no cover - CPU-only environments
         vmem = None
-    G, _, L, T = pts_t.shape
+    G = pts_t.shape[0]
+    pt_block = (1,) + tuple(pts_t.shape[1:])
+    T = pts_t.shape[-1]
     n = aux_t.shape[1]
     f = _field()
     fold = jnp.asarray(np.asarray(f.fold))  # [nfold, B]
@@ -287,15 +343,15 @@ def _run_tiles(kernel, pts_t: jnp.ndarray, aux_t: jnp.ndarray, interpret: bool):
 
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((G, 3, L, T), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct(tuple(pts_t.shape), jnp.int32),
         grid=(G,),
         in_specs=[
-            spec((1, 3, L, T)),
+            spec(pt_block),
             spec((1, n, T)),
             spec(tuple(fold.shape), tiled=False),
             spec(tuple(pad.shape), tiled=False),
         ],
-        out_specs=spec((1, 3, L, T)),
+        out_specs=spec(pt_block),
         interpret=interpret,
     )(pts_t, aux_t, fold, pad)
 
@@ -310,25 +366,39 @@ def _windowed_tiles(pts_t: jnp.ndarray, dig_t: jnp.ndarray, interpret: bool):
     return _run_tiles(_windowed_kernel, pts_t, dig_t, interpret)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _windowed_g2_tiles(pts_t: jnp.ndarray, dig_t: jnp.ndarray, interpret: bool):
+    return _run_tiles(_windowed_kernel_g2, pts_t, dig_t, interpret)
+
+
 def _tile_transpose(pts: np.ndarray, aux: np.ndarray):
     """Pad K to the 128-lane tile and transpose into the kernel's
-    [limbs/windows, lanes] layout.  aux is bits or digits [K, n]."""
-    K, _, L = pts.shape
+    [limbs/windows, lanes] layout.  pts is [K, 3, L] (G1) or
+    [K, 3, 2, L] (G2); aux is bits or digits [K, n]."""
+    K = pts.shape[0]
+    mid = pts.shape[1:]  # (3, L) or (3, 2, L)
     n = aux.shape[1]
     G = max(1, -(-K // TILE))
     Kp = G * TILE
-    pts_p = np.zeros((Kp, 3, L), dtype=np.int32)
+    pts_p = np.zeros((Kp,) + mid, dtype=np.int32)
     pts_p[:K] = np.asarray(pts)
-    pts_p[K:, 1, 0] = 1  # pad with the identity (0 : 1 : 0)
+    if len(mid) == 2:
+        pts_p[K:, 1, 0] = 1  # pad with the identity (0 : 1 : 0)
+    else:
+        pts_p[K:, 1, 0, 0] = 1
     aux_p = np.zeros((Kp, n), dtype=np.int32)
     aux_p[:K] = np.asarray(aux)
-    pts_t = jnp.asarray(pts_p.reshape(G, TILE, 3, L).transpose(0, 2, 3, 1))
+    # [Kp, *mid] → [G, T, *mid] → [G, *mid, T]
+    perm = (0,) + tuple(range(2, 2 + len(mid))) + (1,)
+    pts_t = jnp.asarray(pts_p.reshape((G, TILE) + mid).transpose(perm))
     aux_t = jnp.asarray(aux_p.reshape(G, TILE, n).transpose(0, 2, 1))
     return pts_t, aux_t, G, Kp
 
 
-def _untile(out_t: jnp.ndarray, K: int, Kp: int, L: int) -> jnp.ndarray:
-    out = jnp.transpose(out_t, (0, 3, 1, 2)).reshape(Kp, 3, L)
+def _untile(out_t: jnp.ndarray, K: int, Kp: int) -> jnp.ndarray:
+    mid = out_t.shape[1:-1]  # (3, L) or (3, 2, L)
+    perm = (0, out_t.ndim - 1) + tuple(range(1, out_t.ndim - 1))
+    out = jnp.transpose(out_t, perm).reshape((Kp,) + mid)
     return out[:K]
 
 
@@ -340,10 +410,10 @@ def scalar_mul_pallas(
     the XLA scan (same op schedule)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    K, _, L = pts.shape
+    K = pts.shape[0]
     pts_t, bits_t, G, Kp = _tile_transpose(pts, bits)
     out_t = _scalar_mul_tiles(pts_t, bits_t, bool(interpret))
-    return _untile(out_t, K, Kp, L)
+    return _untile(out_t, K, Kp)
 
 
 def bits_to_digits(bits: np.ndarray) -> np.ndarray:
@@ -365,11 +435,25 @@ def scalar_mul_windowed(
     every other path (the redundant limb form may differ)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    K, _, L = pts.shape
+    K = pts.shape[0]
     digits = bits_to_digits(np.asarray(bits))
     pts_t, dig_t, G, Kp = _tile_transpose(pts, digits)
     out_t = _windowed_tiles(pts_t, dig_t, bool(interpret))
-    return _untile(out_t, K, Kp, L)
+    return _untile(out_t, K, Kp)
+
+
+def scalar_mul_windowed_g2(
+    pts: np.ndarray, bits: np.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """Batched G2 scalar-mul via the windowed kernel over Fq2:
+    pts [K, 3, 2, L] limbs × bits [K, nbits] → [K, 3, 2, L]."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    K = pts.shape[0]
+    digits = bits_to_digits(np.asarray(bits))
+    pts_t, dig_t, G, Kp = _tile_transpose(pts, digits)
+    out_t = _windowed_g2_tiles(pts_t, dig_t, bool(interpret))
+    return _untile(out_t, K, Kp)
 
 
 def g1_msm_pallas(
@@ -389,3 +473,22 @@ def g1_msm_pallas(
     bits = LB.scalars_to_bits(scalars, nbits)
     prods = scalar_mul_windowed(pts, bits, interpret=interpret)
     return ec_jax.g1_from_limbs(ec_jax.g1_kernel().tree_sum(prods))
+
+
+def g2_msm_pallas(
+    points: Sequence[Any],
+    scalars: Sequence[int],
+    nbits: int = 255,
+    interpret: Optional[bool] = None,
+):
+    """Full G2 MSM via the windowed Fq2 kernel + XLA tree reduction."""
+    from . import ec_jax
+
+    if not points:
+        from ..crypto.curve import G2
+
+        return G2.infinity()
+    pts = ec_jax.g2_to_limbs(points)
+    bits = LB.scalars_to_bits(scalars, nbits)
+    prods = scalar_mul_windowed_g2(pts, bits, interpret=interpret)
+    return ec_jax.g2_from_limbs(ec_jax.g2_kernel().tree_sum(prods))

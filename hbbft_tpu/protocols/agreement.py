@@ -28,6 +28,7 @@ from ..core.algorithm import DistAlgorithm, HbbftError
 from ..core.fault import FaultKind
 from ..core.network_info import NetworkInfo
 from ..core.serialize import wire
+from ..core.fault import log as _log
 from ..core.step import Step
 from .bool_set import BoolMultimap, BoolSet
 from .common_coin import CommonCoin, CommonCoinMessage, make_nonce
@@ -288,6 +289,10 @@ class Agreement(DistAlgorithm):
         if self.decision is not None:
             return Step()
         self.decision = b
+        _log.debug(
+            "%r: agreement on %r decided %s at epoch %d",
+            self.netinfo.our_id, self.proposer_id, b, self.epoch,
+        )
         step = Step.with_output(b)
         if self.netinfo.is_validator:
             step.send_all(AgreementMessage(self.epoch + 1, TermContent(b)))

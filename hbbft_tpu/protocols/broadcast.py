@@ -27,6 +27,7 @@ from ..core.algorithm import DistAlgorithm, HbbftError
 from ..core.fault import FaultKind
 from ..core.network_info import NetworkInfo
 from ..core.serialize import wire
+from ..core.fault import log as _log
 from ..core.step import Step, Target
 from ..crypto.merkle import MerkleProof
 
@@ -242,6 +243,10 @@ class Broadcast(DistAlgorithm):
                 self.proposer_id, FaultKind.BROADCAST_DECODING_FAILED
             )
         self.decided = True
+        _log.debug(
+            "%r: broadcast from %r delivered (%d bytes)",
+            self.netinfo.our_id, self.proposer_id, length,
+        )
         return Step.with_output(payload[4 : 4 + length])
 
     # -- helpers -----------------------------------------------------------
