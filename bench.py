@@ -166,6 +166,52 @@ def bench_coin64(flips: int = 3, nodes: int = 64):
     )
 
 
+def bench_coin1024(nodes: int = 1024, flips: int = 2):
+    """North-star scale (BASELINE target: N=1024 validators): the
+    vectorized co-simulation flips a real-BLS common coin across 1024
+    validators with ONE batched verification flush per flip — the
+    sequential path would need N² ≈ 1M pairing checks per flip
+    (~1 hour network-wide; extrapolated below from a measured sample)."""
+    import random as _r
+
+    from hbbft_tpu.crypto.threshold import PublicKeyShare, SignatureShare
+    from hbbft_tpu.harness.vectorized import VectorizedCoinSim
+
+    rng = _r.Random(0xC01)
+    t0 = time.perf_counter()
+    sim = VectorizedCoinSim(nodes, rng, mock=False)
+    # warm the per-index public-key-share cache (setup, not flip cost)
+    for nid in range(nodes):
+        sim.netinfos[0].public_key_share(nid)
+    setup_s = time.perf_counter() - t0
+
+    sim.flip(b"warm")  # compile/warm whatever the backend uses
+    t0 = time.perf_counter()
+    for i in range(flips):
+        r = sim.flip(b"bench-%d" % i)
+        assert len(r.outputs) == nodes
+    dt = (time.perf_counter() - t0) / flips
+
+    # sequential extrapolation from a measured per-share sample
+    ni = sim.netinfos[0]
+    share = ni.secret_key_share.sign(b"sample")
+    pk = ni.public_key_share(0)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        assert pk.verify_signature_share(share, b"sample")
+    per_verify = (time.perf_counter() - t0) / 8
+    seq_est = nodes * nodes * per_verify
+    return _emit(
+        "coin1024_flips_per_s",
+        1.0 / dt,
+        "flips/s",
+        vs_baseline=seq_est / dt,
+        seq_extrapolated_s_per_flip=round(seq_est, 1),
+        setup_s=round(setup_s, 1),
+        nodes=nodes,
+    )
+
+
 def bench_broadcast_1mb(nodes: int = 64):
     """Config 3: 1 MB payload reliable broadcast (RS encode/decode +
     Merkle build/verify dominate; reference ``broadcast.rs:332-404``)."""
@@ -282,6 +328,7 @@ SUITE = {
     "sim_default": lambda: bench_sim_default(batched=False),
     "sim_batched": lambda: bench_sim_default(batched=True),
     "coin64": bench_coin64,
+    "coin1024": bench_coin1024,
     "broadcast_1mb": bench_broadcast_1mb,
     "decshares": bench_decshares,
     "qhb_scale": bench_qhb_scale,

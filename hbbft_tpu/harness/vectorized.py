@@ -1,0 +1,176 @@
+"""Vectorized co-simulation — thousands of validators, one fused
+launch per protocol round.
+
+This is the execution model of the BASELINE north star: the sequential
+harnesses (``network.py``, ``simulation.py``) interleave one
+``handle_message`` at a time, which caps co-simulation at tens of nodes
+(O(N²) Python message handling); this module advances *all* N
+validators' state machines through a protocol round with array-level
+bookkeeping and a single batched crypto flush, preserving the exact
+outcomes the sequential path would produce:
+
+- **Share subset independence**: Lagrange interpolation in the exponent
+  yields the *unique* group signature from any t+1 valid shares
+  (``crypto/threshold.py``), so every correct node outputs the same
+  coin value regardless of message arrival order — the vectorized
+  all-at-once exchange is observationally equivalent to any
+  adversarial schedule that delivers > f valid shares
+  (asserted against ``TestNetwork`` runs in
+  ``tests/test_vectorized.py``).
+- **Deduplicated verification**: a sequential network verifies each
+  share at every receiver (N² pairim checks network-wide); the
+  vectorized round verifies each distinct share once (N² pairing
+  checks network-wide collapse to one random-linear-combination flush:
+  2 pairings + MSMs — the device kernels), and attributes invalid
+  shares to their senders exactly as
+  ``CommonCoin._handle_share`` would.
+
+Byzantine behavior is modeled the way the reference's adversary API
+does it (silent nodes, forged shares); the round reports per-node
+outputs plus the fault attribution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from ..core.fault import Fault, FaultKind, FaultLog
+from ..core.network_info import NetworkInfo
+from ..crypto import threshold as T
+from ..crypto.hashing import DST_SIG, hash_to_g1
+
+
+@dataclasses.dataclass
+class CoinRound:
+    """Outcome of one vectorized coin flip."""
+
+    value: bool
+    outputs: Dict[Any, bool]  # per live node (identical by agreement)
+    valid_senders: List[Any]
+    fault_log: FaultLog
+    crypto_flushes: int
+
+
+class VectorizedCoinSim:
+    """N-validator common-coin co-simulation (BASELINE config 2 at
+    north-star scale: n=1024 is a single flush instead of ~1M
+    sequential pairing checks).
+
+    Keys are dealt centrally like the test harnesses
+    (``NetworkInfo.generate_map``); ``mock`` uses the fast hash-based
+    crypto for protocol-logic runs.
+    """
+
+    def __init__(self, n: int, rng, mock: bool = False, ops: Any = None):
+        self.n = n
+        self.netinfos = NetworkInfo.generate_map(
+            list(range(n)), rng, mock=mock, ops=ops
+        )
+        self.mock = mock
+        ni = self.netinfos[0]
+        self.num_faulty = ni.num_faulty
+        self.pk_set = ni.public_key_set
+        self.ops = ni.ops
+
+    def flip(
+        self,
+        nonce: bytes,
+        dead: Optional[Set[Any]] = None,
+        forged: Optional[Dict[Any, Any]] = None,
+    ) -> CoinRound:
+        """One coin flip: every live validator signs and multicasts its
+        share; each distinct share is verified once (batched); every
+        live node combines > f valid shares → identical parity bit.
+
+        ``dead``: silent nodes (reference ``SilentAdversary``);
+        ``forged``: node id → bogus share (reference
+        ``FaultyShareAdversary`` pattern).
+        """
+        dead = dead or set()
+        forged = forged or {}
+        if self.n - len(dead) <= self.num_faulty:
+            raise ValueError("not enough live nodes to flip the coin")
+
+        # 1. sign (the per-node work a real deployment does locally)
+        shares: Dict[Any, Any] = {}
+        for nid, ni in self.netinfos.items():
+            if nid in dead:
+                continue
+            if nid in forged:
+                shares[nid] = forged[nid]
+            else:
+                shares[nid] = ni.secret_key_share.sign(nonce)
+
+        # 2. verify each distinct share once — one batched flush
+        faults = FaultLog()
+        flushes = 0
+        valid: Dict[Any, Any] = {}
+        if not self.mock:
+            items = sorted(shares.items())
+            real = [
+                (nid, s)
+                for nid, s in items
+                if isinstance(s, T.SignatureShare)
+            ]
+            for nid, s in items:
+                if not isinstance(s, T.SignatureShare):
+                    faults.add(nid, FaultKind.INVALID_SIGNATURE_SHARE)
+            if real:
+                flushes = 1
+                base = hash_to_g1(nonce, DST_SIG)
+                pks = [
+                    self.netinfos[0].public_key_share(nid) for nid, _ in real
+                ]
+                ok = self.ops.batch_verify_shares(
+                    [s.point for _, s in real],
+                    [pk.point for pk in pks],
+                    base,
+                    context=nonce,
+                )
+                if ok:
+                    valid = dict(real)
+                else:
+                    # bisecting fallback: per-item attribution, exactly
+                    # like the sequential handler
+                    for (nid, s), pk in zip(real, pks):
+                        if self.ops.verify_sig_share(pk, s, nonce):
+                            valid[nid] = s
+                        else:
+                            faults.add(
+                                nid, FaultKind.INVALID_SIGNATURE_SHARE
+                            )
+        else:
+            for nid, s in sorted(shares.items()):
+                pk = self.netinfos[0].public_key_share(nid)
+                try:
+                    ok = self.ops.verify_sig_share(pk, s, nonce)
+                except Exception:
+                    ok = False
+                if ok:
+                    valid[nid] = s
+                else:
+                    faults.add(nid, FaultKind.INVALID_SIGNATURE_SHARE)
+
+        if len(valid) <= self.num_faulty:
+            raise ValueError("fewer than f+1 valid shares — no coin")
+
+        # 3. combine — any t+1 valid shares give the unique signature,
+        # so one combine stands for every node's local combine
+        shares_by_idx = {
+            self.netinfos[0].node_index(nid): s for nid, s in valid.items()
+        }
+        sig = self.pk_set.combine_signatures(shares_by_idx)
+        if not self.pk_set.verify_signature(sig, nonce):
+            raise RuntimeError("combined coin signature failed verification")
+        value = sig.parity()
+        outputs = {
+            nid: value for nid in self.netinfos if nid not in dead
+        }
+        return CoinRound(
+            value=value,
+            outputs=outputs,
+            valid_senders=sorted(valid),
+            fault_log=faults,
+            crypto_flushes=flushes,
+        )

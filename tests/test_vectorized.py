@@ -1,0 +1,97 @@
+"""Vectorized co-simulation tests (``harness/vectorized.py``).
+
+Key contract: the vectorized round produces exactly the coin value and
+fault attribution a sequential adversarial network run would — the
+combined threshold signature is unique regardless of which > f valid
+shares each node happens to combine.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.harness.vectorized import VectorizedCoinSim
+from hbbft_tpu.protocols.common_coin import CommonCoin
+
+
+def _sequential_coin(seed, n, f_silent, nonce, mock):
+    """Reference result: a TestNetwork run with silent Byzantine nodes
+    under a random scheduler."""
+    rng = random.Random(seed)
+    net = TestNetwork(
+        n - f_silent,
+        f_silent,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        lambda ni: CommonCoin(ni, nonce),
+        rng,
+        mock_crypto=mock,
+    )
+    net.input_all(None)
+    net.step_until(lambda: all(n_.terminated() for n_ in net.nodes.values()))
+    vals = {n_.outputs[0] for n_ in net.nodes.values()}
+    assert len(vals) == 1
+    return vals.pop()
+
+
+@pytest.mark.parametrize("mock", [True, False])
+def test_matches_sequential_network(mock):
+    """Same keys (same rng seed) → the vectorized flip equals the
+    sequential adversarial network's coin, for several nonces."""
+    n, f = 7, 2
+    for i, nonce in enumerate([b"vec-a", b"vec-b", b"vec-c"]):
+        seq = _sequential_coin(1000 + i, n, f, nonce, mock)
+        vec = VectorizedCoinSim(
+            n, random.Random(1000 + i), mock=mock
+        ).flip(nonce, dead={n - 2, n - 1})
+        assert vec.value == seq
+        assert all(v == seq for v in vec.outputs.values())
+        assert vec.fault_log.is_empty()
+
+
+def test_forged_share_attribution():
+    """A well-formed but wrong share is rejected and attributed, and
+    the coin still completes from the honest shares."""
+    rng = random.Random(77)
+    sim = VectorizedCoinSim(7, rng, mock=False)
+    forged_share = sim.netinfos[3].secret_key_share.sign(b"WRONG-NONCE")
+    r = sim.flip(b"the-nonce", forged={3: forged_share})
+    assert 3 not in r.valid_senders
+    assert [(f.node_id, f.kind.name) for f in r.fault_log] == [
+        (3, "INVALID_SIGNATURE_SHARE")
+    ]
+    # and matches a clean flip's value (same keys, same honest shares
+    # are a superset of any t+1)
+    clean = sim.flip(b"the-nonce")
+    assert r.value == clean.value
+
+
+def test_garbage_share_rejected():
+    rng = random.Random(78)
+    sim = VectorizedCoinSim(4, rng, mock=False)
+    r = sim.flip(b"n", forged={2: b"not-a-share"})
+    assert 2 not in r.valid_senders
+    assert len(r.fault_log) == 1
+
+
+def test_mock_scale_distribution():
+    """Mock-crypto co-simulation at n=256: flips are produced and not
+    constant (distribution sanity, reference ``tests/common_coin.rs``
+    statistical check in spirit)."""
+    rng = random.Random(79)
+    sim = VectorizedCoinSim(256, rng, mock=True)
+    vals = [sim.flip(b"flip-%d" % i).value for i in range(20)]
+    assert 0 < sum(vals) < 20
+
+
+def test_too_few_live_nodes():
+    rng = random.Random(80)
+    sim = VectorizedCoinSim(4, rng, mock=True)
+    with pytest.raises(ValueError):
+        sim.flip(b"x", dead={1, 2, 3})
