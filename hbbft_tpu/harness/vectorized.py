@@ -39,6 +39,7 @@ from ..core.fault import Fault, FaultKind, FaultLog
 from ..core.network_info import NetworkInfo
 from ..crypto import threshold as T
 from ..crypto.hashing import DST_SIG, hash_to_g1
+from .batching import BatchingBackend, DecObligation
 
 
 @dataclasses.dataclass
@@ -173,4 +174,109 @@ class VectorizedCoinSim:
             valid_senders=sorted(valid),
             fault_log=faults,
             crypto_flushes=flushes,
+        )
+
+
+@dataclasses.dataclass
+class DecryptionRound:
+    """Outcome of one vectorized HoneyBadger decryption phase."""
+
+    contributions: Dict[Any, bytes]  # proposer → decrypted plaintext
+    fault_log: FaultLog
+    shares_verified: int
+
+
+class VectorizedHoneyBadgerRound:
+    """The decryption phase of one HoneyBadger epoch at co-simulation
+    scale — the framework's single hottest crypto surface
+    (``honey_badger.rs:351-444``: after the common subset decides, every
+    validator multicasts a decryption share per accepted proposer; each
+    node verifies N×P shares and combines > f per proposer).
+
+    Scope: this vectorizes the *decryption* phase given an agreed
+    ciphertext set (what ``CommonSubset`` outputs); the agreement path
+    itself runs in the sequential harnesses or the coin co-simulation.
+    Equivalence argument is the same as the coin's: combined plaintexts
+    are unique for any t+1 valid shares, and the deduplicated grouped
+    verification attributes faults exactly as the sequential
+    ``_verify_decryption_share`` would.
+    """
+
+    def __init__(self, n: int, rng, ops: Any = None):
+        self.n = n
+        self.rng = rng
+        self.netinfos = NetworkInfo.generate_map(
+            list(range(n)), rng, mock=False, ops=ops
+        )
+        ni = self.netinfos[0]
+        self.num_faulty = ni.num_faulty
+        self.pk_set = ni.public_key_set
+
+    def encrypt_contributions(
+        self, contributions: Dict[Any, bytes]
+    ) -> Dict[Any, Any]:
+        """What each proposer does locally before the common subset
+        (``honey_badger.rs:101-122``)."""
+        master = self.pk_set.public_key()
+        return {
+            pid: master.encrypt(data, self.rng)
+            for pid, data in contributions.items()
+        }
+
+    def decrypt_round(
+        self,
+        ciphertexts: Dict[Any, Any],
+        dead: Optional[Set[Any]] = None,
+        forged: Optional[Dict[Any, Dict[Any, Any]]] = None,
+    ) -> DecryptionRound:
+        """One epoch's decryption: every live node emits a share per
+        proposer; each distinct (sender, proposer) share is verified
+        once via the batching façade's grouped RLC flush; every
+        proposer's contribution is combined from the lowest t+1 valid
+        shares (the deterministic subset rule of
+        ``PublicKeySet.combine_decryption_shares``).
+
+        ``forged``: sender → {proposer → bogus share}.
+        """
+        dead = dead or set()
+        forged = forged or {}
+        be = BatchingBackend(inner=self.netinfos[0].ops)
+
+        # 1. share emission (per-node local work)
+        entries: List = []  # (proposer, sender, DecObligation)
+        for nid, ni in sorted(self.netinfos.items()):
+            if nid in dead:
+                continue
+            pk = ni.public_key_share(nid)
+            for pid, ct in sorted(ciphertexts.items()):
+                share = forged.get(nid, {}).get(pid)
+                if share is None:
+                    share = ni.secret_key_share.decrypt_share_no_verify(ct)
+                entries.append((pid, nid, DecObligation(pk, share, ct)))
+
+        # 2. one grouped verification flush for the whole round
+        be.prefetch(ob for _, _, ob in entries)
+        faults = FaultLog()
+        valid: Dict[Any, Dict[Any, Any]] = {}
+        flagged: Set[Any] = set()
+        for pid, nid, ob in entries:
+            if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
+                valid.setdefault(pid, {})[nid] = ob.share
+            elif nid not in flagged:
+                flagged.add(nid)
+                faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
+
+        # 3. combine per proposer (unique result from any t+1 shares)
+        out: Dict[Any, bytes] = {}
+        for pid, ct in sorted(ciphertexts.items()):
+            by_idx = {
+                self.netinfos[0].node_index(nid): s
+                for nid, s in valid.get(pid, {}).items()
+            }
+            if len(by_idx) <= self.num_faulty:
+                faults.add(pid, FaultKind.SHARE_DECRYPTION_FAILED)
+                continue
+            out[pid] = self.pk_set.combine_decryption_shares(by_idx, ct)
+        return DecryptionRound(
+            contributions=out, fault_log=faults, shares_verified=len(entries)
         )

@@ -95,3 +95,39 @@ def test_too_few_live_nodes():
     sim = VectorizedCoinSim(4, rng, mock=True)
     with pytest.raises(ValueError):
         sim.flip(b"x", dead={1, 2, 3})
+
+
+def test_hb_decryption_round_roundtrip():
+    """Full decryption phase: N=7 validators, 3 proposers; every
+    contribution round-trips through encrypt → shares → grouped
+    verification → combine."""
+    from hbbft_tpu.harness.vectorized import VectorizedHoneyBadgerRound
+
+    rng = random.Random(81)
+    sim = VectorizedHoneyBadgerRound(7, rng)
+    contribs = {p: b"contrib-%d" % p for p in (0, 2, 5)}
+    cts = sim.encrypt_contributions(contribs)
+    r = sim.decrypt_round(cts)
+    assert r.contributions == contribs
+    assert r.fault_log.is_empty()
+    assert r.shares_verified == 7 * 3
+
+
+def test_hb_decryption_round_byzantine():
+    """Dead nodes and forged shares: contributions still decrypt
+    (> f honest shares remain) and forgers are attributed."""
+    from hbbft_tpu.harness.vectorized import VectorizedHoneyBadgerRound
+
+    rng = random.Random(82)
+    sim = VectorizedHoneyBadgerRound(7, rng)
+    contribs = {p: b"data-%d" % p for p in (1, 4)}
+    cts = sim.encrypt_contributions(contribs)
+    # node 6 silent; node 3 sends a share for the wrong ciphertext
+    wrong = sim.netinfos[3].secret_key_share.decrypt_share_no_verify(
+        cts[4]
+    )
+    r = sim.decrypt_round(cts, dead={6}, forged={3: {1: wrong}})
+    assert r.contributions == contribs
+    assert [(f.node_id, f.kind.name) for f in r.fault_log] == [
+        (3, "INVALID_DECRYPTION_SHARE")
+    ]
