@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Timed QueueingHoneyBadger network simulation — the reference's
+headline benchmark binary (``examples/simulation.rs``), same flag
+surface and per-epoch output table.
+
+    python examples/simulation.py -n 10 -f 0 -t 1000 -b 100 \
+        --lag 100 --bw 2000 --cpu 100 --tx-size 10
+
+Add ``--real-bls`` for real BLS12-381 threshold crypto (default: fast
+mock crypto, like protocol-logic tests) and ``--batched`` to route
+share verifications through the fused batching façade.
+"""
+
+import argparse
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hbbft_tpu.harness.simulation import simulate_queueing_honey_badger
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--nodes", type=int, default=10, help="total validators")
+    p.add_argument("-f", "--faulty", type=int, default=0, help="crashed (silent) nodes")
+    p.add_argument("-t", "--txs", type=int, default=1000, help="transactions to process")
+    p.add_argument("-b", "--batch", type=int, default=100, help="batch size (txs/epoch)")
+    p.add_argument("--lag", type=float, default=100.0, help="message latency, ms")
+    p.add_argument("--bw", type=float, default=2000.0, help="upstream bandwidth, kbit/s")
+    p.add_argument("--cpu", type=float, default=100.0, help="CPU speed, %% of host")
+    p.add_argument("--tx-size", type=int, default=10, help="transaction size, bytes")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--real-bls", action="store_true", help="real BLS12-381 crypto")
+    p.add_argument("--batched", action="store_true", help="fused batched verification")
+    args = p.parse_args()
+
+    if 3 * args.faulty >= args.nodes:
+        p.error("requires 3·f < n")
+
+    ops = None
+    if args.batched:
+        from hbbft_tpu.harness.batching import BatchingBackend
+
+        ops = BatchingBackend()
+
+    stats, wall, sim_time = simulate_queueing_honey_badger(
+        num_nodes=args.nodes,
+        num_dead=args.faulty,
+        num_txs=args.txs,
+        batch_size=args.batch,
+        tx_size=args.tx_size,
+        lag_ms=args.lag,
+        bw_kbit_s=args.bw,
+        cpu_pct=args.cpu,
+        rng=random.Random(args.seed),
+        mock_crypto=not args.real_bls,
+        ops=ops,
+        verbose=True,
+    )
+    print(
+        f"\n{len(stats.rows)} epochs | wall {wall:.2f}s "
+        f"({len(stats.rows) / wall:.2f} epochs/s) | simulated {sim_time:.2f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()
