@@ -75,6 +75,9 @@ class SimNode:
         # batched prefetch (harness/batching.py); populated only when
         # the network runs a batching backend
         self.pending_obs: List[Any] = []
+        # scheduler version: stamps the node's live event-heap entry
+        # (see SimNetwork._push_event)
+        self.sched_ver = 0
         if initial_step is not None and not dead:
             self._send_output_and_msgs(initial_step, 0.0)
 
@@ -82,6 +85,7 @@ class SimNode:
         self.__dict__.update(state)
         # checkpoints from before the enqueue-time extraction change
         self.__dict__.setdefault("pending_obs", [])
+        self.__dict__.setdefault("sched_ver", 0)
 
     # -- queue -------------------------------------------------------------
 
@@ -150,6 +154,14 @@ class SimNetwork:
         # backend will consume them
         self._collect_obs = ops is not None and hasattr(ops, "prefetch")
         self.nodes: Dict[Any, SimNode] = {}
+        # lazy event heap: (next_event_time, seq, nid, ver).  Every
+        # state change that can move a node's next event pushes a fresh
+        # version-stamped entry; step() discards entries whose version
+        # is no longer the node's latest — exactly one live entry per
+        # node, O(log M) scheduling instead of scanning all N nodes per
+        # step (which made the whole co-simulation O(N³)).
+        self._heap: List[Tuple[float, int, Any, int]] = []
+        self._hseq = 0
         for nid in range(num_nodes):
             result = new_algo(netinfos[nid])
             algo, step = result if isinstance(result, tuple) else (result, None)
@@ -157,6 +169,23 @@ class SimNetwork:
             dead = nid >= num_nodes - num_dead
             self.nodes[nid] = SimNode(algo, step, hw, dead=dead)
         self._drain_out_queues()
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        # checkpoints from before the event-heap scheduler: rebuild
+        if "_heap" not in self.__dict__:
+            self._heap = []
+            self._hseq = 0
+            for nid in self.nodes:
+                self._push_event(nid)
+
+    def _push_event(self, nid) -> None:
+        node = self.nodes[nid]
+        t = node.next_event_time()
+        if t is not None:
+            node.sched_ver += 1
+            self._hseq += 1
+            heapq.heappush(self._heap, (t, self._hseq, nid, node.sched_ver))
 
     def _drain_out_queues(self) -> None:
         msgs = []
@@ -167,17 +196,29 @@ class SimNetwork:
         for sender_id, (arrival, target, message, size) in msgs:
             self._dispatch(sender_id, arrival, target, message, size)
 
+    def _drain_node(self, nid) -> None:
+        """Dispatch only ``nid``'s pending sends (the only node whose
+        out_queue can be non-empty after it handled one message)."""
+        node = self.nodes[nid]
+        if not node.out_queue:
+            return
+        items, node.out_queue = node.out_queue, []
+        for arrival, target, message, size in items:
+            self._dispatch(nid, arrival, target, message, size)
+
     def _dispatch(self, sender_id, arrival, target, message, size) -> None:
         if target.is_all:
             for nid, node in self.nodes.items():
                 if nid != sender_id:
                     node.add_message(arrival, sender_id, message, size)
                     self._note_obs(node, sender_id, message)
+                    self._push_event(nid)
         else:
             node = self.nodes.get(target.node)
             if node is not None:
                 node.add_message(arrival, sender_id, message, size)
                 self._note_obs(node, sender_id, message)
+                self._push_event(target.node)
 
     def _note_obs(self, node: SimNode, sender_id, message) -> None:
         """Extract the message's crypto obligations once, at enqueue
@@ -211,25 +252,48 @@ class SimNetwork:
         backend.prefetch(self.queued_obligations())
 
     def step(self) -> Optional[Any]:
-        """Advance the node with the earliest next event by one message."""
-        candidates = [
-            (t, nid)
-            for nid, node in self.nodes.items()
-            if (t := node.next_event_time()) is not None
-        ]
-        if not candidates:
-            return None
-        min_time = min(t for t, _ in candidates)
-        min_ids = [nid for t, nid in candidates if t == min_time]
-        next_id = self.rng.choice(sorted(min_ids))
-        node = self.nodes[next_id]
-        node.handle_message()
-        self._drain_out_queues()
-        return next_id
+        """Advance the node with the earliest next event by one message.
+
+        Lazy-heap scheduling invariant: every mutation that can move a
+        node's next event goes through ``_push_event``, which bumps the
+        node's version stamp — so the entry carrying the node's current
+        version is accurate by construction, and any other entry is
+        dead and simply discarded.  Equal-time heads are tie-broken
+        with the scheduler RNG (same seed-driven schedule diversity as
+        the reference's scan, ``simulation.rs:313-324``)."""
+        while self._heap:
+            t, _, nid, ver = heapq.heappop(self._heap)
+            node = self.nodes[nid]
+            if ver != node.sched_ver:
+                continue  # dead entry (superseded)
+            if node.next_event_time() is None:
+                continue  # queue drained since this entry was pushed
+            # collect live entries tied at the same time; rng picks
+            ties = [nid]
+            while self._heap and self._heap[0][0] == t:
+                _, _, nid2, ver2 = heapq.heappop(self._heap)
+                node2 = self.nodes[nid2]
+                if ver2 == node2.sched_ver and node2.next_event_time() is not None:
+                    ties.append(nid2)
+            if len(ties) > 1:
+                chosen = self.rng.choice(sorted(ties))
+                for other in ties:
+                    if other != chosen:
+                        self._push_event(other)
+            else:
+                chosen = ties[0]
+            node = self.nodes[chosen]
+            node.handle_message()
+            self._drain_node(chosen)
+            self._push_event(chosen)
+            return chosen
+        return None
 
     def input(self, nid, value) -> None:
         self.nodes[nid].handle_input(value)
         self._drain_out_queues()
+        # handle_input advanced the node's clock → refresh its entry
+        self._push_event(nid)
 
     def message_count(self) -> int:
         return sum(n.message_count for n in self.nodes.values())
