@@ -45,9 +45,20 @@ class _DeviceMerkleTree(MerkleTree):
 
 
 class TpuBackend(CpuBackend):
-    """Batched JAX/TPU ops backend (bit-identical to ``CpuBackend``)."""
+    """Batched JAX/TPU ops backend (bit-identical to ``CpuBackend``).
+
+    ``mesh``: an optional ``jax.sharding.Mesh`` — G1 MSMs beyond the
+    device threshold then shard over the validator axis with the
+    all-gather + tree reduction of ``parallel/mesh.py`` (multi-chip
+    scale-out; validated on the virtual CPU mesh in
+    ``tests/test_parallel.py`` and by the driver's multi-chip dry run).
+    """
 
     name = "tpu"
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self._sharded_g1 = None
 
     # -- hashing / merkle -------------------------------------------------
 
@@ -95,6 +106,18 @@ class TpuBackend(CpuBackend):
         points, scalars = list(points), list(scalars)
         if self._native_host() and len(points) < self.G1_DEVICE_MIN:
             return super().g1_msm(points, scalars)
+        if self.mesh is not None:
+            from ..parallel import mesh as M
+
+            if self._sharded_g1 is None:
+                self._sharded_g1 = M.sharded_msm_fn(self.mesh)
+            import jax.numpy as jnp
+            from . import limbs as LB
+
+            w = ec_jax._width(scalars, None)
+            pts = jnp.asarray(ec_jax.g1_to_limbs(points))
+            bits = jnp.asarray(LB.scalars_to_bits(scalars, w))
+            return ec_jax.g1_from_limbs(self._sharded_g1(pts, bits))
         return ec_jax.g1_msm(points, scalars)
 
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
