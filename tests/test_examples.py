@@ -1,0 +1,71 @@
+"""The shipped examples stay runnable (the reference exercises its
+examples in CI; here they run as subprocess integration tests)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_ports(n):
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_simulation_cli():
+    out = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "examples" / "simulation.py"),
+            "-n", "5", "-f", "1", "-t", "40", "-b", "20",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "epochs/s" in out.stdout
+    assert "Epoch" in out.stdout  # the per-epoch stats table header
+
+
+def test_consensus_node_cli_three_processes():
+    ports = _free_ports(3)
+    addrs = sorted(f"127.0.0.1:{p}" for p in ports)
+    procs = []
+    try:
+        for addr in addrs:
+            remotes = [a for a in addrs if a != addr]
+            cmd = [
+                sys.executable,
+                str(REPO / "examples" / "consensus_node.py"),
+                f"--bind-address={addr}",
+            ] + [f"--remote-address={r}" for r in remotes]
+            if addr == addrs[0]:
+                cmd.append("--value=example-test")
+            procs.append(
+                subprocess.Popen(
+                    cmd,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    cwd=REPO,
+                )
+            )
+        outs = [p.communicate(timeout=60)[0] for p in procs]
+        for out in outs:
+            assert "agreed value: b'example-test'" in out, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
