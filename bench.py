@@ -259,9 +259,10 @@ def bench_hb_dec_round(nodes: int = 256, proposers: int = 64):
     )
 
 
-def bench_broadcast_1mb(nodes: int = 64):
+def bench_broadcast_1mb(nodes: int = 64, device: bool = False):
     """Config 3: 1 MB payload reliable broadcast (RS encode/decode +
-    Merkle build/verify dominate; reference ``broadcast.rs:332-404``)."""
+    Merkle build/verify dominate; reference ``broadcast.rs:332-404``).
+    ``device=True`` routes the RS/Merkle work through the TPU kernels."""
     from hbbft_tpu.harness.network import (
         MessageScheduler,
         SilentAdversary,
@@ -269,8 +270,13 @@ def bench_broadcast_1mb(nodes: int = 64):
     )
     from hbbft_tpu.protocols.broadcast import Broadcast
 
+    ops = None
+    if device:
+        from hbbft_tpu.ops.backend_tpu import TpuBackend
+
+        ops = TpuBackend()
     rng = random.Random(0xB0)
-    payload = bytes(rng.randrange(256) for _ in range(1 << 20))
+    payload = rng.randbytes(1 << 20)
     net = TestNetwork(
         nodes - (nodes - 1) // 3,
         (nodes - 1) // 3,
@@ -279,6 +285,7 @@ def bench_broadcast_1mb(nodes: int = 64):
         ),
         lambda ni: Broadcast(ni, 0),
         rng,
+        ops=ops,
     )
     t0 = time.perf_counter()
     net.input(0, payload)
@@ -286,7 +293,7 @@ def bench_broadcast_1mb(nodes: int = 64):
     dt = time.perf_counter() - t0
     assert all(n.outputs == [payload] for n in net.nodes.values())
     return _emit(
-        "broadcast_1mb_s", dt, "s", nodes=nodes
+        "broadcast_1mb_s", dt, "s", nodes=nodes, backend="tpu" if device else "native",
     )
 
 

@@ -124,6 +124,8 @@ def test_device_codec_stripped_from_snapshot():
     from hbbft_tpu.crypto.rs import ReedSolomon
 
     net_rng = random.Random(94)
+    be = TpuBackend()
+    be._native_host = lambda: False  # force the device codec path
     net = TestNetwork(
         6,
         2,
@@ -132,7 +134,7 @@ def test_device_codec_stripped_from_snapshot():
         ),
         lambda ni: Broadcast(ni, 0),
         net_rng,
-        ops=TpuBackend(),
+        ops=be,
     )
     payload = bytes(random.Random(95).randrange(256) for _ in range(2048))
     net.input(0, payload)
