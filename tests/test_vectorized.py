@@ -97,6 +97,57 @@ def test_too_few_live_nodes():
         sim.flip(b"x", dead={1, 2, 3})
 
 
+def test_broadcast_round_roundtrip():
+    """N=16, 1 KB payload round-trips; equals the sequential TestNetwork
+    broadcast output for the same payload."""
+    from hbbft_tpu.harness.vectorized import VectorizedBroadcastRound
+    from hbbft_tpu.protocols.broadcast import Broadcast
+
+    payload = bytes(range(256)) * 4
+    rng = random.Random(83)
+    vec = VectorizedBroadcastRound(16, rng).broadcast(payload)
+    assert vec.value == payload
+    assert vec.fault_log.is_empty()
+    assert len(vec.valid_shard_holders) == 16
+
+    net_rng = random.Random(83)
+    net = TestNetwork(
+        11, 5,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, net_rng)
+        ),
+        lambda ni: Broadcast(ni, 0), net_rng,
+    )
+    net.input(0, payload)
+    net.step_until(lambda: all(n.terminated() for n in net.nodes.values()))
+    assert all(n.outputs == [vec.value] for n in net.nodes.values())
+
+
+def test_broadcast_round_byzantine():
+    """Dead nodes + tampered echo shards: tamperers attributed, payload
+    still reconstructs from the honest ≥ N−2f shards."""
+    from hbbft_tpu.harness.vectorized import VectorizedBroadcastRound
+
+    rng = random.Random(84)
+    sim = VectorizedBroadcastRound(16, rng)  # f=5, data=6, parity=10
+    payload = b"tamper-resistant-payload" * 20
+    r = sim.broadcast(
+        payload, dead={14, 15}, corrupt={7: b"\x00" * 8, 9: b"junk"}
+    )
+    assert r.value == payload
+    assert sorted(f.node_id for f in r.fault_log) == [7, 9]
+    assert 7 not in r.valid_shard_holders
+
+
+def test_broadcast_round_too_few_shards():
+    from hbbft_tpu.harness.vectorized import VectorizedBroadcastRound
+
+    rng = random.Random(85)
+    sim = VectorizedBroadcastRound(4, rng)  # f=1, data=2
+    with pytest.raises(ValueError):
+        sim.broadcast(b"x", dead={1, 2, 3})
+
+
 def test_hb_decryption_round_roundtrip():
     """Full decryption phase: N=7 validators, 3 proposers; every
     contribution round-trips through encrypt → shares → grouped
