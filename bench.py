@@ -229,13 +229,15 @@ def bench_broadcast_vec(nodes: int = 256):
     r = sim.broadcast(payload)
     dt = time.perf_counter() - t0
     assert r.value == payload
-    seq_measured = 4.4  # bench_broadcast_1mb(nodes=256), this host
+    # baseline: the sequential network run at the same size, measured
+    # in-process on this host/backend
+    seq = bench_broadcast_1mb(nodes=nodes)
     return _emit(
         "broadcast_vec_s",
         dt,
         "s",
-        vs_baseline=seq_measured / dt,
-        seq_measured_s=seq_measured,
+        vs_baseline=seq["value"] / dt,
+        seq_measured_s=seq["value"],
         nodes=nodes,
     )
 

@@ -220,26 +220,32 @@ class VectorizedBroadcastRound:
         value: bytes,
         dead: Optional[Set[Any]] = None,
         corrupt: Optional[Dict[Any, bytes]] = None,
+        proposer: Any = None,
     ) -> BroadcastRound:
         """One broadcast: encode + commit (proposer work), validate
         every live node's echoed proof once, decode once from the valid
         shard set.  ``corrupt``: node id → substituted shard bytes (the
-        echo-tampering adversary); ``dead``: silent nodes."""
+        echo-tampering adversary); ``dead``: silent nodes.
+
+        Liveness guard mirrors the sequential protocol's tolerance: at
+        most f Byzantine/silent nodes (the Ready phase needs N−f
+        distinct Echos before anyone commits, ``broadcast.rs:460-466``),
+        not merely enough shards to reconstruct."""
+        from ..protocols.broadcast import frame_into_shards, unframe_shards
+
         dead = dead or set()
         corrupt = corrupt or {}
-        if self.n - len(dead) < self.data:
-            raise ValueError("not enough live nodes to reconstruct")
+        proposer = proposer if proposer is not None else sorted(self.netinfos)[0]
+        byzantine = set(dead) | set(corrupt)
+        if len(byzantine) > self.num_faulty:
+            raise ValueError(
+                f"{len(byzantine)} Byzantine nodes exceeds the "
+                f"f={self.num_faulty} bound"
+            )
 
         # proposer path (reference ``send_shards``)
-        payload = len(value).to_bytes(4, "big") + bytes(value)
-        shard_len = max(-(-len(payload) // self.data), 1)
-        padded = payload.ljust(shard_len * self.data, b"\x00")
-        data = [
-            padded[i * shard_len : (i + 1) * shard_len]
-            for i in range(self.data)
-        ]
         codec = self.ops.rs_codec(self.data, self.parity)
-        shards = codec.encode(data)
+        shards = codec.encode(frame_into_shards(bytes(value), self.data))
         mtree = self.ops.merkle_tree(shards)
         root = mtree.root_hash
 
@@ -269,13 +275,15 @@ class VectorizedBroadcastRound:
 
         # decode once (any ≥ N−2f shards of one codeword reconstruct
         # the same payload); re-root to catch proposer equivocation
-        full = codec.reconstruct(list(echoed))
+        full = codec.reconstruct(echoed)
         if self.ops.merkle_tree(full).root_hash != root:
-            faults.add(0, FaultKind.BROADCAST_DECODING_FAILED)
+            faults.add(proposer, FaultKind.BROADCAST_DECODING_FAILED)
             return BroadcastRound(None, faults, holders)
-        joined = b"".join(full[: self.data])
-        length = int.from_bytes(joined[:4], "big")
-        return BroadcastRound(joined[4 : 4 + length], faults, holders)
+        out = unframe_shards(full, self.data)
+        if out is None:
+            faults.add(proposer, FaultKind.BROADCAST_DECODING_FAILED)
+            return BroadcastRound(None, faults, holders)
+        return BroadcastRound(out, faults, holders)
 
 
 @dataclasses.dataclass

@@ -53,6 +53,31 @@ class BroadcastReady:
 BroadcastMessage = Any  # one of the three dataclasses above
 
 
+def frame_into_shards(value: bytes, data_shard_num: int) -> List[bytes]:
+    """Length-prefix + pad + split into equal data shards (reference
+    ``send_shards``, ``broadcast.rs:341-363``).  Shared by the protocol
+    proposer path and the vectorized co-simulation round."""
+    payload = len(value).to_bytes(4, "big") + value
+    shard_len = max(-(-len(payload) // data_shard_num), 1)
+    padded = payload.ljust(shard_len * data_shard_num, b"\x00")
+    return [
+        padded[i * shard_len : (i + 1) * shard_len]
+        for i in range(data_shard_num)
+    ]
+
+
+def unframe_shards(shards: List[bytes], data_shard_num: int) -> Optional[bytes]:
+    """Inverse of :func:`frame_into_shards`: join + strip the 4-byte
+    length header (reference ``glue_shards``, ``broadcast.rs:697-707``).
+    Returns None if the length header is inconsistent (a malformed
+    proposal — the caller attributes the fault)."""
+    payload = b"".join(shards[:data_shard_num])
+    length = int.from_bytes(payload[:4], "big")
+    if length > len(payload) - 4:
+        return None
+    return payload[4 : 4 + length]
+
+
 class BroadcastError(HbbftError):
     pass
 
@@ -129,14 +154,7 @@ class Broadcast(DistAlgorithm):
     def _send_shards(self, value: bytes):
         """RS-encode + Merkle-commit the value; unicast proof i to node i
         (reference ``send_shards``, ``broadcast.rs:332-404``)."""
-        payload = len(value).to_bytes(4, "big") + value
-        shard_len = -(-len(payload) // self.data_shard_num)
-        shard_len = max(shard_len, 1)
-        padded = payload.ljust(shard_len * self.data_shard_num, b"\x00")
-        data = [
-            padded[i * shard_len : (i + 1) * shard_len]
-            for i in range(self.data_shard_num)
-        ]
+        data = frame_into_shards(value, self.data_shard_num)
         shards = self.coding.encode(data)
         mtree = self.netinfo.ops.merkle_tree(shards)
         step: Step = Step()
@@ -236,18 +254,17 @@ class Broadcast(DistAlgorithm):
             return Step.from_fault(
                 self.proposer_id, FaultKind.BROADCAST_DECODING_FAILED
             )
-        payload = b"".join(shards[: self.data_shard_num])
-        length = int.from_bytes(payload[:4], "big")
-        if length > len(payload) - 4:
+        value = unframe_shards(shards, self.data_shard_num)
+        if value is None:
             return Step.from_fault(
                 self.proposer_id, FaultKind.BROADCAST_DECODING_FAILED
             )
         self.decided = True
         _log.debug(
             "%r: broadcast from %r delivered (%d bytes)",
-            self.netinfo.our_id, self.proposer_id, length,
+            self.netinfo.our_id, self.proposer_id, len(value),
         )
-        return Step.with_output(payload[4 : 4 + length])
+        return Step.with_output(value)
 
     # -- helpers -----------------------------------------------------------
 
