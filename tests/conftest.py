@@ -25,8 +25,10 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the EC scalar-mul scans are large XLA
 # programs (minutes to compile cold); cache them across test runs.
-os.makedirs("/tmp/hbbft_tpu_xla_cache", exist_ok=True)
-jax.config.update("jax_compilation_cache_dir", "/tmp/hbbft_tpu_xla_cache")
+# Repo-local so it survives across driver rounds (git-ignored).
+_CACHE = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".xla_cache")
+os.makedirs(_CACHE, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _CACHE)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import random  # noqa: E402
