@@ -22,7 +22,7 @@ from typing import Any, List, Optional, Sequence
 from .curve import G1, G2, g1_multi_exp, g2_multi_exp
 from .hashing import sha256
 from .merkle import MerkleProof, MerkleTree
-from .rs import ReedSolomon
+from .rs import make_codec
 from . import threshold as T
 
 
@@ -45,8 +45,8 @@ class CpuBackend:
 
     # -- erasure coding ---------------------------------------------------
 
-    def rs_codec(self, data_shards: int, parity_shards: int) -> ReedSolomon:
-        return ReedSolomon(data_shards, parity_shards)
+    def rs_codec(self, data_shards: int, parity_shards: int):
+        return make_codec(data_shards, parity_shards)
 
     # -- group MSMs -------------------------------------------------------
 

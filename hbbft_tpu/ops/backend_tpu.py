@@ -96,6 +96,8 @@ class TpuBackend(CpuBackend):
     def rs_codec(self, data_shards: int, parity_shards: int):
         if parity_shards == 0 or self._native_host():
             return super().rs_codec(data_shards, parity_shards)
+        if data_shards + parity_shards > 256:
+            return gf256_jax.ReedSolomonDevice16(data_shards, parity_shards)
         return gf256_jax.ReedSolomonDevice(data_shards, parity_shards)
 
     # -- group MSMs --------------------------------------------------------

@@ -44,7 +44,7 @@ def _gf_mul_table_bits(c: int) -> np.ndarray:
     return np.array(cols, dtype=np.int8).T  # [out_bit, in_bit]
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=256)  # decode matrices vary per erasure pattern
 def _binary_matrix(key: Tuple[int, int, bytes]) -> np.ndarray:
     """GF(256) matrix (m, k) → binary matrix (8m, 8k) int8."""
     m, k, raw = key
@@ -154,4 +154,126 @@ class ReedSolomonDevice:
             rec = np.asarray(gf_matmul_device(rows, data))
             for j, i in enumerate(missing):
                 out[i] = rec[j].tobytes()
+        return out  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# GF(2^16) generalization — the north-star n=1024 broadcast path
+# ---------------------------------------------------------------------------
+# Identical strategy with 16-bit symbols: multiplication by a constant
+# c ∈ GF(2^16) is GF(2)-linear, a 16×16 binary matrix, so an (m×k)
+# GF(2^16) matmul lowers to a (16m×16k) binary matmul + parity.
+
+_BITS16: Optional[np.ndarray] = None  # [65536, 16, 16] uint8
+
+
+def _bits16_table() -> np.ndarray:
+    """M[c] with bits16(c·x) = M[c] @ bits16(x), for every constant c
+    (built vectorised from the host log/antilog tables, ~16 MB)."""
+    global _BITS16
+    if _BITS16 is None:
+        _host_rs._build_tables16()
+        exp, log = _host_rs._EXP16, _host_rs._LOG16
+        cs = np.arange(65536, dtype=np.int64)
+        table = np.zeros((65536, 16, 16), dtype=np.uint8)
+        for bit in range(16):
+            prod = np.where(cs == 0, 0, exp[log[cs] + int(log[1 << bit])])
+            for r in range(16):
+                table[:, r, bit] = (prod >> r) & 1
+        _BITS16 = table
+    return _BITS16
+
+
+@functools.lru_cache(maxsize=8)  # ~30-60 MB each; decode patterns vary
+def _binary_matrix16(key: Tuple[int, int, bytes]) -> np.ndarray:
+    """GF(2^16) matrix (m, k) → binary matrix (16m, 16k) int8."""
+    m, k, raw = key
+    mat = np.frombuffer(raw, dtype=np.uint16).reshape(m, k)
+    blocks = _bits16_table()[mat]  # [m, k, 16, 16]
+    return (
+        blocks.transpose(0, 2, 1, 3).reshape(16 * m, 16 * k).astype(np.int8)
+    )
+
+
+def _unpack_bits16(x: jnp.ndarray) -> jnp.ndarray:
+    """[k, n] uint16 → [16k, n] int8 bit planes (lsb-first)."""
+    shifts = jnp.arange(16, dtype=jnp.uint16)
+    bits = (x[:, None, :] >> shifts[None, :, None]) & 1
+    return bits.reshape(-1, x.shape[-1]).astype(jnp.int8)
+
+
+def _pack_bits16(bits: jnp.ndarray) -> jnp.ndarray:
+    """[16m, n] int32 bit planes → [m, n] uint16."""
+    m16 = bits.shape[0]
+    b = bits.reshape(m16 // 16, 16, -1).astype(jnp.uint16)
+    shifts = jnp.arange(16, dtype=jnp.uint16)
+    return jnp.sum(b << shifts[None, :, None], axis=1).astype(jnp.uint16)
+
+
+@jax.jit
+def _bitsliced_matmul16(binmat: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    bits = _unpack_bits16(data)  # [16k, n]
+    acc = jnp.matmul(binmat.astype(jnp.int32), bits.astype(jnp.int32))
+    return _pack_bits16(acc & 1)
+
+
+def gf16_matmul_device(mat: np.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """Constant GF(2^16) matrix × uint16 symbol matrix on device."""
+    m, k = mat.shape
+    binmat = jnp.asarray(
+        _binary_matrix16(
+            (m, k, np.ascontiguousarray(mat, dtype=np.uint16).tobytes())
+        )
+    )
+    return _bitsliced_matmul16(binmat, data)
+
+
+class ReedSolomonDevice16:
+    """Device-accelerated GF(2^16) codec (semantics of
+    ``crypto.rs.ReedSolomon16``) — lifts the reference crate's 256-shard
+    cap (``/root/reference/src/broadcast.rs:310-312``) to 65536 with the
+    payload matmuls on the MXU."""
+
+    symbol = 2
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        self._host = _host_rs.ReedSolomon16(data_shards, parity_shards)
+        self.k = self._host.k
+        self.m = self._host.m
+        self.n = self._host.n
+
+    def _to_syms(self, shard: bytes) -> np.ndarray:
+        return self._host._to_syms(shard)
+
+    def encode(self, data: Sequence[bytes]) -> List[bytes]:
+        if len(data) != self.k:
+            raise ValueError(f"expected {self.k} data shards")
+        if self.m == 0:
+            return list(data)
+        arr = jnp.asarray(np.stack([self._to_syms(s) for s in data]))
+        parity = np.asarray(
+            gf16_matmul_device(self._host.matrix[self.k :], arr)
+        )
+        return list(data) + [p.astype("<u2").tobytes() for p in parity]
+
+    def reconstruct(self, shards: List[Optional[bytes]]) -> List[bytes]:
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} shard slots")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.k:
+            raise ValueError("not enough shards to reconstruct")
+        if self.m == 0:
+            return [s for s in shards]  # type: ignore[misc]
+        use = present[: self.k]
+        dec = _host_rs._gf16_mat_inv(self._host.matrix[use, :].copy())
+        avail = jnp.asarray(np.stack([self._to_syms(shards[i]) for i in use]))
+        data = gf16_matmul_device(dec, avail)
+        missing = [i for i, s in enumerate(shards) if s is None]
+        out: List[Optional[bytes]] = list(shards)
+        if missing:
+            rec = np.asarray(
+                gf16_matmul_device(self._host.matrix[missing, :], data)
+            )
+            for j, i in enumerate(missing):
+                out[i] = rec[j].astype("<u2").tobytes()
         return out  # type: ignore[return-value]

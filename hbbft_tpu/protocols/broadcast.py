@@ -53,12 +53,17 @@ class BroadcastReady:
 BroadcastMessage = Any  # one of the three dataclasses above
 
 
-def frame_into_shards(value: bytes, data_shard_num: int) -> List[bytes]:
+def frame_into_shards(
+    value: bytes, data_shard_num: int, symbol: int = 1
+) -> List[bytes]:
     """Length-prefix + pad + split into equal data shards (reference
     ``send_shards``, ``broadcast.rs:341-363``).  Shared by the protocol
-    proposer path and the vectorized co-simulation round."""
+    proposer path and the vectorized co-simulation round.  ``symbol``:
+    the codec's symbol width — shard lengths round up to a multiple of
+    it (2 for the GF(2^16) codec that lifts the 256-shard cap)."""
     payload = len(value).to_bytes(4, "big") + value
     shard_len = max(-(-len(payload) // data_shard_num), 1)
+    shard_len = -(-shard_len // symbol) * symbol
     padded = payload.ljust(shard_len * data_shard_num, b"\x00")
     return [
         padded[i * shard_len : (i + 1) * shard_len]
@@ -154,7 +159,9 @@ class Broadcast(DistAlgorithm):
     def _send_shards(self, value: bytes):
         """RS-encode + Merkle-commit the value; unicast proof i to node i
         (reference ``send_shards``, ``broadcast.rs:332-404``)."""
-        data = frame_into_shards(value, self.data_shard_num)
+        data = frame_into_shards(
+            value, self.data_shard_num, getattr(self.coding, "symbol", 1)
+        )
         shards = self.coding.encode(data)
         mtree = self.netinfo.ops.merkle_tree(shards)
         step: Step = Step()
