@@ -245,7 +245,11 @@ class VectorizedBroadcastRound:
 
         # proposer path (reference ``send_shards``)
         codec = self.ops.rs_codec(self.data, self.parity)
-        shards = codec.encode(frame_into_shards(bytes(value), self.data))
+        shards = codec.encode(
+            frame_into_shards(
+                bytes(value), self.data, getattr(codec, "symbol", 1)
+            )
+        )
         mtree = self.ops.merkle_tree(shards)
         root = mtree.root_hash
 
@@ -292,7 +296,8 @@ class DecryptionRound:
 
     contributions: Dict[Any, bytes]  # proposer → decrypted plaintext
     fault_log: FaultLog
-    shares_verified: int
+    shares_verified: int  # verifications actually performed (after the
+    # verify_honest elision this excludes self-generated honest shares)
 
 
 class VectorizedHoneyBadgerRound:
@@ -338,54 +343,95 @@ class VectorizedHoneyBadgerRound:
         dead: Optional[Set[Any]] = None,
         forged: Optional[Dict[Any, Dict[Any, Any]]] = None,
     ) -> DecryptionRound:
-        """One epoch's decryption: every live node emits a share per
-        proposer; each distinct (sender, proposer) share is verified
-        once via the batching façade's grouped RLC flush; every
-        proposer's contribution is combined from the lowest t+1 valid
-        shares (the deterministic subset rule of
-        ``PublicKeySet.combine_decryption_shares``).
+        """One epoch's decryption — see :func:`decrypt_round`."""
+        return decrypt_round(self.netinfos, ciphertexts, dead, forged)
 
-        ``forged``: sender → {proposer → bogus share}.
-        """
-        dead = dead or set()
-        forged = forged or {}
-        be = BatchingBackend(inner=self.netinfos[0].ops)
 
-        # 1. share emission (per-node local work)
-        entries: List = []  # (proposer, sender, DecObligation)
-        for nid, ni in sorted(self.netinfos.items()):
-            if nid in dead:
+def decrypt_round(
+    netinfos: Dict[Any, NetworkInfo],
+    ciphertexts: Dict[Any, Any],
+    dead: Optional[Set[Any]] = None,
+    forged: Optional[Dict[Any, Dict[Any, Any]]] = None,
+    be: Optional[BatchingBackend] = None,
+    verify_honest: bool = True,
+) -> DecryptionRound:
+    """One epoch's decryption: every live node emits a share per
+    proposer; each distinct (sender, proposer) share is verified
+    once via the batching façade's grouped RLC flush; every
+    proposer's contribution is combined from the lowest t+1 valid
+    shares (the deterministic subset rule of
+    ``PublicKeySet.combine_decryption_shares``).
+
+    ``forged``: sender → {proposer → bogus share}.
+
+    ``verify_honest=False`` skips verification of the shares this
+    co-simulation itself just generated honestly (they verify by
+    construction — the secret key share that made them is the one the
+    public key share checks), verifying only adversarial entries.
+    Outcome-equivalent: the valid/invalid partition and all fault
+    attributions are identical; only provably-redundant checks are
+    elided.  Shared by the single-phase round
+    (:class:`VectorizedHoneyBadgerRound`) and the full-epoch driver
+    (``harness/epoch.py``).
+    """
+    dead = dead or set()
+    forged = forged or {}
+    ref = netinfos[sorted(netinfos)[0]]
+    num_faulty = ref.num_faulty
+    pk_set = ref.public_key_set
+    if be is None:
+        be = BatchingBackend(inner=ref.ops)
+
+    # 1. share emission (per-node local work)
+    entries: List = []  # (proposer, sender, DecObligation, honest)
+    for nid, ni in sorted(netinfos.items()):
+        if nid in dead:
+            continue
+        pk = ni.public_key_share(nid)
+        for pid, ct in sorted(ciphertexts.items()):
+            share = forged.get(nid, {}).get(pid)
+            honest = share is None
+            if honest:
+                share = ni.secret_key_share.decrypt_share_no_verify(ct)
+            entries.append((pid, nid, DecObligation(pk, share, ct), honest))
+
+    # 2. one grouped verification flush for the whole round
+    faults = FaultLog()
+    valid: Dict[Any, Dict[Any, Any]] = {}
+    flagged: Set[Any] = set()
+    n_verified = 0
+    if verify_honest:
+        be.prefetch(ob for _, _, ob, _ in entries)
+        n_verified = len(entries)
+        for pid, nid, ob, _ in entries:
+            if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
+                valid.setdefault(pid, {})[nid] = ob.share
+            elif nid not in flagged:
+                flagged.add(nid)
+                faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
+    else:
+        be.prefetch(ob for _, _, ob, honest in entries if not honest)
+        for pid, nid, ob, honest in entries:
+            if honest:
+                valid.setdefault(pid, {})[nid] = ob.share
                 continue
-            pk = ni.public_key_share(nid)
-            for pid, ct in sorted(ciphertexts.items()):
-                share = forged.get(nid, {}).get(pid)
-                if share is None:
-                    share = ni.secret_key_share.decrypt_share_no_verify(ct)
-                entries.append((pid, nid, DecObligation(pk, share, ct)))
-
-        # 2. one grouped verification flush for the whole round
-        be.prefetch(ob for _, _, ob in entries)
-        faults = FaultLog()
-        valid: Dict[Any, Dict[Any, Any]] = {}
-        flagged: Set[Any] = set()
-        for pid, nid, ob in entries:
+            n_verified += 1
             if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
                 valid.setdefault(pid, {})[nid] = ob.share
             elif nid not in flagged:
                 flagged.add(nid)
                 faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
 
-        # 3. combine per proposer (unique result from any t+1 shares)
-        out: Dict[Any, bytes] = {}
-        for pid, ct in sorted(ciphertexts.items()):
-            by_idx = {
-                self.netinfos[0].node_index(nid): s
-                for nid, s in valid.get(pid, {}).items()
-            }
-            if len(by_idx) <= self.num_faulty:
-                faults.add(pid, FaultKind.SHARE_DECRYPTION_FAILED)
-                continue
-            out[pid] = self.pk_set.combine_decryption_shares(by_idx, ct)
-        return DecryptionRound(
-            contributions=out, fault_log=faults, shares_verified=len(entries)
-        )
+    # 3. combine per proposer (unique result from any t+1 shares)
+    out: Dict[Any, bytes] = {}
+    for pid, ct in sorted(ciphertexts.items()):
+        by_idx = {
+            ref.node_index(nid): s for nid, s in valid.get(pid, {}).items()
+        }
+        if len(by_idx) <= num_faulty:
+            faults.add(pid, FaultKind.SHARE_DECRYPTION_FAILED)
+            continue
+        out[pid] = pk_set.combine_decryption_shares(by_idx, ct)
+    return DecryptionRound(
+        contributions=out, fault_log=faults, shares_verified=n_verified
+    )
