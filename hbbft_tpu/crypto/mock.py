@@ -39,6 +39,23 @@ def _idx(i: int) -> bytes:
     return i.to_bytes(8, "big")
 
 
+_KEY_CACHE: dict = {}  # (seed, nonce) → symmetric key
+
+
+def _enc_key(seed: bytes, nonce: bytes) -> bytes:
+    """The per-ciphertext symmetric key — memoized because a co-simulated
+    decryption round derives it once per (group, ciphertext) but calls
+    ``decrypt_share_no_verify`` per *(sender, ciphertext)* (N× more)."""
+    k = (seed, nonce)
+    key = _KEY_CACHE.get(k)
+    if key is None:
+        if len(_KEY_CACHE) > 1 << 16:
+            _KEY_CACHE.clear()
+        key = _tag(b"KEY", seed, nonce)
+        _KEY_CACHE[k] = key
+    return key
+
+
 @wire("MockSig")
 @dataclasses.dataclass(frozen=True)
 class MockSignature:
@@ -102,7 +119,7 @@ class MockPublicKey:
     def encrypt(self, msg: bytes, rng) -> MockCiphertext:
         nonce = rng.randrange(2**128).to_bytes(16, "big")
         seed_id = _tag(b"SEEDID", self.seed)
-        v = xor_stream(_tag(b"KEY", self.seed, nonce), msg)
+        v = xor_stream(_enc_key(self.seed, nonce), msg)
         return MockCiphertext(
             seed_id, nonce, v, _tag(b"CTMAC", seed_id, nonce, v)
         )
@@ -129,7 +146,7 @@ class MockSecretKey:
     def decrypt(self, ct: MockCiphertext) -> Optional[bytes]:
         if not ct.verify():
             return None
-        return xor_stream(_tag(b"KEY", self.seed, ct.nonce), ct.v)
+        return xor_stream(_enc_key(self.seed, ct.nonce), ct.v)
 
 
 @wire("MockSecretKeyShare")
@@ -150,7 +167,7 @@ class MockSecretKeyShare:
         return self.decrypt_share_no_verify(ct)
 
     def decrypt_share_no_verify(self, ct: MockCiphertext) -> MockDecryptionShare:
-        key = _tag(b"KEY", self.seed, ct.nonce)
+        key = _enc_key(self.seed, ct.nonce)
         return MockDecryptionShare(
             _tag(b"DECSHARE", self.seed, _idx(self.index), key), key
         )
@@ -171,7 +188,7 @@ class MockPublicKeyShare:
     def verify_decryption_share(
         self, share: MockDecryptionShare, ct: MockCiphertext
     ) -> bool:
-        key = _tag(b"KEY", self.seed, ct.nonce)
+        key = _enc_key(self.seed, ct.nonce)
         return share.key == key and share.tag == _tag(
             b"DECSHARE", self.seed, _idx(self.index), key
         )

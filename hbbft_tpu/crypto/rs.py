@@ -164,6 +164,17 @@ class ReedSolomon:
         self.matrix = (
             _systematic_matrix(self.k, self.n) if parity_shards > 0 else None
         )
+        self._dec_cache: dict = {}  # present-subset → inverted submatrix
+
+    def decode_matrix(self, use: Sequence[int]) -> np.ndarray:
+        key = tuple(use)
+        dec = self._dec_cache.get(key)
+        if dec is None:
+            dec = _mat_inv(self.matrix[list(use), :].copy())
+            if len(self._dec_cache) >= 16:
+                self._dec_cache.pop(next(iter(self._dec_cache)))
+            self._dec_cache[key] = dec
+        return dec
 
     def encode(self, data: Sequence[bytes]) -> List[bytes]:
         """data: k equal-length shards → n shards (data ++ parity)."""
@@ -188,8 +199,7 @@ class ReedSolomon:
         if self.m == 0:
             return [s for s in shards]  # type: ignore[misc]
         use = present[: self.k]
-        sub = self.matrix[use, :]
-        dec = _mat_inv(sub.copy())
+        dec = self.decode_matrix(use)
         avail = np.stack(
             [np.frombuffer(shards[i], dtype=np.uint8) for i in use]
         )
@@ -343,6 +353,20 @@ class ReedSolomon16:
         self.matrix = (
             _systematic_matrix16(self.k, self.n) if parity_shards > 0 else None
         )
+        # decode matrices keyed by the present-shard subset: a co-simulated
+        # epoch decodes N broadcasts against one erasure pattern, and the
+        # O(k³) Gauss-Jordan dominated the profile without this
+        self._dec_cache: dict = {}
+
+    def decode_matrix(self, use: Sequence[int]) -> np.ndarray:
+        key = tuple(use)
+        dec = self._dec_cache.get(key)
+        if dec is None:
+            dec = _gf16_mat_inv(self.matrix[list(use), :].copy())
+            if len(self._dec_cache) >= 16:
+                self._dec_cache.pop(next(iter(self._dec_cache)))
+            self._dec_cache[key] = dec
+        return dec
 
     def _to_syms(self, shard: bytes) -> np.ndarray:
         if len(shard) % 2:
@@ -374,8 +398,7 @@ class ReedSolomon16:
         if self.m == 0:
             return [s for s in shards]  # type: ignore[misc]
         use = present[: self.k]
-        sub = self.matrix[use, :]
-        dec = _gf16_mat_inv(sub.copy())
+        dec = self.decode_matrix(use)
         avail = np.stack([self._to_syms(shards[i]) for i in use])
         data = gf16_matmul(dec, avail)
         missing = [i for i, s in enumerate(shards) if s is None]

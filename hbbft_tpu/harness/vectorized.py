@@ -354,6 +354,7 @@ def decrypt_round(
     forged: Optional[Dict[Any, Dict[Any, Any]]] = None,
     be: Optional[BatchingBackend] = None,
     verify_honest: bool = True,
+    emit_minimal: bool = False,
 ) -> DecryptionRound:
     """One epoch's decryption: every live node emits a share per
     proposer; each distinct (sender, proposer) share is verified
@@ -373,6 +374,16 @@ def decrypt_round(
     elided.  Shared by the single-phase round
     (:class:`VectorizedHoneyBadgerRound`) and the full-epoch driver
     (``harness/epoch.py``).
+
+    ``emit_minimal=True`` emits honest shares only from the lowest
+    t+1 live non-forging senders (plus every forged entry).  Also
+    outcome-equivalent: ``combine_decryption_shares`` uses the lowest
+    t+1 *valid* indices (``crypto/threshold.py:284``), forged shares
+    are invalid under either emission, so the combined subset — and
+    hence every plaintext — is identical; the elided shares are the
+    redundant deliveries a real network sends for liveness against
+    senders that might be slow, which the synchronous co-simulation
+    schedule never needs.
     """
     dead = dead or set()
     forged = forged or {}
@@ -382,10 +393,25 @@ def decrypt_round(
     if be is None:
         be = BatchingBackend(inner=ref.ops)
 
+    emit_senders: Optional[Set[Any]] = None
+    if emit_minimal:
+        honest_live = [
+            nid
+            for nid in sorted(netinfos)
+            if nid not in dead and nid not in forged
+        ]
+        emit_senders = set(honest_live[: num_faulty + 1])
+
     # 1. share emission (per-node local work)
     entries: List = []  # (proposer, sender, DecObligation, honest)
     for nid, ni in sorted(netinfos.items()):
         if nid in dead:
+            continue
+        if (
+            emit_senders is not None
+            and nid not in emit_senders
+            and nid not in forged
+        ):
             continue
         pk = ni.public_key_share(nid)
         for pid, ct in sorted(ciphertexts.items()):

@@ -277,3 +277,19 @@ class ReedSolomonDevice16:
             for j, i in enumerate(missing):
                 out[i] = rec[j].astype("<u2").tobytes()
         return out  # type: ignore[return-value]
+
+
+def _delegate_decode_matrix(cls):
+    """Device codecs delegate decode-matrix construction (tiny O(k³)
+    host algebra, cached per erasure pattern) to their host twin so
+    batched callers (``harness/epoch.py``) treat host and device codecs
+    uniformly."""
+
+    def decode_matrix(self, use):
+        return self._host.decode_matrix(use)
+
+    cls.decode_matrix = decode_matrix
+
+
+_delegate_decode_matrix(ReedSolomonDevice)
+_delegate_decode_matrix(ReedSolomonDevice16)
