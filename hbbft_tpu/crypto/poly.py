@@ -30,11 +30,23 @@ def _rand_fr(rng) -> int:
 # ---------------------------------------------------------------------------
 
 
+_LAGRANGE_CACHE: dict = {}
+
+
 def lagrange_coefficients_at_zero(xs: Sequence[int]) -> List[int]:
     """λᵢ = Π_{j≠i} xⱼ/(xⱼ−xᵢ) mod r, for interpolation at x=0.
 
     ``xs`` must be distinct and nonzero (we use index+1 as evaluation
-    points, mirroring the reference's convention)."""
+    points, mirroring the reference's convention).
+
+    Cached by the point set: one co-simulated epoch combines N
+    contributions from the *same* lowest-t+1 share subset, and the
+    O(k²) Python coefficient computation dominated the combine
+    (~80 ms at k=342 vs ~9 ms for the native MSM)."""
+    key = tuple(xs)
+    cached = _LAGRANGE_CACHE.get(key)
+    if cached is not None:
+        return list(cached)
     lams = []
     for i, xi in enumerate(xs):
         num, den = 1, 1
@@ -44,7 +56,10 @@ def lagrange_coefficients_at_zero(xs: Sequence[int]) -> List[int]:
             num = num * xj % R
             den = den * (xj - xi) % R
         lams.append(num * pow(den, -1, R) % R)
-    return lams
+    if len(_LAGRANGE_CACHE) >= 64:
+        _LAGRANGE_CACHE.pop(next(iter(_LAGRANGE_CACHE)))
+    _LAGRANGE_CACHE[key] = lams
+    return list(lams)
 
 
 def interpolate_at_zero(points: Sequence[Tuple[int, int]]) -> int:

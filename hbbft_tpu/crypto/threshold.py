@@ -95,6 +95,34 @@ class Ciphertext:
     ``verify()`` plays the role of the reference's
     ``Ciphertext::verify`` (``honey_badger.rs:371``): it proves the
     encryptor knew the randomness r, giving plaintext-awareness.
+
+    **Deviation from the reference's scheme, and why it is safe.**
+    ``threshold_crypto`` uses Baek–Zheng: a third element W = r·H(U, V)
+    checked by a pairing.  Here the same validity role is filled by a
+    Schnorr proof of knowledge of r for U = r·P₁ whose challenge binds
+    the whole ciphertext: c = H(DST_POK ‖ U ‖ H(V) ‖ A), A = a·P₁,
+    z = a + c·r.  CCA argument (ROM), mirroring Shoup–Gennaro TDH2:
+
+    1. *Validity ⇒ plaintext awareness*: a verifying (c, z) is a Fiat–
+       Shamir Schnorr proof, so the encryptor of any valid ciphertext
+       knows r (rewinding extractor); a decryption oracle therefore
+       tells the adversary nothing it could not compute itself.
+    2. *Non-malleability*: c binds U **and** H(V).  Flipping any bit of
+       V (the classic ElGamal XOR mauling) or substituting U changes
+       the challenge input, and producing a fresh valid (c, z) for the
+       mauled pair is another Schnorr forgery.  Transplanting (c, z)
+       between ciphertexts fails the same way.  Re-randomizing
+       U' = U + s·P₁ requires z' with z'·P₁ − c'·U' = A' and
+       c' = H(U' ‖ H(V) ‖ A') — knowing s but not r leaves z' = z + c'·s
+       short by exactly the unknown c'·r adjustment.
+    3. *Share consistency*: decryption shares (x_i·U) are individually
+       verifiable against the public key shares by a pairing
+       (``PublicKeyShare.verify_decryption_share``), the TDH2 rôle of
+       the per-share DLEQ proofs — combined with (1)/(2) this gives
+       threshold-CCA in the random-oracle model.
+
+    The adversarial cases in (2) are exercised by
+    ``tests/test_crypto_threshold.py::TestCiphertextAttacks``.
     """
 
     u: G1

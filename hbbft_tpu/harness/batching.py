@@ -235,7 +235,23 @@ class BatchingBackend:
 
     def _prefetch_real(self, items: List[Tuple[Any, Any]]) -> None:
         """One product-pairing check over all real-BLS obligations,
-        grouped by base point; bisecting fallback on failure."""
+        grouped by base point; bisecting fallback on failure.
+
+        Fast path (*product-form coefficients*): with rᵢ,g = sᵢ·t_g
+        (sᵢ per sender, t_g per group, both Fiat–Shamir over the full
+        batch transcript) the per-group pk aggregates factor —
+        Σ_{i∈g} rᵢ,g·pkᵢ = t_g · Σ_{i∈g} sᵢ·pkᵢ — so every set of
+        groups sharing one sender set needs ONE G2 MSM and ONE pairing
+        (e(Σ_g t_g·base_g, A) by bilinearity) instead of a G2 MSM and a
+        pairing per group.  That is the epoch shape: N senders × P
+        ciphertexts collapse from P host G2 MSMs (the round-1 decryption
+        bottleneck) to one.  Soundness: a nonzero deviation matrix
+        δ[i,g] survives only if the bilinear form Σ sᵢ·t_g·δ[i,g]
+        vanishes at the random (s, t) — Schwartz–Zippel bounds that by
+        2/2⁹⁶ for 96-bit coefficients.  The form is only per-*cell*,
+        so if the batch holds two obligations for one (sender, group)
+        cell (adversarial double-send: their deviations could cancel),
+        we use fully independent per-item coefficients instead."""
         # group key -> (base G1, [(cache_key, obligation)])
         groups: Dict[bytes, Tuple[Any, List[Tuple[Any, Any]]]] = {}
         for key, ob in items:
@@ -251,35 +267,12 @@ class BatchingBackend:
                 groups[gkey] = (base, [])
             groups[gkey][1].append((key, ob))
 
-        # Fiat–Shamir RLC coefficients binding every (pk, share, base).
         ordered = sorted(groups.items())
-        flat: List[Tuple[Any, Any]] = []
-        item_bytes: List[bytes] = []
-        for gkey, (base, members) in ordered:
-            for key, ob in members:
-                flat.append((key, ob))
-                item_bytes.append(
-                    ob.pk_share.to_bytes() + ob.share.to_bytes() + gkey
-                )
-        coeffs = T._rlc_coeffs(b"hbbft_tpu batching flush", item_bytes)
-
-        # Fused check: e(Σ rᵢσᵢ, P₂) · Πg e(−base_g, Σ_{i∈g} rᵢpkᵢ) == 1
+        flat: List[Tuple[Any, Any]] = [
+            (key, ob) for _, (_, members) in ordered for key, ob in members
+        ]
         try:
-            idx = 0
-            all_shares, all_coeffs = [], []
-            pairs = []
-            for gkey, (base, members) in ordered:
-                g_pks, g_coeffs = [], []
-                for key, ob in members:
-                    all_shares.append(ob.share.point)
-                    all_coeffs.append(coeffs[idx])
-                    g_pks.append(ob.pk_share.point)
-                    g_coeffs.append(coeffs[idx])
-                    idx += 1
-                u_pks, u_coeffs = T.aggregate_by_point(g_pks, g_coeffs)
-                pairs.append((-base, self.g2_msm(u_pks, u_coeffs)))
-            agg_share = self.g1_msm(all_shares, all_coeffs)
-            ok = pairing_check([(agg_share, G2_GEN)] + pairs)
+            ok = self._fused_check(ordered)
         except Exception:
             ok = False
         if ok:
@@ -306,6 +299,107 @@ class BatchingBackend:
             for key, ob in members:
                 self.stats.fallback_items += 1
                 self._cache[key] = self._verify_one(ob)
+
+    def _fused_check(self, ordered) -> bool:
+        """The single pairing-product equation over all groups."""
+        # serialize each obligation exactly once (at the 262k-item epoch
+        # shape, repeated to_bytes() — an uncached Jacobian→affine
+        # inversion each — would dominate the host side of the flush)
+        pre = [
+            (
+                gkey,
+                base,
+                [
+                    (ob, ob.pk_share.to_bytes(), ob.share.to_bytes())
+                    for _, ob in members
+                ],
+            )
+            for gkey, (base, members) in ordered
+        ]
+        cells = set()
+        duplicate_cell = False
+        for gkey, _, members in pre:
+            for _, pkb, _sb in members:
+                c = (pkb, gkey)
+                if c in cells:
+                    duplicate_cell = True
+                    break
+                cells.add(c)
+            if duplicate_cell:
+                break
+
+        if duplicate_cell:
+            # independent per-item coefficients:
+            # e(Σ rᵢσᵢ, P₂) · Π_g e(−base_g, Σ_{i∈g} rᵢpkᵢ) == 1
+            item_bytes = [
+                pkb + sb + gkey
+                for gkey, _, members in pre
+                for _, pkb, sb in members
+            ]
+            coeffs = T._rlc_coeffs(b"hbbft_tpu batching flush", item_bytes)
+            idx = 0
+            all_shares, all_coeffs, pairs = [], [], []
+            for gkey, base, members in pre:
+                g_pks, g_coeffs = [], []
+                for ob, _, _ in members:
+                    all_shares.append(ob.share.point)
+                    all_coeffs.append(coeffs[idx])
+                    g_pks.append(ob.pk_share.point)
+                    g_coeffs.append(coeffs[idx])
+                    idx += 1
+                u_pks, u_coeffs = T.aggregate_by_point(g_pks, g_coeffs)
+                pairs.append((-base, self.g2_msm(u_pks, u_coeffs)))
+            agg_share = self.g1_msm(all_shares, all_coeffs)
+            return pairing_check([(agg_share, G2_GEN)] + pairs)
+
+        # product-form path: transcript binds every (pk, share, group)
+        from ..crypto.hashing import sha256
+
+        transcript = sha256(
+            b"hbbft_tpu batching flush v2"
+            + b"".join(
+                pkb + sb + gkey
+                for gkey, _, members in pre
+                for _, pkb, sb in members
+            )
+        )
+
+        def coeff(label: bytes) -> int:
+            return int.from_bytes(sha256(transcript + label)[:12], "big") | 1
+
+        s: Dict[bytes, int] = {}
+        t: Dict[bytes, int] = {}
+        all_shares, all_coeffs = [], []
+        # sender-set signature → [group keys]
+        classes: Dict[Tuple[bytes, ...], List[bytes]] = {}
+        group_info: Dict[bytes, Tuple[Any, List[Tuple[bytes, Any]]]] = {}
+        for gkey, base, members in pre:
+            t[gkey] = coeff(b"t" + gkey)
+            sender_pks: List[Tuple[bytes, Any]] = []
+            for ob, pkb, _sb in members:
+                if pkb not in s:
+                    s[pkb] = coeff(b"s" + pkb)
+                all_shares.append(ob.share.point)
+                all_coeffs.append((s[pkb] * t[gkey]) % T.R)
+                sender_pks.append((pkb, ob.pk_share.point))
+            sig = tuple(sorted(pkb for pkb, _ in sender_pks))
+            classes.setdefault(sig, []).append(gkey)
+            group_info[gkey] = (base, sender_pks)
+
+        agg_share = self.g1_msm(all_shares, all_coeffs)
+        pairs = []
+        for sig in sorted(classes):
+            gkeys = classes[sig]
+            _, sender_pks = group_info[gkeys[0]]
+            a = self.g2_msm(
+                [pt for _, pt in sender_pks],
+                [s[pkb] for pkb, _ in sender_pks],
+            )
+            b = self.g1_msm(
+                [group_info[g][0] for g in gkeys], [t[g] for g in gkeys]
+            )
+            pairs.append((-b, a))
+        return pairing_check([(agg_share, G2_GEN)] + pairs)
 
 
 # ---------------------------------------------------------------------------

@@ -121,6 +121,11 @@ class TpuBackend(CpuBackend):
         points, scalars = list(points), list(scalars)
         if self._native_host() and len(points) < self.G1_DEVICE_MIN:
             return super().g1_msm(points, scalars)
+        # NOTE: the mesh path runs the XLA scan kernel per shard (the
+        # windowed Pallas kernel is not yet exercised under shard_map),
+        # so per-chip throughput is the scan kernel's — the mesh wins
+        # only by sharding width.  Single-chip large MSMs take the
+        # windowed Pallas path via ec_jax.g1_msm below (ADVICE r1).
         if self.mesh is not None:
             from ..parallel import mesh as M
 

@@ -324,7 +324,7 @@ def _width(scalars: Sequence[int], nbits: Optional[int]) -> int:
     if nbits is not None:
         return nbits
     m = max((s.bit_length() for s in scalars), default=1)
-    for w in (128, 160, 255):
+    for w in (128, 160, 192, 255):  # 192: product-form RLC coefficients
         if m <= w:
             return w
     raise ValueError(f"scalar wider than the group order: {m} bits")

