@@ -310,6 +310,24 @@ def _gf16_mat_inv(m: np.ndarray) -> np.ndarray:
     return aug[:, n:]
 
 
+def _matmul16(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF(2^16) matmul via the C++ native library (AVX2 nibble-table
+    row kernel) when loaded, else the chunked NumPy oracle."""
+    from .. import native as _native
+
+    if _native.available():
+        return _native.gf16_matmul(a, b)
+    return gf16_matmul(a, b)
+
+
+def _mat_inv16(m: np.ndarray) -> np.ndarray:
+    from .. import native as _native
+
+    if _native.available():
+        return _native.gf16_mat_inv(m)
+    return _gf16_mat_inv(m)
+
+
 _MATRIX16_CACHE: dict = {}
 
 
@@ -362,7 +380,7 @@ class ReedSolomon16:
         key = tuple(use)
         dec = self._dec_cache.get(key)
         if dec is None:
-            dec = _gf16_mat_inv(self.matrix[list(use), :].copy())
+            dec = _mat_inv16(self.matrix[list(use), :].copy())
             if len(self._dec_cache) >= 16:
                 self._dec_cache.pop(next(iter(self._dec_cache)))
             self._dec_cache[key] = dec
@@ -383,7 +401,7 @@ class ReedSolomon16:
         if self.m == 0:
             return list(data)
         arr = np.stack([self._to_syms(s) for s in data])
-        parity = gf16_matmul(self.matrix[self.k :], arr)
+        parity = _matmul16(self.matrix[self.k :], arr)
         return list(data) + [
             p.astype("<u2").tobytes() for p in parity
         ]
@@ -400,11 +418,11 @@ class ReedSolomon16:
         use = present[: self.k]
         dec = self.decode_matrix(use)
         avail = np.stack([self._to_syms(shards[i]) for i in use])
-        data = gf16_matmul(dec, avail)
+        data = _matmul16(dec, avail)
         missing = [i for i, s in enumerate(shards) if s is None]
         out: List[Optional[bytes]] = list(shards)
         if missing:
-            rec = gf16_matmul(self.matrix[missing, :], data)
+            rec = _matmul16(self.matrix[missing, :], data)
             for j, i in enumerate(missing):
                 out[i] = rec[j].astype("<u2").tobytes()
         return out  # type: ignore[return-value]

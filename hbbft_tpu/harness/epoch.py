@@ -410,6 +410,22 @@ class VectorizedHoneyBadgerSim:
         self.be = BatchingBackend(inner=ref.ops)
         self.codec = ref.ops.rs_codec(self.data, self.parity)
 
+    # -- checkpointing (harness/checkpoint.py) -----------------------------
+    # The façade and the codec may hold compiled device executables /
+    # caches; snapshots carry only the plain protocol state and restore
+    # rebuilds both from the re-injected backend.
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("be", None)
+        state.pop("codec", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.be = BatchingBackend(inner=self.ref.ops)
+        self.codec = self.ref.ops.rs_codec(self.data, self.parity)
+
     # -- one epoch ---------------------------------------------------------
 
     def run_epoch(

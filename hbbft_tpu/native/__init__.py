@@ -72,6 +72,13 @@ def _try_load() -> Optional[ctypes.CDLL]:
     cdll.hb_gf_matmul.restype = None
     cdll.hb_gf_mat_inv.argtypes = [u8p, u8p, ctypes.c_int]
     cdll.hb_gf_mat_inv.restype = ctypes.c_int
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    cdll.hb_gf16_matmul.argtypes = [
+        u16p, u16p, u16p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    cdll.hb_gf16_matmul.restype = None
+    cdll.hb_gf16_mat_inv.argtypes = [u16p, u16p, ctypes.c_int]
+    cdll.hb_gf16_mat_inv.restype = ctypes.c_int
     # BLS12-381 (native/bls12_381.cpp)
     b = ctypes.c_char_p
     cdll.hb_g1_mul.argtypes = [b, b, u8p]
@@ -181,6 +188,35 @@ def gf_mat_inv(m: np.ndarray) -> np.ndarray:
     rc = lib.hb_gf_mat_inv(_as_u8p(m), _as_u8p(out), n)
     if rc != 0:
         raise ValueError("matrix not invertible over GF(256)")
+    return out
+
+
+def _as_u16p(a: np.ndarray):
+    import ctypes
+
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def gf16_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(m,k)·(k,n) GF(2^16) product (AVX2 nibble-table row kernel)."""
+    a = np.ascontiguousarray(a, dtype=np.uint16)
+    b = np.ascontiguousarray(b, dtype=np.uint16)
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"shape mismatch: ({m},{k}) @ ({k2},{n})")
+    out = np.empty((m, n), dtype=np.uint16)
+    lib.hb_gf16_matmul(_as_u16p(a), _as_u16p(b), _as_u16p(out), m, k, n)
+    return out
+
+
+def gf16_mat_inv(m: np.ndarray) -> np.ndarray:
+    m = np.ascontiguousarray(m, dtype=np.uint16)
+    n = m.shape[0]
+    out = np.empty((n, n), dtype=np.uint16)
+    rc = lib.hb_gf16_mat_inv(_as_u16p(m), _as_u16p(out), n)
+    if rc != 0:
+        raise ValueError("matrix not invertible over GF(2^16)")
     return out
 
 

@@ -464,3 +464,163 @@ int hb_gf_mat_inv(const uint8_t* m, uint8_t* out, int n) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// GF(2^16) Reed-Solomon kernels — the >256-shard (N=1024 validator)
+// broadcast path.  Same design as the GF(2^8) kernels above: log/exp
+// tables for scalars, a per-coefficient nibble-table row kernel
+// (4 input nibbles x lo/hi product bytes = 8 VPSHUFB lookups per 16
+// symbols) for the payload matmuls.  Polynomial 0x1100B, generator 3
+// (must match hbbft_tpu/crypto/rs.py).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint16_t* GF16_EXP = nullptr;  // [2*65535]
+int32_t* GF16_LOG = nullptr;   // [65536]
+
+struct Gf16Init {
+  Gf16Init() {
+    GF16_EXP = new uint16_t[2 * 65535];
+    GF16_LOG = new int32_t[65536];
+    int x = 1;
+    for (int i = 0; i < 65535; ++i) {
+      GF16_EXP[i] = uint16_t(x);
+      GF16_LOG[x] = i;
+      x <<= 1;
+      if (x & 0x10000) x ^= 0x1100B;
+    }
+    for (int i = 65535; i < 2 * 65535; ++i) GF16_EXP[i] = GF16_EXP[i - 65535];
+    GF16_LOG[0] = 0;
+  }
+} gf16_init_once;
+
+inline uint16_t gf16_mul(uint16_t a, uint16_t b) {
+  if (!a || !b) return 0;
+  return GF16_EXP[GF16_LOG[a] + GF16_LOG[b]];
+}
+
+inline uint16_t gf16_inv(uint16_t a) { return GF16_EXP[65535 - GF16_LOG[a]]; }
+
+// Per-coefficient nibble tables: c*x = XOR_j c*(nib_j(x) << 4j).
+struct Gf16Tables {
+  // tab[j][e] = c * (e << (4*j)), split into lo/hi bytes for PSHUFB
+  alignas(32) uint8_t lo[4][16];
+  alignas(32) uint8_t hi[4][16];
+  uint16_t full[4][16];
+  void build(uint16_t c) {
+    for (int j = 0; j < 4; ++j)
+      for (int e = 0; e < 16; ++e) {
+        uint16_t p = gf16_mul(c, uint16_t(e << (4 * j)));
+        full[j][e] = p;
+        lo[j][e] = uint8_t(p & 0xff);
+        hi[j][e] = uint8_t(p >> 8);
+      }
+  }
+};
+
+inline void gf16_mul_xor_row_scalar(uint16_t* out, const uint16_t* in,
+                                    const Gf16Tables& t, uint64_t len) {
+  for (uint64_t i = 0; i < len; ++i) {
+    uint16_t x = in[i];
+    out[i] ^= t.full[0][x & 0xf] ^ t.full[1][(x >> 4) & 0xf] ^
+              t.full[2][(x >> 8) & 0xf] ^ t.full[3][(x >> 12) & 0xf];
+  }
+}
+
+#if defined(__x86_64__)
+__attribute__((target("avx2"))) static void gf16_mul_xor_row_avx2(
+    uint16_t* out, const uint16_t* in, const Gf16Tables& t, uint64_t len) {
+  const __m256i nib = _mm256_set1_epi16(0x000f);
+  const __m256i lobyte = _mm256_set1_epi16(0x00ff);
+  __m256i vlo[4], vhi[4];
+  for (int j = 0; j < 4; ++j) {
+    vlo[j] = _mm256_broadcastsi128_si256(_mm_load_si128((const __m128i*)t.lo[j]));
+    vhi[j] = _mm256_broadcastsi128_si256(_mm_load_si128((const __m128i*)t.hi[j]));
+  }
+  uint64_t i = 0;
+  for (; i + 16 <= len; i += 16) {
+    __m256i x = _mm256_loadu_si256((const __m256i*)(in + i));
+    __m256i acc = _mm256_setzero_si256();
+    for (int j = 0; j < 4; ++j) {
+      __m256i n = _mm256_and_si256(_mm256_srli_epi16(x, 4 * j), nib);
+      // replicate the nibble index into both bytes of each 16-bit lane
+      __m256i idx = _mm256_or_si256(n, _mm256_slli_epi16(n, 8));
+      __m256i pl = _mm256_and_si256(_mm256_shuffle_epi8(vlo[j], idx), lobyte);
+      __m256i ph = _mm256_slli_epi16(
+          _mm256_and_si256(_mm256_shuffle_epi8(vhi[j], idx), lobyte), 8);
+      acc = _mm256_xor_si256(acc, _mm256_or_si256(pl, ph));
+    }
+    __m256i o = _mm256_loadu_si256((const __m256i*)(out + i));
+    _mm256_storeu_si256((__m256i*)(out + i), _mm256_xor_si256(o, acc));
+  }
+  if (i < len) gf16_mul_xor_row_scalar(out + i, in + i, t, len - i);
+}
+#endif
+
+inline void gf16_mul_xor_row(uint16_t* out, const uint16_t* in,
+                             const Gf16Tables& t, uint64_t len) {
+#if defined(__x86_64__)
+  if (HAS_AVX2) {
+    gf16_mul_xor_row_avx2(out, in, t, len);
+    return;
+  }
+#endif
+  gf16_mul_xor_row_scalar(out, in, t, len);
+}
+
+}  // namespace
+
+extern "C" {
+
+// C = A(m x k) . B(k x n) over GF(2^16); all row-major uint16.
+void hb_gf16_matmul(const uint16_t* a, const uint16_t* b, uint16_t* c, int m,
+                    int k, int n) {
+  std::memset(c, 0, size_t(m) * n * 2);
+  Gf16Tables t;
+  for (int i = 0; i < m; ++i)
+    for (int j = 0; j < k; ++j) {
+      uint16_t aij = a[size_t(i) * k + j];
+      if (!aij) continue;
+      t.build(aij);
+      gf16_mul_xor_row(c + size_t(i) * n, b + size_t(j) * n, t, n);
+    }
+}
+
+// Gauss-Jordan inverse over GF(2^16); 0 on success, -1 if singular.
+int hb_gf16_mat_inv(const uint16_t* m, uint16_t* out, int n) {
+  std::vector<uint16_t> aug(size_t(n) * 2 * n, 0);
+  for (int i = 0; i < n; ++i) {
+    std::memcpy(&aug[size_t(i) * 2 * n], m + size_t(i) * n, size_t(n) * 2);
+    aug[size_t(i) * 2 * n + n + i] = 1;
+  }
+  int w = 2 * n;
+  for (int col = 0; col < n; ++col) {
+    int pivot = -1;
+    for (int row = col; row < n; ++row)
+      if (aug[size_t(row) * w + col]) {
+        pivot = row;
+        break;
+      }
+    if (pivot < 0) return -1;
+    if (pivot != col)
+      for (int j = 0; j < w; ++j)
+        std::swap(aug[size_t(col) * w + j], aug[size_t(pivot) * w + j]);
+    uint16_t inv_p = gf16_inv(aug[size_t(col) * w + col]);
+    for (int j = 0; j < w; ++j)
+      aug[size_t(col) * w + j] = gf16_mul(aug[size_t(col) * w + j], inv_p);
+    for (int row = 0; row < n; ++row) {
+      if (row == col) continue;
+      uint16_t factor = aug[size_t(row) * w + col];
+      if (!factor) continue;
+      Gf16Tables t;
+      t.build(factor);
+      gf16_mul_xor_row(&aug[size_t(row) * w], &aug[size_t(col) * w], t, w);
+    }
+  }
+  for (int i = 0; i < n; ++i)
+    std::memcpy(out + size_t(i) * n, &aug[size_t(i) * w + n], size_t(n) * 2);
+  return 0;
+}
+
+}  // extern "C"
