@@ -204,13 +204,15 @@ class ReedSolomon:
             [np.frombuffer(shards[i], dtype=np.uint8) for i in use]
         )
         data = _matmul(dec, avail)
-        full = _matmul(self.matrix, data)
-        out: List[bytes] = []
-        for i in range(self.n):
-            out.append(
-                shards[i] if shards[i] is not None else full[i].tobytes()
-            )
-        return out
+        # recompute only the erased rows (matches the GF(2^16) codec and
+        # the device codecs; present shards pass through untouched)
+        missing = [i for i, s in enumerate(shards) if s is None]
+        out: List[Optional[bytes]] = list(shards)
+        if missing:
+            rec = _matmul(self.matrix[missing, :], data)
+            for j, i in enumerate(missing):
+                out[i] = rec[j].tobytes()
+        return out  # type: ignore[return-value]
 
 
 # --- GF(2^16), primitive polynomial 0x1100B, generator 3 ---------------------

@@ -567,7 +567,7 @@ class VectorizedHoneyBadgerSim:
             return np.asarray(GJ.gf_matmul_device(rows, byte_mat))
         if getattr(self.codec, "symbol", 1) == 2:
             syms = np.ascontiguousarray(byte_mat).view("<u2")
-            out = RS.gf16_matmul(rows, syms)
+            out = RS._matmul16(rows, syms)
             return np.ascontiguousarray(out.astype("<u2")).view(np.uint8)
         return RS._matmul(rows, byte_mat)
 

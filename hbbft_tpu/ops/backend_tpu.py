@@ -110,7 +110,14 @@ class TpuBackend(CpuBackend):
     # so the device takes everything it can.  All paths are exact.
 
     G1_DEVICE_MIN = 8192  # measured crossover vs native Pippenger
-    G2_DEVICE_MIN = 1 << 30  # device G2 loses to native Pippenger at all sizes today
+    # Device G2 (windowed Fq2 Pallas, exec-cached so the 18-min Mosaic
+    # compile is paid once ever) measured 2026-07-30: ~3k pts/s at
+    # K=1024 and K=8192 vs native host Pippenger ~6-12k pts/s — it
+    # loses at every size.  More importantly the product-form fused
+    # check (harness/batching.py) reduced every flush's pk-half to ONE
+    # N-point G2 MSM (~85 ms at N=1024 on host), so G2 is no longer a
+    # bottleneck anywhere; routing stays host-side by measurement.
+    G2_DEVICE_MIN = 1 << 30
 
     def _native_host(self) -> bool:
         from .. import native as _native

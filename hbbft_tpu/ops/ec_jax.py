@@ -368,6 +368,10 @@ def g2_msm(
 
         return G2.infinity()
     w = _width(scalars, nbits)
+    if _use_pallas(len(points)):
+        from . import pallas_ec
+
+        return pallas_ec.g2_msm_pallas(points, scalars, nbits=w, interpret=False)
     pts = jnp.asarray(g2_to_limbs(points))
     bits = jnp.asarray(LB.scalars_to_bits(scalars, w))
     return g2_from_limbs(g2_msm_device(pts, bits))

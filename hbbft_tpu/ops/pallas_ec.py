@@ -535,22 +535,32 @@ def bits_to_digits(bits: np.ndarray) -> np.ndarray:
 
 
 def scalar_mul_windowed(
-    pts: np.ndarray, bits: np.ndarray, interpret: Optional[bool] = None
+    pts: np.ndarray,
+    bits: np.ndarray,
+    interpret: Optional[bool] = None,
+    trim: bool = True,
 ) -> jnp.ndarray:
     """Batched G1 scalar-mul via the 4-bit fixed-window kernel — the
     fast path (~1.5× over the bit-serial scan).  Canonically equal to
-    every other path (the redundant limb form may differ)."""
+    every other path (the redundant limb form may differ).
+
+    ``trim=False`` keeps the identity-padded bucketed batch (length
+    Kp): downstream reductions then see only the small set of bucketed
+    shapes and their jit compiles are reused."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     K = pts.shape[0]
     digits = bits_to_digits(np.asarray(bits))
     pts_t, dig_t, G, Kp = _tile_transpose(pts, digits)
     out_t = _windowed_tiles(pts_t, dig_t, bool(interpret))
-    return _untile(out_t, K, Kp)
+    return _untile(out_t, K if trim else Kp, Kp)
 
 
 def scalar_mul_windowed_g2(
-    pts: np.ndarray, bits: np.ndarray, interpret: Optional[bool] = None
+    pts: np.ndarray,
+    bits: np.ndarray,
+    interpret: Optional[bool] = None,
+    trim: bool = True,
 ) -> jnp.ndarray:
     """Batched G2 scalar-mul via the windowed kernel over Fq2:
     pts [K, 3, 2, L] limbs × bits [K, nbits] → [K, 3, 2, L]."""
@@ -560,7 +570,21 @@ def scalar_mul_windowed_g2(
     digits = bits_to_digits(np.asarray(bits))
     pts_t, dig_t, G, Kp = _tile_transpose(pts, digits)
     out_t = _windowed_g2_tiles(pts_t, dig_t, bool(interpret))
-    return _untile(out_t, K, Kp)
+    return _untile(out_t, K if trim else Kp, Kp)
+
+
+@jax.jit
+def _tree_sum_g1(prods):
+    from . import ec_jax
+
+    return ec_jax.g1_kernel().tree_sum(prods)
+
+
+@jax.jit
+def _tree_sum_g2(prods):
+    from . import ec_jax
+
+    return ec_jax.g2_kernel().tree_sum(prods)
 
 
 def g1_msm_pallas(
@@ -569,7 +593,10 @@ def g1_msm_pallas(
     nbits: int = 255,
     interpret: Optional[bool] = None,
 ):
-    """Full MSM via the Pallas scalar-mul + the XLA tree reduction."""
+    """Full MSM via the Pallas scalar-mul + the XLA tree reduction
+    (jitted — the eager per-add dispatch chain is latency-bound on
+    remote-tunnel devices; the jitted reduction compiles once per
+    bucketed K and lands in the persistent XLA cache)."""
     from . import ec_jax
 
     if not points:
@@ -578,8 +605,8 @@ def g1_msm_pallas(
         return G1.infinity()
     pts = ec_jax.g1_to_limbs(points)
     bits = LB.scalars_to_bits(scalars, nbits)
-    prods = scalar_mul_windowed(pts, bits, interpret=interpret)
-    return ec_jax.g1_from_limbs(ec_jax.g1_kernel().tree_sum(prods))
+    prods = scalar_mul_windowed(pts, bits, interpret=interpret, trim=False)
+    return ec_jax.g1_from_limbs(_tree_sum_g1(prods))
 
 
 def g2_msm_pallas(
@@ -597,5 +624,5 @@ def g2_msm_pallas(
         return G2.infinity()
     pts = ec_jax.g2_to_limbs(points)
     bits = LB.scalars_to_bits(scalars, nbits)
-    prods = scalar_mul_windowed_g2(pts, bits, interpret=interpret)
-    return ec_jax.g2_from_limbs(ec_jax.g2_kernel().tree_sum(prods))
+    prods = scalar_mul_windowed_g2(pts, bits, interpret=interpret, trim=False)
+    return ec_jax.g2_from_limbs(_tree_sum_g2(prods))
