@@ -411,7 +411,12 @@ def decrypt_round(
         emit_senders = set(honest_live[: num_faulty + 1])
 
     # 1. share emission (per-node local work)
-    entries: List = []  # (proposer, sender, DecObligation, honest)
+    faults = FaultLog()
+    valid: Dict[Any, Dict[Any, Any]] = {}
+    flagged: Set[Any] = set()
+    n_verified = 0
+    entries: List = []  # (proposer, sender, DecObligation) — to verify
+    sorted_cts = sorted(ciphertexts.items())
     for nid, ni in sorted(netinfos.items()):
         if nid in dead:
             continue
@@ -422,49 +427,38 @@ def decrypt_round(
         ):
             continue
         pk = ni.public_key_share(nid)
-        for pid, ct in sorted(ciphertexts.items()):
-            share = forged.get(nid, {}).get(pid)
-            honest = share is None
-            if honest:
+        pre = (shares or {}).get(nid, {})
+        node_forged = forged.get(nid, {})
+        for pid, ct in sorted_cts:
+            share = node_forged.get(pid)
+            if share is None:
                 # ``shares``: pre-generated honest shares (the per-node
                 # local signing work, embarrassingly parallel in a real
                 # deployment — benchmarks stage it outside the timed
                 # network phase)
-                share = (shares or {}).get(nid, {}).get(pid)
+                share = pre.get(pid)
                 if share is None:
                     share = ni.secret_key_share.decrypt_share_no_verify(ct)
-            entries.append((pid, nid, DecObligation(pk, share, ct), honest))
+                if not verify_honest:
+                    # self-generated: valid by construction (module doc);
+                    # no obligation object, no cache traffic
+                    valid.setdefault(pid, {})[nid] = share
+                    continue
+            entries.append((pid, nid, DecObligation(pk, share, ct)))
 
-    # 2. one grouped verification flush for the whole round
-    faults = FaultLog()
-    valid: Dict[Any, Dict[Any, Any]] = {}
-    flagged: Set[Any] = set()
-    n_verified = 0
-    if verify_honest:
-        be.prefetch(ob for _, _, ob, _ in entries)
-        n_verified = len(entries)
-        for pid, nid, ob, _ in entries:
-            if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
-                valid.setdefault(pid, {})[nid] = ob.share
-            elif nid not in flagged:
-                flagged.add(nid)
-                faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
-    else:
-        be.prefetch(ob for _, _, ob, honest in entries if not honest)
-        for pid, nid, ob, honest in entries:
-            if honest:
-                valid.setdefault(pid, {})[nid] = ob.share
-                continue
-            n_verified += 1
-            if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
-                valid.setdefault(pid, {})[nid] = ob.share
-            elif nid not in flagged:
-                flagged.add(nid)
-                faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
+    # 2. one grouped verification flush for everything still in question
+    be.prefetch(ob for _, _, ob in entries)
+    n_verified = len(entries)
+    for pid, nid, ob in entries:
+        if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
+            valid.setdefault(pid, {})[nid] = ob.share
+        elif nid not in flagged:
+            flagged.add(nid)
+            faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
 
     # 3. combine per proposer (unique result from any t+1 shares)
     out: Dict[Any, bytes] = {}
-    for pid, ct in sorted(ciphertexts.items()):
+    for pid, ct in sorted_cts:
         by_idx = {
             ref.node_index(nid): s for nid, s in valid.get(pid, {}).items()
         }

@@ -280,6 +280,35 @@ def scalar_to_bits(k: int, nbits: int = 255) -> np.ndarray:
 
 
 def scalars_to_bits(ks: Sequence[int], nbits: int = 255) -> np.ndarray:
+    """Vectorized batch of :func:`scalar_to_bits`: ``to_bytes`` (C) +
+    one ``np.unpackbits`` instead of a Python loop per bit — the per-bit
+    loop was ~40% of a 262k-point flush's wall clock."""
     if not len(ks):
         return np.zeros((0, nbits), dtype=np.int32)
-    return np.stack([scalar_to_bits(k, nbits) for k in ks])
+    nbytes = (nbits + 7) // 8
+    buf = np.frombuffer(
+        b"".join((int(k) % R).to_bytes(nbytes, "big") for k in ks),
+        dtype=np.uint8,
+    ).reshape(len(ks), nbytes)
+    bits = np.unpackbits(buf, axis=1)  # msb-first
+    return bits[:, nbytes * 8 - nbits :].astype(np.int32)
+
+
+def ints_to_limbs_batch(xs: Sequence[int], nlimbs: int) -> np.ndarray:
+    """Vectorized :func:`int_to_limbs` over a batch: little-endian
+    bytes (C) + one ``np.unpackbits`` + a bit-weight matmul."""
+    n = len(xs)
+    if not n:
+        return np.zeros((0, nlimbs), dtype=np.int32)
+    nbytes = (nlimbs * LIMB_BITS + 7) // 8
+    buf = np.frombuffer(
+        b"".join(int(x).to_bytes(nbytes, "little") for x in xs),
+        dtype=np.uint8,
+    ).reshape(n, nbytes)
+    bits = np.unpackbits(buf, axis=1, bitorder="little")[
+        :, : nlimbs * LIMB_BITS
+    ]
+    w = (1 << np.arange(LIMB_BITS, dtype=np.int32)).astype(np.int32)
+    return (
+        bits.reshape(n, nlimbs, LIMB_BITS).astype(np.int32) @ w
+    ).astype(np.int32)
