@@ -27,12 +27,16 @@ from .hashing import sha256, xor_stream
 from ..core.serialize import dumps, wire
 
 
-def _tag(*parts: bytes) -> bytes:
+def _tag_preimage(*parts: bytes) -> bytes:
     out = []
     for p in parts:
         out.append(len(p).to_bytes(4, "big"))
         out.append(p)
-    return sha256(b"".join(out))
+    return b"".join(out)
+
+
+def _tag(*parts: bytes) -> bytes:
+    return sha256(_tag_preimage(*parts))
 
 
 def _idx(i: int) -> bytes:
@@ -171,6 +175,25 @@ class MockSecretKeyShare:
         return MockDecryptionShare(
             _tag(b"DECSHARE", self.seed, _idx(self.index), key), key
         )
+
+    def decrypt_shares_no_verify_batch(self, cts) -> list:
+        """Batch of :meth:`decrypt_share_no_verify` — one batched hash
+        call for all tags (the co-simulated decryption phase generates
+        t+1 × P shares; the per-call ``_tag`` overhead dominated the
+        mock epoch profile).  Preimages go through the same
+        ``_tag_preimage`` as :func:`_tag`, so batch- and singly-built
+        shares are byte-identical by construction."""
+        from .backend import default_backend
+
+        keys = [_enc_key(self.seed, ct.nonce) for ct in cts]
+        msgs = [
+            _tag_preimage(b"DECSHARE", self.seed, _idx(self.index), k)
+            for k in keys
+        ]
+        tags = default_backend().sha256_many(msgs)
+        return [
+            MockDecryptionShare(t, k) for t, k in zip(tags, keys)
+        ]
 
 
 @wire("MockPublicKeyShare")

@@ -238,6 +238,11 @@ class SecretKeyShare:
         verified when the contribution was accepted."""
         return DecryptionShare(ct.u * self.scalar)
 
+    def decrypt_shares_no_verify_batch(self, cts) -> list:
+        """Batch counterpart (interface parity with the mock twin; the
+        scalar-muls stay sequential host work here)."""
+        return [self.decrypt_share_no_verify(ct) for ct in cts]
+
 
 @wire("PublicKeyShare")
 @dataclasses.dataclass(frozen=True)

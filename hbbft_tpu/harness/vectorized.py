@@ -429,16 +429,25 @@ def decrypt_round(
         pk = ni.public_key_share(nid)
         pre = (shares or {}).get(nid, {})
         node_forged = forged.get(nid, {})
+        # honest shares not staged by the caller: one batched generation
+        # call per sender (``shares``: pre-generated honest shares — the
+        # per-node local signing work, embarrassingly parallel in a real
+        # deployment; benchmarks stage it outside the timed phase)
+        gen_pids = [
+            pid
+            for pid, _ in sorted_cts
+            if node_forged.get(pid) is None and pre.get(pid) is None
+        ]
+        if gen_pids:
+            generated = ni.secret_key_share.decrypt_shares_no_verify_batch(
+                [ciphertexts[pid] for pid in gen_pids]
+            )
+            pre = dict(pre)
+            pre.update(zip(gen_pids, generated))
         for pid, ct in sorted_cts:
             share = node_forged.get(pid)
             if share is None:
-                # ``shares``: pre-generated honest shares (the per-node
-                # local signing work, embarrassingly parallel in a real
-                # deployment — benchmarks stage it outside the timed
-                # network phase)
-                share = pre.get(pid)
-                if share is None:
-                    share = ni.secret_key_share.decrypt_share_no_verify(ct)
+                share = pre[pid]
                 if not verify_honest:
                     # self-generated: valid by construction (module doc);
                     # no obligation object, no cache traffic
