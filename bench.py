@@ -535,6 +535,42 @@ def bench_hb_epoch64_real(nodes: int = 64, epochs: int = 2):
     )
 
 
+def bench_qhb_1024_txrate(nodes: int = 1024, batch: int = 65536, n_dead: int = 50):
+    """BASELINE north-star throughput metric: tx/sec at N=1024.  Same
+    full stack as ``qhb_1024`` with the reference's batch-size knob
+    turned up (B txs/epoch, each proposer sampling B/N — throughput
+    scales with B while the epoch cost is dominated by the fixed N²
+    bookkeeping, ``queueing_honey_badger.rs:13-23``)."""
+    import random as _r
+
+    from hbbft_tpu.harness.epoch import VectorizedQueueingSim
+
+    rng = _r.Random(0x7A)
+    qsim = VectorizedQueueingSim(
+        nodes,
+        rng,
+        batch_size=batch,
+        mock=True,
+        verify_honest=False,
+        emit_minimal=True,
+    )
+    qsim.input_all([b"tx-%07d" % i for i in range(2 * batch)])
+    dead = set(range(nodes - n_dead, nodes))
+    qsim.run_epoch(dead=dead)  # warm
+    t0 = time.perf_counter()
+    res = qsim.run_epoch(dead=dead)
+    dt = time.perf_counter() - t0
+    return _emit(
+        "qhb_1024_tx_per_s",
+        len(res.batch) / dt,
+        "tx/s",
+        nodes=nodes,
+        batch_size=batch,
+        txs_per_epoch=len(res.batch),
+        s_per_epoch=round(dt, 2),
+    )
+
+
 def bench_broadcast_vec_1024(nodes: int = 1024):
     """1 MB reliable broadcast at N=1024 — past the reference crate's
     256-shard cap via the GF(2^16) codec (``crypto/rs.py``).  Baseline:
@@ -599,6 +635,7 @@ SUITE = {
     "decshares": bench_decshares,
     "qhb_scale": bench_qhb_scale,
     "qhb_1024": bench_qhb_1024,
+    "qhb_1024_txrate": bench_qhb_1024_txrate,
     "broadcast_vec_1024": bench_broadcast_vec_1024,
     "hb_epoch64_real": bench_hb_epoch64_real,
 }

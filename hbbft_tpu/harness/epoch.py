@@ -728,10 +728,18 @@ class VectorizedHoneyBadgerSim:
 
 
 class VectorizedQueueingSim:
-    """QueueingHoneyBadger co-simulation: per-node transaction queues,
-    random B/N proposals, committed-transaction removal (reference
+    """QueueingHoneyBadger co-simulation: transaction queues, random
+    B/N proposals, committed-transaction removal (reference
     ``queueing_honey_badger.rs:188-268``) over the vectorized epoch
-    driver — BASELINE config 5's full-stack shape."""
+    driver — BASELINE config 5's full-stack shape.
+
+    One shared queue stands for every node's: with uniform
+    ``input_all`` injection (the harness/bench scenario) all per-node
+    queues hold identical contents forever — ``choose`` never mutates
+    and every node removes the same committed set — so N copies would
+    be pure duplication.  Per-node proposals still draw independent
+    random samples from the queue head, exactly the reference's
+    duplicate-avoidance scheme (``queueing_honey_badger.rs:13-23``)."""
 
     def __init__(
         self,
@@ -755,23 +763,39 @@ class VectorizedQueueingSim:
         )
         self.rng = rng
         self.batch_size = batch_size
-        self.queues = {nid: TransactionQueue() for nid in self.sim.netinfos}
+        self.queue = TransactionQueue()
+
+    # kept for checkpoint/introspection compatibility: a mapping view
+    # of "each node's queue" (all identical by construction)
+    @property
+    def queues(self):
+        return {nid: self.queue for nid in self.sim.netinfos}
 
     def input_all(self, txs: Sequence[Any]) -> None:
-        for q in self.queues.values():
-            for tx in txs:
-                q.push(tx)
+        for tx in txs:
+            self.queue.push(tx)
 
     def run_epoch(self, dead: Optional[Set[Any]] = None, **adv) -> EpochResult:
+        import itertools
+
         dead = set(dead or set())
         amount = max(1, self.batch_size // self.sim.n)
+        # materialize the queue head once; every live node samples from
+        # it independently (semantically equal to per-node queue.choose)
+        head = list(
+            itertools.islice(
+                self.queue.queue, min(self.batch_size, len(self.queue))
+            )
+        )
         contribs = {
-            nid: q.choose(amount, self.batch_size, self.rng)
-            for nid, q in self.queues.items()
+            nid: (
+                list(head)
+                if len(head) <= amount
+                else self.rng.sample(head, amount)
+            )
+            for nid in self.sim.netinfos
             if nid not in dead
         }
         result = self.sim.run_epoch(contribs, dead=dead, **adv)
-        committed = [tx for tx in result.batch.tx_iter()]
-        for q in self.queues.values():
-            q.remove_all(committed)
+        self.queue.remove_all(result.batch.tx_iter())
         return result

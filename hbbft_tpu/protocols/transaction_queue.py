@@ -9,6 +9,7 @@ redundancy across proposers (``queueing_honey_badger.rs:13-23``).
 from __future__ import annotations
 
 import collections
+import itertools
 from typing import Deque, Iterable, List
 
 
@@ -27,9 +28,10 @@ class TransactionQueue:
 
     def choose(self, amount: int, batch_size: int, rng) -> List:
         """Random sample of ``amount`` from the first ``batch_size``
-        entries; the queue is unchanged."""
-        limit = min(batch_size, len(self.queue))
-        head = [self.queue[i] for i in range(limit)]
+        entries; the queue is unchanged.  (``islice`` — indexing a
+        deque is O(distance from an end), so per-index access made
+        large batch sizes quadratic.)"""
+        head = list(itertools.islice(self.queue, min(batch_size, len(self.queue))))
         if len(head) <= amount:
             return head
         return rng.sample(head, amount)
