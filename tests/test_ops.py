@@ -305,3 +305,64 @@ class TestTpuBackend:
         # and must agree with the CPU reference on both outcomes
         assert T.batch_verify_shares(shares, pks, base, b"ctx")
         assert not T.batch_verify_shares(bad, pks, base, b"ctx")
+
+
+class TestMarshallingBatch:
+    """Vectorized host↔device marshalling (round-2: the per-element
+    Python loops dominated large flushes)."""
+
+    def test_scalars_to_bits_matches_single(self):
+        import numpy as np
+        import random
+
+        from hbbft_tpu.ops import limbs as LB
+
+        rng = random.Random(0xB17)
+        for nbits in (128, 192, 255):
+            ks = [rng.randrange(0, 1 << nbits) for _ in range(40)] + [0, 1]
+            ref = np.stack([LB.scalar_to_bits(k, nbits) for k in ks])
+            assert np.array_equal(LB.scalars_to_bits(ks, nbits), ref)
+
+    def test_scalars_to_bits_overwidth_raises(self):
+        import pytest
+
+        from hbbft_tpu.ops import limbs as LB
+
+        with pytest.raises(OverflowError):
+            LB.scalars_to_bits([1 << 200], 192)
+
+    def test_ints_to_limbs_batch_matches_single(self):
+        import numpy as np
+        import random
+
+        from hbbft_tpu.ops import limbs as LB
+
+        rng = random.Random(0xB18)
+        f = LB.fq()
+        vals = [rng.randrange(0, f.p) for _ in range(32)] + [0, 1, f.p - 1]
+        ref = np.stack([LB.int_to_limbs(v, f.L) for v in vals])
+        assert np.array_equal(LB.ints_to_limbs_batch(vals, f.L), ref)
+
+    def test_g1_to_limbs_mixed_reps(self):
+        import random
+
+        from hbbft_tpu.crypto.curve import G1, G1_GEN
+        from hbbft_tpu.ops import ec_jax as EC, limbs as LB
+
+        rng = random.Random(0xB19)
+        f = LB.fq()
+        pts = [G1.infinity()]
+        for _ in range(8):
+            a = G1_GEN * rng.randrange(1, LB.R)
+            b = G1_GEN * rng.randrange(1, LB.R)
+            pts += [a, a + b]  # affine-built and Jacobian (Z≠1) mixes
+        out = EC.g1_to_limbs(pts)
+        for i, pt in enumerate(pts):
+            aff = pt.affine()
+            if aff is None:
+                assert f.from_limbs(out[i, 1]) == 1
+                assert out[i, 0].sum() == 0 and out[i, 2].sum() == 0
+            else:
+                assert f.from_limbs(out[i, 0]) == aff[0]
+                assert f.from_limbs(out[i, 1]) == aff[1]
+                assert f.from_limbs(out[i, 2]) == 1
