@@ -9,6 +9,13 @@ surface and per-epoch output table.
 Add ``--real-bls`` for real BLS12-381 threshold crypto (default: fast
 mock crypto, like protocol-logic tests) and ``--batched`` to route
 share verifications through the fused batching façade.
+
+``--vectorized`` switches to the array-based full-epoch co-simulation
+(``harness/epoch.py``): no virtual-time network model, but it runs the
+complete stack at sizes the event-driven simulator cannot reach —
+
+    python examples/simulation.py --vectorized -n 1024 -f 50 \
+        -t 4096 -b 1024
 """
 
 import argparse
@@ -34,10 +41,53 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--real-bls", action="store_true", help="real BLS12-381 crypto")
     p.add_argument("--batched", action="store_true", help="fused batched verification")
+    p.add_argument(
+        "--vectorized",
+        action="store_true",
+        help="array-based full-epoch co-simulation (north-star scale)",
+    )
     args = p.parse_args()
 
     if 3 * args.faulty >= args.nodes:
         p.error("requires 3·f < n")
+
+    if args.vectorized:
+        import time
+
+        from hbbft_tpu.harness.epoch import VectorizedQueueingSim
+
+        rng = random.Random(args.seed)
+        qsim = VectorizedQueueingSim(
+            args.nodes,
+            rng,
+            batch_size=args.batch,
+            mock=not args.real_bls,
+            verify_honest=False,
+            emit_minimal=True,
+        )
+        qsim.input_all(
+            [b"tx-%08d" % i + bytes(max(0, args.tx_size - 11)) for i in range(args.txs)]
+        )
+        dead = set(sorted(qsim.sim.netinfos)[-args.faulty :]) if args.faulty else set()
+        committed: set = set()
+        epoch = 0
+        t0 = time.perf_counter()
+        print(f"{'Epoch':>5} {'Time':>8} {'Txs':>7} {'Total':>7}")
+        while len(committed) < args.txs:
+            te = time.perf_counter()
+            res = qsim.run_epoch(dead=dead)
+            committed.update(res.batch.tx_iter())
+            print(
+                f"{epoch:>5} {time.perf_counter() - te:>7.2f}s "
+                f"{len(res.batch):>7} {len(committed):>7}"
+            )
+            epoch += 1
+        wall = time.perf_counter() - t0
+        print(
+            f"\n{epoch} epochs | wall {wall:.2f}s "
+            f"({epoch / wall:.2f} epochs/s, {len(committed) / wall:.0f} distinct tx/s)"
+        )
+        return
 
     ops = None
     if args.batched:
