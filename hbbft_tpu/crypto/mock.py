@@ -186,10 +186,10 @@ class MockSecretKeyShare:
         from .backend import default_backend
 
         keys = [_enc_key(self.seed, ct.nonce) for ct in cts]
-        msgs = [
-            _tag_preimage(b"DECSHARE", self.seed, _idx(self.index), k)
-            for k in keys
-        ]
+        # _tag_preimage concatenates independent per-part frames, so the
+        # loop-invariant prefix hoists without any framing drift risk
+        prefix = _tag_preimage(b"DECSHARE", self.seed, _idx(self.index))
+        msgs = [prefix + _tag_preimage(k) for k in keys]
         tags = default_backend().sha256_many(msgs)
         return [
             MockDecryptionShare(t, k) for t, k in zip(tags, keys)
