@@ -200,7 +200,11 @@ def _is_lex_largest_fq2(y: F.Fq2) -> bool:
 class _Point:
     """Shared wrapper over Jacobian tuples; subclassed per group."""
 
-    __slots__ = ("jac",)
+    # _wire: lazily-memoized native wire encoding (a pure function of
+    # the immutable jac — repeated MSMs over the same points, e.g. the
+    # 1024 evaluations of one polynomial commitment during key dealing,
+    # paid an Fq/Fq2 inversion per call without it)
+    __slots__ = ("jac", "_wire")
     ops: dict
     b: Any
 

@@ -234,10 +234,19 @@ _G2_INF = b"\x00" * 192
 
 
 def g1_wire(pt) -> bytes:
+    w = getattr(pt, "_wire", None)
+    if w is not None and len(w) == 96:  # length-tagged: a cached G2
+        return w  # wire must not satisfy a (buggy) G1 call site
     a = pt.affine()
     if a is None:
-        return _G1_INF
-    return a[0].to_bytes(48, "big") + a[1].to_bytes(48, "big")
+        w = _G1_INF
+    else:
+        w = a[0].to_bytes(48, "big") + a[1].to_bytes(48, "big")
+    try:
+        pt._wire = w
+    except AttributeError:  # assignment-restricted stand-ins (no slot)
+        pass
+    return w
 
 
 def g1_unwire(raw: bytes, cls):
@@ -253,16 +262,25 @@ def g1_unwire(raw: bytes, cls):
 
 
 def g2_wire(pt) -> bytes:
+    w = getattr(pt, "_wire", None)
+    if w is not None and len(w) == 192:  # see g1_wire length check
+        return w
     a = pt.affine()
     if a is None:
-        return _G2_INF
-    (x0, x1), (y0, y1) = a
-    return (
-        x0.to_bytes(48, "big")
-        + x1.to_bytes(48, "big")
-        + y0.to_bytes(48, "big")
-        + y1.to_bytes(48, "big")
-    )
+        w = _G2_INF
+    else:
+        (x0, x1), (y0, y1) = a
+        w = (
+            x0.to_bytes(48, "big")
+            + x1.to_bytes(48, "big")
+            + y0.to_bytes(48, "big")
+            + y1.to_bytes(48, "big")
+        )
+    try:
+        pt._wire = w
+    except AttributeError:
+        pass
+    return w
 
 
 def g2_unwire(raw: bytes, cls):
