@@ -291,3 +291,18 @@ def test_matches_sequential_two_epochs():
         vec = sim.run_epoch(contribs[e])
         assert vec.batch.epoch == e == seq_batches[e].epoch
         assert vec.batch.contributions == seq_batches[e].contributions
+
+
+def test_matches_sequential_n13_f_dead():
+    """A wider odd size (n=13, f=4): both engines agree exactly with
+    exactly f silent Byzantine nodes."""
+    n, f = 13, 4
+    dead = {9, 10, 11, 12}
+    contributions = {i: [b"w%d" % i] for i in range(n)}
+    seq = sequential_first_batch(random.Random(87), n, f, contributions)
+    sim = VectorizedHoneyBadgerSim(n, random.Random(88), mock=True)
+    vec = sim.run_epoch(
+        {i: c for i, c in contributions.items() if i not in dead}, dead=dead
+    )
+    assert vec.batch.contributions == seq.contributions
+    assert set(vec.accepted) == set(range(n)) - dead
