@@ -33,9 +33,9 @@ outputs plus the fault attribution.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Sequence, Set
+from typing import Any, Dict, List, Optional, Set
 
-from ..core.fault import Fault, FaultKind, FaultLog
+from ..core.fault import FaultKind, FaultLog
 from ..core.network_info import NetworkInfo
 from ..crypto import threshold as T
 from ..crypto.hashing import DST_SIG, hash_to_g1
