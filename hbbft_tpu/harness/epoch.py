@@ -291,6 +291,7 @@ class VectorizedAgreement:
             return out, 0
 
         from ..crypto.hashing import DST_SIG, hash_to_g1
+        from .vectorized import batch_sign_shares
 
         all_shares: List[Any] = []
         all_pks: List[Any] = []
@@ -298,9 +299,12 @@ class VectorizedAgreement:
         bases: List[Any] = []
         for p, nonce in nonces:
             base = hash_to_g1(nonce, DST_SIG)
+            signed = batch_sign_shares(
+                self.netinfos, self.live, nonce, base=base
+            )
             shares = {}
             for nid in self.live:
-                s = self.netinfos[nid].secret_key_share.sign(nonce)
+                s = signed[nid]
                 shares[self.ref.node_index(nid)] = s
                 all_shares.append(s.point)
                 all_pks.append(self.ref.public_key_share(nid).point)

@@ -93,15 +93,20 @@ class VectorizedCoinSim:
         if self.n - len(dead) <= self.num_faulty:
             raise ValueError("not enough live nodes to flip the coin")
 
-        # 1. sign (the per-node work a real deployment does locally)
-        shares: Dict[Any, Any] = {}
-        for nid, ni in self.netinfos.items():
-            if nid in dead:
-                continue
-            if nid in forged:
+        # 1. sign (the per-node work a real deployment does locally;
+        # one shared-base native batch when the crypto is real)
+        base = None if self.mock else hash_to_g1(nonce, DST_SIG)
+        honest = [
+            nid
+            for nid in sorted(self.netinfos)
+            if nid not in dead and nid not in forged
+        ]
+        shares: Dict[Any, Any] = batch_sign_shares(
+            self.netinfos, honest, nonce, base=base
+        )
+        for nid in forged:
+            if nid not in dead:
                 shares[nid] = forged[nid]
-            else:
-                shares[nid] = ni.secret_key_share.sign(nonce)
 
         # 2. verify each distinct share once — one batched flush
         faults = FaultLog()
@@ -119,7 +124,6 @@ class VectorizedCoinSim:
                     faults.add(nid, FaultKind.INVALID_SIGNATURE_SHARE)
             if real:
                 flushes = 1
-                base = hash_to_g1(nonce, DST_SIG)
                 pks = [
                     self.netinfos[0].public_key_share(nid) for nid, _ in real
                 ]
@@ -354,6 +358,76 @@ class VectorizedHoneyBadgerRound:
         return decrypt_round(self.netinfos, ciphertexts, dead, forged)
 
 
+def batch_sign_shares(
+    netinfos: Dict[Any, NetworkInfo],
+    senders,
+    nonce: bytes,
+    base=None,
+) -> Dict[Any, Any]:
+    """The co-simulation's sign phase: every sender signs the SAME
+    nonce, i.e. x_i·H(nonce) over one shared base — a single native
+    fixed-base-comb call for all products (``hb_g1_mul_many``),
+    bit-identical to ``SecretKeyShare.sign``.  Falls back to per-sender
+    ``sign`` internally (mock crypto, no native library), so callers
+    never branch.  ``base``: the caller's precomputed
+    ``hash_to_g1(nonce, DST_SIG)`` (avoids a second hash-to-curve)."""
+    from .. import native as NT
+    from ..crypto.curve import G1
+
+    if not senders:
+        return {}
+    sk0 = netinfos[senders[0]].secret_key_share
+    if NT.available() and isinstance(sk0, T.SecretKeyShare):
+        if base is None:
+            base = hash_to_g1(nonce, DST_SIG)
+        wires = NT.g1_mul_many(
+            NT.g1_wire(base),
+            [netinfos[nid].secret_key_share.scalar for nid in senders],
+        )
+        return {
+            nid: T.SignatureShare(NT.g1_unwire(w, G1))
+            for nid, w in zip(senders, wires)
+        }
+    return {
+        nid: netinfos[nid].secret_key_share.sign(nonce) for nid in senders
+    }
+
+
+def _stage_real_shares(
+    netinfos, sorted_cts, dead, forged, emit_senders
+) -> Optional[Dict[Any, Dict[Any, Any]]]:
+    """Real-BLS fast staging: each ciphertext's decryption shares are
+    x_i·U for ONE shared base U, so all senders' shares of one
+    ciphertext batch into a single native shared-base call
+    (``hb_g1_mul_many``) instead of a ctypes crossing + wire decode
+    per (sender, ciphertext) product.  Bit-identical to
+    ``decrypt_share_no_verify`` (same scalar, same base, same wire
+    math).  Returns None when the fast path does not apply (mock
+    crypto, no native library) — the per-sender batch generator in the
+    emission loop then handles it."""
+    from .. import native as NT
+
+    if not sorted_cts or not NT.available():
+        return None
+    if not isinstance(sorted_cts[0][1], T.Ciphertext):
+        return None
+    senders = [
+        nid
+        for nid in sorted(netinfos)
+        if nid not in dead
+        and (emit_senders is None or nid in emit_senders or nid in forged)
+    ]
+    if not senders:
+        return None
+    scalars = [netinfos[nid].secret_key_share.scalar for nid in senders]
+    staged: Dict[Any, Dict[Any, Any]] = {nid: {} for nid in senders}
+    for pid, ct in sorted_cts:
+        wires = NT.g1_mul_many(NT.g1_wire(ct.u), scalars)
+        for nid, w in zip(senders, wires):
+            staged[nid][pid] = T.DecryptionShare(NT.g1_unwire(w, type(ct.u)))
+    return staged
+
+
 def decrypt_round(
     netinfos: Dict[Any, NetworkInfo],
     ciphertexts: Dict[Any, Any],
@@ -410,13 +484,18 @@ def decrypt_round(
         ]
         emit_senders = set(honest_live[: num_faulty + 1])
 
+    sorted_cts = sorted(ciphertexts.items())
+    if shares is None:
+        shares = _stage_real_shares(
+            netinfos, sorted_cts, dead, forged, emit_senders
+        )
+
     # 1. share emission (per-node local work)
     faults = FaultLog()
     valid: Dict[Any, Dict[Any, Any]] = {}
     flagged: Set[Any] = set()
     n_verified = 0
     entries: List = []  # (proposer, sender, DecObligation) — to verify
-    sorted_cts = sorted(ciphertexts.items())
     for nid, ni in sorted(netinfos.items()):
         if nid in dead:
             continue

@@ -58,6 +58,15 @@ def _try_load() -> Optional[ctypes.CDLL]:
         cdll = ctypes.CDLL(str(_SO_PATH))
     except OSError:
         return None
+    try:
+        return _bind(cdll)
+    except AttributeError:
+        # stale prebuilt library missing a newer symbol (and no working
+        # toolchain to rebuild): degrade to the pure-Python path
+        return None
+
+
+def _bind(cdll):
     u8p = ctypes.POINTER(ctypes.c_uint8)
     u64p = ctypes.POINTER(ctypes.c_uint64)
     cdll.hb_sha256_many.argtypes = [u8p, u64p, ctypes.c_uint64, u8p]
@@ -87,6 +96,8 @@ def _try_load() -> Optional[ctypes.CDLL]:
     cdll.hb_g2_mul.restype = None
     cdll.hb_g1_msm.argtypes = [ctypes.c_uint64, b, b, u8p]
     cdll.hb_g1_msm.restype = None
+    cdll.hb_g1_mul_many.argtypes = [ctypes.c_uint64, b, b, u8p]
+    cdll.hb_g1_mul_many.restype = None
     cdll.hb_g2_msm.argtypes = [ctypes.c_uint64, b, b, u8p]
     cdll.hb_g2_msm.restype = None
     cdll.hb_pairing_check.argtypes = [ctypes.c_uint64, b, b]
@@ -294,6 +305,18 @@ def g1_mul(pt_wire: bytes, k: int) -> bytes:
     out = np.empty(96, dtype=np.uint8)
     lib.hb_g1_mul(pt_wire, k.to_bytes(32, "big"), _as_u8p(out))
     return out.tobytes()
+
+
+def g1_mul_many(pt_wire: bytes, ks) -> list:
+    """[k₀·P, k₁·P, …] for ONE shared base — one native call instead of
+    a ctypes crossing + wire decode per product (the co-simulation's
+    sign-one-nonce / decrypt-one-ciphertext shapes)."""
+    n = len(ks)
+    out = np.empty(n * 96, dtype=np.uint8)
+    kbuf = b"".join(int(k).to_bytes(32, "big") for k in ks)
+    lib.hb_g1_mul_many(n, pt_wire, kbuf, _as_u8p(out))
+    raw = out.tobytes()
+    return [raw[i * 96 : (i + 1) * 96] for i in range(n)]
 
 
 def g2_mul(pt_wire: bytes, k: int) -> bytes:
