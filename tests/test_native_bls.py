@@ -190,3 +190,29 @@ class TestThresholdEndToEnd:
         ref = pks.combine_signatures(shares)
         monkeypatch.delenv("HBBFT_TPU_NO_NATIVE")
         assert nat.to_bytes() == ref.to_bytes()
+
+
+def test_g1_mul_many_comb_paths():
+    """Shared-base batch scalar-mul: the fixed-base comb (n ≥ 8) and
+    the direct loop (n < 8) agree with per-call muls, including the
+    zero scalar and the infinity base."""
+    import random
+
+    from hbbft_tpu import native as NT
+    from hbbft_tpu.crypto.curve import G1, G1_GEN
+
+    if not NT.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = random.Random(0xC0B)
+    base = G1_GEN * 31337
+    bw = NT.g1_wire(base)
+    for n in (1, 7, 8, 33):  # straddle the comb threshold
+        ks = [rng.randrange(0, 1 << 255) for _ in range(n - 1)] + [0]
+        outs = NT.g1_mul_many(bw, ks)
+        for k, w in zip(ks, outs):
+            assert w == NT.g1_wire(base * k), (n, k)
+    inf = NT.g1_wire(G1.infinity())
+    for w in NT.g1_mul_many(inf, [5, 0, 123456789, 1 << 254]):
+        assert w == inf
