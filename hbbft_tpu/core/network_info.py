@@ -145,6 +145,11 @@ class NetworkInfo(Generic[N]):
             sk_set = T.SecretKeySet.random(num_faulty, rng)
             sec_keys = {nid: T.SecretKey.random(rng) for nid in ids}
         pk_set = sk_set.public_keys()
+        if hasattr(pk_set, "precompute_shares"):
+            # one range evaluation for all validator indices (the
+            # shared pk_set memoizes, so every NetworkInfo below hits
+            # the cache instead of re-evaluating the commitment)
+            pk_set.precompute_shares(len(ids))
         pub_keys = {nid: sk.public_key() for nid, sk in sec_keys.items()}
         return {
             nid: NetworkInfo(

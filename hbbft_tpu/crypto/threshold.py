@@ -283,17 +283,46 @@ class PublicKeySet:
     def public_key(self) -> PublicKey:
         return PublicKey(self.master_g1, self.commitment.evaluate(0))
 
-    def public_key_share(self, i: int) -> PublicKeyShare:
-        # Commitment evaluation is an MSM; every protocol message
-        # verification hits this, so memoize per index (frozen
-        # dataclass → side-table via object.__setattr__).
+    def _share_cache(self) -> Dict[int, "PublicKeyShare"]:
+        # memoized per index (frozen dataclass → side-table)
         cache = getattr(self, "_pks_cache", None)
         if cache is None:
             cache = {}
             object.__setattr__(self, "_pks_cache", cache)
+        return cache
+
+    def public_key_share(self, i: int) -> PublicKeyShare:
+        # Commitment evaluation is an MSM; every protocol message
+        # verification hits this, so memoize per index.
+        cache = self._share_cache()
         if i not in cache:
             cache[i] = PublicKeyShare(self.commitment.evaluate(i + 1))
         return cache[i]
+
+    def precompute_shares(self, n: int) -> None:
+        """Fill the share cache for indices 0..n−1 in one pass.
+
+        With the native library this uses the forward-difference range
+        evaluation (t+1 seeding MSMs, then t point-additions per
+        further index — no scalar muls), ~5× the per-index MSMs at
+        n=1024; bit-identical results either way."""
+        from .. import native as NT
+
+        cache = self._share_cache()
+        missing = [i for i in range(n) if i not in cache]
+        if not missing:
+            return
+        # the range kernel always evaluates the full 1..n span; a few
+        # pre-cached entries don't justify losing the fast path
+        if NT.available() and n > len(self.commitment.coeffs):
+            wires = NT.g2_poly_eval_range(
+                [NT.g2_wire(c) for c in self.commitment.coeffs], n, R
+            )
+            for i in missing:
+                cache[i] = PublicKeyShare(NT.g2_unwire(wires[i], G2))
+            return
+        for i in missing:
+            self.public_key_share(i)
 
     # -- combination ------------------------------------------------------
 

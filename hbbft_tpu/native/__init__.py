@@ -100,6 +100,10 @@ def _bind(cdll):
     cdll.hb_g1_mul_many.restype = None
     cdll.hb_g2_msm.argtypes = [ctypes.c_uint64, b, b, u8p]
     cdll.hb_g2_msm.restype = None
+    cdll.hb_g2_poly_eval_range.argtypes = [
+        ctypes.c_uint64, b, ctypes.c_uint64, b, u8p,
+    ]
+    cdll.hb_g2_poly_eval_range.restype = None
     cdll.hb_pairing_check.argtypes = [ctypes.c_uint64, b, b]
     cdll.hb_pairing_check.restype = ctypes.c_int
     cdll.hb_pairing.argtypes = [b, b, u8p]
@@ -305,6 +309,31 @@ def g1_mul(pt_wire: bytes, k: int) -> bytes:
     out = np.empty(96, dtype=np.uint8)
     lib.hb_g1_mul(pt_wire, k.to_bytes(32, "big"), _as_u8p(out))
     return out.tobytes()
+
+
+def g2_poly_eval_range(coeff_wires, n: int, order: int) -> list:
+    """Evaluate a G2-coefficient polynomial at x = 1..n (wire outputs).
+
+    Direct MSMs seed the first min(ncoeffs, n) points (scalar power
+    rows computed here, mod the group ``order``); the rest follow by
+    the forward-difference recurrence in native code — t additions per
+    point, no scalar muls (the key-dealing shape: one commitment
+    evaluated at every validator index)."""
+    ncoeffs = len(coeff_wires)
+    m = min(ncoeffs, n)
+    rows = []
+    for i in range(m):
+        x = i + 1
+        acc = 1
+        for _ in range(ncoeffs):
+            rows.append(acc.to_bytes(32, "big"))
+            acc = acc * x % order
+    out = np.empty(n * 192, dtype=np.uint8)
+    lib.hb_g2_poly_eval_range(
+        ncoeffs, b"".join(coeff_wires), n, b"".join(rows), _as_u8p(out)
+    )
+    raw = out.tobytes()
+    return [raw[i * 192 : (i + 1) * 192] for i in range(n)]
 
 
 def g1_mul_many(pt_wire: bytes, ks) -> list:

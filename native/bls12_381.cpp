@@ -1045,6 +1045,46 @@ void hb_g2_msm(uint64_t n, const uint8_t* pts, const uint8_t* ks, uint8_t* out) 
   g2_to_wire(jac_to_aff(msm(apts, scalars)), out);
 }
 
+// Evaluate a G2-coefficient polynomial (a threshold public-key
+// commitment) at the consecutive points x = 1..n — the key-dealing /
+// DKG shape where every validator index needs its public key share.
+// Strategy: the caller supplies scalar power rows for the first
+// m = min(ncoeffs, n) points (direct MSMs); the remaining n−m values
+// come from the forward-difference recurrence — for a degree-t
+// polynomial the (t+1)-th difference vanishes, so each further point
+// is t group additions and no scalar multiplications at all.
+void hb_g2_poly_eval_range(uint64_t ncoeffs, const uint8_t* coeffs,
+                           uint64_t n, const uint8_t* powmat,
+                           uint8_t* out) {
+  std::vector<Aff<Fp2>> apts(ncoeffs);
+  for (uint64_t j = 0; j < ncoeffs; j++)
+    apts[j] = g2_from_wire(coeffs + 192 * j);
+  uint64_t m = ncoeffs < n ? ncoeffs : n;
+  std::vector<Jac<Fp2>> d(m);
+  for (uint64_t i = 0; i < m; i++) {
+    std::vector<std::vector<uint8_t>> ks(ncoeffs);
+    for (uint64_t j = 0; j < ncoeffs; j++)
+      ks[j].assign(powmat + (i * ncoeffs + j) * 32,
+                   powmat + (i * ncoeffs + j) * 32 + 32);
+    d[i] = msm(apts, ks);
+    g2_to_wire(jac_to_aff(d[i]), out + 192 * i);
+  }
+  if (n <= m) return;
+  // difference pyramid: d[k] := Δᵏf(1)
+  for (uint64_t k = 1; k < m; k++)
+    for (uint64_t i = m - 1; i >= k; i--) {
+      Jac<Fp2> neg = {d[i - 1].X, fp2_neg(d[i - 1].Y), d[i - 1].Z};
+      d[i] = jac_add(d[i], neg);
+      if (i == k) break;
+    }
+  // advance the state one point per step; from step >= m the head is a
+  // fresh value f(step+1)
+  for (uint64_t step = 1; step < n; step++) {
+    for (uint64_t k = 0; k + 1 < m; k++) d[k] = jac_add(d[k], d[k + 1]);
+    if (step >= m) g2_to_wire(jac_to_aff(d[0]), out + 192 * step);
+  }
+}
+
 // Π e(Pᵢ, Qᵢ) == 1 ?  (one shared final exponentiation)
 int hb_pairing_check(uint64_t n, const uint8_t* g1s, const uint8_t* g2s) {
   Fp12 acc = FP12_ONE;

@@ -216,3 +216,56 @@ def test_g1_mul_many_comb_paths():
     inf = NT.g1_wire(G1.infinity())
     for w in NT.g1_mul_many(inf, [5, 0, 123456789, 1 << 254]):
         assert w == inf
+
+
+def test_g2_poly_eval_range_matches_per_index():
+    """Forward-difference range evaluation at the kernel boundary:
+    every shape class — n > ncoeffs (difference path), n <= ncoeffs
+    (pure seeding), degree 0 — must be bit-identical to per-index
+    commitment evaluation, and the partial-cache / no-native fallbacks
+    must produce the same shares."""
+    import random
+
+    import pytest
+
+    from hbbft_tpu import native as NT
+    from hbbft_tpu.crypto import threshold as T
+
+    if not NT.available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(0xD1F)
+    for t, n in ((3, 12), (7, 8), (7, 3), (0, 6)):
+        sks = T.SecretKeySet.random(t, rng)
+        ref = sks.public_keys()
+        # raw kernel vs Commitment.evaluate
+        wires = NT.g2_poly_eval_range(
+            [NT.g2_wire(c) for c in ref.commitment.coeffs], n, T.R
+        )
+        for i in range(n):
+            assert wires[i] == NT.g2_wire(ref.commitment.evaluate(i + 1)), (
+                t,
+                n,
+                i,
+            )
+        # precompute with a partially warm cache keeps the fast path
+        warm = sks.public_keys()
+        expected0 = warm.public_key_share(0)  # pre-cache one entry
+        warm.precompute_shares(n)
+        for i in range(n):
+            assert warm.public_key_share(i) == ref.public_key_share(i)
+        assert warm.public_key_share(0) == expected0
+
+
+def test_precompute_shares_pure_python_fallback(monkeypatch):
+    import random
+
+    from hbbft_tpu.crypto import threshold as T
+
+    monkeypatch.setenv("HBBFT_TPU_NO_NATIVE", "1")
+    rng = random.Random(0xD20)
+    sks = T.SecretKeySet.random(2, rng)
+    a = sks.public_keys()
+    a.precompute_shares(7)
+    b = sks.public_keys()
+    for i in range(7):
+        assert a.public_key_share(i) == b.public_key_share(i)
