@@ -610,8 +610,31 @@ class VectorizedHoneyBadgerSim:
             parity_all = self._codec_matmul(mat[k:], data_all)
             encoded = np.vstack([data_all, parity_all])  # [n, P·L]
             present = [i for i in range(n) if i not in dead_idx]
-            use = present[:k]
-            dec = codec.decode_matrix(use)
+            # Every k-row submatrix of the shipped systematic matrix is
+            # invertible (M = V·V_top⁻¹ from a true Vandermonde at
+            # distinct points, so det(M_S) = det(V_S)/det(V_top) ≠ 0) —
+            # this retry loop is defensive for custom ops codecs whose
+            # coding matrices lack that property: slide to a different
+            # k-subset of the present rows until one decodes.
+            dec = use = None
+            n_starts = len(present) - k + 1
+            first = getattr(self, "_decode_start", 0) % n_starts
+            for start in [first] + [
+                s for s in range(n_starts) if s != first
+            ]:
+                try:
+                    use = present[start : start + k]
+                    dec = codec.decode_matrix(use)
+                    self._decode_start = start  # skip bad windows next wave
+                    break
+                except ValueError:
+                    continue
+            if dec is None:
+                # no invertible subset among the sliding windows — a
+                # backend defect, not proposer misbehavior: fail closed
+                # with nothing delivered (matching the per-instance
+                # path, which records no fault on reconstruct failure)
+                return {}
             data_rec = self._codec_matmul(dec, encoded[use])
         else:
             encoded = data_all

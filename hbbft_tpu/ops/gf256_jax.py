@@ -139,8 +139,7 @@ class ReedSolomonDevice:
         if self.m == 0:
             return [s for s in shards]  # type: ignore[misc]
         use = present[: self.k]
-        sub = self._host.matrix[use, :]
-        dec = _host_rs._gf_mat_inv(sub.copy())
+        dec = self.decode_matrix(use)
         avail = jnp.asarray(
             np.stack([np.frombuffer(shards[i], dtype=np.uint8) for i in use])
         )
@@ -265,7 +264,7 @@ class ReedSolomonDevice16:
         if self.m == 0:
             return [s for s in shards]  # type: ignore[misc]
         use = present[: self.k]
-        dec = _host_rs._gf16_mat_inv(self._host.matrix[use, :].copy())
+        dec = self.decode_matrix(use)
         avail = jnp.asarray(np.stack([self._to_syms(shards[i]) for i in use]))
         data = gf16_matmul_device(dec, avail)
         missing = [i for i, s in enumerate(shards) if s is None]

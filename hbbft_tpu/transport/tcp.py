@@ -196,7 +196,11 @@ class TcpNode:
             # unknown claim, or an impostor claiming a peer whose link
             # is already LIVE — reject rather than displace the writer.
             # (Dead links are unregistered on recv-loop exit, so a
-            # legitimately restarted peer can always re-handshake.)
+            # legitimately restarted peer can always re-handshake; a
+            # peer reconnecting FASTER than its stale link's EOF is
+            # observed gets refused once and must retry — acceptable
+            # for this demo transport, a production one would probe
+            # the existing writer on a conflicting handshake.)
             writer.close()
             return
         self._register(peer, writer)

@@ -306,3 +306,50 @@ def test_matches_sequential_n13_f_dead():
     )
     assert vec.batch.contributions == seq.contributions
     assert set(vec.accepted) == set(range(n)) - dead
+
+
+def test_rbc_phase_singular_decode_retries_subsets():
+    """ADVICE r2 follow-up: a custom codec whose coding matrix has a
+    singular k-row submatrix (impossible for the shipped Vandermonde-
+    derived matrices, possible for exotic ops backends) must not abort
+    the epoch — the batched wave slides to a different k-subset of the
+    present rows.  The patched decode_matrix raises *deterministically*
+    for the first subset tried, exactly as a real singular submatrix
+    would."""
+    n = 7
+    sim = VectorizedHoneyBadgerSim(n, random.Random(90), mock=True)
+    contribs = {i: [b"fb-%d" % i] for i in range(n)}
+    orig = sim.codec.decode_matrix
+    refused = {"key": None}
+
+    def singular_subset(use):
+        if refused["key"] is None:
+            refused["key"] = tuple(use)
+        if tuple(use) == refused["key"]:
+            raise ValueError("singular submatrix")
+        return orig(use)
+
+    sim.codec.decode_matrix = singular_subset
+    res = sim.run_epoch(contribs, dead={6})
+    assert refused["key"] is not None, "batched decode was not exercised"
+    assert res.batch.contributions == {
+        i: contribs[i] for i in range(n - 1)
+    }
+    assert res.fault_log.is_empty()
+
+
+def test_rbc_phase_no_invertible_subset_fails_closed():
+    """If NO k-subset decodes (every sliding window singular — a
+    backend defect, not proposer misbehavior), the wave delivers
+    nothing and the epoch aborts, with no honest proposer blamed
+    (matching the per-instance path's reconstruct-failure semantics)."""
+    n = 7
+    sim = VectorizedHoneyBadgerSim(n, random.Random(91), mock=True)
+    contribs = {i: [b"fb-%d" % i] for i in range(n)}
+
+    def always_singular(use):
+        raise ValueError("singular submatrix")
+
+    sim.codec.decode_matrix = always_singular
+    with pytest.raises(RuntimeError, match="fewer than"):
+        sim.run_epoch(contribs)
