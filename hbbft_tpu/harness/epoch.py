@@ -34,11 +34,17 @@ event-driven harness at small N in ``tests/test_epoch_vec.py``:
   per instance represents every correct node's state; per-node
   estimates stay individual ([P, N] array) so split inputs and the real
   threshold coin path (epochs ≡ 2 mod 3) are exercised exactly.
-- **Common subset** (``common_subset.rs:199-343``): with ≤ f dead
-  proposers, all live-proposer broadcasts deliver before any agreement
-  decides, so the ``N−f yes ⇒ input false to the rest`` rule reduces to
-  est₀ = delivered-mask; the accepted set is exactly the live proposers
-  (deterministic — the property the cross-check test pins).
+- **Common subset** (``common_subset.rs:199-343``): est₀ =
+  delivered-mask.  With ≤ f dead proposers and no delays, all
+  live-proposer broadcasts deliver before any agreement decides and
+  the accepted set is exactly the live proposers; with *late*
+  broadcasts (the asynchronous schedule, ``run_epoch(late=...)``) the
+  withheld instances get ``false`` from every correct node — the
+  ``N−f yes ⇒ input false to the rest`` rule, whose trigger (N−f yes
+  decisions) always fires here because ≥ N−f delivered instances are
+  unanimous-true — and decide false: accepted ⊊ live, deterministic,
+  cross-checked against the sequential engine with a matching
+  delaying schedule (``tests/test_epoch_vec.py``).
 - **Decryption phase**: delegated to the round-1 grouped-flush
   machinery (``harness/vectorized.decrypt_round``), which preserves
   fault attribution per share.
@@ -372,6 +378,8 @@ class EpochResult:
     coin_flips: int
     shares_verified: int
     agreement_epochs: Dict[Any, int]
+    observer_batch: Optional[Batch] = None  # the non-validator lane's
+    # independently derived batch (``run_epoch(observe=True)``)
 
 
 class VectorizedHoneyBadgerSim:
@@ -438,6 +446,8 @@ class VectorizedHoneyBadgerSim:
         dead: Optional[Set[Any]] = None,
         corrupt_shards: Optional[Dict[Any, Dict[Any, bytes]]] = None,
         forged_dec: Optional[Dict[Any, Dict[Any, Any]]] = None,
+        late: Optional[Set[Any]] = None,
+        observe: bool = False,
     ) -> EpochResult:
         """Advance every correct node through one complete epoch.
 
@@ -446,8 +456,24 @@ class VectorizedHoneyBadgerSim:
         ``dead``: silent nodes (never propose, echo, or send shares).
         ``corrupt_shards``: proposer → {node → bytes} echo tampering.
         ``forged_dec``: sender → {proposer → bogus decryption share}.
+        ``late``: LIVE proposers whose broadcast traffic the
+        asynchronous adversary delays past the agreement phase — the
+        schedule where the ``N−f yes ⇒ input false to the rest`` rule
+        of the reference (``common_subset.rs:271-289``) bites: these
+        proposers' agreements receive ``false`` from every correct
+        node, decide false, and the batch excludes them even though
+        they proposed (accepted ⊊ live).  Their delayed messages
+        arrive after the epoch — too late to matter, exactly the
+        reference semantics (an agreement that decided false ignores
+        its broadcast's eventual output).
+        ``observe``: also run the non-validator observer lane
+        (reference ``tests/network/mod.rs:402-420``) — an observer
+        with no secret key share derives its own batch from the
+        network-visible traffic alone; returned as
+        ``EpochResult.observer_batch``.
         """
         dead = set(dead or set())
+        late = set(late or set())
         corrupt_shards = corrupt_shards or {}
         forged_dec = forged_dec or {}
         if len(dead) > self.num_faulty:
@@ -455,6 +481,7 @@ class VectorizedHoneyBadgerSim:
                 f"{len(dead)} dead nodes exceeds the f={self.num_faulty} bound"
             )
         faults = FaultLog()
+        self._decode_exhausted = False
 
         # 1. propose: serialize + threshold-encrypt (honey_badger.rs:101-122)
         payloads: Dict[Any, bytes] = {}
@@ -472,12 +499,17 @@ class VectorizedHoneyBadgerSim:
         # Uncorrupted instances batch: one parity matmul and one decode
         # matmul across ALL proposers (the per-instance Gauss-Jordan and
         # GF matmuls dominated the profile at n=1024 before this).
+        # ``late`` proposers' RBC waves are withheld by the adversary's
+        # schedule: nothing delivers before agreement.
         delivered: Dict[Any, bytes] = {}
+        timely = {
+            pid: v for pid, v in payloads.items() if pid not in late
+        }
         plain = {
-            pid: v for pid, v in payloads.items() if pid not in corrupt_shards
+            pid: v for pid, v in timely.items() if pid not in corrupt_shards
         }
         delivered.update(self._rbc_phase(plain, dead, faults))
-        for pid in sorted(set(payloads) - set(plain)):
+        for pid in sorted(set(timely) - set(plain)):
             value = self._rbc(
                 pid, payloads[pid], dead, corrupt_shards.get(pid), faults
             )
@@ -485,13 +517,23 @@ class VectorizedHoneyBadgerSim:
                 delivered[pid] = value
 
         # 3. common subset: one agreement per validator; est₀ =
-        # delivered-mask (common_subset.rs:199-289 — with ≤ f dead all
-        # live broadcasts deliver first, so the N−f ⇒ input-false rule
-        # collapses to this mask; guarded below)
+        # delivered-mask.  Undelivered instances (dead proposers, late
+        # broadcasts) receive ``false`` from every correct node — in
+        # the reference this happens once N−f agreements decide yes
+        # (``common_subset.rs:271-289``); since the ≥ N−f delivered
+        # instances here are unanimous-true (decide yes at epoch 0),
+        # that trigger always fires and inputting false in round 0 is
+        # outcome-identical.
         if len(delivered) < self.ref.num_correct:
+            hint = (
+                "the codec found no invertible decode window — a "
+                "backend/coding-matrix defect, not a schedule problem"
+                if getattr(self, "_decode_exhausted", False)
+                else "more than f dead/corrupt/late proposers"
+            )
             raise RuntimeError(
-                "fewer than N−f broadcasts delivered — the synchronous "
-                "schedule requires ≤ f dead/corrupt proposers"
+                "fewer than N−f broadcasts delivered — common subset "
+                f"cannot terminate on this schedule ({hint})"
             )
         ag = VectorizedAgreement(
             self.netinfos,
@@ -538,6 +580,14 @@ class VectorizedHoneyBadgerSim:
             except Exception:  # malformed plaintext ⇒ proposer's fault
                 faults.add(pid, FaultKind.BATCH_DESERIALIZATION_FAILED)
         batch = Batch(self.epoch, out_contribs)
+
+        # 7. observer lane (optional): derive the batch again from
+        # public traffic only, with no secret key share
+        observer_batch = None
+        if observe:
+            observer_batch = self._observer_epoch(
+                delivered, res.decisions, dec.emitted
+            )
         self.epoch += 1
         return EpochResult(
             batch=batch,
@@ -546,7 +596,71 @@ class VectorizedHoneyBadgerSim:
             coin_flips=res.coin_flips,
             shares_verified=dec.shares_verified,
             agreement_epochs=res.epochs_used,
+            observer_batch=observer_batch,
         )
+
+    # -- observer lane ------------------------------------------------------
+
+    def _observer_epoch(
+        self,
+        delivered: Dict[Any, bytes],
+        decisions: Dict[Any, bool],
+        emitted: Dict[Any, Dict[Any, Any]],
+    ) -> Batch:
+        """The non-validator lane (reference ``tests/network/mod.rs:
+        402-420``): from ``Target::All`` traffic alone — delivered RBC
+        payloads, the public agreement decisions, and the emitted
+        decryption shares — an observer holding NO secret key share
+        derives the identical batch.  Every share it uses is verified
+        through the public batched path (an observer cannot elide
+        ``verify_honest``: it has no way to know which shares are
+        honest), then combined with the same lowest-t+1-valid rule."""
+        from .batching import DecObligation
+
+        obs_ni = self.ref.observer_view("observer")
+        assert not obs_ni.is_validator
+        accepted = sorted(pid for pid, yes in decisions.items() if yes)
+        cts: Dict[Any, Any] = {}
+        for pid in accepted:
+            try:
+                ct = loads(delivered[pid])
+                if ct.verify():
+                    cts[pid] = ct
+            except Exception:
+                pass
+        entries = []
+        for pid in sorted(cts):
+            ct = cts[pid]
+            for nid in sorted(emitted.get(pid, {})):
+                entries.append(
+                    (
+                        pid,
+                        nid,
+                        DecObligation(
+                            obs_ni.public_key_share(nid),
+                            emitted[pid][nid],
+                            ct,
+                        ),
+                    )
+                )
+        self.be.prefetch(ob for _, _, ob in entries)
+        valid: Dict[Any, Dict[int, Any]] = {}
+        for pid, nid, ob in entries:
+            if self.be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
+                valid.setdefault(pid, {})[obs_ni.node_index(nid)] = ob.share
+        contribs: Dict[Any, Any] = {}
+        pk_set = obs_ni.public_key_set
+        for pid in sorted(cts):
+            by_idx = valid.get(pid, {})
+            if len(by_idx) <= self.num_faulty:
+                continue
+            try:
+                contribs[pid] = loads(
+                    pk_set.combine_decryption_shares(by_idx, cts[pid])
+                )
+            except Exception:
+                pass
+        return Batch(self.epoch, contribs)
 
     # -- reliable broadcast (batched across uncorrupted instances) ---------
 
@@ -633,7 +747,9 @@ class VectorizedHoneyBadgerSim:
                 # no invertible subset among the sliding windows — a
                 # backend defect, not proposer misbehavior: fail closed
                 # with nothing delivered (matching the per-instance
-                # path, which records no fault on reconstruct failure)
+                # path, which records no fault on reconstruct failure);
+                # flagged so run_epoch's guard names the real culprit
+                self._decode_exhausted = True
                 return {}
             data_rec = self._codec_matmul(dec, encoded[use])
         else:
@@ -760,13 +876,17 @@ class VectorizedQueueingSim:
     ``queueing_honey_badger.rs:188-268``) over the vectorized epoch
     driver — BASELINE config 5's full-stack shape.
 
-    One shared queue stands for every node's: with uniform
-    ``input_all`` injection (the harness/bench scenario) all per-node
-    queues hold identical contents forever — ``choose`` never mutates
-    and every node removes the same committed set — so N copies would
-    be pure duplication.  Per-node proposals still draw independent
-    random samples from the queue head, exactly the reference's
-    duplicate-avoidance scheme (``queueing_honey_badger.rs:13-23``)."""
+    Queues are **per node** (the reference's normal operating mode:
+    each node holds its own queue and proposes from it,
+    ``queueing_honey_badger.rs:188-204``) with a copy-on-diverge
+    representation: while every injection is uniform (``input_all``,
+    the harness/bench scenario) all per-node queues are provably
+    identical — ``choose`` never mutates and every node removes the
+    same committed set — so ONE shared deque stands for all of them;
+    the first divergent ``input_node`` call materializes real
+    per-node queues.  Per-node proposals always draw independent
+    random samples, exactly the reference's duplicate-avoidance
+    scheme (``queueing_honey_badger.rs:13-23``)."""
 
     def __init__(
         self,
@@ -790,39 +910,86 @@ class VectorizedQueueingSim:
         )
         self.rng = rng
         self.batch_size = batch_size
-        self.queue = TransactionQueue()
+        self.queue = TransactionQueue()  # shared while uniform
+        self._per_node: Optional[Dict[Any, Any]] = None
 
-    # kept for checkpoint/introspection compatibility: a mapping view
-    # of "each node's queue" (all identical by construction)
     @property
-    def queues(self):
+    def diverged(self) -> bool:
+        return self._per_node is not None
+
+    @property
+    def queues(self) -> Dict[Any, Any]:
+        """Each node's queue (the uniform view maps every node to the
+        one shared queue; after divergence, the real per-node ones)."""
+        if self._per_node is not None:
+            return self._per_node
         return {nid: self.queue for nid in self.sim.netinfos}
 
+    def _materialize(self) -> None:
+        """Copy-on-diverge: split the shared queue into real per-node
+        copies (identical until now by the uniformity argument)."""
+        from ..protocols.transaction_queue import TransactionQueue
+
+        if self._per_node is None:
+            self._per_node = {
+                nid: TransactionQueue(self.queue.queue)
+                for nid in self.sim.netinfos
+            }
+
     def input_all(self, txs: Sequence[Any]) -> None:
+        if self._per_node is None:
+            for tx in txs:
+                self.queue.push(tx)
+        else:
+            for q in self._per_node.values():
+                for tx in txs:
+                    q.push(tx)
+
+    def input_node(self, nid: Any, txs: Sequence[Any]) -> None:
+        """Divergent injection: transactions only node ``nid`` has
+        heard of (the reference's normal mode — queues differ across
+        nodes until commits drain them)."""
+        self._materialize()
+        q = self._per_node[nid]
         for tx in txs:
-            self.queue.push(tx)
+            q.push(tx)
 
     def run_epoch(self, dead: Optional[Set[Any]] = None, **adv) -> EpochResult:
         import itertools
 
         dead = set(dead or set())
         amount = max(1, self.batch_size // self.sim.n)
-        # materialize the queue head once; every live node samples from
-        # it independently (semantically equal to per-node queue.choose)
-        head = list(
-            itertools.islice(
-                self.queue.queue, min(self.batch_size, len(self.queue))
+        if self._per_node is None:
+            # uniform fast path: materialize the shared head ONCE;
+            # every live node samples from it independently
+            # (semantically equal to per-node queue.choose)
+            head = list(
+                itertools.islice(
+                    self.queue.queue, min(self.batch_size, len(self.queue))
+                )
             )
-        )
-        contribs = {
-            nid: (
-                list(head)
-                if len(head) <= amount
-                else self.rng.sample(head, amount)
-            )
-            for nid in self.sim.netinfos
-            if nid not in dead
-        }
+            contribs = {
+                nid: (
+                    list(head)
+                    if len(head) <= amount
+                    else self.rng.sample(head, amount)
+                )
+                for nid in self.sim.netinfos
+                if nid not in dead
+            }
+        else:
+            contribs = {
+                nid: self._per_node[nid].choose(
+                    amount, self.batch_size, self.rng
+                )
+                for nid in self.sim.netinfos
+                if nid not in dead
+            }
         result = self.sim.run_epoch(contribs, dead=dead, **adv)
-        self.queue.remove_all(result.batch.tx_iter())
+        committed = list(result.batch.tx_iter())
+        if self._per_node is None:
+            self.queue.remove_all(committed)
+        else:
+            for q in self._per_node.values():
+                q.remove_all(committed)
         return result

@@ -221,6 +221,7 @@ class TestNetwork:
         rng,
         mock_crypto: bool = True,
         ops: Any = None,
+        message_filter: Optional[Callable[[Any, Any, Any], bool]] = None,
     ):
         n = good_num + adv_num
         netinfos = NetworkInfo.generate_map(
@@ -228,6 +229,14 @@ class TestNetwork:
         )
         self.rng = rng
         self.ops = ops
+        # ``message_filter(sender, recipient, message) -> deliver?``:
+        # the asynchronous network model lets the adversary delay any
+        # message arbitrarily (but finitely); a False verdict holds the
+        # message in ``held_messages`` until ``release_held()``.  This
+        # is scheduler power (delaying), not corruption — the reference
+        # models it through adversarial scheduling of its queues.
+        self.message_filter = message_filter
+        self.held_messages: List[Tuple[Any, Any, Any]] = []
         # batching backends get a prefetch pass every ~n steps
         self.prefetch_every = n if ops is not None and hasattr(ops, "prefetch") else 0
         self._steps = 0
@@ -257,6 +266,38 @@ class TestNetwork:
 
     # ------------------------------------------------------------------
 
+    def _enqueue(self, recipient, node, sender_id, message) -> None:
+        """Deliver to one queue unless the delay filter holds it."""
+        if self.message_filter is not None and not self.message_filter(
+            sender_id, recipient, message
+        ):
+            self.held_messages.append((sender_id, recipient, message))
+            return
+        node.queue.append((sender_id, message))
+        if node is not self.observer:
+            self._note_obs(node, sender_id, message)
+
+    def release_held(self) -> None:
+        """Deliver every held message (the adversary's delays are
+        finite; call this to model their eventual arrival)."""
+        held, self.held_messages = self.held_messages, []
+        for sender_id, recipient, message in held:
+            node = (
+                self.observer
+                if recipient == self.OBSERVER_ID
+                else self.nodes[recipient]
+            )
+            node.queue.append((sender_id, message))
+            if node is not self.observer:
+                self._note_obs(node, sender_id, message)
+        # the observer normally drains inside dispatch_messages; the
+        # released copies must not strand in its queue
+        while self.observer.queue:
+            self.observer.handle_message()
+            assert not self.observer.messages, (
+                "observer attempted to send messages"
+            )
+
     def dispatch_messages(self, sender_id, msgs) -> None:
         """Route messages to queues; observer drains synchronously
         (reference ``:447-481``)."""
@@ -264,20 +305,21 @@ class TestNetwork:
             if tm.target.is_all:
                 for nid, node in self.nodes.items():
                     if nid != sender_id:
-                        node.queue.append((sender_id, tm.message))
-                        self._note_obs(node, sender_id, tm.message)
-                self.observer.queue.append((sender_id, tm.message))
+                        self._enqueue(nid, node, sender_id, tm.message)
+                self._enqueue(
+                    self.OBSERVER_ID, self.observer, sender_id, tm.message
+                )
                 self.adversary.push_message(sender_id, tm)
             else:
                 to_id = tm.target.node
                 if to_id in self.adv_netinfos:
                     self.adversary.push_message(sender_id, tm)
                 elif to_id in self.nodes:
-                    node = self.nodes[to_id]
-                    node.queue.append((sender_id, tm.message))
-                    self._note_obs(node, sender_id, tm.message)
+                    self._enqueue(to_id, self.nodes[to_id], sender_id, tm.message)
                 elif to_id == self.OBSERVER_ID:
-                    self.observer.queue.append((sender_id, tm.message))
+                    self._enqueue(
+                        self.OBSERVER_ID, self.observer, sender_id, tm.message
+                    )
                 # unknown recipients are dropped (reference warns only)
         while self.observer.queue:
             self.observer.handle_message()

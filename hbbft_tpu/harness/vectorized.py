@@ -309,6 +309,10 @@ class DecryptionRound:
     fault_log: FaultLog
     shares_verified: int  # verifications actually performed (after the
     # verify_honest elision this excludes self-generated honest shares)
+    emitted: Dict[Any, Dict[Any, Any]] = dataclasses.field(
+        default_factory=dict
+    )  # proposer → {sender → share}: the network-visible share traffic
+    # (honest + forged) — what an observer sees on the wire
 
 
 class VectorizedHoneyBadgerRound:
@@ -492,6 +496,7 @@ def decrypt_round(
 
     # 1. share emission (per-node local work)
     faults = FaultLog()
+    emitted: Dict[Any, Dict[Any, Any]] = {}
     valid: Dict[Any, Dict[Any, Any]] = {}
     flagged: Set[Any] = set()
     n_verified = 0
@@ -527,11 +532,14 @@ def decrypt_round(
             share = node_forged.get(pid)
             if share is None:
                 share = pre[pid]
+                emitted.setdefault(pid, {})[nid] = share
                 if not verify_honest:
                     # self-generated: valid by construction (module doc);
                     # no obligation object, no cache traffic
                     valid.setdefault(pid, {})[nid] = share
                     continue
+            else:
+                emitted.setdefault(pid, {})[nid] = share
             entries.append((pid, nid, DecObligation(pk, share, ct)))
 
     # 2. one grouped verification flush for everything still in question
@@ -555,5 +563,8 @@ def decrypt_round(
             continue
         out[pid] = pk_set.combine_decryption_shares(by_idx, ct)
     return DecryptionRound(
-        contributions=out, fault_log=faults, shares_verified=n_verified
+        contributions=out,
+        fault_log=faults,
+        shares_verified=n_verified,
+        emitted=emitted,
     )
