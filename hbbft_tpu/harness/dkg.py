@@ -1,0 +1,659 @@
+"""Vectorized dealerless DKG co-simulation — SyncKeyGen at scale.
+
+Reference: ``src/sync_key_gen.rs`` (semantics implemented sequentially
+in ``protocols/sync_key_gen.py``).  The sequential protocol's hot math
+is exactly the MSM-shaped work this framework batches (VERDICT r2
+item 3):
+
+- **row checks** (``sync_key_gen.rs:334``): receiver r checks its row
+  of dealer d's bivariate commitment — N·N checks, each comparing a
+  (t+1)-coefficient commitment row against G2 exponentials;
+- **value checks** (``sync_key_gen.rs:449``): receiver r checks sender
+  s's ack value for dealer d against ``commit.evaluate(r+1, s+1)`` —
+  N·N·N checks, each a (t+1)²-point commitment evaluation.
+
+This driver advances all N participants through one synchronous DKG
+(the schedule DynamicHoneyBadger realizes by committing Parts/Acks
+*on-chain*, ``sync_key_gen.rs:3-5`` — every node handles the identical
+message sequence, which is why one array-form pass represents every
+node exactly), with the crypto restructured tpu-first:
+
+1. **Dealing** — every dealer's symmetric bivariate coefficient matrix
+   is generated host-side; commitment entries are shared-base G2 comb
+   exponentials (``native hb_g2_mul_many``), and all row/value grids
+   are native Fr matrix products (``hb_fr_matmul``):
+   ``ROWS_d = POW·C_d`` and ``VAL_d = ROWS_d·POWᵀ`` with
+   ``POW[r][j] = (r+1)^j`` — hundreds of millions of Montgomery
+   multiplications at N=256, Python-infeasible.
+2. **Verification** — ALL row checks and ALL value checks collapse
+   into ONE G2 MSM over the commitment entries via product-form
+   random-linear-combination (the trilinear extension of
+   ``harness/batching.py``'s bilinear trick):
+
+       Σ_d Σ_{j,k} C_d[j][k] · (α_d·c_k·u_j + α'_d·u'_j·w'_k)
+           == G2 · T
+
+   with u_j = Σ_r γ_r (r+1)^j, w'_k = Σ_s β_s (s+1)^k and T the
+   matching Fr combination of the known row/value scalars.  A nonzero
+   deviation δ survives only if a multilinear form in the Fiat–Shamir
+   coefficients vanishes by chance (Schwartz–Zippel, ≤ d/2⁹⁶ for
+   96-bit coefficients).  Every (d, r, k) row cell and (d, s, r)
+   value cell appears exactly once by construction, so the
+   duplicate-cell degeneracy of the bilinear case cannot arise.  On
+   failure: per-dealer fused re-checks, then per-item checks inside
+   bad dealers — identical fault attribution to the sequential
+   machine (INVALID_PART for bad rows to the dealer, INVALID_ACK for
+   bad values to the ack sender).
+3. **verify_honest elision** (the ``decrypt_round`` argument): shares
+   this co-simulation itself dealt honestly verify by construction;
+   ``verify_honest=False`` skips their checks and verifies only
+   adversarial injections exactly — outcome-equivalent, and the mode
+   that makes N=256 practical.  Acks are emitted from the lowest 2t+1
+   senders (completeness threshold), and values are materialized for
+   the lowest t+1 (the deterministic generation subset,
+   ``sync_key_gen.rs:403``); the elided values are never read by any
+   honest consumer.
+4. **Generation** — ``pk_set`` and every node's secret share exactly
+   as ``SyncKeyGen.generate()``: pk commitment = Σ_d row-0 commitment,
+   share_r = Σ_d Lagrange₀(lowest t+1 valid values) — asserted
+   byte-identical to the sequential machine in
+   ``tests/test_dkg_vec.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.fault import FaultKind, FaultLog
+from ..crypto import fields as F
+from ..crypto import mock as M
+from ..crypto import threshold as T
+from ..crypto.curve import G1_GEN, G2_GEN
+from ..crypto.hashing import sha256
+from ..crypto.poly import (
+    Commitment,
+    lagrange_coefficients_at_zero,
+)
+
+R = F.R
+
+
+def _fr_bytes(vals: Sequence[int]) -> np.ndarray:
+    return np.frombuffer(
+        b"".join(int(v % R).to_bytes(32, "big") for v in vals), dtype=np.uint8
+    ).copy()
+
+
+def _fr_ints(buf: np.ndarray) -> List[int]:
+    raw = buf.tobytes()
+    return [
+        int.from_bytes(raw[i : i + 32], "big") for i in range(0, len(raw), 32)
+    ]
+
+
+@dataclasses.dataclass
+class DkgResult:
+    """Outcome of one co-simulated DKG session."""
+
+    pk_set: Any  # T.PublicKeySet | M.MockPublicKeySet
+    shares: Dict[Any, Any]  # node id → SecretKeyShare (validators only)
+    fault_log: FaultLog
+    complete: List[Any]  # dealers whose parts completed (≥ 2t+1 acks)
+    row_checks: int  # row-check cells settled (N dealers × N receivers)
+    value_checks: int  # value-check cells settled
+    msm_points: int  # size of the single fused verification MSM
+
+
+class VectorizedDkg:
+    """One synchronous dealerless DKG over ``node_ids`` at threshold t.
+
+    ``mock`` mirrors ``SyncKeyGen``'s mock dealing byte-for-byte (the
+    churn co-simulation's protocol-plane mode); real mode implements
+    the full BLS12-381 path described in the module doc.
+    """
+
+    def __init__(
+        self,
+        node_ids: Sequence[Any],
+        threshold: int,
+        rng,
+        mock: bool = False,
+        ops: Any = None,
+    ):
+        self.node_ids = sorted(node_ids)
+        self.n = len(self.node_ids)
+        self.t = threshold
+        if self.n < 2 * threshold + 1:
+            raise ValueError("need at least 2t+1 nodes for completeness")
+        self.rng = rng
+        self.mock = mock
+        self.ops = ops
+
+    # -- dealing rngs (aligned with the sequential equivalence test) ---
+
+    def _dealer_coeffs(self, seed_rng) -> List[List[List[int]]]:
+        """Symmetric (t+1)×(t+1) coefficient matrices, one per dealer,
+        drawn exactly as ``BivarPoly.random`` does from per-dealer rngs
+        (the cross-engine test replays the same streams sequentially)."""
+        from ..crypto.poly import BivarPoly
+
+        out = []
+        for _ in self.node_ids:
+            out.append(BivarPoly.random(self.t, seed_rng).coeffs)
+        return out
+
+    # -- the run -----------------------------------------------------------
+
+    def run(
+        self,
+        verify_honest: bool = True,
+        wrong_row: Optional[Dict[Any, Set[Any]]] = None,
+        wrong_value: Optional[Dict[Tuple[Any, Any], Set[Any]]] = None,
+        coeffs: Optional[List] = None,
+    ) -> DkgResult:
+        """Run the DKG to readiness and generation.
+
+        ``wrong_row``: dealer → receivers given a corrupted row
+        (receiver's row check fails ⇒ INVALID_PART on the dealer; the
+        receiver refuses to ack that part).
+        ``wrong_value``: (dealer, ack sender) → receivers given a
+        corrupted value (receiver's value check fails ⇒ INVALID_ACK on
+        the sender; the receiver interpolates from other senders).
+        ``coeffs``: externally supplied dealing matrices (the
+        equivalence test feeds both engines identical polynomials).
+        """
+        if self.mock:
+            return self._run_mock()
+        return self._run_real(
+            verify_honest, wrong_row or {}, wrong_value or {}, coeffs
+        )
+
+    # -- mock --------------------------------------------------------------
+
+    def _run_mock(self) -> DkgResult:
+        seeds = [
+            self.rng.randrange(2**256).to_bytes(32, "big")
+            for _ in self.node_ids
+        ]
+        group = sha256(
+            b"DKGGROUP"
+            + b"".join(
+                idx.to_bytes(4, "big") + seed for idx, seed in enumerate(seeds)
+            )
+        )
+        pk_set = M.MockPublicKeySet(group, self.t)
+        shares = {
+            nid: M.MockSecretKeyShare(group, i)
+            for i, nid in enumerate(self.node_ids)
+        }
+        return DkgResult(
+            pk_set, shares, FaultLog(), list(self.node_ids), 0, 0, 0
+        )
+
+    # -- real --------------------------------------------------------------
+
+    def _run_real(self, verify_honest, wrong_row, wrong_value, coeffs):
+        from .. import native as NT
+
+        if not NT.available():
+            raise RuntimeError(
+                "the vectorized real-BLS DKG requires the native library "
+                "(hb_fr_matmul / hb_g2_mul_many)"
+            )
+        n, t = self.n, self.t
+        tp1 = t + 1
+        faults = FaultLog()
+        if coeffs is None:
+            coeffs = self._dealer_coeffs(self.rng)
+
+        # power matrices POW[r][j] = (r+1)^j (bytes, reused everywhere)
+        pow_rows: List[List[int]] = []
+        for r in range(n):
+            x, acc = r + 1, 1
+            row = []
+            for _ in range(tp1):
+                row.append(acc)
+                acc = acc * x % R
+            pow_rows.append(row)
+        POW = _fr_bytes([v for row in pow_rows for v in row])  # [n, t+1]
+        POWT = _fr_bytes(
+            [pow_rows[r][j] for j in range(tp1) for r in range(n)]
+        )  # [t+1, n]
+
+        # flat coefficient buffers per dealer
+        C = [
+            _fr_bytes([c for row in mat for c in row]) for mat in coeffs
+        ]  # each [t+1, t+1]
+
+        # ack senders: every node in verify mode or with adversaries
+        # present (the reference has every node ack every part); the
+        # lowest 2t+1 under clean elision (completeness threshold;
+        # elided values are never read — module doc)
+        adversarial = bool(wrong_row or wrong_value)
+        if verify_honest or adversarial:
+            n_ackers = n
+            n_valued = n
+        else:
+            n_ackers = min(n, 2 * t + 1)
+            n_valued = min(n, t + 1)
+
+        # per-dealer grids (native Fr matmuls)
+        ROWS: List[np.ndarray] = []  # [n or ackers, t+1] row coefficients
+        VAL: List[np.ndarray] = []  # [n_valued, n] value grids
+        n_rowed = n if verify_honest else n_ackers
+        for d in range(n):
+            rows_d = NT.fr_matmul(POW[: n_rowed * tp1 * 32], C[d], n_rowed, tp1, tp1)
+            ROWS.append(rows_d)
+            VAL.append(
+                NT.fr_matmul(rows_d[: n_valued * tp1 * 32], POWT, n_valued, tp1, n)
+            )
+
+        # commitments: needed for verification (and for any dealer with
+        # adversarial cells, to run the exact per-item checks)
+        need_commit = (
+            set(range(n))
+            if verify_honest
+            else {
+                self.node_ids.index(d) for d in wrong_row
+            } | {self.node_ids.index(d) for d, _ in wrong_value}
+        )
+        commit_wires: Dict[int, np.ndarray] = {}
+        if need_commit:
+            g2w = NT.g2_wire(G2_GEN)
+            for d in sorted(need_commit):
+                commit_wires[d] = NT.g2_mul_many_raw(g2w, C[d])
+
+        # adversarial deltas: indexes of corrupted cells
+        bad_rows: Set[Tuple[int, int]] = set()  # (dealer, receiver)
+        for did, rs in wrong_row.items():
+            d = self.node_ids.index(did)
+            for rid in rs:
+                bad_rows.add((d, self.node_ids.index(rid)))
+        bad_vals: Set[Tuple[int, int, int]] = set()  # (dealer, sender, recv)
+        for (did, sid), rs in wrong_value.items():
+            d = self.node_ids.index(did)
+            s = self.node_ids.index(sid)
+            for rid in rs:
+                if s >= n_valued:
+                    raise ValueError(
+                        "adversarial ack sender outside the valued set"
+                    )
+                bad_vals.add((d, s, self.node_ids.index(rid)))
+
+        # apply the corruptions to the wire-visible buffers: a bad row
+        # perturbs what the receiver decrypted; a bad value perturbs
+        # one ack cell.  (Generation skips exactly these cells below.)
+        for d, r in bad_rows:
+            ROWS[d] = ROWS[d].copy()
+            off = (r * tp1) * 32
+            cur = int.from_bytes(ROWS[d][off : off + 32].tobytes(), "big")
+            ROWS[d][off : off + 32] = np.frombuffer(
+                ((cur + 1) % R).to_bytes(32, "big"), dtype=np.uint8
+            )
+        for d, s, r in bad_vals:
+            VAL[d] = VAL[d].copy()
+            off = (s * n + r) * 32
+            cur = int.from_bytes(VAL[d][off : off + 32].tobytes(), "big")
+            VAL[d][off : off + 32] = np.frombuffer(
+                ((cur + 1) % R).to_bytes(32, "big"), dtype=np.uint8
+            )
+
+        row_checks = value_checks = msm_points = 0
+        if verify_honest:
+            ok, msm_points = self._fused_check(
+                ROWS, VAL, commit_wires, n_ackers
+            )
+            row_checks = n * n
+            value_checks = n * n_ackers * n
+            if not ok:
+                self._fallback_attribution(
+                    ROWS, VAL, commit_wires, faults
+                )
+        else:
+            # adversarial cells are verified exactly, per item, against
+            # the flagged dealer's real commitment — the same checks the
+            # sequential machine runs (attribution identical); honest
+            # cells verify by construction (module doc) and are elided
+            flagged_dealers: Set[int] = set()
+            flagged_senders: Set[Tuple[int, int]] = set()
+            for d, r in sorted(bad_rows):
+                row_checks += 1
+                if not self._check_row_item(
+                    commit_wires[d], _fr_ints(ROWS[d][r * tp1 * 32 : (r + 1) * tp1 * 32]), r
+                ):
+                    if d not in flagged_dealers:
+                        flagged_dealers.add(d)
+                        faults.add(self.node_ids[d], FaultKind.INVALID_PART)
+            for d, s, r in sorted(bad_vals):
+                value_checks += 1
+                off = (s * n + r) * 32
+                val = int.from_bytes(
+                    VAL[d][off : off + 32].tobytes(), "big"
+                )
+                if not self._check_value_item(commit_wires[d], val, r, s):
+                    if (d, s) not in flagged_senders:
+                        flagged_senders.add((d, s))
+                        faults.add(self.node_ids[s], FaultKind.INVALID_ACK)
+
+        # ack bookkeeping: receiver with a bad row refuses to ack
+        acks: Dict[int, Set[int]] = {d: set() for d in range(n)}
+        for d in range(n):
+            for s in range(n_ackers):
+                if (d, s) in bad_rows:
+                    continue  # bad row ⇒ sender s never acks part d
+                acks[d].add(s)
+        complete = [
+            d for d in range(n) if len(acks[d]) > 2 * t
+        ]
+        if len(complete) <= t:
+            raise RuntimeError("DKG not ready: too few complete parts")
+
+        # generation (sync_key_gen.rs:396-409 semantics):
+        # pk commitment = Σ_d row-0 commitment; share_r = Σ_d
+        # interpolate₀(lowest t+1 VALID values for r)
+        pk_coeffs_scalars = [
+            sum(coeffs[d][0][k] for d in complete) % R for k in range(tp1)
+        ]
+        pk_commit = Commitment([G2_GEN * s for s in pk_coeffs_scalars])
+        master_g1 = G1_GEN * (sum(coeffs[d][0][0] for d in complete) % R)
+
+        lam = lagrange_coefficients_at_zero(list(range(1, tp1 + 1)))
+        lam_buf = _fr_bytes(lam)
+        shares: Dict[Any, Any] = {}
+        share_acc = [0] * n
+        for d in complete:
+            # the deterministic subset: lowest t+1 ack senders whose
+            # value passed (sync_key_gen.rs:403); with no adversarial
+            # cells that is senders 0..t and one Fr matmul covers all
+            # receivers at once
+            d_bad = {(s, r) for dd, s, r in bad_vals if dd == d}
+            if not d_bad:
+                contrib = _fr_ints(
+                    NT.fr_matmul(lam_buf, VAL[d][: tp1 * n * 32], 1, tp1, n)
+                )
+                for r in range(n):
+                    share_acc[r] = (share_acc[r] + contrib[r]) % R
+            else:
+                vals_d = _fr_ints(VAL[d])  # [n_valued, n] flattened
+                for r in range(n):
+                    pts = []
+                    for s in sorted(acks[d]):
+                        if (s, r) in d_bad:
+                            continue
+                        if s >= n_valued:
+                            break
+                        pts.append((s + 1, vals_d[s * self.n + r]))
+                        if len(pts) == tp1:
+                            break
+                    if len(pts) <= t:
+                        raise RuntimeError(
+                            "not enough valid values to reconstruct a share"
+                        )
+                    from ..crypto.poly import interpolate_at_zero
+
+                    share_acc[r] = (
+                        share_acc[r] + interpolate_at_zero(pts)
+                    ) % R
+        for r, nid in enumerate(self.node_ids):
+            shares[nid] = T.SecretKeyShare(share_acc[r])
+
+        pk_set = T.PublicKeySet(pk_commit, master_g1)
+        return DkgResult(
+            pk_set,
+            shares,
+            faults,
+            [self.node_ids[d] for d in complete],
+            row_checks,
+            value_checks,
+            msm_points,
+        )
+
+    # -- the single fused verification MSM ---------------------------------
+
+    def _coeff_stream(self, transcript: bytes, label: bytes, count: int):
+        return [
+            int.from_bytes(
+                sha256(transcript + label + i.to_bytes(4, "big"))[:12], "big"
+            )
+            | 1
+            for i in range(count)
+        ]
+
+    def _fused_check(
+        self, ROWS, VAL, commit_wires, n_ackers
+    ) -> Tuple[bool, int]:
+        """ALL row checks + ALL value checks in one G2 MSM over the
+        commitment entries (module doc equation)."""
+        from .. import native as NT
+
+        n, t = self.n, self.t
+        tp1 = t + 1
+        transcript = sha256(
+            b"hbbft_tpu dkg fused v1"
+            + b"".join(w.tobytes()[:64] for w in commit_wires.values())
+            + b"".join(r.tobytes() for r in ROWS)
+            + b"".join(v.tobytes() for v in VAL)
+        )
+        alpha = self._coeff_stream(transcript, b"a", n)
+        gamma = self._coeff_stream(transcript, b"g", n)
+        ck = self._coeff_stream(transcript, b"c", tp1)
+        alpha2 = self._coeff_stream(transcript, b"A", n)
+        beta = self._coeff_stream(transcript, b"b", n_ackers)
+        gamma2 = self._coeff_stream(transcript, b"G", n)
+
+        # u_j = Σ_r γ_r (r+1)^j ; u'_j = Σ_r γ'_r (r+1)^j ;
+        # w'_k = Σ_s β_s (s+1)^k   (tiny Fr sums)
+        pow_cols: List[List[int]] = [[] for _ in range(tp1)]
+        for r in range(n):
+            x, acc = r + 1, 1
+            for j in range(tp1):
+                pow_cols[j].append(acc)
+                acc = acc * x % R
+        u = [
+            sum(gamma[r] * pow_cols[j][r] for r in range(n)) % R
+            for j in range(tp1)
+        ]
+        u2 = [
+            sum(gamma2[r] * pow_cols[j][r] for r in range(n)) % R
+            for j in range(tp1)
+        ]
+        w2 = [
+            sum(beta[s] * pow_cols[k][s] for s in range(n_ackers)) % R
+            for k in range(tp1)
+        ]
+
+        # MSM scalars per commitment entry (j, k), dealer d:
+        #   M = α_d·u_j·c_k + α'_d·u'_j·w'_k
+        pts: List[bytes] = []
+        scalars: List[int] = []
+        for d in range(n):
+            wires = commit_wires[d].tobytes()
+            for j in range(tp1):
+                for k in range(tp1):
+                    m = (
+                        alpha[d] * u[j] % R * ck[k]
+                        + alpha2[d] * u2[j] % R * w2[k]
+                    ) % R
+                    pts.append(wires[(j * tp1 + k) * 192 : (j * tp1 + k + 1) * 192])
+                    scalars.append(m)
+
+        # the known-scalar side: T = Σ α_d γ_r c_k ROWS_d[r][k]
+        #                          + Σ α'_d β_s γ'_r VAL_d[s][r]
+        gamma_buf = _fr_bytes(gamma)
+        ck_buf = _fr_bytes(ck)
+        beta_buf = _fr_bytes(beta)
+        gamma2_buf = _fr_bytes(gamma2)
+        total = 0
+        for d in range(n):
+            gr = NT.fr_matmul(gamma_buf, ROWS[d], 1, n, tp1)  # γᵀ·ROWS_d
+            grc = NT.fr_matmul(gr, ck_buf, 1, tp1, 1)  # ·c
+            bv = NT.fr_matmul(
+                beta_buf, VAL[d], 1, n_ackers, self.n
+            )  # βᵀ·VAL_d
+            bvg = NT.fr_matmul(bv, gamma2_buf, 1, self.n, 1)  # ·γ'
+            total = (
+                total
+                + alpha[d] * _fr_ints(grc)[0]
+                + alpha2[d] * _fr_ints(bvg)[0]
+            ) % R
+
+        lhs_wire = NT.g2_msm(pts, scalars)
+        rhs_wire = NT.g2_mul(NT.g2_wire(G2_GEN), total)
+        return lhs_wire == rhs_wire, len(pts)
+
+    # -- exact per-item checks (sequential semantics) ----------------------
+
+    def _check_row_item(
+        self, commit_wire: np.ndarray, row_coeffs: List[int], r: int
+    ) -> bool:
+        """Receiver r's row check against dealer's commitment — the
+        exact ``sync_key_gen.rs:334`` comparison: for every column k,
+        Σ_j C[j][k]·(r+1)^j == G2^{row_k}."""
+        from .. import native as NT
+
+        tp1 = self.t + 1
+        wires = commit_wire.tobytes()
+        entries = [wires[e * 192 : (e + 1) * 192] for e in range(tp1 * tp1)]
+        x_pows, acc = [], 1
+        for _ in range(tp1):
+            x_pows.append(acc)
+            acc = acc * (r + 1) % R
+        g2w = NT.g2_wire(G2_GEN)
+        for k in range(tp1):
+            lhs = NT.g2_msm(
+                [entries[j * tp1 + k] for j in range(tp1)], x_pows
+            )
+            if lhs != NT.g2_mul(g2w, row_coeffs[k]):
+                return False
+        return True
+
+    def _check_value_item(
+        self, commit_wire: np.ndarray, val: int, r: int, s: int
+    ) -> bool:
+        """The exact ``sync_key_gen.rs:449`` check:
+        commit.evaluate(r+1, s+1) == G2^val."""
+        from .. import native as NT
+
+        tp1 = self.t + 1
+        wires = commit_wire.tobytes()
+        entries = [wires[e * 192 : (e + 1) * 192] for e in range(tp1 * tp1)]
+        x_pows, acc = [], 1
+        for _ in range(tp1):
+            x_pows.append(acc)
+            acc = acc * (r + 1) % R
+        y_pows, acc = [], 1
+        for _ in range(tp1):
+            y_pows.append(acc)
+            acc = acc * (s + 1) % R
+        scal = [
+            x_pows[j] * y_pows[k] % R for j in range(tp1) for k in range(tp1)
+        ]
+        return NT.g2_msm(entries, scal) == NT.g2_mul(
+            NT.g2_wire(G2_GEN), val
+        )
+
+    # -- fallback attribution ----------------------------------------------
+
+    def _fused_check_dealer(self, d, ROWS, VAL, commit_wires) -> bool:
+        """One dealer's row + value cells fused into a single
+        (t+1)²-point MSM — the per-dealer tier of the escalation (fresh
+        Fiat–Shamir coefficients; same algebra as the global check
+        restricted to dealer d)."""
+        from .. import native as NT
+
+        n = self.n
+        tp1 = self.t + 1
+        rows_d = ROWS[d]
+        vals_d = VAL[d]
+        n_rowed = len(rows_d) // (tp1 * 32)
+        n_valued = len(vals_d) // (n * 32)
+        transcript = sha256(
+            b"hbbft_tpu dkg dealer v1"
+            + d.to_bytes(4, "big")
+            + rows_d.tobytes()[:64]
+            + vals_d.tobytes()[:64]
+        )
+        gamma = self._coeff_stream(transcript, b"g", n_rowed)
+        ck = self._coeff_stream(transcript, b"c", tp1)
+        beta = self._coeff_stream(transcript, b"b", n_valued)
+        gamma2 = self._coeff_stream(transcript, b"G", n)
+
+        pow_cols: List[List[int]] = [[] for _ in range(tp1)]
+        for r in range(n):
+            x, acc = r + 1, 1
+            for j in range(tp1):
+                pow_cols[j].append(acc)
+                acc = acc * x % R
+        u = [
+            sum(gamma[r] * pow_cols[j][r] for r in range(n_rowed)) % R
+            for j in range(tp1)
+        ]
+        u2 = [
+            sum(gamma2[r] * pow_cols[j][r] for r in range(n)) % R
+            for j in range(tp1)
+        ]
+        w2 = [
+            sum(beta[s] * pow_cols[k][s] for s in range(n_valued)) % R
+            for k in range(tp1)
+        ]
+        wires = commit_wires[d].tobytes()
+        pts = [
+            wires[(j * tp1 + k) * 192 : (j * tp1 + k + 1) * 192]
+            for j in range(tp1)
+            for k in range(tp1)
+        ]
+        scalars = [
+            (u[j] * ck[k] + u2[j] * w2[k]) % R
+            for j in range(tp1)
+            for k in range(tp1)
+        ]
+        gamma_buf = _fr_bytes(gamma)
+        ck_buf = _fr_bytes(ck)
+        beta_buf = _fr_bytes(beta)
+        gamma2_buf = _fr_bytes(gamma2)
+        gr = NT.fr_matmul(gamma_buf, rows_d, 1, n_rowed, tp1)
+        grc = NT.fr_matmul(gr, ck_buf, 1, tp1, 1)
+        bv = NT.fr_matmul(beta_buf, vals_d, 1, n_valued, n)
+        bvg = NT.fr_matmul(bv, gamma2_buf, 1, n, 1)
+        total = (_fr_ints(grc)[0] + _fr_ints(bvg)[0]) % R
+        return NT.g2_msm(pts, scalars) == NT.g2_mul(
+            NT.g2_wire(G2_GEN), total
+        )
+
+    def _fallback_attribution(
+        self, ROWS, VAL, commit_wires, faults: FaultLog
+    ) -> None:
+        """The fused equation failed: escalate per-dealer fused checks
+        first (one (t+1)²-point MSM each), then exact per-item checks
+        only INSIDE the failing dealers — attributing INVALID_PART to
+        dealers with bad rows and INVALID_ACK to senders of bad values
+        (sequential semantics)."""
+        n = self.n
+        tp1 = self.t + 1
+        for d in range(n):
+            if self._fused_check_dealer(d, ROWS, VAL, commit_wires):
+                continue
+            rows_d = _fr_ints(ROWS[d])
+            vals_d = _fr_ints(VAL[d])
+            flagged_dealer = False
+            for r in range(len(rows_d) // tp1):
+                if not self._check_row_item(
+                    commit_wires[d], rows_d[r * tp1 : (r + 1) * tp1], r
+                ):
+                    if not flagged_dealer:
+                        flagged_dealer = True
+                        faults.add(self.node_ids[d], FaultKind.INVALID_PART)
+            flagged_senders: Set[int] = set()
+            n_valued = len(vals_d) // n
+            for s in range(n_valued):
+                for r in range(n):
+                    if s in flagged_senders:
+                        break
+                    if not self._check_value_item(
+                        commit_wires[d], vals_d[s * n + r], r, s
+                    ):
+                        flagged_senders.add(s)
+                        faults.add(self.node_ids[s], FaultKind.INVALID_ACK)

@@ -1,0 +1,259 @@
+"""DynamicHoneyBadger co-simulation at scale — votes, on-chain DKG,
+era switches over the vectorized epoch driver.
+
+Reference: ``src/dynamic_honey_badger/`` (semantics implemented
+sequentially in ``protocols/dynamic_honey_badger.py``).  VERDICT r2
+item 3: the vectorized driver's "QHB" was HB+queue with no dynamic
+layer; this module adds it:
+
+- **Votes ride on-chain**: each epoch's contributions bundle the
+  proposers' pending signed votes (the reference's ``InternalContrib``,
+  ``dynamic_honey_badger/mod.rs:187-194``); only *committed* votes —
+  those inside the agreed batch — are counted, era-scoped, one active
+  vote per voter, f+1 committed votes pick a winner
+  (``votes.rs:137-148``, via the same :class:`VoteCounter` the
+  sequential engine uses).
+- **On-chain DKG, atomically**: the reference interleaves Part/Ack
+  messages through batches across several epochs purely to give the
+  *asynchronous* network a synchronized message order
+  (``sync_key_gen.rs:3-5``).  The co-simulation's schedule is already
+  synchronous — every correct node sees the identical batch sequence —
+  so the key generation runs as one :class:`VectorizedDkg` session at
+  the winning epoch's boundary: the same Parts, the same Acks, the
+  same generate() outputs, delivered in one step.  (Outcome
+  equivalence is checked against the sequential DHB churn in
+  ``tests/test_dkg_vec.py``.)
+- **Era restart**: the new ``NetworkInfo`` set (DKG keys) replaces the
+  old, the inner epoch driver restarts with epoch numbering
+  continuing, and the epoch's result carries
+  ``ChangeState.Complete(change)`` — the reference's
+  ``restart_honey_badger`` path (``dynamic_honey_badger.rs:275-296``).
+
+A removed validator keeps observing (it can still run the observer
+lane); an added validator must have registered its individual key pair
+with the co-simulation (``register_candidate``), mirroring
+``Change::Add(id, pub_key)`` carrying the joiner's public key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from ..core.fault import FaultLog
+from ..core.network_info import NetworkInfo
+from ..core.serialize import dumps, wire
+from ..crypto import mock as M
+from ..crypto import threshold as T
+from ..protocols.change import Add, Change, ChangeState, Complete, NoChange, Remove
+from ..protocols.honey_badger import Batch
+from ..protocols.votes import SignedVote, Vote, VoteCounter
+from .dkg import VectorizedDkg
+from .epoch import EpochResult, VectorizedHoneyBadgerSim
+
+
+@wire("DynContrib")
+@dataclasses.dataclass(frozen=True)
+class DynContrib:
+    """One proposer's epoch contribution: user payload + the signed
+    votes riding on-chain (reference ``InternalContrib``)."""
+
+    user: Any
+    votes: tuple
+
+
+@dataclasses.dataclass
+class DynamicEpochResult:
+    """One dynamic epoch: the inner result plus membership state."""
+
+    batch: Batch  # user-facing contributions (votes stripped)
+    inner: EpochResult
+    era: int
+    change: ChangeState
+    validators: List[Any]
+    fault_log: FaultLog
+
+
+class VectorizedDynamicSim:
+    """Era-aware co-simulation: vectorized HoneyBadger epochs with
+    on-chain votes and DKG-backed membership changes at scale."""
+
+    def __init__(
+        self,
+        n: int,
+        rng,
+        mock: bool = False,
+        ops: Any = None,
+        verify_honest: bool = True,
+        emit_minimal: bool = False,
+        dkg_verify_honest: Optional[bool] = None,
+    ):
+        self.rng = rng
+        self.mock = mock
+        self.ops = ops
+        self.verify_honest = verify_honest
+        self.emit_minimal = emit_minimal
+        # DKG honest-check elision defaults to the epoch driver's flag
+        self.dkg_verify_honest = (
+            verify_honest if dkg_verify_honest is None else dkg_verify_honest
+        )
+        self.era = 0
+        self.epoch = 0
+        # initial era: centrally dealt keys (reference test harness
+        # bootstrap, messaging.rs:359-400); later eras use the DKG
+        netinfos = NetworkInfo.generate_map(
+            list(range(n)), rng, mock=mock, ops=ops
+        )
+        ref = netinfos[sorted(netinfos)[0]]
+        self.sec_keys: Dict[Any, Any] = {
+            nid: ni.secret_key for nid, ni in netinfos.items()
+        }
+        self.pub_keys: Dict[Any, Any] = ref.public_key_map
+        self.validators: List[Any] = sorted(netinfos)
+        self._vote_num: Dict[Any, int] = {}
+        self.pending: Dict[Any, List[SignedVote]] = {}
+        self._attach(netinfos)
+
+    # -- era plumbing ------------------------------------------------------
+
+    def _attach(self, netinfos: Dict[Any, NetworkInfo]) -> None:
+        self.sim = VectorizedHoneyBadgerSim.from_netinfos(
+            netinfos,
+            self.rng,
+            mock=self.mock,
+            verify_honest=self.verify_honest,
+            emit_minimal=self.emit_minimal,
+        )
+        self.sim.epoch = self.epoch
+        self.counter = VoteCounter(
+            netinfos[sorted(netinfos)[0]], self.era
+        )
+
+    def register_candidate(self, nid: Any, sec_key: Any = None) -> Any:
+        """Register a joiner's individual key pair (the co-simulation
+        plays every node); returns its public key for ``Add``."""
+        if sec_key is None:
+            sec_key = (
+                M.MockSecretKey.random(self.rng)
+                if self.mock
+                else T.SecretKey.random(self.rng)
+            )
+        self.sec_keys[nid] = sec_key
+        self.pub_keys[nid] = sec_key.public_key()
+        return self.pub_keys[nid]
+
+    # -- voting ------------------------------------------------------------
+
+    def vote_for(self, voter: Any, change: Change) -> None:
+        """Sign a vote with the voter's individual key and queue it to
+        ride in the voter's next contribution (``votes.rs:45-61``)."""
+        if voter not in self.sim.netinfos:
+            raise ValueError(f"{voter!r} is not a current validator")
+        num = self._vote_num.get(voter, -1) + 1
+        self._vote_num[voter] = num
+        vote = Vote(change, self.era, num)
+        sig = self.sec_keys[voter].sign(dumps(vote))
+        self.pending.setdefault(voter, []).append(
+            SignedVote(vote, voter, sig)
+        )
+
+    # -- epochs ------------------------------------------------------------
+
+    def run_epoch(
+        self,
+        contributions: Dict[Any, Any],
+        dead: Optional[Set[Any]] = None,
+        **adv,
+    ) -> DynamicEpochResult:
+        """One epoch: wrap contributions with pending votes, run the
+        vectorized epoch, count the committed votes, and switch eras if
+        a change wins (f+1 committed votes)."""
+        wrapped = {}
+        for pid in sorted(self.sim.netinfos):
+            if dead and pid in dead:
+                continue
+            votes = tuple(self.pending.get(pid, ()))
+            if pid not in contributions and not votes:
+                continue
+            wrapped[pid] = DynContrib(contributions.get(pid), votes)
+
+        res = self.sim.run_epoch(wrapped, dead=dead, **adv)
+        faults = res.fault_log
+
+        # committed (batch-ordered) votes only — the on-chain rule that
+        # makes every correct node count identically
+        user_contribs: Dict[Any, Any] = {}
+        for pid in sorted(res.batch.contributions):
+            contrib = res.batch.contributions[pid]
+            if not isinstance(contrib, DynContrib):
+                continue
+            for sv in contrib.votes:
+                faults.merge(self.counter.add_committed_vote(pid, sv))
+            if pid in self.pending:
+                committed = set(contrib.votes)
+                self.pending[pid] = [
+                    sv for sv in self.pending[pid] if sv not in committed
+                ]
+            if contrib.user is not None:
+                user_contribs[pid] = contrib.user
+        batch = Batch(res.batch.epoch, user_contribs)
+        self.epoch = self.sim.epoch
+
+        winner = self.counter.compute_winner()
+        change_state: ChangeState = NoChange()
+        if winner is not None:
+            change_state = Complete(winner)
+            self._switch_era(winner)
+        return DynamicEpochResult(
+            batch=batch,
+            inner=res,
+            era=self.era,
+            change=change_state,
+            validators=list(self.validators),
+            fault_log=faults,
+        )
+
+    # -- the era switch ----------------------------------------------------
+
+    def _switch_era(self, change: Change) -> None:
+        if isinstance(change, Remove):
+            new_set = [v for v in self.validators if v != change.node_id]
+        elif isinstance(change, Add):
+            if change.node_id in self.validators:
+                new_set = list(self.validators)
+            else:
+                if change.node_id not in self.sec_keys:
+                    raise ValueError(
+                        f"candidate {change.node_id!r} has no registered "
+                        "key pair (register_candidate)"
+                    )
+                new_set = sorted(self.validators + [change.node_id])
+        else:  # pragma: no cover - defensive
+            raise TypeError(f"unknown change {change!r}")
+
+        threshold = (len(new_set) - 1) // 3
+        dkg = VectorizedDkg(
+            new_set, threshold, self.rng, mock=self.mock, ops=self.ops
+        )
+        out = dkg.run(verify_honest=self.dkg_verify_honest)
+        pub_keys = {nid: self.pub_keys[nid] for nid in new_set}
+        netinfos = {
+            nid: NetworkInfo(
+                nid,
+                out.shares[nid],
+                self.sec_keys[nid],
+                out.pk_set,
+                pub_keys,
+                ops=self.ops,
+            )
+            for nid in new_set
+        }
+        self.validators = list(new_set)
+        self.era += 1
+        # pending votes are era-scoped (the reference's era restart
+        # builds a fresh VoteCounter and old-era pending votes die with
+        # it, votes.rs:64-85): carrying them over would have honest
+        # proposers committing stale-era votes and getting flagged
+        self.pending.clear()
+        self._vote_num.clear()
+        self._attach(netinfos)

@@ -404,20 +404,40 @@ class VectorizedHoneyBadgerSim:
         verify_honest: bool = True,
         emit_minimal: bool = False,
     ):
-        self.n = n
+        netinfos = NetworkInfo.generate_map(
+            list(range(n)), rng, mock=mock, ops=ops
+        )
+        self._bind(netinfos, rng, mock, verify_honest, emit_minimal)
+
+    @classmethod
+    def from_netinfos(
+        cls,
+        netinfos: Dict[Any, NetworkInfo],
+        rng,
+        mock: bool = False,
+        verify_honest: bool = True,
+        emit_minimal: bool = False,
+    ) -> "VectorizedHoneyBadgerSim":
+        """Build over an existing keyed validator set — the era-restart
+        path of the dynamic layer (``harness/dynamic.py``), where keys
+        come from an on-chain DKG instead of central dealing."""
+        sim = cls.__new__(cls)
+        sim._bind(dict(netinfos), rng, mock, verify_honest, emit_minimal)
+        return sim
+
+    def _bind(self, netinfos, rng, mock, verify_honest, emit_minimal):
+        self.n = len(netinfos)
         self.rng = rng
         self.mock = mock
         self.verify_honest = verify_honest
         self.emit_minimal = emit_minimal
-        self.netinfos = NetworkInfo.generate_map(
-            list(range(n)), rng, mock=mock, ops=ops
-        )
-        ref = self.netinfos[0]
+        self.netinfos = netinfos
+        ref = netinfos[sorted(netinfos)[0]]
         self.ref = ref
         self.num_faulty = ref.num_faulty
         self.pk_set = ref.public_key_set
         self.parity = 2 * ref.num_faulty
-        self.data = n - self.parity
+        self.data = self.n - self.parity
         self.epoch = 0
         self.be = BatchingBackend(inner=ref.ops)
         self.codec = ref.ops.rs_codec(self.data, self.parity)

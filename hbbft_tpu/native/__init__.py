@@ -104,6 +104,12 @@ def _bind(cdll):
         ctypes.c_uint64, b, ctypes.c_uint64, b, u8p,
     ]
     cdll.hb_g2_poly_eval_range.restype = None
+    cdll.hb_g2_mul_many.argtypes = [ctypes.c_uint64, b, u8p, u8p]
+    cdll.hb_g2_mul_many.restype = None
+    cdll.hb_fr_matmul.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u8p, u8p, u8p,
+    ]
+    cdll.hb_fr_matmul.restype = None
     cdll.hb_pairing_check.argtypes = [ctypes.c_uint64, b, b]
     cdll.hb_pairing_check.restype = ctypes.c_int
     cdll.hb_pairing.argtypes = [b, b, u8p]
@@ -352,6 +358,31 @@ def g2_mul(pt_wire: bytes, k: int) -> bytes:
     out = np.empty(192, dtype=np.uint8)
     lib.hb_g2_mul(pt_wire, k.to_bytes(32, "big"), _as_u8p(out))
     return out.tobytes()
+
+
+def g2_mul_many_raw(pt_wire: bytes, ks_be: np.ndarray) -> np.ndarray:
+    """[k₀·P, k₁·P, …] for ONE shared G2 base via the fixed-base comb.
+    ``ks_be``: uint8 array of n×32 big-endian scalars; returns the raw
+    n×192 wire buffer (the DKG dealing path keeps everything as
+    buffers — no per-point Python objects)."""
+    ks_be = np.ascontiguousarray(ks_be, dtype=np.uint8).reshape(-1)
+    n = len(ks_be) // 32
+    out = np.empty(n * 192, dtype=np.uint8)
+    lib.hb_g2_mul_many(n, pt_wire, _as_u8p(ks_be), _as_u8p(out))
+    return out
+
+
+def fr_matmul(a: np.ndarray, b_: np.ndarray, n: int, k: int, m: int) -> np.ndarray:
+    """[n×k]·[k×m] over the scalar field Fr — entries are 32-byte
+    big-endian scalars in flat uint8 buffers (the DKG's bivariate
+    row/value-grid algebra at co-simulation scale)."""
+    a = np.ascontiguousarray(a, dtype=np.uint8).reshape(-1)
+    b_ = np.ascontiguousarray(b_, dtype=np.uint8).reshape(-1)
+    if len(a) != n * k * 32 or len(b_) != k * m * 32:
+        raise ValueError("fr_matmul buffer shape mismatch")
+    out = np.empty(n * m * 32, dtype=np.uint8)
+    lib.hb_fr_matmul(n, k, m, _as_u8p(a), _as_u8p(b_), _as_u8p(out))
+    return out
 
 
 def g1_msm(pts_wire: Sequence[bytes], scalars: Sequence[int]) -> bytes:

@@ -961,6 +961,104 @@ struct BlsInit {
 };
 static BlsInit _init;
 
+// ---------------------------------------------------------------------------
+// Fr: the 255-bit scalar field (group order r), 4x64 limbs, Montgomery
+// form with R = 2^256.  Powers the DKG's bivariate-polynomial algebra
+// (sync_key_gen.rs:268-299, :449): row-coefficient and value-grid
+// matrix products that would be hundreds of millions of Python bigint
+// multiplications at co-simulation scale.
+// ---------------------------------------------------------------------------
+
+struct Fr {
+  uint64_t l[4];
+};
+
+static const Fr FR_MOD = {{0xffffffff00000001ULL, 0x53bda402fffe5bfeULL,
+                           0x3339d80809a1d805ULL, 0x73eda753299d7d48ULL}};
+static const Fr FR_R2 = {{0xc999e990f3f29c6dULL, 0x2b6cedcb87925c23ULL,
+                          0x05d314967254398fULL, 0x0748d9d99f59ff11ULL}};
+static const uint64_t FR_NINV = 0xfffffffeffffffffULL;  // -r^{-1} mod 2^64
+static const Fr FR_ONE_PLAIN = {{1, 0, 0, 0}};
+
+static inline void fr_cond_sub(Fr& a) {
+  // branchless: compute a - p, select on the final borrow
+  uint64_t s[4];
+  uint64_t borrow = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 cur = (u128)a.l[i] - FR_MOD.l[i] - borrow;
+    s[i] = (uint64_t)cur;
+    borrow = (uint64_t)(cur >> 64) & 1;
+  }
+  uint64_t keep = 0 - borrow;  // all-ones if a < p (keep a)
+  for (int i = 0; i < 4; i++)
+    a.l[i] = (a.l[i] & keep) | (s[i] & ~keep);
+}
+
+static inline Fr fr_add(const Fr& a, const Fr& b) {
+  Fr r;
+  u128 carry = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 cur = (u128)a.l[i] + b.l[i] + (uint64_t)carry;
+    r.l[i] = (uint64_t)cur;
+    carry = cur >> 64;
+  }
+  // r < 2^255 + 2^255 < 2^256: no limb overflow; one conditional subtract
+  fr_cond_sub(r);
+  return r;
+}
+
+// CIOS Montgomery multiplication, 4 limbs
+static inline Fr fr_mont_mul(const Fr& a, const Fr& b) {
+  uint64_t t[6] = {0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 4; i++) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; j++) {
+      u128 cur = (u128)a.l[j] * b.l[i] + t[j] + (uint64_t)carry;
+      t[j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    u128 cur = (u128)t[4] + (uint64_t)carry;
+    t[4] = (uint64_t)cur;
+    t[5] = (uint64_t)(cur >> 64);
+    uint64_t m = t[0] * FR_NINV;
+    u128 c0 = (u128)m * FR_MOD.l[0] + t[0];
+    carry = c0 >> 64;
+    for (int j = 1; j < 4; j++) {
+      u128 cur2 = (u128)m * FR_MOD.l[j] + t[j] + (uint64_t)carry;
+      t[j - 1] = (uint64_t)cur2;
+      carry = cur2 >> 64;
+    }
+    u128 cur3 = (u128)t[4] + (uint64_t)carry;
+    t[3] = (uint64_t)cur3;
+    t[4] = t[5] + (uint64_t)(cur3 >> 64);
+  }
+  Fr r = {{t[0], t[1], t[2], t[3]}};
+  // r < 2p here (p < 2^255 keeps t[4] zero); reduce to canonical
+  fr_cond_sub(r);
+  return r;
+}
+
+static inline Fr fr_from_be(const uint8_t* in) {
+  Fr r;
+  for (int i = 0; i < 4; i++) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; j++) v = (v << 8) | in[(3 - i) * 8 + j];
+    r.l[i] = v;
+  }
+  fr_cond_sub(r);  // tolerate non-canonical input
+  return r;
+}
+
+static inline void fr_to_be(const Fr& a, uint8_t* out) {
+  for (int i = 0; i < 4; i++) {
+    uint64_t v = a.l[i];
+    for (int j = 7; j >= 0; j--) {
+      out[(3 - i) * 8 + j] = (uint8_t)v;
+      v >>= 8;
+    }
+  }
+}
+
 }  // namespace bls
 
 // ---------------------------------------------------------------------------
@@ -1082,6 +1180,68 @@ void hb_g2_poly_eval_range(uint64_t ncoeffs, const uint8_t* coeffs,
   for (uint64_t step = 1; step < n; step++) {
     for (uint64_t k = 0; k + 1 < m; k++) d[k] = jac_add(d[k], d[k + 1]);
     if (step >= m) g2_to_wire(jac_to_aff(d[0]), out + 192 * step);
+  }
+}
+
+// out[n*m] = a[n*k] · b[k*m] over Fr — every entry a 32-byte
+// big-endian scalar mod r.  The DKG dealing/value-grid workhorse
+// (sync_key_gen.rs:268-299): row coefficients for all receivers are
+// POW·C_d, value grids are ROWS·POWᵀ — at N=256 that is ~10⁹
+// Montgomery multiplications, native-only territory.
+void hb_fr_matmul(uint64_t n, uint64_t k, uint64_t m, const uint8_t* a,
+                  const uint8_t* b, uint8_t* out) {
+  std::vector<Fr> am(n * k), bm(k * m);
+  for (uint64_t i = 0; i < n * k; i++)
+    am[i] = fr_mont_mul(fr_from_be(a + 32 * i), FR_R2);
+  for (uint64_t i = 0; i < k * m; i++)
+    bm[i] = fr_mont_mul(fr_from_be(b + 32 * i), FR_R2);
+  for (uint64_t i = 0; i < n; i++) {
+    for (uint64_t j = 0; j < m; j++) {
+      Fr acc = {{0, 0, 0, 0}};
+      const Fr* arow = &am[i * k];
+      for (uint64_t l = 0; l < k; l++)
+        acc = fr_add(acc, fr_mont_mul(arow[l], bm[l * m + j]));
+      acc = fr_mont_mul(acc, FR_ONE_PLAIN);  // leave Montgomery form
+      fr_to_be(acc, out + 32 * (i * m + j));
+    }
+  }
+}
+
+// Many scalar-muls of ONE shared G2 base — the DKG dealing shape
+// (every commitment entry is coeff·P₂, sync_key_gen.rs:268-299).
+// Same 4-bit fixed-base comb as hb_g1_mul_many, over Fq².
+void hb_g2_mul_many(uint64_t n, const uint8_t* p, const uint8_t* ks,
+                    uint8_t* out) {
+  Aff<Fp2> a = g2_from_wire(p);
+  if (n == 0) return;
+  if (n < 8) {
+    for (uint64_t i = 0; i < n; ++i) {
+      Jac<Fp2> r = jac_mul_be(a, ks + i * 32, 32);
+      g2_to_wire(jac_to_aff(r), out + i * 192);
+    }
+    return;
+  }
+  // 8-bit windows (G2 adds are ~3× a G1 add, so the bigger 32×255
+  // table halves the per-scalar adds vs the G1 comb's 4-bit windows
+  // and amortizes once n is in the thousands — the DKG dealing shape)
+  static thread_local std::vector<Jac<Fp2>> table;
+  table.assign(32 * 255, jac_infinity<Fp2>());
+  Jac<Fp2> cur = jac_madd(jac_infinity<Fp2>(), a);
+  for (int j = 0; j < 32; ++j) {
+    table[j * 255] = cur;
+    for (int d = 2; d < 256; ++d)
+      table[j * 255 + d - 1] = jac_add(table[j * 255 + d - 2], cur);
+    if (j < 31)
+      for (int t = 0; t < 8; ++t) cur = jac_double(cur);
+  }
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint8_t* k = ks + i * 32;
+    Jac<Fp2> acc = jac_infinity<Fp2>();
+    for (int j = 0; j < 32; ++j) {
+      uint8_t d = k[31 - j];
+      if (d) acc = jac_add(acc, table[j * 255 + d - 1]);
+    }
+    g2_to_wire(jac_to_aff(acc), out + i * 192);
   }
 }
 
