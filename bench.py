@@ -101,6 +101,8 @@ def bench_headline(k: int = 65536, iters: int = 3):
             )
         return obs
 
+    from hbbft_tpu.crypto.backend import CpuBackend
+
     inner = TpuBackend()
     BatchingBackend(inner=inner).prefetch(make_obs(b"warm"))  # compile
     dts = []
@@ -118,6 +120,15 @@ def bench_headline(k: int = 65536, iters: int = 3):
     dt = sum(dts) / len(dts)
     device_rate = k / dt
 
+    # the same flush on the pure host path (native Pippenger), for the
+    # honest device-vs-host end-to-end record every round
+    host_obs = make_obs(b"host")
+    host_be = BatchingBackend(inner=CpuBackend())
+    t0 = time.perf_counter()
+    host_be.prefetch(host_obs)
+    host_dt = time.perf_counter() - t0
+    assert host_be.stats.fallback_items == 0
+
     sample = 8
     ob0 = obs[:sample]
     t0 = time.perf_counter()
@@ -132,6 +143,8 @@ def bench_headline(k: int = 65536, iters: int = 3):
         nodes=n_nodes,
         groups=groups,
         flush_s=round(dt, 2),
+        host_flush_s=round(host_dt, 2),
+        host_rate=round(k / host_dt, 1),
     )
 
 
@@ -639,6 +652,10 @@ def bench_hb_1024_real(nodes: int = 1024, epochs: int = 1, n_dead: int = 50):
         assert res.batch.contributions == contribs
         shares += res.shares_verified
     dt = (time.perf_counter() - t0) / epochs
+    # the fused flush must not have silently degraded to the per-group
+    # fallback (a device failure would otherwise masquerade as a
+    # measurement — the round-3 OOM lesson)
+    assert sim.be.stats.fallback_groups == 0, sim.be.stats
 
     # sequential anchor: real-BLS n=4 virtual-time sim, quadratic
     stats, wall, _ = simulate_queueing_honey_badger(
@@ -728,6 +745,66 @@ def bench_broadcast_vec_1024(nodes: int = 1024):
         vs_baseline=seq_est / dt,
         seq256_measured_s=seq256["value"],
         nodes=nodes,
+    )
+
+
+def bench_qhb_dyn_1024(nodes: int = 1024, n_dead: int = 50):
+    """BASELINE config 5, now with the TRUE reference stack shape:
+    QueueingHoneyBadger = **DynamicHoneyBadger** + queue
+    (``queueing_honey_badger.rs:161-176``) — votes, on-chain DKG and an
+    era switch run mid-measurement at N=1024 (the round-2 driver's
+    'QHB' wrapped the static HB sim; VERDICT r2 missing #1).  Same
+    protocol-plane settings as qhb_1024 (mock crypto, honest checks
+    elided — annotated in the JSON)."""
+    import random as _r
+
+    from hbbft_tpu.harness.dynamic import VectorizedDynamicQueueingSim
+    from hbbft_tpu.protocols.change import Complete, Remove
+
+    rng = _r.Random(0x5D1)
+    t0 = time.perf_counter()
+    qsim = VectorizedDynamicQueueingSim(
+        nodes,
+        rng,
+        batch_size=nodes,
+        mock=True,
+        verify_honest=False,
+        emit_minimal=True,
+    )
+    qsim.input_all([b"tx-%06d" % i for i in range(4 * nodes)])
+    setup_s = time.perf_counter() - t0
+    # n_dead silent nodes, keeping the churn target (the highest id) live
+    dead = set(range(nodes - n_dead - 1, nodes - 1))
+    qsim.run_epoch(dead=dead)  # warm
+    f = (nodes - 1) // 3
+    for v in qsim.validators[: f + 1]:
+        qsim.vote_for(v, Remove(nodes - 1))
+    t0 = time.perf_counter()
+    committed = 0
+    churn_epoch = None
+    epochs = 3
+    for e in range(epochs):
+        res = qsim.run_epoch(dead=dead)
+        committed += len(res.batch)
+        if isinstance(res.change, Complete):
+            churn_epoch = e
+    dt = (time.perf_counter() - t0) / epochs
+    assert churn_epoch is not None and qsim.era == 1
+    assert (nodes - 1) not in qsim.validators
+    return _emit(
+        "qhb_dyn_1024_epochs_per_s",
+        1.0 / dt,
+        "epochs/s",
+        nodes=nodes,
+        dead=n_dead,
+        txs_per_epoch=committed // epochs,
+        s_per_epoch=round(dt, 2),
+        setup_s=round(setup_s, 1),
+        churn_at_epoch=churn_epoch,
+        eras=qsim.era + 1,
+        crypto="mock",
+        verify_honest=False,
+        emit_minimal=True,
     )
 
 
@@ -903,6 +980,7 @@ SUITE = {
     "qhb_1024": bench_qhb_1024,
     "qhb_1024_txrate": bench_qhb_1024_txrate,
     "hb_1024_real": bench_hb_1024_real,
+    "qhb_dyn_1024": bench_qhb_dyn_1024,
     "dkg_verified": bench_dkg_verified,
     "dkg_256": bench_dkg_256,
     "churn_256": bench_churn_256,

@@ -46,6 +46,13 @@ def main() -> None:
         action="store_true",
         help="array-based full-epoch co-simulation (north-star scale)",
     )
+    p.add_argument(
+        "--dynamic",
+        action="store_true",
+        help="with --vectorized: the full QHB = DHB + queue stack "
+        "(votes/on-chain DKG/era machinery active), and one "
+        "Remove-churn of the highest node id mid-run",
+    )
     args = p.parse_args()
 
     if 3 * args.faulty >= args.nodes:
@@ -54,21 +61,50 @@ def main() -> None:
     if args.vectorized:
         import time
 
-        from hbbft_tpu.harness.epoch import VectorizedQueueingSim
-
         rng = random.Random(args.seed)
-        qsim = VectorizedQueueingSim(
-            args.nodes,
-            rng,
-            batch_size=args.batch,
-            mock=not args.real_bls,
-            verify_honest=False,
-            emit_minimal=True,
-        )
+        if args.dynamic:
+            from hbbft_tpu.harness.dynamic import (
+                VectorizedDynamicQueueingSim,
+            )
+            from hbbft_tpu.protocols.change import Complete, Remove
+
+            qsim = VectorizedDynamicQueueingSim(
+                args.nodes,
+                rng,
+                batch_size=args.batch,
+                mock=not args.real_bls,
+                verify_honest=False,
+                emit_minimal=True,
+            )
+            f = (args.nodes - 1) // 3
+            churn_target = max(qsim.validators)
+            for v in qsim.validators[: f + 1]:
+                qsim.vote_for(v, Remove(churn_target))
+        else:
+            from hbbft_tpu.harness.epoch import VectorizedQueueingSim
+
+            qsim = VectorizedQueueingSim(
+                args.nodes,
+                rng,
+                batch_size=args.batch,
+                mock=not args.real_bls,
+                verify_honest=False,
+                emit_minimal=True,
+            )
         qsim.input_all(
             [b"tx-%08d" % i + bytes(max(0, args.tx_size - 11)) for i in range(args.txs)]
         )
-        dead = set(sorted(qsim.sim.netinfos)[-args.faulty :]) if args.faulty else set()
+        all_ids = (
+            qsim.validators
+            if args.dynamic
+            else sorted(qsim.sim.netinfos)
+        )
+        if args.dynamic and args.faulty:
+            # keep the churn target (the highest id) alive: kill the
+            # `faulty` ids just below it
+            dead = set(all_ids[-(args.faulty + 1) : -1])
+        else:
+            dead = set(all_ids[-args.faulty :]) if args.faulty else set()
         committed: set = set()
         epoch = 0
         t0 = time.perf_counter()
@@ -77,9 +113,12 @@ def main() -> None:
             te = time.perf_counter()
             res = qsim.run_epoch(dead=dead)
             committed.update(res.batch.tx_iter())
+            note = ""
+            if args.dynamic and isinstance(res.change, Complete):
+                note = f"  [era {res.era}: {res.change.change!r} complete]"
             print(
                 f"{epoch:>5} {time.perf_counter() - te:>7.2f}s "
-                f"{len(res.batch):>7} {len(committed):>7}"
+                f"{len(res.batch):>7} {len(committed):>7}{note}"
             )
             epoch += 1
         wall = time.perf_counter() - t0

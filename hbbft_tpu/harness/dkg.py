@@ -431,9 +431,15 @@ class VectorizedDkg:
 
         n, t = self.n, self.t
         tp1 = t + 1
+        # the Fiat–Shamir transcript must bind EVERY byte the equation
+        # ranges over — all commitment entries and all row/value
+        # scalars — or an adaptively-chosen commitment could solve for
+        # an unbound entry after seeing the challenges
         transcript = sha256(
             b"hbbft_tpu dkg fused v1"
-            + b"".join(w.tobytes()[:64] for w in commit_wires.values())
+            + b"".join(
+                commit_wires[d].tobytes() for d in sorted(commit_wires)
+            )
             + b"".join(r.tobytes() for r in ROWS)
             + b"".join(v.tobytes() for v in VAL)
         )
@@ -570,11 +576,14 @@ class VectorizedDkg:
         vals_d = VAL[d]
         n_rowed = len(rows_d) // (tp1 * 32)
         n_valued = len(vals_d) // (n * 32)
+        # bind the dealer's full commitment + every checked scalar
+        # (same adaptive-soundness requirement as the global check)
         transcript = sha256(
             b"hbbft_tpu dkg dealer v1"
             + d.to_bytes(4, "big")
-            + rows_d.tobytes()[:64]
-            + vals_d.tobytes()[:64]
+            + commit_wires[d].tobytes()
+            + rows_d.tobytes()
+            + vals_d.tobytes()
         )
         gamma = self._coeff_stream(transcript, b"g", n_rowed)
         ck = self._coeff_stream(transcript, b"c", tp1)

@@ -890,48 +890,31 @@ class VectorizedHoneyBadgerSim:
 # ---------------------------------------------------------------------------
 
 
-class VectorizedQueueingSim:
-    """QueueingHoneyBadger co-simulation: transaction queues, random
-    B/N proposals, committed-transaction removal (reference
-    ``queueing_honey_badger.rs:188-268``) over the vectorized epoch
-    driver — BASELINE config 5's full-stack shape.
+class TransactionQueueMixin:
+    """Copy-on-diverge per-node transaction queues (the reference's
+    normal operating mode: each node holds its own queue and proposes
+    from it, ``queueing_honey_badger.rs:188-204``).
 
-    Queues are **per node** (the reference's normal operating mode:
-    each node holds its own queue and proposes from it,
-    ``queueing_honey_badger.rs:188-204``) with a copy-on-diverge
-    representation: while every injection is uniform (``input_all``,
-    the harness/bench scenario) all per-node queues are provably
-    identical — ``choose`` never mutates and every node removes the
-    same committed set — so ONE shared deque stands for all of them;
-    the first divergent ``input_node`` call materializes real
-    per-node queues.  Per-node proposals always draw independent
-    random samples, exactly the reference's duplicate-avoidance
-    scheme (``queueing_honey_badger.rs:13-23``)."""
+    While every injection is uniform (``input_all``, the harness/bench
+    scenario) all per-node queues are provably identical — ``choose``
+    never mutates and every node removes the same committed set — so
+    ONE shared deque stands for all of them; the first divergent
+    ``input_node`` call materializes real per-node copies.  Per-node
+    proposals always draw independent random samples, exactly the
+    reference's duplicate-avoidance scheme
+    (``queueing_honey_badger.rs:13-23``).
 
-    def __init__(
-        self,
-        n: int,
-        rng,
-        batch_size: int = 100,
-        mock: bool = False,
-        ops: Any = None,
-        verify_honest: bool = True,
-        emit_minimal: bool = False,
-    ):
+    Users provide ``_queue_ids()`` (the current validator set) and the
+    ``rng``/``batch_size`` attributes."""
+
+    def _init_queues(self) -> None:
         from ..protocols.transaction_queue import TransactionQueue
 
-        self.sim = VectorizedHoneyBadgerSim(
-            n,
-            rng,
-            mock=mock,
-            ops=ops,
-            verify_honest=verify_honest,
-            emit_minimal=emit_minimal,
-        )
-        self.rng = rng
-        self.batch_size = batch_size
         self.queue = TransactionQueue()  # shared while uniform
         self._per_node: Optional[Dict[Any, Any]] = None
+
+    def _queue_ids(self) -> List[Any]:  # pragma: no cover - abstract
+        raise NotImplementedError
 
     @property
     def diverged(self) -> bool:
@@ -943,7 +926,7 @@ class VectorizedQueueingSim:
         one shared queue; after divergence, the real per-node ones)."""
         if self._per_node is not None:
             return self._per_node
-        return {nid: self.queue for nid in self.sim.netinfos}
+        return {nid: self.queue for nid in self._queue_ids()}
 
     def _materialize(self) -> None:
         """Copy-on-diverge: split the shared queue into real per-node
@@ -953,7 +936,7 @@ class VectorizedQueueingSim:
         if self._per_node is None:
             self._per_node = {
                 nid: TransactionQueue(self.queue.queue)
-                for nid in self.sim.netinfos
+                for nid in self._queue_ids()
             }
 
     def input_all(self, txs: Sequence[Any]) -> None:
@@ -974,11 +957,12 @@ class VectorizedQueueingSim:
         for tx in txs:
             q.push(tx)
 
-    def run_epoch(self, dead: Optional[Set[Any]] = None, **adv) -> EpochResult:
+    def _sample_contribs(self, dead: Set[Any]) -> Dict[Any, List[Any]]:
+        """Every live validator's B/N random proposal from its queue."""
         import itertools
 
-        dead = set(dead or set())
-        amount = max(1, self.batch_size // self.sim.n)
+        ids = self._queue_ids()
+        amount = max(1, self.batch_size // len(ids))
         if self._per_node is None:
             # uniform fast path: materialize the shared head ONCE;
             # every live node samples from it independently
@@ -988,28 +972,75 @@ class VectorizedQueueingSim:
                     self.queue.queue, min(self.batch_size, len(self.queue))
                 )
             )
-            contribs = {
+            return {
                 nid: (
                     list(head)
                     if len(head) <= amount
                     else self.rng.sample(head, amount)
                 )
-                for nid in self.sim.netinfos
+                for nid in ids
                 if nid not in dead
             }
-        else:
-            contribs = {
-                nid: self._per_node[nid].choose(
-                    amount, self.batch_size, self.rng
-                )
-                for nid in self.sim.netinfos
-                if nid not in dead
-            }
-        result = self.sim.run_epoch(contribs, dead=dead, **adv)
-        committed = list(result.batch.tx_iter())
+        from ..protocols.transaction_queue import TransactionQueue
+
+        for nid in ids:
+            if nid not in self._per_node:
+                # a joining validator synchronizes the backlog from a
+                # sponsor (JoinPlan semantics): seed from a live queue
+                sponsor = next(iter(self._per_node.values()))
+                self._per_node[nid] = TransactionQueue(sponsor.queue)
+        return {
+            nid: self._per_node[nid].choose(
+                amount, self.batch_size, self.rng
+            )
+            for nid in ids
+            if nid not in dead
+        }
+
+    def _drain(self, committed: List[Any]) -> None:
         if self._per_node is None:
             self.queue.remove_all(committed)
         else:
             for q in self._per_node.values():
                 q.remove_all(committed)
+
+
+class VectorizedQueueingSim(TransactionQueueMixin):
+    """QueueingHoneyBadger co-simulation over the static epoch driver:
+    transaction queues, random B/N proposals, committed-transaction
+    removal (reference ``queueing_honey_badger.rs:188-268``) —
+    BASELINE config 5's throughput shape.  (The full reference stack,
+    QHB = DHB + queue with votes/DKG/eras, is
+    ``harness/dynamic.VectorizedDynamicQueueingSim``.)"""
+
+    def __init__(
+        self,
+        n: int,
+        rng,
+        batch_size: int = 100,
+        mock: bool = False,
+        ops: Any = None,
+        verify_honest: bool = True,
+        emit_minimal: bool = False,
+    ):
+        self.sim = VectorizedHoneyBadgerSim(
+            n,
+            rng,
+            mock=mock,
+            ops=ops,
+            verify_honest=verify_honest,
+            emit_minimal=emit_minimal,
+        )
+        self.rng = rng
+        self.batch_size = batch_size
+        self._init_queues()
+
+    def _queue_ids(self) -> List[Any]:
+        return sorted(self.sim.netinfos)
+
+    def run_epoch(self, dead: Optional[Set[Any]] = None, **adv) -> EpochResult:
+        dead = set(dead or set())
+        contribs = self._sample_contribs(dead)
+        result = self.sim.run_epoch(contribs, dead=dead, **adv)
+        self._drain(list(result.batch.tx_iter()))
         return result

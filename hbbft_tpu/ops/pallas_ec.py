@@ -434,6 +434,58 @@ def _cached_tiles(name: str, kernel, pts_t, aux_t):
     return out
 
 
+def cached_compiled(name: str, fn, *args):
+    """Run ``jax.jit(fn)(*args)`` through the compiled-executable disk
+    cache (generic sibling of ``_cached_tiles`` for programs that embed
+    the Pallas kernels inside larger jitted bodies — e.g. the
+    shard_map'd mesh MSM, whose Mosaic sub-compile would otherwise be
+    repaid every process)."""
+    import os
+    import pickle
+
+    key = (
+        name,
+        tuple(
+            (tuple(a.shape), str(getattr(a, "dtype", ""))) for a in args
+        ),
+        jax.__version__,
+        jax.devices()[0].device_kind,
+    )
+    loaded = _EXEC_MEM.get(key)
+    if loaded is None:
+        fname = (
+            "-".join(str(p) for p in key).replace(" ", "").replace("/", "_")
+            + ".palexe"
+        )
+        path = os.path.join(_exec_cache_dir(), fname)
+        if os.path.exists(path):
+            try:
+                from jax.experimental.serialize_executable import (
+                    deserialize_and_load,
+                )
+
+                with open(path, "rb") as fh:
+                    payload, in_tree, out_tree = pickle.load(fh)
+                loaded = deserialize_and_load(payload, in_tree, out_tree)
+            except Exception:
+                loaded = None
+        if loaded is None:
+            compiled = jax.jit(fn).lower(*args).compile()
+            try:
+                from jax.experimental.serialize_executable import serialize
+
+                payload, in_tree, out_tree = serialize(compiled)
+                tmp = path + ".tmp.%d" % os.getpid()
+                with open(tmp, "wb") as fh:
+                    pickle.dump((payload, in_tree, out_tree), fh)
+                os.replace(tmp, path)
+            except Exception:
+                pass
+            loaded = compiled
+        _EXEC_MEM[key] = loaded
+    return loaded(*args)
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def _scalar_mul_tiles_jit(pts_t, bits_t, interpret: bool):
     return _run_tiles(_scalar_mul_kernel, pts_t, bits_t, interpret)
@@ -587,6 +639,26 @@ def _tree_sum_g2(prods):
     return ec_jax.g2_kernel().tree_sum(prods)
 
 
+# Largest point count one jitted tree reduction may span: the first
+# levels materialize s32[K/2, 38, 38] convolution intermediates
+# (~9.5 GB at K=512k with TPU tiling padding — measured HBM OOM on
+# v5e), so bigger batches reduce in fixed-size chunks whose compiles
+# are shared, then a tiny tree over the chunk partials.
+_TREE_CHUNK_G1 = 1 << 18
+_TREE_CHUNK_G2 = 1 << 16
+
+
+def _tree_sum_chunked(prods, g2: bool):
+    chunk = _TREE_CHUNK_G2 if g2 else _TREE_CHUNK_G1
+    fn = _tree_sum_g2 if g2 else _tree_sum_g1
+    K = prods.shape[0]
+    if K <= chunk:
+        return fn(prods)
+    # bucketed Kp is a power of two ≥ chunk, so slices divide evenly
+    parts = [fn(prods[i : i + chunk]) for i in range(0, K, chunk)]
+    return fn(jnp.stack(parts))
+
+
 def g1_msm_pallas(
     points: Sequence[Any],
     scalars: Sequence[int],
@@ -606,7 +678,7 @@ def g1_msm_pallas(
     pts = ec_jax.g1_to_limbs(points)
     bits = LB.scalars_to_bits(scalars, nbits)
     prods = scalar_mul_windowed(pts, bits, interpret=interpret, trim=False)
-    return ec_jax.g1_from_limbs(_tree_sum_g1(prods))
+    return ec_jax.g1_from_limbs(_tree_sum_chunked(prods, g2=False))
 
 
 def g2_msm_pallas(
@@ -625,4 +697,4 @@ def g2_msm_pallas(
     pts = ec_jax.g2_to_limbs(points)
     bits = LB.scalars_to_bits(scalars, nbits)
     prods = scalar_mul_windowed_g2(pts, bits, interpret=interpret, trim=False)
-    return ec_jax.g2_from_limbs(_tree_sum_g2(prods))
+    return ec_jax.g2_from_limbs(_tree_sum_chunked(prods, g2=True))

@@ -109,6 +109,100 @@ def sharded_msm_fn(mesh: Mesh, g2: bool = False):
     return run
 
 
+def sharded_windowed_msm_fn(
+    mesh: Mesh, g2: bool = False, interpret: Optional[bool] = None
+):
+    """The 4-bit windowed Pallas kernel under ``shard_map`` (VERDICT r2
+    item 5 / ADVICE r1 item 3): the tile grid shards over the mesh, each
+    device runs the windowed scalar-mul on its tiles and tree-reduces
+    locally, and only the [3, L] partial sums cross ICI (one
+    ``all_gather`` + replicated log-tree of complete adds).  Per-chip
+    throughput is therefore the single-chip windowed rate — the mesh
+    scales it by device count with O(1) communication.
+
+    Returns ``run(pts_t, dig_t) -> [3, (2,) L]`` over tile-transposed
+    inputs (``pallas_ec._tile_transpose`` layout), padded to the mesh.
+    """
+    from ..ops import pallas_ec
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kern = (
+        pallas_ec._windowed_kernel_g2 if g2 else pallas_ec._windowed_kernel
+    )
+    ec_kernel = ec_jax.g2_kernel() if g2 else ec_jax.g1_kernel()
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=P(),
+    )
+    def _sharded(pts_t, dig_t):
+        prods_t = pallas_ec._run_tiles(kern, pts_t, dig_t, interpret)
+        kp = prods_t.shape[0] * prods_t.shape[-1]
+        local = ec_kernel.tree_sum(pallas_ec._untile(prods_t, kp, kp))
+        partials = jax.lax.all_gather(local, AXIS)
+        return ec_kernel.tree_sum(partials)
+
+    _jitted = jax.jit(_sharded)
+    cache_name = "mesh_win_%s_%dd" % ("g2" if g2 else "g1", mesh.devices.size)
+
+    def run(pts_t: jnp.ndarray, dig_t: jnp.ndarray) -> jnp.ndarray:
+        n = mesh.devices.size
+        G = pts_t.shape[0]
+        if G % n:
+            padG = (-G) % n
+            pad_pts = np.zeros((padG,) + pts_t.shape[1:], dtype=np.int32)
+            # identity point (0 : 1 : 0) in every padded lane
+            if pts_t.ndim == 4:  # [G, 3, L, T] (G1)
+                pad_pts[:, 1, 0, :] = 1
+            else:  # [G, 3, 2, L, T] (G2)
+                pad_pts[:, 1, 0, 0, :] = 1
+            pts_t = jnp.concatenate([pts_t, jnp.asarray(pad_pts)], axis=0)
+            dig_t = jnp.concatenate(
+                [
+                    dig_t,
+                    jnp.zeros(
+                        (padG,) + tuple(dig_t.shape[1:]), dtype=dig_t.dtype
+                    ),
+                ],
+                axis=0,
+            )
+        if not interpret:
+            # the embedded Mosaic kernel compile is minutes; route the
+            # whole sharded program through the executable disk cache
+            return pallas_ec.cached_compiled(
+                cache_name, _sharded, pts_t, dig_t
+            )
+        return _jitted(pts_t, dig_t)
+
+    return run
+
+
+def sharded_windowed_g1_msm(
+    points: Sequence,
+    scalars: Sequence[int],
+    mesh: Optional[Mesh] = None,
+    nbits: int = 255,
+    interpret: Optional[bool] = None,
+):
+    """Host-facing sharded windowed MSM over hbbft_tpu G1 points."""
+    from ..ops import pallas_ec
+
+    if not points:
+        from ..crypto.curve import G1
+
+        return G1.infinity()
+    mesh = mesh or make_mesh()
+    run = sharded_windowed_msm_fn(mesh, interpret=interpret)
+    pts = ec_jax.g1_to_limbs(list(points))
+    bits = LB.scalars_to_bits(list(scalars), nbits)
+    digits = pallas_ec.bits_to_digits(bits)
+    pts_t, dig_t, _, _ = pallas_ec._tile_transpose(pts, digits)
+    return ec_jax.g1_from_limbs(run(pts_t, dig_t))
+
+
 def sharded_epoch_crypto_fn(mesh: Mesh):
     """The framework's 'training step': one epoch's batched crypto,
     sharded over the validator axis — the program the driver dry-runs

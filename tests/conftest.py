@@ -8,12 +8,18 @@ without TPU hardware.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# HBBFT_TPU_HW=1 opts into the real-hardware smoke suite
+# (tests/test_hw_smoke.py): the process then keeps the real TPU
+# platform.  Everything else runs on the virtual 8-device CPU mesh.
+_HW = bool(os.environ.get("HBBFT_TPU_HW"))
+
+if not _HW:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # Some environments inject a TPU plugin via sitecustomize that calls
 # ``jax.config.update("jax_platforms", ...)`` — which silently outranks
@@ -21,7 +27,8 @@ if "xla_force_host_platform_device_count" not in flags:
 # 8-device CPU mesh is what tests actually run on.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _HW:
+    jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the EC scalar-mul scans are large XLA
 # programs (minutes to compile cold); cache them across test runs.

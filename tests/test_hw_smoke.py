@@ -1,0 +1,108 @@
+"""Real-hardware smoke gate (VERDICT r2 item 7).
+
+Run with ``HBBFT_TPU_HW=1 python -m pytest tests/test_hw_smoke.py -q``
+— the whole suite is skipped otherwise (the regular CI forces the
+virtual CPU mesh; full-width Pallas on a real chip is what this file
+guards round-over-round, replacing bench-time assertions).
+
+~2-3 min warm: the windowed Mosaic executables load from the
+``.xla_cache/pallas_exec`` disk cache (~1 s each); only the small XLA
+reductions compile per process.  Run it before each BENCH capture.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+try:
+    import jax
+
+    _ON_TPU = jax.default_backend() == "tpu"
+except Exception:  # pragma: no cover - no jax
+    _ON_TPU = False
+
+pytestmark = pytest.mark.skipif(
+    not _ON_TPU,
+    reason="hardware smoke suite needs the real TPU "
+    "(HBBFT_TPU_HW=1, outside the CPU-forced CI)",
+)
+
+
+def _fr_scalars(rng, k):
+    from hbbft_tpu.ops import limbs as LB
+
+    return [rng.randrange(1, LB.R) for _ in range(k)]
+
+
+class TestWindowedKernelsHw:
+    """Full-width (255-bit) windowed Pallas correctness on the chip."""
+
+    def test_g1_windowed_full_width(self):
+        from hbbft_tpu.crypto.curve import G1_GEN, g1_multi_exp
+        from hbbft_tpu.ops import pallas_ec
+
+        rng = random.Random(0x51)
+        k = 256  # buckets to a cached tile grid
+        pts = [G1_GEN * rng.randrange(1, 1 << 64) for _ in range(k)]
+        scalars = _fr_scalars(rng, k)
+        got = pallas_ec.g1_msm_pallas(pts, scalars, nbits=255, interpret=False)
+        assert got == g1_multi_exp(pts, scalars)
+
+    def test_g2_windowed_full_width(self):
+        from hbbft_tpu.crypto.curve import G2_GEN, g2_multi_exp
+        from hbbft_tpu.ops import pallas_ec
+
+        rng = random.Random(0x52)
+        k = 64
+        pts = [G2_GEN * rng.randrange(1, 1 << 64) for _ in range(k)]
+        scalars = _fr_scalars(rng, k)
+        got = pallas_ec.g2_msm_pallas(pts, scalars, nbits=255, interpret=False)
+        assert got == g2_multi_exp(pts, scalars)
+
+    def test_g1_windowed_epoch_shape_192bit(self):
+        # the product-form flush width (192-bit coefficients) at a
+        # cached epoch-scale bucket
+        from hbbft_tpu.crypto.curve import G1_GEN, g1_multi_exp
+        from hbbft_tpu.ops import pallas_ec
+
+        rng = random.Random(0x53)
+        k = 200  # buckets to the 2-tile 192-bit shape (exec-cached)
+        pts = [G1_GEN * rng.randrange(1, 1 << 64) for _ in range(k)]
+        scalars = [rng.randrange(1, 1 << 192) for _ in range(k)]
+        got = pallas_ec.g1_msm_pallas(pts, scalars, nbits=192, interpret=False)
+        assert got == g1_multi_exp(pts, scalars)
+
+    def test_edge_scalars(self):
+        # 0, 1, r-1 and duplicate points through the windowed path
+        from hbbft_tpu.crypto.curve import G1_GEN, g1_multi_exp
+        from hbbft_tpu.ops import limbs as LB
+        from hbbft_tpu.ops import pallas_ec
+
+        pts = [G1_GEN * 7] * 4 + [G1_GEN * 11] * 4
+        scalars = [0, 1, LB.R - 1, 2, 0, 1, LB.R - 1, 3]
+        got = pallas_ec.g1_msm_pallas(pts, scalars, nbits=255, interpret=False)
+        assert got == g1_multi_exp(pts, scalars)
+
+
+class TestBackendRoutingHw:
+    def test_backend_batch_verify_on_device(self):
+        """The TpuBackend's fused share verification at a device-routed
+        size agrees with ground truth on real shares."""
+        from hbbft_tpu.crypto.curve import G2_GEN
+        from hbbft_tpu.crypto.hashing import hash_to_g1
+        from hbbft_tpu.ops import limbs as LB
+        from hbbft_tpu.ops.backend_tpu import TpuBackend
+
+        rng = random.Random(0x54)
+        k = TpuBackend.G1_DEVICE_MIN  # smallest device-routed batch
+        base = hash_to_g1(b"hw-smoke")
+        sks = [rng.randrange(1, LB.R) for _ in range(1024)]
+        shares = [base * sk for sk in sks] * (k // 1024)
+        pks = [G2_GEN * sk for sk in sks] * (k // 1024)
+        be = TpuBackend()
+        assert be.batch_verify_shares(shares, pks, base, b"hw")
+        # one corrupted share must fail the fused equation
+        bad = list(shares)
+        bad[5] = base * (sks[5] + 1)
+        assert not be.batch_verify_shares(bad, pks, base, b"hw")

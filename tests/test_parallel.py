@@ -107,3 +107,20 @@ class TestMeshBackend:
         pts = [G1_GEN * r.randrange(1, 1 << 40) for _ in range(10)]
         ks = [r.randrange(1, 1 << 96) for _ in range(10)]
         assert be.g1_msm(pts, ks) == g1_multi_exp(pts, ks)
+
+
+class TestShardedWindowedMsm:
+    """The 4-bit windowed Pallas kernel under shard_map (VERDICT r2
+    item 5): tile grid sharded over the mesh, per-device windowed
+    scalar-mul + local reduction, one all_gather of [3, L] partials.
+    Narrow scalar width keeps CPU interpret mode tractable; full-width
+    correctness on real silicon is the hardware smoke suite's job
+    (tests/test_hw_smoke.py)."""
+
+    def test_windowed_matches_host(self, mesh8, rng):
+        pts = [G1_GEN * rng.randrange(1, 1 << 30) for _ in range(24)]
+        scalars = [rng.randrange(1, 1 << 16) for _ in range(24)]
+        got = M.sharded_windowed_g1_msm(
+            pts, scalars, mesh=mesh8, nbits=16, interpret=True
+        )
+        assert got == g1_multi_exp(pts, scalars)
