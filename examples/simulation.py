@@ -57,6 +57,8 @@ def main() -> None:
 
     if 3 * args.faulty >= args.nodes:
         p.error("requires 3·f < n")
+    if args.dynamic and not args.vectorized:
+        p.error("--dynamic requires --vectorized")
 
     if args.vectorized:
         import time

@@ -388,66 +388,36 @@ def _exec_cache_dir() -> "str":
 def _cached_tiles(name: str, kernel, pts_t, aux_t):
     """Run one tile program through the executable cache (TPU only —
     interpret mode and CPU use the plain jit path)."""
-    import os
-    import pickle
-
-    key = (
+    out = cached_compiled(
         name,
-        tuple(pts_t.shape),
-        tuple(aux_t.shape),
-        jax.__version__,
-        jax.devices()[0].device_kind,
+        lambda p, a: _run_tiles(kernel, p, a, False),
+        pts_t,
+        aux_t,
+        key_parts=(tuple(pts_t.shape), tuple(aux_t.shape)),
     )
-    loaded = _EXEC_MEM.get(key)
-    if loaded is None:
-        fname = "-".join(str(p) for p in key).replace(" ", "") + ".palexe"
-        path = os.path.join(_exec_cache_dir(), fname)
-        if os.path.exists(path):
-            try:
-                from jax.experimental.serialize_executable import (
-                    deserialize_and_load,
-                )
-
-                with open(path, "rb") as fh:
-                    payload, in_tree, out_tree = pickle.load(fh)
-                loaded = deserialize_and_load(payload, in_tree, out_tree)
-            except Exception:
-                loaded = None  # stale/incompatible blob: recompile below
-        if loaded is None:
-            fn = jax.jit(lambda p, a: _run_tiles(kernel, p, a, False))
-            compiled = fn.lower(pts_t, aux_t).compile()
-            try:
-                from jax.experimental.serialize_executable import serialize
-
-                payload, in_tree, out_tree = serialize(compiled)
-                tmp = path + ".tmp.%d" % os.getpid()
-                with open(tmp, "wb") as fh:
-                    pickle.dump((payload, in_tree, out_tree), fh)
-                os.replace(tmp, path)
-            except Exception:
-                pass  # cache write is best-effort
-            loaded = compiled
-        _EXEC_MEM[key] = loaded
-    out = loaded(pts_t, aux_t)  # jax.stages.Compiled (fresh or reloaded)
     if isinstance(out, (list, tuple)):
         return out[0]
     return out
 
 
-def cached_compiled(name: str, fn, *args):
+def cached_compiled(name: str, fn, *args, key_parts=None):
     """Run ``jax.jit(fn)(*args)`` through the compiled-executable disk
-    cache (generic sibling of ``_cached_tiles`` for programs that embed
-    the Pallas kernels inside larger jitted bodies — e.g. the
-    shard_map'd mesh MSM, whose Mosaic sub-compile would otherwise be
-    repaid every process)."""
+    cache — the one home for the load/compile/serialize dance (used by
+    the per-tile kernels via ``_cached_tiles`` and by programs that
+    embed Pallas kernels inside larger jitted bodies, e.g. the
+    shard_map'd mesh MSM).  ``key_parts`` overrides the shape part of
+    the cache key (``_cached_tiles`` passes bare shapes to keep the
+    legacy ``.palexe`` filenames valid)."""
     import os
     import pickle
 
+    if key_parts is None:
+        key_parts = tuple(
+            (tuple(a.shape), str(getattr(a, "dtype", ""))) for a in args
+        )
     key = (
         name,
-        tuple(
-            (tuple(a.shape), str(getattr(a, "dtype", ""))) for a in args
-        ),
+        *key_parts,
         jax.__version__,
         jax.devices()[0].device_kind,
     )
@@ -552,6 +522,28 @@ def _tile_transpose(pts: np.ndarray, aux: np.ndarray):
     pts_t = jnp.asarray(pts_p.reshape((G, TILE) + mid).transpose(perm))
     aux_t = jnp.asarray(aux_p.reshape(G, TILE, n).transpose(0, 2, 1))
     return pts_t, aux_t, G, Kp
+
+
+def pad_identity_tiles(pts_t, aux_t, pad_g: int):
+    """Append ``pad_g`` identity-point tiles (and zero digit/bit tiles)
+    in the tile-transposed layout — the ONE home for the limb-layout
+    knowledge that identity is (0 : 1 : 0), shared with
+    ``_tile_transpose``'s lane padding (mesh sharding pads whole tiles
+    so the grid divides the device count)."""
+    pad_pts = np.zeros((pad_g,) + tuple(pts_t.shape[1:]), dtype=np.int32)
+    if pts_t.ndim == 4:  # [G, 3, L, T] (G1)
+        pad_pts[:, 1, 0, :] = 1
+    else:  # [G, 3, 2, L, T] (G2)
+        pad_pts[:, 1, 0, 0, :] = 1
+    pts_t = jnp.concatenate([pts_t, jnp.asarray(pad_pts)], axis=0)
+    aux_t = jnp.concatenate(
+        [
+            aux_t,
+            jnp.zeros((pad_g,) + tuple(aux_t.shape[1:]), dtype=aux_t.dtype),
+        ],
+        axis=0,
+    )
+    return pts_t, aux_t
 
 
 def _untile(out_t: jnp.ndarray, K: int, Kp: int) -> jnp.ndarray:

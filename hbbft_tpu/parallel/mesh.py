@@ -152,22 +152,8 @@ def sharded_windowed_msm_fn(
         n = mesh.devices.size
         G = pts_t.shape[0]
         if G % n:
-            padG = (-G) % n
-            pad_pts = np.zeros((padG,) + pts_t.shape[1:], dtype=np.int32)
-            # identity point (0 : 1 : 0) in every padded lane
-            if pts_t.ndim == 4:  # [G, 3, L, T] (G1)
-                pad_pts[:, 1, 0, :] = 1
-            else:  # [G, 3, 2, L, T] (G2)
-                pad_pts[:, 1, 0, 0, :] = 1
-            pts_t = jnp.concatenate([pts_t, jnp.asarray(pad_pts)], axis=0)
-            dig_t = jnp.concatenate(
-                [
-                    dig_t,
-                    jnp.zeros(
-                        (padG,) + tuple(dig_t.shape[1:]), dtype=dig_t.dtype
-                    ),
-                ],
-                axis=0,
+            pts_t, dig_t = pallas_ec.pad_identity_tiles(
+                pts_t, dig_t, (-G) % n
             )
         if not interpret:
             # the embedded Mosaic kernel compile is minutes; route the

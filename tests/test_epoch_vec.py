@@ -593,3 +593,39 @@ class TestRealBlsCrossEngine:
         sim = VectorizedHoneyBadgerSim(n, random.Random(108), mock=False)
         vec = sim.run_epoch(contributions, late={late_pid})
         assert vec.batch.contributions == seq.contributions
+
+
+class TestVirtualTime:
+    """VERDICT r2 weak #6: epoch-latency statistics from the vectorized
+    engine under the HwQuality model (SURVEY §5.8's batched-flush →
+    virtual-time design)."""
+
+    def test_virtual_account_present_and_sane(self):
+        from hbbft_tpu.harness.simulation import HwQuality
+
+        hw = HwQuality.from_flags(lag_ms=100, bw_kbit_s=2000, cpu_pct=100)
+        sim = VectorizedHoneyBadgerSim(7, random.Random(120), mock=True, hw=hw)
+        res = sim.run_epoch({i: [b"v%d" % i] for i in range(7)})
+        v = res.virtual
+        assert v is not None and v.total_s > 0
+        assert v.network_s > 0 and v.cpu_s > 0
+        assert abs(v.total_s - (v.network_s + v.cpu_s)) < 1e-9
+        # at least value/echo/ready + 1 agreement epoch (2) + decshares
+        assert v.rounds >= 6
+        # every round pays one latency
+        assert v.network_s >= v.rounds * hw.latency
+
+    def test_virtual_time_scales_with_payload(self):
+        from hbbft_tpu.harness.simulation import HwQuality
+
+        hw = HwQuality.from_flags(lag_ms=10, bw_kbit_s=100, cpu_pct=100)
+        sim = VectorizedHoneyBadgerSim(7, random.Random(121), mock=True, hw=hw)
+        small = sim.run_epoch({i: [b"x"] for i in range(7)}).virtual
+        big = sim.run_epoch({i: [b"y" * 4096] for i in range(7)}).virtual
+        assert big.per_node_bytes > small.per_node_bytes
+        assert big.network_s > small.network_s
+
+    def test_no_hw_no_account(self):
+        sim = VectorizedHoneyBadgerSim(4, random.Random(122), mock=True)
+        res = sim.run_epoch({i: [b"n%d" % i] for i in range(4)})
+        assert res.virtual is None
