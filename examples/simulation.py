@@ -53,6 +53,13 @@ def main() -> None:
         "(votes/on-chain DKG/era machinery active), and one "
         "Remove-churn of the highest node id mid-run",
     )
+    p.add_argument(
+        "--virtual",
+        action="store_true",
+        help="with --vectorized: also print each epoch's SIMULATED "
+        "latency under the --lag/--bw/--cpu hardware profile "
+        "(the reference table's Min/MaxTime at co-simulation scale)",
+    )
     args = p.parse_args()
 
     if 3 * args.faulty >= args.nodes:
@@ -64,6 +71,11 @@ def main() -> None:
         import time
 
         rng = random.Random(args.seed)
+        hw = None
+        if args.virtual:
+            from hbbft_tpu.harness.simulation import HwQuality
+
+            hw = HwQuality.from_flags(args.lag, args.bw, args.cpu)
         if args.dynamic:
             from hbbft_tpu.harness.dynamic import (
                 VectorizedDynamicQueueingSim,
@@ -77,6 +89,7 @@ def main() -> None:
                 mock=not args.real_bls,
                 verify_honest=False,
                 emit_minimal=True,
+                hw=hw,
             )
             f = (args.nodes - 1) // 3
             churn_target = max(qsim.validators)
@@ -92,6 +105,7 @@ def main() -> None:
                 mock=not args.real_bls,
                 verify_honest=False,
                 emit_minimal=True,
+                hw=hw,
             )
         qsim.input_all(
             [b"tx-%08d" % i + bytes(max(0, args.tx_size - 11)) for i in range(args.txs)]
@@ -110,7 +124,13 @@ def main() -> None:
         committed: set = set()
         epoch = 0
         t0 = time.perf_counter()
-        print(f"{'Epoch':>5} {'Time':>8} {'Txs':>7} {'Total':>7}")
+        if args.virtual:
+            print(
+                f"{'Epoch':>5} {'Time':>8} {'SimTime':>9} "
+                f"{'Txs':>7} {'Total':>7}"
+            )
+        else:
+            print(f"{'Epoch':>5} {'Time':>8} {'Txs':>7} {'Total':>7}")
         while len(committed) < args.txs:
             te = time.perf_counter()
             res = qsim.run_epoch(dead=dead)
@@ -118,8 +138,12 @@ def main() -> None:
             note = ""
             if args.dynamic and isinstance(res.change, Complete):
                 note = f"  [era {res.era}: {res.change.change!r} complete]"
+            virt = res.inner.virtual if args.dynamic else res.virtual
+            sim_col = (
+                f" {virt.total_s:>8.2f}s" if args.virtual and virt else ""
+            )
             print(
-                f"{epoch:>5} {time.perf_counter() - te:>7.2f}s "
+                f"{epoch:>5} {time.perf_counter() - te:>7.2f}s{sim_col} "
                 f"{len(res.batch):>7} {len(committed):>7}{note}"
             )
             epoch += 1

@@ -91,12 +91,14 @@ class VectorizedDynamicSim:
         verify_honest: bool = True,
         emit_minimal: bool = False,
         dkg_verify_honest: Optional[bool] = None,
+        hw: Any = None,
     ):
         self.rng = rng
         self.mock = mock
         self.ops = ops
         self.verify_honest = verify_honest
         self.emit_minimal = emit_minimal
+        self.hw = hw
         # DKG honest-check elision defaults to the epoch driver's flag
         self.dkg_verify_honest = (
             verify_honest if dkg_verify_honest is None else dkg_verify_honest
@@ -127,6 +129,7 @@ class VectorizedDynamicSim:
             mock=self.mock,
             verify_honest=self.verify_honest,
             emit_minimal=self.emit_minimal,
+            hw=self.hw,
         )
         self.sim.epoch = self.epoch
         self.counter = VoteCounter(
@@ -206,8 +209,15 @@ class VectorizedDynamicSim:
         winner = self.counter.compute_winner()
         change_state: ChangeState = NoChange()
         if winner is not None:
+            import time as _time
+
             change_state = Complete(winner)
+            _t0 = _time.perf_counter()
             self._switch_era(winner)
+            if self.hw is not None and res.virtual is not None:
+                self._add_dkg_virtual(
+                    res.virtual, _time.perf_counter() - _t0
+                )
         return DynamicEpochResult(
             batch=batch,
             inner=res,
@@ -216,6 +226,40 @@ class VectorizedDynamicSim:
             validators=list(self.validators),
             fault_log=faults,
         )
+
+    def _add_dkg_virtual(self, virtual, dkg_wall: float) -> None:
+        """Fold the on-chain DKG's traffic and compute into the
+        era-switch epoch's virtual-time account (the epoch whose
+        simulated latency the --dynamic mode exists to measure):
+        one Part round (every dealer multicasts its bivariate
+        commitment + N encrypted rows) and one Ack round (every node
+        multicasts one Ack per dealer, each with N encrypted values) —
+        message sizes per ``sync_key_gen.rs:268-349`` shapes — plus the
+        co-simulated DKG wall time as the cpu term (dealing is
+        per-dealer work but verification dominates and is replicated
+        per node, same argument as the epoch phases)."""
+        hw = self.hw
+        n = len(self.validators)
+        t = (n - 1) // 3
+        enc = 32 + 150  # one encrypted Fr value (ciphertext overhead)
+        part_size = (t + 1) ** 2 * 192 + n * ((t + 1) * 32 + 150)
+        ack_size = n * enc + 8
+        rounds = [
+            ("dkg-part", (n - 1) * part_size, n - 1),
+            ("dkg-ack", n * (n - 1) * ack_size, n * (n - 1)),
+        ]
+        cpu = dkg_wall * 100.0 / hw.cpu_factor
+        for label, bytes_, msgs in rounds:
+            secs = bytes_ * hw.inv_bw + hw.latency
+            virtual.breakdown[label] = secs
+            virtual.network_s += secs
+            virtual.total_s += secs
+            virtual.rounds += 1
+            virtual.per_node_msgs += msgs
+            virtual.per_node_bytes += bytes_
+        virtual.breakdown["cpu:dkg"] = cpu
+        virtual.cpu_s += cpu
+        virtual.total_s += cpu
 
     # -- the era switch ----------------------------------------------------
 
@@ -286,6 +330,7 @@ class VectorizedDynamicQueueingSim(TransactionQueueMixin):
         verify_honest: bool = True,
         emit_minimal: bool = False,
         dkg_verify_honest: Optional[bool] = None,
+        hw: Any = None,
     ):
         self.dyn = VectorizedDynamicSim(
             n,
@@ -295,6 +340,7 @@ class VectorizedDynamicQueueingSim(TransactionQueueMixin):
             verify_honest=verify_honest,
             emit_minimal=emit_minimal,
             dkg_verify_honest=dkg_verify_honest,
+            hw=hw,
         )
         self.rng = rng
         self.batch_size = batch_size

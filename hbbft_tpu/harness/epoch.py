@@ -547,6 +547,7 @@ class VectorizedHoneyBadgerSim:
             )
             payloads[pid] = dumps(ct)
 
+        _t_prop = _time.perf_counter()
         # 2. reliable broadcast per live proposer (broadcast.rs semantics,
         # deduplicated per the round-1 argument: each echoed proof checked
         # once, one decode per instance, re-rooted against equivocation).
@@ -644,7 +645,8 @@ class VectorizedHoneyBadgerSim:
                 res,
                 cts,
                 walls={
-                    "propose+rbc": _t_rbc - _t0,
+                    "propose": _t_prop - _t0,
+                    "rbc": _t_rbc - _t_prop,
                     "agreement": _t_agree - _t_rbc,
                     "decrypt": _t_dec - _t_agree,
                     "assembly": _time.perf_counter() - _t_dec,
@@ -704,33 +706,50 @@ class VectorizedHoneyBadgerSim:
         rounds.append(("echo", P * (n - 1) * s_value, P * (n - 1)))
         # Ready: every node multicasts a root hash per instance
         rounds.append(("ready", P * (n - 1) * s_ready, P * (n - 1)))
-        # Agreement epochs: BVal + Aux per epoch (+ Conf + coin shares
-        # before each real coin)
+        # Agreement epochs: BVal + Aux from the instances still ACTIVE
+        # at that epoch (decided instances stop sending — counted from
+        # the per-instance deciding epochs), plus Conf + coin-share
+        # rounds before each real coin (schedule epochs ≡ 2 mod 3,
+        # agreement.rs:314-328)
         ag_epochs = max(res.epochs_used.values(), default=0) + 1
-        n_inst = len(res.decisions)
         for e in range(ag_epochs):
+            active = sum(1 for v in res.epochs_used.values() if v >= e)
             rounds.append(
-                ("bval-%d" % e, n_inst * (n - 1) * s_bool, n_inst * (n - 1))
+                ("bval-%d" % e, active * (n - 1) * s_bool, active * (n - 1))
             )
             rounds.append(
-                ("aux-%d" % e, n_inst * (n - 1) * s_bool, n_inst * (n - 1))
+                ("aux-%d" % e, active * (n - 1) * s_bool, active * (n - 1))
             )
-        if res.coin_flips:
-            rounds.append(
-                ("conf+coin", 2 * res.coin_flips * (n - 1) * s_share,
-                 2 * res.coin_flips * (n - 1))
-            )
+            if e % 3 == 2 and active:
+                rounds.append(
+                    ("conf-%d" % e, active * (n - 1) * s_bool,
+                     active * (n - 1))
+                )
+                rounds.append(
+                    ("coin-%d" % e, active * (n - 1) * s_share,
+                     active * (n - 1))
+                )
         # Decryption: one share per accepted ciphertext to every node
         rounds.append(
             ("decshares", len(cts) * (n - 1) * s_share, len(cts) * (n - 1))
         )
 
         network_s = sum(b * hw.inv_bw + hw.latency for _, b, _ in rounds)
-        cpu_s = sum(walls.values()) * 100.0 / hw.cpu_factor
+        # cpu: verification/bookkeeping phases are replicated per node
+        # (every real node checks all distinct shares/proofs — the
+        # batch wall IS one node's work); the PROPOSE phase is
+        # per-proposer (each node encrypts only its own contribution),
+        # so its wall is divided by the proposer count
+        scale = 100.0 / hw.cpu_factor
+        cpu_parts = {}
+        for kk, v in walls.items():
+            if kk == "propose":
+                cpu_parts["cpu:" + kk] = v * scale / max(P, 1)
+            else:
+                cpu_parts["cpu:" + kk] = v * scale
+        cpu_s = sum(cpu_parts.values())
         breakdown = {label: b * hw.inv_bw + hw.latency for label, b, _ in rounds}
-        breakdown.update(
-            {"cpu:" + kk: v * 100.0 / hw.cpu_factor for kk, v in walls.items()}
-        )
+        breakdown.update(cpu_parts)
         return VirtualEpochTime(
             total_s=network_s + cpu_s,
             rounds=len(rounds),
@@ -1144,6 +1163,7 @@ class VectorizedQueueingSim(TransactionQueueMixin):
         ops: Any = None,
         verify_honest: bool = True,
         emit_minimal: bool = False,
+        hw: Any = None,
     ):
         self.sim = VectorizedHoneyBadgerSim(
             n,
@@ -1152,6 +1172,7 @@ class VectorizedQueueingSim(TransactionQueueMixin):
             ops=ops,
             verify_honest=verify_honest,
             emit_minimal=emit_minimal,
+            hw=hw,
         )
         self.rng = rng
         self.batch_size = batch_size

@@ -364,3 +364,23 @@ class TestDynamicQueueing:
             assert guard < 20
             committed.update(q.run_epoch().batch.tx_iter())
         assert committed == {b"r-%d" % i for i in range(8)}
+
+
+class TestDynamicVirtualTime:
+    def test_era_switch_epoch_accounts_dkg(self):
+        from hbbft_tpu.harness.simulation import HwQuality
+
+        hw = HwQuality.from_flags(lag_ms=50, bw_kbit_s=10_000, cpu_pct=100)
+        sim = VectorizedDynamicSim(7, random.Random(60), mock=True, hw=hw)
+        plain = sim.run_epoch({i: [b"p%d" % i] for i in range(7)})
+        assert "dkg-part" not in plain.inner.virtual.breakdown
+        for v in range(3):
+            sim.vote_for(v, C.Remove(6))
+        churn = sim.run_epoch({i: [b"q%d" % i] for i in range(7)})
+        assert isinstance(churn.change, C.Complete)
+        v = churn.inner.virtual
+        assert "dkg-part" in v.breakdown and "dkg-ack" in v.breakdown
+        assert "cpu:dkg" in v.breakdown
+        # the DKG traffic makes the switching epoch strictly costlier
+        assert v.total_s > plain.inner.virtual.total_s
+        assert abs(v.total_s - (v.network_s + v.cpu_s)) < 1e-9
