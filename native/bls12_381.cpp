@@ -567,6 +567,35 @@ static Aff<F> jac_to_aff(const Jac<F>& p) {
   return {O::mul(p.X, zinv2), O::mul(p.Y, zinv3), false};
 }
 
+// Batch Jacobian→affine: ONE field inversion for n points (Montgomery
+// trick) — the per-point inversion (~450 muls via Fermat) was about
+// half the fixed-base comb's cost per scalar.
+template <class F>
+static void jac_batch_to_aff(const std::vector<Jac<F>>& pts,
+                             std::vector<Aff<F>>& out) {
+  using O = FieldOps<F>;
+  size_t n = pts.size();
+  out.resize(n);
+  std::vector<F> prefix(n);
+  F acc = O::one();
+  for (size_t i = 0; i < n; i++) {
+    prefix[i] = acc;
+    if (!pts[i].is_inf()) acc = O::mul(acc, pts[i].Z);
+  }
+  F inv = O::inv(acc);
+  for (size_t i = n; i-- > 0;) {
+    if (pts[i].is_inf()) {
+      out[i] = {O::zero(), O::zero(), true};
+      continue;
+    }
+    F zinv = O::mul(inv, prefix[i]);
+    inv = O::mul(inv, pts[i].Z);
+    F zinv2 = O::sq(zinv);
+    F zinv3 = O::mul(zinv2, zinv);
+    out[i] = {O::mul(pts[i].X, zinv2), O::mul(pts[i].Y, zinv3), false};
+  }
+}
+
 // scalar multiplication, scalar as big-endian bytes
 template <class F>
 static Jac<F> jac_mul_be(const Aff<F>& p, const uint8_t* k, size_t klen) {
@@ -1067,6 +1096,59 @@ static inline void fr_to_be(const Fr& a, uint8_t* out) {
 
 using namespace bls;
 
+// Many scalar-muls of ONE shared base point, individual outputs — the
+// co-simulation shapes (every validator signing one nonce; every
+// validator's decryption share of one ciphertext's U).  Fixed-base
+// 8-bit comb, shared by G1 and G2: precompute T[j][d] = d·2^(8j)·P
+// once (32 window positions × 255 nonzero digits, normalized to
+// affine with ONE batch inversion so the per-scalar loop runs mixed
+// adds), then each scalar is ≤ 32 mixed additions with no doublings;
+// outputs are batch-normalized with one more inversion.  The table
+// (~8k adds + one inversion) amortizes beyond the n < 64 cutoff —
+// below it the plain double-and-add loop wins (the N=1024 epoch
+// stages ~10⁶ of these per epoch, the shapes this is built for).
+template <class F, size_t WIRE, Aff<F> (*FROM)(const uint8_t*),
+          void (*TO)(const Aff<F>&, uint8_t*)>
+static void comb_mul_many(uint64_t n, const uint8_t* p, const uint8_t* ks,
+                          uint8_t* out) {
+  Aff<F> a = FROM(p);
+  if (n == 0) return;
+  if (n < 64) {  // table + 2 inversions not worth building
+    for (uint64_t i = 0; i < n; ++i) {
+      Jac<F> r = jac_mul_be(a, ks + i * 32, 32);
+      TO(jac_to_aff(r), out + i * WIRE);
+    }
+    return;
+  }
+  // T[j][d-1] = d * 2^(8j) * P, j in [0, 32), d in [1, 256)
+  static thread_local std::vector<Jac<F>> table;
+  table.assign(32 * 255, jac_infinity<F>());
+  Jac<F> cur = jac_madd(jac_infinity<F>(), a);  // P as Jacobian
+  for (int j = 0; j < 32; ++j) {
+    table[j * 255] = cur;
+    for (int d = 2; d < 256; ++d)
+      table[j * 255 + d - 1] = jac_add(table[j * 255 + d - 2], cur);
+    if (j < 31)
+      for (int t = 0; t < 8; ++t) cur = jac_double(cur);
+  }
+  static thread_local std::vector<Aff<F>> table_aff;
+  jac_batch_to_aff(table, table_aff);
+  std::vector<Jac<F>> res(n);
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint8_t* k = ks + i * 32;  // big-endian 32 bytes
+    Jac<F> acc = jac_infinity<F>();
+    for (int j = 0; j < 32; ++j) {
+      uint8_t d = k[31 - j];
+      if (d) acc = jac_madd(acc, table_aff[j * 255 + d - 1]);
+    }
+    res[i] = acc;
+  }
+  std::vector<Aff<F>> affs;
+  jac_batch_to_aff(res, affs);
+  for (uint64_t i = 0; i < n; ++i) TO(affs[i], out + i * WIRE);
+}
+
+
 extern "C" {
 
 void hb_g1_mul(const uint8_t* p, const uint8_t* k, uint8_t* out) {
@@ -1081,46 +1163,9 @@ void hb_g2_mul(const uint8_t* p, const uint8_t* k, uint8_t* out) {
   g2_to_wire(jac_to_aff(r), out);
 }
 
-// Many scalar-muls of ONE shared base point, individual outputs — the
-// co-simulation shapes (every validator signing one nonce; every
-// validator's decryption share of one ciphertext's U).  Fixed-base
-// 4-bit comb: precompute T[j][d] = d·2^(4j)·P once (64 window
-// positions x 15 nonzero digits), then each scalar is <= 64 additions
-// with no doublings — ~6x over the generic double-and-add when n is
-// large enough to amortize the table (n = N validators here).
 void hb_g1_mul_many(uint64_t n, const uint8_t* p, const uint8_t* ks,
                     uint8_t* out) {
-  Aff<Fp> a = g1_from_wire(p);
-  if (n == 0) return;
-  if (n < 8) {  // table not worth building
-    for (uint64_t i = 0; i < n; ++i) {
-      Jac<Fp> r = jac_mul_be(a, ks + i * 32, 32);
-      g1_to_wire(jac_to_aff(r), out + i * 96);
-    }
-    return;
-  }
-  // T[j][d-1] = d * 2^(4j) * P, j in [0, 64), d in [1, 16)
-  static thread_local std::vector<Jac<Fp>> table;
-  table.assign(64 * 15, jac_infinity<Fp>());
-  Jac<Fp> cur = jac_madd(jac_infinity<Fp>(), a);  // P as Jacobian
-  for (int j = 0; j < 64; ++j) {
-    table[j * 15] = cur;
-    for (int d = 2; d < 16; ++d)
-      table[j * 15 + d - 1] = jac_add(table[j * 15 + d - 2], cur);
-    if (j < 63)
-      for (int t = 0; t < 4; ++t) cur = jac_double(cur);
-  }
-  for (uint64_t i = 0; i < n; ++i) {
-    const uint8_t* k = ks + i * 32;  // big-endian 32 bytes
-    Jac<Fp> acc = jac_infinity<Fp>();
-    for (int j = 0; j < 64; ++j) {
-      // window j covers bits [4j, 4j+4): byte 31 - j/2, nibble j%2
-      uint8_t byte = k[31 - j / 2];
-      uint8_t d = (j % 2) ? (byte >> 4) : (byte & 0x0f);
-      if (d) acc = jac_add(acc, table[j * 15 + d - 1]);
-    }
-    g1_to_wire(jac_to_aff(acc), out + i * 96);
-  }
+  comb_mul_many<Fp, 96, g1_from_wire, g1_to_wire>(n, p, ks, out);
 }
 
 void hb_g1_msm(uint64_t n, const uint8_t* pts, const uint8_t* ks, uint8_t* out) {
@@ -1209,40 +1254,10 @@ void hb_fr_matmul(uint64_t n, uint64_t k, uint64_t m, const uint8_t* a,
 
 // Many scalar-muls of ONE shared G2 base — the DKG dealing shape
 // (every commitment entry is coeff·P₂, sync_key_gen.rs:268-299).
-// Same 4-bit fixed-base comb as hb_g1_mul_many, over Fq².
+// Same 8-bit fixed-base comb as hb_g1_mul_many, over Fq².
 void hb_g2_mul_many(uint64_t n, const uint8_t* p, const uint8_t* ks,
                     uint8_t* out) {
-  Aff<Fp2> a = g2_from_wire(p);
-  if (n == 0) return;
-  if (n < 8) {
-    for (uint64_t i = 0; i < n; ++i) {
-      Jac<Fp2> r = jac_mul_be(a, ks + i * 32, 32);
-      g2_to_wire(jac_to_aff(r), out + i * 192);
-    }
-    return;
-  }
-  // 8-bit windows (G2 adds are ~3× a G1 add, so the bigger 32×255
-  // table halves the per-scalar adds vs the G1 comb's 4-bit windows
-  // and amortizes once n is in the thousands — the DKG dealing shape)
-  static thread_local std::vector<Jac<Fp2>> table;
-  table.assign(32 * 255, jac_infinity<Fp2>());
-  Jac<Fp2> cur = jac_madd(jac_infinity<Fp2>(), a);
-  for (int j = 0; j < 32; ++j) {
-    table[j * 255] = cur;
-    for (int d = 2; d < 256; ++d)
-      table[j * 255 + d - 1] = jac_add(table[j * 255 + d - 2], cur);
-    if (j < 31)
-      for (int t = 0; t < 8; ++t) cur = jac_double(cur);
-  }
-  for (uint64_t i = 0; i < n; ++i) {
-    const uint8_t* k = ks + i * 32;
-    Jac<Fp2> acc = jac_infinity<Fp2>();
-    for (int j = 0; j < 32; ++j) {
-      uint8_t d = k[31 - j];
-      if (d) acc = jac_add(acc, table[j * 255 + d - 1]);
-    }
-    g2_to_wire(jac_to_aff(acc), out + i * 192);
-  }
+  comb_mul_many<Fp2, 192, g2_from_wire, g2_to_wire>(n, p, ks, out);
 }
 
 // Π e(Pᵢ, Qᵢ) == 1 ?  (one shared final exponentiation)
