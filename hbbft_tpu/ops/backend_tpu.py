@@ -19,6 +19,8 @@ protocols cannot tell which backend they run on (asserted in
 
 from __future__ import annotations
 
+import os
+
 from typing import List, Sequence
 
 import numpy as np
@@ -109,15 +111,30 @@ class TpuBackend(CpuBackend):
     # native library the host fallback is pure Python (~100× slower),
     # so the device takes everything it can.  All paths are exact.
 
-    G1_DEVICE_MIN = 8192  # measured crossover vs native Pippenger
-    # Above this, host Pippenger wins END-TO-END on this host: the MSM
-    # input is born as host wire bytes, and wire→limb conversion + the
-    # remote-tunnel transfer (~460 B/point) + the chunked tree
-    # reduction grow linearly while Pippenger's per-point cost falls —
-    # measured r3: K=948k device 68 s warm vs host 24 s.  (On a
-    # locally-attached TPU the transfer term is ~100× smaller and this
-    # cap should rise; it is policy, not architecture.)
-    G1_DEVICE_MAX = 1 << 18
+    # G1 MSM routing band [G1_DEVICE_MIN, G1_DEVICE_MAX] — outside it
+    # the native host Pippenger runs.  Measured r3 END-TO-END on this
+    # remote-tunnel host (wire→limb marshalling + ~460 B/point tunnel
+    # transfer + the chunked tree reduction included, warm):
+    #
+    #     K        device            host Pippenger
+    #     8,192     1.2 s (6.9k/s)    0.25 s (33k/s)
+    #     65,536    2.7 s (24k/s)     1.3 s  (50k/s)
+    #     262,144   38 s  (6.9k/s)    6.5 s  (40k/s)
+    #
+    # The windowed kernel's COMPUTE beats Pippenger beyond ~6k points
+    # (67.5k pts/s at 64k — BASELINE kernel table), but on this host
+    # the fixed marshal/transfer/reduction overhead never amortizes,
+    # so the band ships EMPTY: correctness stays gated by the hardware
+    # smoke suite and the per-round headline device leg, and a
+    # locally-attached deployment (transfer ~100× cheaper) re-opens
+    # the band via HBBFT_TPU_G1_DEVICE_MIN/MAX.  Policy, not
+    # architecture.
+    G1_DEVICE_MIN = int(
+        os.environ.get("HBBFT_TPU_G1_DEVICE_MIN", 1 << 62)
+    )
+    G1_DEVICE_MAX = int(
+        os.environ.get("HBBFT_TPU_G1_DEVICE_MAX", 1 << 62)
+    )
     # Device G2 (windowed Fq2 Pallas, exec-cached so the 18-min Mosaic
     # compile is paid once ever) measured 2026-07-30: ~3k pts/s at
     # K=1024 and K=8192 vs native host Pippenger ~6-12k pts/s — it
@@ -134,16 +151,13 @@ class TpuBackend(CpuBackend):
 
     def g1_msm(self, points: Sequence[G1], scalars: Sequence[int]) -> G1:
         points, scalars = list(points), list(scalars)
-        if self._native_host() and not (
-            self.G1_DEVICE_MIN <= len(points) <= self.G1_DEVICE_MAX
-        ):
-            return super().g1_msm(points, scalars)
-        # Mesh path: the 4-bit windowed Pallas kernel under shard_map
-        # (parallel/mesh.sharded_windowed_msm_fn) — per-chip throughput
-        # is the single-chip windowed rate and only the [3, L] partial
-        # sums cross ICI, so the mesh scales it by device count
-        # (ADVICE r1 item 3 / VERDICT r2 item 5, resolved).
-        if self.mesh is not None:
+        # Mesh path first: an explicitly mesh-configured backend shards
+        # its G1 MSMs — the 4-bit windowed Pallas kernel under
+        # shard_map (parallel/mesh.sharded_windowed_msm_fn); per-chip
+        # throughput is the single-chip windowed rate and only the
+        # [3, L] partial sums cross ICI, so the mesh scales it by
+        # device count (ADVICE r1 item 3 / VERDICT r2 item 5).
+        if self.mesh is not None and len(points) >= 2:
             from ..parallel import mesh as M
             from . import limbs as LB, pallas_ec
 
@@ -156,6 +170,10 @@ class TpuBackend(CpuBackend):
             )
             pts_t, dig_t, _, _ = pallas_ec._tile_transpose(pts, digits)
             return ec_jax.g1_from_limbs(self._sharded_g1(pts_t, dig_t))
+        if self._native_host() and not (
+            self.G1_DEVICE_MIN <= len(points) <= self.G1_DEVICE_MAX
+        ):
+            return super().g1_msm(points, scalars)
         return ec_jax.g1_msm(points, scalars)
 
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:

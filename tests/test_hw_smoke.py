@@ -95,7 +95,7 @@ class TestBackendRoutingHw:
         from hbbft_tpu.ops.backend_tpu import TpuBackend
 
         rng = random.Random(0x54)
-        k = TpuBackend.G1_DEVICE_MIN  # smallest device-routed batch
+        k = 8192  # a cached device tile bucket
         base = hash_to_g1(b"hw-smoke")
         sks = [rng.randrange(1, LB.R) for _ in range(1024)]
         shares = [base * sk for sk in sks] * (k // 1024)
