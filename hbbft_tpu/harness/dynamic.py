@@ -118,6 +118,7 @@ class VectorizedDynamicSim:
         self.validators: List[Any] = sorted(netinfos)
         self._vote_num: Dict[Any, int] = {}
         self.pending: Dict[Any, List[SignedVote]] = {}
+        self._last_change: ChangeState = NoChange()
         self._attach(netinfos)
 
     # -- era plumbing ------------------------------------------------------
@@ -148,6 +149,41 @@ class VectorizedDynamicSim:
         self.sec_keys[nid] = sec_key
         self.pub_keys[nid] = sec_key.public_key()
         return self.pub_keys[nid]
+
+    # -- join plans (reference mod.rs:136-145 / builder.rs:82-114) ---------
+
+    def join_plan(self):
+        """Everything a fresh observer needs to synchronize with the
+        CURRENT era (the vectorized counterpart of
+        ``DhbBatch.join_plan``): the next epoch number (which anchors
+        the era, as in the reference), the membership change that
+        produced this era (``Complete(...)`` right after a switch),
+        the validator set's public keys, and the threshold public key
+        set."""
+        from ..protocols.dynamic_honey_badger import JoinPlan
+
+        return JoinPlan(
+            epoch=self.epoch,
+            change=self._last_change,
+            pub_key_set=self.sim.pk_set,
+            pub_keys={
+                nid: self.pub_keys[nid] for nid in self.validators
+            },
+        )
+
+    def observer_from_plan(self, plan, observer_id: Any = "observer"):
+        """Hydrate a non-validator ``NetworkInfo`` from a join plan —
+        the observer can verify everything (run the epoch driver's
+        observer lane, check shares/batches) but holds no key share
+        (``builder.rs:82-114`` semantics)."""
+        return NetworkInfo(
+            observer_id,
+            None,
+            None,
+            plan.pub_key_set,
+            plan.pub_keys,
+            ops=self.ops,
+        )
 
     # -- voting ------------------------------------------------------------
 
@@ -212,6 +248,7 @@ class VectorizedDynamicSim:
             import time as _time
 
             change_state = Complete(winner)
+            self._last_change = change_state
             _t0 = _time.perf_counter()
             self._switch_era(winner)
             if self.hw is not None and res.virtual is not None:

@@ -61,6 +61,12 @@ class TpuBackend(CpuBackend):
     def __init__(self, mesh=None):
         self.mesh = mesh
         self._sharded_g1 = None
+        # env overrides are read here (not at import) so operators and
+        # tests can set them after the module loads
+        for attr in ("G1_DEVICE_MIN", "G1_DEVICE_MAX", "G1_MESH_MIN"):
+            env = os.environ.get("HBBFT_TPU_" + attr)
+            if env is not None:
+                setattr(self, attr, int(env))
 
     # -- hashing / merkle -------------------------------------------------
     # Like the MSMs, routed by measured capability: the native C++ host
@@ -129,12 +135,12 @@ class TpuBackend(CpuBackend):
     # locally-attached deployment (transfer ~100× cheaper) re-opens
     # the band via HBBFT_TPU_G1_DEVICE_MIN/MAX.  Policy, not
     # architecture.
-    G1_DEVICE_MIN = int(
-        os.environ.get("HBBFT_TPU_G1_DEVICE_MIN", 1 << 62)
-    )
-    G1_DEVICE_MAX = int(
-        os.environ.get("HBBFT_TPU_G1_DEVICE_MAX", 1 << 62)
-    )
+    G1_DEVICE_MIN = 1 << 62
+    G1_DEVICE_MAX = 1 << 62
+    # a mesh-configured backend shards MSMs at or above this size;
+    # smaller ones stay on the fast host path (a tiny MSM should not
+    # pay a shard_map dispatch over the interconnect)
+    G1_MESH_MIN = 8192
     # Device G2 (windowed Fq2 Pallas, exec-cached so the 18-min Mosaic
     # compile is paid once ever) measured 2026-07-30: ~3k pts/s at
     # K=1024 and K=8192 vs native host Pippenger ~6-12k pts/s — it
@@ -157,7 +163,7 @@ class TpuBackend(CpuBackend):
         # throughput is the single-chip windowed rate and only the
         # [3, L] partial sums cross ICI, so the mesh scales it by
         # device count (ADVICE r1 item 3 / VERDICT r2 item 5).
-        if self.mesh is not None and len(points) >= 2:
+        if self.mesh is not None and len(points) >= self.G1_MESH_MIN:
             from ..parallel import mesh as M
             from . import limbs as LB, pallas_ec
 

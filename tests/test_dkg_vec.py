@@ -384,3 +384,43 @@ class TestDynamicVirtualTime:
         # the DKG traffic makes the switching epoch strictly costlier
         assert v.total_s > plain.inner.virtual.total_s
         assert abs(v.total_s - (v.network_s + v.cpu_s)) < 1e-9
+
+
+class TestJoinPlan:
+    def test_join_plan_tracks_era_and_observer_verifies(self):
+        """The vectorized dynamic layer's join plan (reference
+        ``mod.rs:136-145``): a fresh observer hydrated from the plan
+        holds the CURRENT era's keys — including after a DKG era
+        switch — and can verify a threshold signature made by the new
+        validators."""
+        sim = VectorizedDynamicSim(4, random.Random(70), mock=False)
+        p0 = sim.join_plan()
+        assert sorted(p0.pub_keys) == [0, 1, 2, 3]
+        for v in (0, 1):
+            sim.vote_for(v, C.Remove(3))
+        r = sim.run_epoch({i: [b"j%d" % i] for i in range(4)})
+        assert isinstance(r.change, C.Complete)
+        p1 = sim.join_plan()
+        assert sorted(p1.pub_keys) == [0, 1, 2]
+        # the plan carries the change that produced this era
+        assert isinstance(p1.change, C.Complete)
+        assert p1.change.change == C.Remove(3)
+        assert p1.epoch == sim.epoch and p1.pub_key_set is sim.sim.pk_set
+        obs = sim.observer_from_plan(p1)
+        assert not obs.is_validator
+        # the observer's view verifies a signature under the NEW keys
+        ni0 = sim.sim.netinfos[0]
+        shares = {
+            i: sim.sim.netinfos[i].secret_key_share.sign(b"post-churn")
+            for i in (0, 1)
+        }
+        sig = ni0.public_key_set.combine_signatures(shares)
+        assert obs.public_key_set.verify_signature(sig, b"post-churn")
+        # and an epoch run with observe=True still matches (public lane)
+        r2 = sim.run_epoch(
+            {i: [b"k%d" % i] for i in sim.validators}, observe=True
+        )
+        assert (
+            r2.inner.observer_batch.contributions
+            == r2.inner.batch.contributions
+        )

@@ -87,8 +87,11 @@ class TestWindowedKernelsHw:
 
 class TestBackendRoutingHw:
     def test_backend_batch_verify_on_device(self):
-        """The TpuBackend's fused share verification at a device-routed
-        size agrees with ground truth on real shares."""
+        """The TpuBackend's fused share verification with the G1
+        routing band forced open (the shipping band is empty on this
+        host — ops/backend_tpu.py) agrees with ground truth, so a
+        marshalling/kernel regression in the device leg cannot hide
+        behind host routing."""
         from hbbft_tpu.crypto.curve import G2_GEN
         from hbbft_tpu.crypto.hashing import hash_to_g1
         from hbbft_tpu.ops import limbs as LB
@@ -101,6 +104,8 @@ class TestBackendRoutingHw:
         shares = [base * sk for sk in sks] * (k // 1024)
         pks = [G2_GEN * sk for sk in sks] * (k // 1024)
         be = TpuBackend()
+        be.G1_DEVICE_MIN = 0
+        be.G1_DEVICE_MAX = 1 << 62
         assert be.batch_verify_shares(shares, pks, base, b"hw")
         # one corrupted share must fail the fused equation
         bad = list(shares)

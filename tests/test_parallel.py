@@ -103,7 +103,7 @@ class TestMeshBackend:
         r = random.Random(0x3E5)
         mesh = M.make_mesh(8)
         be = TpuBackend(mesh=mesh)
-        be.G1_DEVICE_MIN = 4  # force the device/mesh path at test size
+        be.G1_MESH_MIN = 4  # force the mesh path at test size
         pts = [G1_GEN * r.randrange(1, 1 << 40) for _ in range(10)]
         ks = [r.randrange(1, 1 << 96) for _ in range(10)]
         assert be.g1_msm(pts, ks) == g1_multi_exp(pts, ks)
