@@ -248,9 +248,12 @@ class VectorizedDynamicSim:
             import time as _time
 
             change_state = Complete(winner)
-            self._last_change = change_state
             _t0 = _time.perf_counter()
             self._switch_era(winner)
+            # recorded only once the switch actually happened — a
+            # failed switch must not leave the join plan advertising a
+            # change the current keys do not reflect
+            self._last_change = change_state
             if self.hw is not None and res.virtual is not None:
                 self._add_dkg_virtual(
                     res.virtual, _time.perf_counter() - _t0
