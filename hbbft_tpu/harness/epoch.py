@@ -171,6 +171,11 @@ class VectorizedAgreement:
         for src, dst in ((adv_bval, ab), (adv_aux, aa)):
             if src:
                 for iid, (v0, v1) in src.items():
+                    if v0 > f or v1 > f:
+                        raise ValueError(
+                            "Byzantine vote injection exceeds the f="
+                            f"{f} bound: {iid!r} -> ({v0}, {v1})"
+                        )
                     p = self.instance_ids.index(iid)
                     dst[p, 0], dst[p, 1] = v0, v1
 
@@ -499,6 +504,8 @@ class VectorizedHoneyBadgerSim:
         forged_dec: Optional[Dict[Any, Dict[Any, Any]]] = None,
         late: Optional[Set[Any]] = None,
         observe: bool = False,
+        adv_bval: Optional[Dict[Any, Tuple[int, int]]] = None,
+        adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
     ) -> EpochResult:
         """Advance every correct node through one complete epoch.
 
@@ -522,6 +529,8 @@ class VectorizedHoneyBadgerSim:
         with no secret key share derives its own batch from the
         network-visible traffic alone; returned as
         ``EpochResult.observer_batch``.
+        ``adv_bval``/``adv_aux``: Byzantine vote injection into the
+        agreement rounds (``VectorizedAgreement.run`` semantics).
         """
         dead = set(dead or set())
         late = set(late or set())
@@ -598,7 +607,11 @@ class VectorizedHoneyBadgerSim:
             dead=dead,
             mock=self.mock,
         )
-        res = ag.run({pid: (pid in delivered) for pid in self.netinfos})
+        res = ag.run(
+            {pid: (pid in delivered) for pid in self.netinfos},
+            adv_bval=adv_bval,
+            adv_aux=adv_aux,
+        )
         faults.merge(res.fault_log)
         accepted = sorted(pid for pid, yes in res.decisions.items() if yes)
 

@@ -629,3 +629,50 @@ class TestVirtualTime:
         sim = VectorizedHoneyBadgerSim(4, random.Random(122), mock=True)
         res = sim.run_epoch({i: [b"n%d" % i] for i in range(4)})
         assert res.virtual is None
+
+
+def test_kitchen_sink_adversarial_epoch():
+    """Every adversarial surface at once, at a size past the sequential
+    harness's comfort zone: n=25 with f=8 — 4 silent nodes, 2 withheld
+    (late) live proposers, a corrupted echo shard, forged decryption
+    shares, Byzantine agreement votes, and the observer lane — one
+    epoch, every property at once."""
+    from hbbft_tpu.crypto.mock import MockDecryptionShare
+
+    n = 25  # f = 8
+    dead = {21, 22, 23, 24}
+    late = {3, 17}
+    sim = VectorizedHoneyBadgerSim(n, random.Random(130), mock=True)
+    contribs = {i: [b"ks-%02d" % i] for i in range(n)}
+    bogus = MockDecryptionShare(b"\x00" * 32, b"\x03" * 32)
+    res = sim.run_epoch(
+        contribs,
+        dead=dead,
+        late=late,
+        corrupt_shards={0: {5: b"\xff\x00"}},
+        forged_dec={20: {p: bogus for p in range(4)}},
+        adv_bval={1: (3, 0)},
+        adv_aux={1: (3, 0)},
+        observe=True,
+    )
+    expected = set(range(n)) - dead - late
+    assert set(res.accepted) == expected
+    assert res.batch.contributions == {
+        i: contribs[i] for i in sorted(expected)
+    }
+    # attribution: the corrupt echoer and the share forger are named
+    flagged = {f.node_id for f in res.fault_log}
+    assert 5 in flagged and 20 in flagged
+    # the observer derives the identical batch from public traffic
+    assert res.observer_batch.contributions == res.batch.contributions
+
+
+def test_adversarial_votes_over_f_rejected():
+    """Vote injection beyond the f bound is a modeling error (more
+    Byzantine voters than the protocol tolerates) and must raise, not
+    silently break agreement validity."""
+    sim = VectorizedHoneyBadgerSim(7, random.Random(131), mock=True)
+    with pytest.raises(ValueError, match="exceeds the f="):
+        sim.run_epoch(
+            {i: [i] for i in range(7)}, adv_bval={1: (3, 0)}
+        )
