@@ -204,7 +204,7 @@ class _Point:
     # the immutable jac — repeated MSMs over the same points, e.g. the
     # 1024 evaluations of one polynomial commitment during key dealing,
     # paid an Fq/Fq2 inversion per call without it)
-    __slots__ = ("jac", "_wire")
+    __slots__ = ("jac", "_wire", "_cbytes")
     ops: dict
     b: Any
 
@@ -238,6 +238,18 @@ class _Point:
 
     def affine(self):
         return self.ops["to_affine"](self.jac)
+
+    def to_bytes(self) -> bytes:
+        """Canonical compressed encoding, memoized: the batching
+        layer keys caches and Fiat-Shamir transcripts by point bytes —
+        at epoch scale every share is serialized at least twice and
+        each public key thousands of times (points are immutable;
+        operations return new objects)."""
+        cached = getattr(self, "_cbytes", None)
+        if cached is None:
+            cached = self._encode()
+            self._cbytes = cached
+        return cached
 
     def __eq__(self, other) -> bool:
         return isinstance(other, type(self)) and self.ops["eq"](self.jac, other.jac)
@@ -294,7 +306,7 @@ class G1(_Point):
 
     _native_mul_raw = _native_mul
 
-    def to_bytes(self) -> bytes:
+    def _encode(self) -> bytes:
         aff = self.affine()
         if aff is None:
             return bytes([0xC0]) + bytes(47)
@@ -327,6 +339,7 @@ class G1(_Point):
         pt = cls.from_affine((x, y))
         if not pt.in_subgroup():
             raise ValueError("G1 point not in subgroup")
+        pt._cbytes = bytes(data)  # strictly validated ⇒ canonical
         return pt
 
 
@@ -351,7 +364,7 @@ class G2(_Point):
 
     _native_mul_raw = _native_mul
 
-    def to_bytes(self) -> bytes:
+    def _encode(self) -> bytes:
         aff = self.affine()
         if aff is None:
             return bytes([0xC0]) + bytes(95)
@@ -387,6 +400,7 @@ class G2(_Point):
         pt = cls.from_affine((x, y))
         if not pt.in_subgroup():
             raise ValueError("G2 point not in subgroup")
+        pt._cbytes = bytes(data)  # strictly validated ⇒ canonical
         return pt
 
 
