@@ -133,6 +133,13 @@ def main() -> None:
             print(f"{'Epoch':>5} {'Time':>8} {'Txs':>7} {'Total':>7}")
         while len(committed) < args.txs:
             te = time.perf_counter()
+            if args.dynamic:
+                # an era switch can shrink the validator set (and its
+                # f bound): keep only still-current dead ids, capped at
+                # the new set's tolerance
+                cur = qsim.validators
+                f_cap = (len(cur) - 1) // 3
+                dead = set(sorted(v for v in dead if v in cur)[:f_cap])
             res = qsim.run_epoch(dead=dead)
             committed.update(res.batch.tx_iter())
             note = ""

@@ -1074,7 +1074,10 @@ static inline Fr fr_from_be(const uint8_t* in) {
     for (int j = 0; j < 8; j++) v = (v << 8) | in[(3 - i) * 8 + j];
     r.l[i] = v;
   }
-  fr_cond_sub(r);  // tolerate non-canonical input
+  // tolerate any raw 256-bit input: 2^256 < 3r (r is 255-bit), so two
+  // conditional subtracts reduce the whole range to canonical
+  fr_cond_sub(r);
+  fr_cond_sub(r);
   return r;
 }
 
@@ -1099,14 +1102,15 @@ using namespace bls;
 // Many scalar-muls of ONE shared base point, individual outputs — the
 // co-simulation shapes (every validator signing one nonce; every
 // validator's decryption share of one ciphertext's U).  Fixed-base
-// 8-bit comb, shared by G1 and G2: precompute T[j][d] = d·2^(8j)·P
-// once (32 window positions × 255 nonzero digits, normalized to
-// affine with ONE batch inversion so the per-scalar loop runs mixed
-// adds), then each scalar is ≤ 32 mixed additions with no doublings;
-// outputs are batch-normalized with one more inversion.  The table
-// (~8k adds + one inversion) amortizes beyond the n < 64 cutoff —
-// below it the plain double-and-add loop wins (the N=1024 epoch
-// stages ~10⁶ of these per epoch, the shapes this is built for).
+// comb, shared by G1 and G2: precompute T[j][d] = d·2^(wbits·j)·P
+// once (normalized to affine with ONE batch inversion so the
+// per-scalar loop runs mixed adds), then each scalar is ≤ 256/wbits
+// mixed additions with no doublings; outputs are batch-normalized
+// with one more inversion.  Window width by batch size: below n = 16
+// no table amortizes and the plain double-and-add loop runs; the
+// 4-bit table (~1k adds) serves 16 ≤ n < 256; the 8-bit table
+// (~8.1k adds, 32 adds/scalar saved) wins from n ≥ 256 (the N=1024
+// epoch stages ~10⁶ of these per epoch, the shapes this is built for).
 template <class F, size_t WIRE, Aff<F> (*FROM)(const uint8_t*),
           void (*TO)(const Aff<F>&, uint8_t*)>
 static void comb_mul_many(uint64_t n, const uint8_t* p, const uint8_t* ks,
