@@ -107,10 +107,13 @@ def bench_headline(k: int = 65536, iters: int = 3):
             )
         return obs
 
-    # device leg: routing band forced open so the windowed Pallas
-    # kernel is exercised and measured regardless of shipping policy
+    # device leg: routing band forced open so the packed-wire device
+    # path is exercised and measured regardless of shipping policy.
+    # MIN stays above the flush's tiny per-class base MSMs (~64
+    # points) — those are launch-latency-bound and belong on host in
+    # ANY sane device configuration.
     device_inner = TpuBackend()
-    device_inner.G1_DEVICE_MIN = 0
+    device_inner.G1_DEVICE_MIN = 2048
     device_inner.G1_DEVICE_MAX = 1 << 62
     BatchingBackend(inner=device_inner).prefetch(make_obs(b"warm"))
     dev_dts = []

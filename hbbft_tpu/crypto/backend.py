@@ -53,6 +53,16 @@ class CpuBackend:
     def g1_msm(self, points: Sequence[G1], scalars: Sequence[int]) -> G1:
         return g1_multi_exp(points, scalars)
 
+    def g1_msm_async(self, points: Sequence[G1], scalars: Sequence[int]):
+        """Enqueue a G1 MSM, returning a zero-arg finalizer.
+
+        Device backends overlap the MSM with host work between the call
+        and the finalize (``ops/packed_msm.py``); the host backend
+        computes eagerly — same results, same ordering guarantees.
+        """
+        result = self.g1_msm(points, scalars)
+        return lambda: result
+
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         return g2_multi_exp(points, scalars)
 

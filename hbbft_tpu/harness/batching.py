@@ -338,7 +338,8 @@ class BatchingBackend:
             ]
             coeffs = T._rlc_coeffs(b"hbbft_tpu batching flush", item_bytes)
             idx = 0
-            all_shares, all_coeffs, pairs = [], [], []
+            all_shares, all_coeffs = [], []
+            per_group = []
             for gkey, base, members in pre:
                 g_pks, g_coeffs = [], []
                 for ob, _, _ in members:
@@ -347,10 +348,15 @@ class BatchingBackend:
                     g_pks.append(ob.pk_share.point)
                     g_coeffs.append(coeffs[idx])
                     idx += 1
+                per_group.append((base, g_pks, g_coeffs))
+            # launch the big G1 MSM first: a device backend overlaps
+            # its transfer + kernel with the host G2 MSMs below
+            agg_share_fin = self.g1_msm_async(all_shares, all_coeffs)
+            pairs = []
+            for base, g_pks, g_coeffs in per_group:
                 u_pks, u_coeffs = T.aggregate_by_point(g_pks, g_coeffs)
                 pairs.append((-base, self.g2_msm(u_pks, u_coeffs)))
-            agg_share = self.g1_msm(all_shares, all_coeffs)
-            return pairing_check([(agg_share, G2_GEN)] + pairs)
+            return pairing_check([(agg_share_fin(), G2_GEN)] + pairs)
 
         # product-form path: transcript binds every (pk, share, group)
         from ..crypto.hashing import sha256
@@ -386,7 +392,10 @@ class BatchingBackend:
             classes.setdefault(sig, []).append(gkey)
             group_info[gkey] = (base, sender_pks)
 
-        agg_share = self.g1_msm(all_shares, all_coeffs)
+        # launch the k-point G1 MSM first (async): a device backend's
+        # tunnel transfer + kernel then run UNDER the host-side G2 MSMs
+        # and per-class base MSMs below (VERDICT r3 item 1)
+        agg_share_fin = self.g1_msm_async(all_shares, all_coeffs)
         pairs = []
         for sig in sorted(classes):
             gkeys = classes[sig]
@@ -399,7 +408,7 @@ class BatchingBackend:
                 [group_info[g][0] for g in gkeys], [t[g] for g in gkeys]
             )
             pairs.append((-b, a))
-        return pairing_check([(agg_share, G2_GEN)] + pairs)
+        return pairing_check([(agg_share_fin(), G2_GEN)] + pairs)
 
 
 # ---------------------------------------------------------------------------

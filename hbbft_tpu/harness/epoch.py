@@ -141,6 +141,7 @@ class VectorizedAgreement:
         est0: Dict[Any, Any],
         adv_bval: Optional[Dict[Any, Tuple[int, int]]] = None,
         adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
+        forged_coin: Optional[Set[Any]] = None,
     ) -> AgreementResult:
         """Run every instance to its decision.
 
@@ -151,7 +152,27 @@ class VectorizedAgreement:
         false, #for true) injected into every round — the vote-stuffing
         shape of the reference's ``RandomAdversary`` (≤ f each; counted
         once per round like a Byzantine sender's single allowed vote).
+        ``forged_coin``: live Byzantine senders whose threshold-coin
+        signature shares are forged (a wrong G1 point) on every real
+        coin flip — drives the grouped-RLC verification into its
+        per-share fallback, which must attribute
+        ``INVALID_SIGNATURE_SHARE`` to exactly these senders and still
+        land every coin (reference: a bad ``CommonCoin`` share is
+        dropped and logged, ``common_coin.rs:149-161``; ≥ f+1 honest
+        shares always remain).  Real BLS only (mock shares carry no
+        verifiable structure for the fallback to reject).
         """
+        forged_coin = set(forged_coin or set())
+        if forged_coin:
+            if self.mock:
+                raise ValueError("forged_coin requires real BLS crypto")
+            if forged_coin - set(self.live):
+                raise ValueError("forged_coin senders must be live")
+            if len(self.dead | forged_coin) > self.f:
+                raise ValueError(
+                    "dead + forged_coin Byzantine nodes exceed the "
+                    f"f={self.f} bound"
+                )
         P, N, f = self.P, self.N, self.f
         n_live = len(self.live)
         live_idx = {nid: i for i, nid in enumerate(self.live)}
@@ -241,6 +262,7 @@ class VectorizedAgreement:
                         for p in real_ps
                     ],
                     faults,
+                    forged=forged_coin,
                 )
                 flushes += nfl
                 coin_flips += len(real_ps)
@@ -281,12 +303,18 @@ class VectorizedAgreement:
     # -- batched real coin --------------------------------------------------
 
     def _flip_coins(
-        self, nonces: List[Tuple[int, bytes]], faults: FaultLog
+        self,
+        nonces: List[Tuple[int, bytes]],
+        faults: FaultLog,
+        forged: Optional[Set[Any]] = None,
     ) -> Tuple[Dict[int, bool], int]:
         """One coin flip per (instance, nonce) — all instances' share
         verifications fused into a single RLC flush (grouped by nonce
         base point, ``harness/batching.py``); one combine per instance
-        (any t+1 valid shares give the unique signature)."""
+        (any t+1 valid shares give the unique signature).  ``forged``
+        senders submit a wrong G1 point instead of their signature
+        share (``run(forged_coin=...)``)."""
+        forged = forged or set()
         pk_set = self.ref.public_key_set
         out: Dict[int, bool] = {}
         if self.mock:
@@ -316,6 +344,10 @@ class VectorizedAgreement:
             shares = {}
             for nid in self.live:
                 s = signed[nid]
+                if nid in forged:
+                    # a wrong point on the curve: passes deserialization
+                    # everywhere, fails verification against pkᵢ
+                    s = T.SignatureShare(base * 0xBAD)
                 shares[self.ref.node_index(nid)] = s
                 all_shares.append(s.point)
                 all_pks.append(self.ref.public_key_share(nid).point)
@@ -323,7 +355,7 @@ class VectorizedAgreement:
             per_inst[p] = shares
         # grouped RLC: Σ over instances of e(Σrᵢσᵢ, P₂)·e(−base_g, Σrᵢpkᵢ)
         ok = self._grouped_batch_verify(all_shares, all_pks, bases)
-        if not ok:  # honest shares: cannot happen; per-share fallback
+        if not ok:  # a forged share broke the batch: per-share fallback
             for p, nonce in nonces:
                 valid = {}
                 for nid in self.live:
@@ -352,7 +384,13 @@ class VectorizedAgreement:
             b"hbbft_tpu vec agreement coins",
             [s.to_bytes() for s in shares] + [p.to_bytes() for p in pks],
         )[: len(shares)]
-        agg_share = ops.g1_msm(shares, coeffs)
+        # async launch: a device backend's G1 MSM overlaps the host G2
+        # MSMs below (same pattern as the fused flush, batching.py)
+        if hasattr(ops, "g1_msm_async"):
+            agg_share_fin = ops.g1_msm_async(shares, coeffs)
+        else:
+            agg_share = ops.g1_msm(shares, coeffs)
+            agg_share_fin = lambda: agg_share  # noqa: E731
         pairs = []
         by_base: Dict[bytes, Tuple[Any, List, List]] = {}
         for s_pk, c, b in zip(pks, coeffs, bases):
@@ -365,7 +403,7 @@ class VectorizedAgreement:
             b, g_pks, g_cs = by_base[key]
             u_pks, u_cs = T.aggregate_by_point(g_pks, g_cs)
             pairs.append((-b, ops.g2_msm(u_pks, u_cs)))
-        return pairing_check([(agg_share, G2_GEN)] + pairs)
+        return pairing_check([(agg_share_fin(), G2_GEN)] + pairs)
 
 
 # ---------------------------------------------------------------------------
@@ -506,6 +544,7 @@ class VectorizedHoneyBadgerSim:
         observe: bool = False,
         adv_bval: Optional[Dict[Any, Tuple[int, int]]] = None,
         adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
+        forged_coin: Optional[Set[Any]] = None,
     ) -> EpochResult:
         """Advance every correct node through one complete epoch.
 
@@ -531,6 +570,9 @@ class VectorizedHoneyBadgerSim:
         ``EpochResult.observer_batch``.
         ``adv_bval``/``adv_aux``: Byzantine vote injection into the
         agreement rounds (``VectorizedAgreement.run`` semantics).
+        ``forged_coin``: live Byzantine senders submitting forged
+        threshold-coin signature shares on every real coin flip
+        (``VectorizedAgreement.run`` semantics; real BLS only).
         """
         dead = set(dead or set())
         late = set(late or set())
@@ -541,45 +583,16 @@ class VectorizedHoneyBadgerSim:
                 f"{len(dead)} dead nodes exceeds the f={self.num_faulty} bound"
             )
         faults = FaultLog()
-        self._decode_exhausted = False
+        diag: Dict[str, bool] = {}
 
         import time as _time
 
         _t0 = _time.perf_counter()
-        # 1. propose: serialize + threshold-encrypt (honey_badger.rs:101-122)
-        payloads: Dict[Any, bytes] = {}
-        for pid in sorted(self.netinfos):
-            if pid in dead or pid not in contributions:
-                continue
-            ct = self.pk_set.public_key().encrypt(
-                dumps(contributions[pid]), self.rng
-            )
-            payloads[pid] = dumps(ct)
-
+        payloads = self._propose_phase(contributions, dead)
         _t_prop = _time.perf_counter()
-        # 2. reliable broadcast per live proposer (broadcast.rs semantics,
-        # deduplicated per the round-1 argument: each echoed proof checked
-        # once, one decode per instance, re-rooted against equivocation).
-        # Uncorrupted instances batch: one parity matmul and one decode
-        # matmul across ALL proposers (the per-instance Gauss-Jordan and
-        # GF matmuls dominated the profile at n=1024 before this).
-        # ``late`` proposers' RBC waves are withheld by the adversary's
-        # schedule: nothing delivers before agreement.
-        delivered: Dict[Any, bytes] = {}
-        timely = {
-            pid: v for pid, v in payloads.items() if pid not in late
-        }
-        plain = {
-            pid: v for pid, v in timely.items() if pid not in corrupt_shards
-        }
-        delivered.update(self._rbc_phase(plain, dead, faults))
-        for pid in sorted(set(timely) - set(plain)):
-            value = self._rbc(
-                pid, payloads[pid], dead, corrupt_shards.get(pid), faults
-            )
-            if value is not None:
-                delivered[pid] = value
-
+        delivered = self._broadcast_phase(
+            payloads, dead, corrupt_shards, late, faults, diag
+        )
         _t_rbc = _time.perf_counter()
         # 3. common subset: one agreement per validator; est₀ =
         # delivered-mask.  Undelivered instances (dead proposers, late
@@ -589,11 +602,55 @@ class VectorizedHoneyBadgerSim:
         # instances here are unanimous-true (decide yes at epoch 0),
         # that trigger always fires and inputting false in round 0 is
         # outcome-identical.
+        return self._finish_epoch(
+            payloads,
+            delivered,
+            faults,
+            dead,
+            forged_dec=forged_dec,
+            observe=observe,
+            adv_bval=adv_bval,
+            adv_aux=adv_aux,
+            forged_coin=forged_coin,
+            walls_head={"propose": _t_prop - _t0, "rbc": _t_rbc - _t_prop},
+            diag=diag,
+        )
+
+    def _finish_epoch(
+        self,
+        payloads: Dict[Any, bytes],
+        delivered: Dict[Any, bytes],
+        faults: FaultLog,
+        dead: Set[Any],
+        corrupt_shards: Optional[Dict[Any, Dict[Any, bytes]]] = None,
+        forged_dec: Optional[Dict[Any, Dict[Any, Any]]] = None,
+        late: Optional[Set[Any]] = None,
+        observe: bool = False,
+        adv_bval: Optional[Dict[Any, Tuple[int, int]]] = None,
+        adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
+        forged_coin: Optional[Set[Any]] = None,
+        walls_head: Optional[Dict[str, float]] = None,
+        diag: Optional[Dict[str, bool]] = None,
+    ) -> "EpochResult":
+        """Phases 3-7 (common subset → decryption → batch → observer):
+        everything after the broadcast wave.  ``corrupt_shards`` and
+        ``late`` were consumed by the broadcast phase — accepted here
+        so the pipelined driver can forward one uniform kwargs dict.
+        ``walls_head``: propose/rbc wall times for the virtual-time
+        account (absent under the pipelined driver, which disables
+        ``hw``).  ``diag``: THIS epoch's broadcast diagnostics — a
+        per-epoch dict rather than instance state, so a pipelined
+        worker filling epoch e+1's diagnostics can never corrupt the
+        failure hint of epoch e."""
+        forged_dec = forged_dec or {}
+        import time as _time
+
+        _t_rbc = _time.perf_counter()
         if len(delivered) < self.ref.num_correct:
             hint = (
                 "the codec found no invertible decode window — a "
                 "backend/coding-matrix defect, not a schedule problem"
-                if getattr(self, "_decode_exhausted", False)
+                if (diag or {}).get("decode_exhausted")
                 else "more than f dead/corrupt/late proposers"
             )
             raise RuntimeError(
@@ -611,6 +668,7 @@ class VectorizedHoneyBadgerSim:
             {pid: (pid in delivered) for pid in self.netinfos},
             adv_bval=adv_bval,
             adv_aux=adv_aux,
+            forged_coin=forged_coin,
         )
         faults.merge(res.fault_log)
         accepted = sorted(pid for pid, yes in res.decisions.items() if yes)
@@ -630,14 +688,20 @@ class VectorizedHoneyBadgerSim:
                 continue
             cts[pid] = ct
 
-        # 5. decryption phase — grouped RLC flush (vectorized.decrypt_round)
+        # 5. decryption phase — grouped RLC flush (vectorized.decrypt_round).
+        # With an observer attached, honest-share checks are no longer
+        # redundant (the observer holds no key share and must verify
+        # every share it uses), so they route through the cache-filling
+        # batched path here: ONE flush serves both lanes and the
+        # observer's own prefetch below is pure cache hits instead of a
+        # second full flush (VERDICT r3 item 9).
         dec = decrypt_round(
             self.netinfos,
             cts,
             dead=dead,
             forged=forged_dec,
             be=self.be,
-            verify_honest=self.verify_honest,
+            verify_honest=self.verify_honest or observe,
             emit_minimal=self.emit_minimal,
         )
         faults.merge(dec.fault_log)
@@ -653,18 +717,15 @@ class VectorizedHoneyBadgerSim:
         batch = Batch(self.epoch, out_contribs)
         virtual = None
         if self.hw is not None:
-            virtual = self._virtual_account(
-                payloads,
-                res,
-                cts,
-                walls={
-                    "propose": _t_prop - _t0,
-                    "rbc": _t_rbc - _t_prop,
+            walls = dict(walls_head or {})
+            walls.update(
+                {
                     "agreement": _t_agree - _t_rbc,
                     "decrypt": _t_dec - _t_agree,
                     "assembly": _time.perf_counter() - _t_dec,
-                },
+                }
             )
+            virtual = self._virtual_account(payloads, res, cts, walls=walls)
 
         # 7. observer lane (optional): derive the batch again from
         # public traffic only, with no secret key share
@@ -684,6 +745,149 @@ class VectorizedHoneyBadgerSim:
             observer_batch=observer_batch,
             virtual=virtual,
         )
+
+    # -- epoch phases -------------------------------------------------------
+
+    def _propose_phase(
+        self, contributions: Dict[Any, Any], dead: Set[Any]
+    ) -> Dict[Any, bytes]:
+        """1. propose: serialize + threshold-encrypt
+        (``honey_badger.rs:101-122``).  The only phase that draws from
+        ``self.rng`` — calling it for epoch e+1 before epoch e's
+        decryption (the pipelined driver) preserves the exact rng
+        sequence of the sequential loop."""
+        payloads: Dict[Any, bytes] = {}
+        for pid in sorted(self.netinfos):
+            if pid in dead or pid not in contributions:
+                continue
+            ct = self.pk_set.public_key().encrypt(
+                dumps(contributions[pid]), self.rng
+            )
+            payloads[pid] = dumps(ct)
+        return payloads
+
+    def _broadcast_phase(
+        self,
+        payloads: Dict[Any, bytes],
+        dead: Set[Any],
+        corrupt_shards: Dict[Any, Dict[Any, bytes]],
+        late: Set[Any],
+        faults: FaultLog,
+        diag: Optional[Dict[str, bool]] = None,
+    ) -> Dict[Any, bytes]:
+        """2. reliable broadcast per live proposer (``broadcast.rs``
+        semantics, deduplicated per the round-1 argument: each echoed
+        proof checked once, one decode per instance, re-rooted against
+        equivocation).  Uncorrupted instances batch: one parity matmul
+        and one decode matmul across ALL proposers (the per-instance
+        Gauss-Jordan and GF matmuls dominated the profile at n=1024
+        before this).  ``late`` proposers' RBC waves are withheld by
+        the adversary's schedule: nothing delivers before agreement.
+
+        Pure host compute over its arguments (no rng, no epoch
+        counter) — safe to run for epoch e+1 on the pipeline worker
+        thread while epoch e's decryption flush waits on the device.
+        """
+        delivered: Dict[Any, bytes] = {}
+        timely = {
+            pid: v for pid, v in payloads.items() if pid not in late
+        }
+        plain = {
+            pid: v for pid, v in timely.items() if pid not in corrupt_shards
+        }
+        delivered.update(self._rbc_phase(plain, dead, faults, diag))
+        for pid in sorted(set(timely) - set(plain)):
+            value = self._rbc(
+                pid, payloads[pid], dead, corrupt_shards.get(pid), faults
+            )
+            if value is not None:
+                delivered[pid] = value
+        return delivered
+
+    # -- pipelined multi-epoch driver ---------------------------------------
+
+    def run_epochs(
+        self,
+        contributions_seq: Sequence[Dict[Any, Any]],
+        dead: Optional[Set[Any]] = None,
+        pipeline: bool = True,
+        **epoch_kwargs,
+    ) -> List["EpochResult"]:
+        """Run consecutive epochs with TWO in flight — the vectorized
+        mirror of the reference's ``max_future_epochs`` window
+        (``honey_badger.rs:30-34``), which keeps future epochs'
+        CommonSubset instances running while the current epoch
+        decrypts.
+
+        Schedule: epoch e+1's proposer encryption runs on the calling
+        thread (deterministic rng order — exactly the sequential
+        sequence, see ``_propose_phase``), then its broadcast matmuls
+        run on a worker thread while THIS thread completes epoch e's
+        agreement + decryption flush (whose device transfers/MSMs
+        release the GIL, so the overlap is real on a single core).
+        Outcomes are bit-identical to the sequential loop (asserted in
+        ``tests/test_epoch_vec.py``).
+
+        ``epoch_kwargs`` are forwarded to every epoch (adversarial
+        schedules apply uniformly).  With a virtual-time ``hw`` model
+        the driver falls back to sequential epochs: overlapped wall
+        clocks would corrupt the measured-phase account.
+        """
+        seq = list(contributions_seq)
+        dead = set(dead or set())
+        if not pipeline or len(seq) <= 1 or self.hw is not None:
+            return [
+                self.run_epoch(c, dead=dead, **epoch_kwargs) for c in seq
+            ]
+        from concurrent.futures import ThreadPoolExecutor
+
+        corrupt_shards = epoch_kwargs.get("corrupt_shards") or {}
+        late = set(epoch_kwargs.get("late") or set())
+        if len(dead) > self.num_faulty:
+            raise ValueError(
+                f"{len(dead)} dead nodes exceeds the f={self.num_faulty} bound"
+            )
+        results: List[EpochResult] = []
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            faults_next = FaultLog()
+            diag_next: Dict[str, bool] = {}
+            payloads_next = self._propose_phase(seq[0], dead)
+            fut = ex.submit(
+                self._broadcast_phase,
+                payloads_next,
+                dead,
+                corrupt_shards,
+                late,
+                faults_next,
+                diag_next,
+            )
+            for e in range(len(seq)):
+                delivered, faults, diag = fut.result(), faults_next, diag_next
+                payloads = payloads_next
+                if e + 1 < len(seq):
+                    faults_next = FaultLog()
+                    diag_next = {}
+                    payloads_next = self._propose_phase(seq[e + 1], dead)
+                    fut = ex.submit(
+                        self._broadcast_phase,
+                        payloads_next,
+                        dead,
+                        corrupt_shards,
+                        late,
+                        faults_next,
+                        diag_next,
+                    )
+                results.append(
+                    self._finish_epoch(
+                        payloads,
+                        delivered,
+                        faults,
+                        dead,
+                        diag=diag,
+                        **epoch_kwargs,
+                    )
+                )
+        return results
 
     # -- virtual-time accounting -------------------------------------------
 
@@ -788,7 +992,14 @@ class VectorizedHoneyBadgerSim:
         derives the identical batch.  Every share it uses is verified
         through the public batched path (an observer cannot elide
         ``verify_honest``: it has no way to know which shares are
-        honest), then combined with the same lowest-t+1-valid rule."""
+        honest), then combined with the same lowest-t+1-valid rule.
+
+        The verifications themselves ran in the epoch's MAIN decryption
+        flush (``run_epoch`` forces the cache-filling path when an
+        observer is attached), so the ``prefetch`` here is pure cache
+        hits — one flush serves both lanes instead of the observer
+        doubling the epoch's dominant cost at scale (VERDICT r3 item
+        9; asserted in ``tests/test_epoch_vec.py``)."""
         from .batching import DecObligation
 
         obs_ni = self.ref.observer_view("observer")
@@ -864,14 +1075,22 @@ class VectorizedHoneyBadgerSim:
         return RS._matmul(rows, byte_mat)
 
     def _rbc_phase(
-        self, payloads: Dict[Any, bytes], dead: Set[Any], faults: FaultLog
+        self,
+        payloads: Dict[Any, bytes],
+        dead: Set[Any],
+        faults: FaultLog,
+        diag: Optional[Dict[str, bool]] = None,
     ) -> Dict[Any, bytes]:
         """All uncorrupted broadcast instances in one wave: a single
         parity matmul over [k, P·L], one cached decode matrix for the
         shared erasure pattern, a single reconstruction matmul, then
         per-instance Merkle commitment (+ re-root self-check unless
         elided).  Shard width is uniform across instances (the framing's
-        length header makes padding invisible to the decoded value)."""
+        length header makes padding invisible to the decoded value).
+        ``diag``: per-epoch diagnostics sink (``decode_exhausted``).
+        The only instance state touched is ``_decode_start``, a
+        window-retry hint where a pipelined-thread race costs at most
+        one extra decode attempt."""
         from ..protocols.broadcast import unframe_shards
 
         if not payloads:
@@ -923,7 +1142,8 @@ class VectorizedHoneyBadgerSim:
                 # with nothing delivered (matching the per-instance
                 # path, which records no fault on reconstruct failure);
                 # flagged so run_epoch's guard names the real culprit
-                self._decode_exhausted = True
+                if diag is not None:
+                    diag["decode_exhausted"] = True
                 return {}
             data_rec = self._codec_matmul(dec, encoded[use])
         else:

@@ -176,11 +176,48 @@ class TpuBackend(CpuBackend):
             )
             pts_t, dig_t, _, _ = pallas_ec._tile_transpose(pts, digits)
             return ec_jax.g1_from_limbs(self._sharded_g1(pts_t, dig_t))
-        if self._native_host() and not (
-            self.G1_DEVICE_MIN <= len(points) <= self.G1_DEVICE_MAX
-        ):
+        if not self._g1_in_device_band(len(points)):
             return super().g1_msm(points, scalars)
-        return ec_jax.g1_msm(points, scalars)
+        return self._device_g1_msm(points, scalars)()
+
+    def _g1_in_device_band(self, k: int) -> bool:
+        """One home for the host/device G1 routing decision (shared by
+        the sync and async entries so they can never drift): the device
+        takes a batch when no native host path exists, or when k falls
+        inside the measured routing band."""
+        return not self._native_host() or (
+            self.G1_DEVICE_MIN <= k <= self.G1_DEVICE_MAX
+        )
+
+    @staticmethod
+    def _device_g1_msm(points, scalars):
+        """Launch the device G1 MSM, returning a finalizer.  On real
+        TPU hardware this is the packed-wire path (96 B/point over the
+        tunnel, on-device unpack — ``ops/packed_msm.py``); on CPU
+        (tests, interpret mode) the XLA limb path keeps its fast
+        compiles."""
+        import jax
+
+        if jax.default_backend() == "tpu":
+            from . import packed_msm
+
+            return packed_msm.g1_msm_packed_async(points, scalars)
+        result = ec_jax.g1_msm(points, scalars)
+        return lambda: result
+
+    def g1_msm_async(self, points, scalars):
+        """Async G1 MSM: device-routed batches overlap the tunnel
+        transfer + kernel with the caller's host work (the fused
+        flush's G2 MSMs and transcript pairings — VERDICT r3 item 1)."""
+        points, scalars = list(points), list(scalars)
+        if (
+            self.mesh is None
+            and points
+            and self._g1_in_device_band(len(points))
+        ):
+            return self._device_g1_msm(points, scalars)
+        result = self.g1_msm(points, scalars)
+        return lambda: result
 
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         points, scalars = list(points), list(scalars)
@@ -207,10 +244,10 @@ class TpuBackend(CpuBackend):
             context,
             [s.to_bytes() for s in shares] + [p.to_bytes() for p in pks],
         )[: len(shares)]  # one rᵢ per (shareᵢ, pkᵢ) pair, as on CPU
-        agg_share = self.g1_msm(shares, coeffs)
+        agg_share_fin = self.g1_msm_async(shares, coeffs)
         u_pks, u_coeffs = T.aggregate_by_point(pks, coeffs)
-        agg_pk = self.g2_msm(u_pks, u_coeffs)
-        return pairing_check([(agg_share, G2_GEN), (-base, agg_pk)])
+        agg_pk = self.g2_msm(u_pks, u_coeffs)  # overlaps the device leg
+        return pairing_check([(agg_share_fin(), G2_GEN), (-base, agg_pk)])
 
 
 _DEFAULT_TPU = None

@@ -250,38 +250,54 @@ def _batch_inv(vals, p: int):
     return out
 
 
-def g1_to_limbs(points: Sequence[Any]) -> np.ndarray:
-    """Host G1 points (crypto.curve.G1) → [k, 3, L] projective limbs.
+def g1_batch_affine(points: Sequence[Any]) -> List[Any]:
+    """Host G1 points → ``[(x, y) | None]`` (None = infinity), with ONE
+    Montgomery batch inversion shared across every Jacobian (Z ∉ {0, 1})
+    point — the one home for the normalization both limb and packed-wire
+    marshalling need (``g1_to_limbs``, ``packed_msm.g1_wires_batch``).
+    Affine-constructed points (Z = 1, the common case for deserialized
+    and native-built shares) skip inversion entirely."""
+    from ..crypto import fields as F
 
-    Batched: affine-constructed points (Z = 1, the common case for
-    deserialized/native-built shares) skip inversion; the rest share
-    one Montgomery batch inversion; limb decomposition is one
-    vectorized ``unpackbits`` pass — a 262k-point flush spent more
-    time in the per-point Python loop than on the device before this.
-    """
-    f = LB.fq()
-    p = f.p
+    p = F.P
     n = len(points)
-    xs = [0] * n
-    ys = [0] * n
-    zs = np.zeros(n, dtype=np.int32)
+    out: List[Any] = [None] * n
     inv_idx, inv_z = [], []
     for i, pt in enumerate(points):
         X, Y, Z = pt.jac
         if Z == 0:
-            ys[i] = 1  # infinity encoded (0 : 1 : 0)
-        elif Z == 1:
-            xs[i], ys[i], zs[i] = X % p, Y % p, 1
+            continue
+        if Z == 1:
+            out[i] = (X % p, Y % p)
         else:
             inv_idx.append(i)
             inv_z.append(Z % p)
-            zs[i] = 1
     if inv_idx:
         for i, zinv in zip(inv_idx, _batch_inv(inv_z, p)):
             X, Y, _ = points[i].jac
             zinv2 = zinv * zinv % p
-            xs[i] = X * zinv2 % p
-            ys[i] = Y * zinv * zinv2 % p
+            out[i] = (X * zinv2 % p, Y * zinv * zinv2 % p)
+    return out
+
+
+def g1_to_limbs(points: Sequence[Any]) -> np.ndarray:
+    """Host G1 points (crypto.curve.G1) → [k, 3, L] projective limbs.
+
+    Batched: one shared batch inversion (``g1_batch_affine``); limb
+    decomposition is one vectorized ``unpackbits`` pass — a 262k-point
+    flush spent more time in the per-point Python loop than on the
+    device before this.
+    """
+    f = LB.fq()
+    n = len(points)
+    xs = [0] * n
+    ys = [0] * n
+    zs = np.zeros(n, dtype=np.int32)
+    for i, aff in enumerate(g1_batch_affine(points)):
+        if aff is None:
+            ys[i] = 1  # infinity encoded (0 : 1 : 0)
+        else:
+            xs[i], ys[i], zs[i] = aff[0], aff[1], 1
     out = np.zeros((n, 3, f.L), dtype=np.int32)
     out[:, 0, :] = LB.ints_to_limbs_batch(xs, f.L)
     out[:, 1, :] = LB.ints_to_limbs_batch(ys, f.L)

@@ -109,30 +109,35 @@ class ModField:
 
         # fold[i] = limbs of 2^(11·(B+i)) mod p — reduces limb B+i.
         # Sized for the widest intermediate (2L−1 product + carry limbs).
+        # All constants are HOST numpy arrays on purpose: captured jnp
+        # device arrays become hidden const-inputs of any jit that
+        # closes over them, which breaks executable serialization (the
+        # reloaded executable expects inputs the caller no longer has —
+        # measured r4 on the tree-reduction cache).  np constants are
+        # inlined into the HLO at trace time instead, making every
+        # compiled program self-contained.
         nfold = L + 5
-        self.fold = jnp.asarray(
-            np.stack(
-                [
-                    int_to_limbs(pow(2, LIMB_BITS * (B + i), p), B)
-                    for i in range(nfold)
-                ]
-            )
+        self.fold = np.stack(
+            [
+                int_to_limbs(pow(2, LIMB_BITS * (B + i), p), B)
+                for i in range(nfold)
+            ]
         )  # [nfold, B]
         # Subtraction pad: smallest multiple of p ≥ 2^(11·B+2), covering
         # any invariant-respecting minuend; a + pad − b is non-negative.
         pad = ((1 << (LIMB_BITS * B + 2)) // p + 1) * p
-        self.sub_pad = jnp.asarray(int_to_limbs(pad, L + 1))
+        self.sub_pad = int_to_limbs(pad, L + 1)
         # canon(): conditional subtraction of (2^k)·p, largest k first.
         ks: List[int] = []
         k = 1
         while k * p < (1 << (self.bits + 2)):
             ks.append(k)
             k <<= 1
-        self.canon_steps = jnp.asarray(
-            np.stack([int_to_limbs(k * p, L + 1) for k in reversed(ks)])
+        self.canon_steps = np.stack(
+            [int_to_limbs(k * p, L + 1) for k in reversed(ks)]
         )  # [n_steps, L+1]
-        self.zero = jnp.zeros(L, dtype=jnp.int32)
-        self.one = jnp.asarray(int_to_limbs(1, L))
+        self.zero = np.zeros(L, dtype=np.int32)
+        self.one = int_to_limbs(1, L)
 
     # -- host conversion ---------------------------------------------------
 
@@ -279,6 +284,18 @@ def scalar_to_bits(k: int, nbits: int = 255) -> np.ndarray:
     )
 
 
+def scalars_to_be_bytes(ks: Sequence[int], nbytes: int) -> np.ndarray:
+    """[K, nbytes] uint8, big-endian, reduced mod r — the one home for
+    scalar byte marshalling (shared by the bit decomposition below and
+    the packed-wire transfer path, ``packed_msm.py``)."""
+    if not len(ks):
+        return np.zeros((0, nbytes), dtype=np.uint8)
+    return np.frombuffer(
+        b"".join((int(k) % R).to_bytes(nbytes, "big") for k in ks),
+        dtype=np.uint8,
+    ).reshape(len(ks), nbytes)
+
+
 def scalars_to_bits(ks: Sequence[int], nbits: int = 255) -> np.ndarray:
     """Vectorized batch of :func:`scalar_to_bits`: ``to_bytes`` (C) +
     one ``np.unpackbits`` instead of a Python loop per bit — the per-bit
@@ -286,10 +303,7 @@ def scalars_to_bits(ks: Sequence[int], nbits: int = 255) -> np.ndarray:
     if not len(ks):
         return np.zeros((0, nbits), dtype=np.int32)
     nbytes = (nbits + 7) // 8
-    buf = np.frombuffer(
-        b"".join((int(k) % R).to_bytes(nbytes, "big") for k in ks),
-        dtype=np.uint8,
-    ).reshape(len(ks), nbytes)
+    buf = scalars_to_be_bytes(ks, nbytes)
     bits = np.unpackbits(buf, axis=1)  # msb-first
     return bits[:, nbytes * 8 - nbits :].astype(np.int32)
 

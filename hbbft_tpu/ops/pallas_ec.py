@@ -421,13 +421,13 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
         jax.__version__,
         jax.devices()[0].device_kind,
     )
+    fname = (
+        "-".join(str(p) for p in key).replace(" ", "").replace("/", "_")
+        + ".palexe"
+    )
+    path = os.path.join(_exec_cache_dir(), fname)
     loaded = _EXEC_MEM.get(key)
     if loaded is None:
-        fname = (
-            "-".join(str(p) for p in key).replace(" ", "").replace("/", "_")
-            + ".palexe"
-        )
-        path = os.path.join(_exec_cache_dir(), fname)
         if os.path.exists(path):
             try:
                 from jax.experimental.serialize_executable import (
@@ -440,20 +440,35 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
             except Exception:
                 loaded = None
         if loaded is None:
-            compiled = jax.jit(fn).lower(*args).compile()
-            try:
-                from jax.experimental.serialize_executable import serialize
-
-                payload, in_tree, out_tree = serialize(compiled)
-                tmp = path + ".tmp.%d" % os.getpid()
-                with open(tmp, "wb") as fh:
-                    pickle.dump((payload, in_tree, out_tree), fh)
-                os.replace(tmp, path)
-            except Exception:
-                pass
-            loaded = compiled
+            loaded = jax.jit(fn).lower(*args).compile()
+            _save_exec(loaded, path)
         _EXEC_MEM[key] = loaded
-    return loaded(*args)
+    try:
+        return loaded(*args)
+    except TypeError:
+        # a stale on-disk executable whose signature no longer matches
+        # (e.g. serialized before the np-constant fix, when closed-over
+        # jnp arrays were hidden const-inputs): recompile and replace
+        compiled = jax.jit(fn).lower(*args).compile()
+        _EXEC_MEM[key] = compiled
+        _save_exec(compiled, path)
+        return compiled(*args)
+
+
+def _save_exec(compiled, path: str) -> None:
+    import os
+    import pickle
+
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb") as fh:
+            pickle.dump((payload, in_tree, out_tree), fh)
+        os.replace(tmp, path)
+    except Exception:
+        pass
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -617,38 +632,60 @@ def scalar_mul_windowed_g2(
     return _untile(out_t, K if trim else Kp, Kp)
 
 
-@jax.jit
-def _tree_sum_g1(prods):
+def _tree_sum_g1_fn(prods):
     from . import ec_jax
 
     return ec_jax.g1_kernel().tree_sum(prods)
 
 
-@jax.jit
-def _tree_sum_g2(prods):
+def _tree_sum_g2_fn(prods):
     from . import ec_jax
 
     return ec_jax.g2_kernel().tree_sum(prods)
 
 
-# Largest point count one jitted tree reduction may span: the first
-# levels materialize s32[K/2, 38, 38] convolution intermediates
-# (~9.5 GB at K=512k with TPU tiling padding — measured HBM OOM on
-# v5e), so bigger batches reduce in fixed-size chunks whose compiles
-# are shared, then a tiny tree over the chunk partials.
-_TREE_CHUNK_G1 = 1 << 18
-_TREE_CHUNK_G2 = 1 << 16
+_tree_sum_g1 = jax.jit(_tree_sum_g1_fn)
+_tree_sum_g2 = jax.jit(_tree_sum_g2_fn)
+
+
+def _tree_sum_exec(prods, g2: bool):
+    """One tree reduction through the executable disk cache on real
+    hardware — its XLA compile at flush shapes is ~3 min on this host
+    and does NOT land in a persistent cache, so every bench/epoch
+    process used to repay it (measured r4); the serialized executable
+    reloads in ~1 s."""
+    if jax.default_backend() == "tpu":
+        return cached_compiled(
+            "tree_g2" if g2 else "tree_g1",
+            _tree_sum_g2_fn if g2 else _tree_sum_g1_fn,
+            prods,
+        )
+    return (_tree_sum_g2 if g2 else _tree_sum_g1)(prods)
+
+
+# Largest point count one jitted tree reduction may span.  Two limits
+# bind: the first levels materialize s32[K/2, 38, 38] convolution
+# intermediates (~9.5 GB at K=512k with TPU tiling padding — measured
+# HBM OOM on v5e), and the unrolled tree's executable grows with K
+# (528 MB serialized at 2^18 — a 197 s compile and a slow disk
+# reload).  2^14 keeps the executable small and shared across every
+# batch ≥ 16k (all chunk calls hit ONE cached shape), with the chunk
+# partials reduced by a tiny second tree.
+_TREE_CHUNK_G1 = 1 << 14
+_TREE_CHUNK_G2 = 1 << 13
 
 
 def _tree_sum_chunked(prods, g2: bool):
     chunk = _TREE_CHUNK_G2 if g2 else _TREE_CHUNK_G1
-    fn = _tree_sum_g2 if g2 else _tree_sum_g1
     K = prods.shape[0]
     if K <= chunk:
-        return fn(prods)
+        return _tree_sum_exec(prods, g2)
     # bucketed Kp is a power of two ≥ chunk, so slices divide evenly
-    parts = [fn(prods[i : i + chunk]) for i in range(0, K, chunk)]
-    return fn(jnp.stack(parts))
+    parts = [
+        _tree_sum_exec(prods[i : i + chunk], g2)
+        for i in range(0, K, chunk)
+    ]
+    return _tree_sum_exec(jnp.stack(parts), g2)
 
 
 def g1_msm_pallas(

@@ -190,6 +190,61 @@ class TestEpochAdversaries:
         flagged = {f.node_id for f in res.fault_log}
         assert 5 in flagged
 
+    def test_forged_coin_share_fallback_attributes_and_lands(self):
+        # A live Byzantine sender forges its threshold-coin signature
+        # share on every real flip: the grouped-RLC check fails, the
+        # per-share fallback must attribute INVALID_SIGNATURE_SHARE to
+        # exactly the forger, and every coin still lands from the ≥ f+1
+        # honest shares (epoch.py fallback branch — VERDICT r3 item 8).
+        from hbbft_tpu.core.fault import FaultKind
+        from hbbft_tpu.core.network_info import NetworkInfo
+        from hbbft_tpu.harness.epoch import VectorizedAgreement
+
+        netinfos = NetworkInfo.generate_map(
+            list(range(4)), random.Random(0xF06), mock=False
+        )
+        ag = VectorizedAgreement(netinfos, 2, list(range(4)))
+        est0 = {p: {n: (n < 2) for n in range(4)} for p in range(4)}
+        res = ag.run(est0, forged_coin={3})
+        assert res.coin_flips > 0  # split inputs force the real coin
+        assert set(res.decisions.values()) <= {True, False}
+        flagged = {
+            f.node_id
+            for f in res.fault_log
+            if f.kind == FaultKind.INVALID_SIGNATURE_SHARE
+        }
+        assert flagged == {3}
+        # honest outcome check: the same run without the forger's
+        # interference decides identically (a bad share changes nothing)
+        netinfos2 = NetworkInfo.generate_map(
+            list(range(4)), random.Random(0xF06), mock=False
+        )
+        ag2 = VectorizedAgreement(netinfos2, 2, list(range(4)))
+        res2 = ag2.run(est0)
+        assert res.decisions == res2.decisions
+        assert res.epochs_used == res2.epochs_used
+
+    def test_forged_coin_validation(self):
+        from hbbft_tpu.core.network_info import NetworkInfo
+        from hbbft_tpu.harness.epoch import VectorizedAgreement
+
+        netinfos = NetworkInfo.generate_map(
+            list(range(4)), random.Random(0xF07), mock=True
+        )
+        ag = VectorizedAgreement(netinfos, 0, list(range(4)))
+        with pytest.raises(ValueError, match="real BLS"):
+            ag.run({p: True for p in range(4)}, forged_coin={0})
+        netinfos = NetworkInfo.generate_map(
+            list(range(4)), random.Random(0xF08), mock=False
+        )
+        ag = VectorizedAgreement(netinfos, 0, list(range(4)))
+        with pytest.raises(ValueError, match="exceed"):
+            ag.run({p: True for p in range(4)}, forged_coin={0, 1})
+        with pytest.raises(ValueError, match="live"):
+            VectorizedAgreement(
+                netinfos, 0, list(range(4)), dead={3}
+            ).run({p: True for p in range(4)}, forged_coin={3})
+
     def test_verify_honest_elision_same_outcome(self):
         contributions = {i: [b"z%d" % i] for i in range(7)}
         a = VectorizedHoneyBadgerSim(
@@ -504,6 +559,88 @@ class TestObserverLane:
         res = sim.run_epoch(contribs, observe=True)
         assert res.observer_batch.contributions == res.batch.contributions
         assert res.observer_batch.contributions == contribs
+
+    def test_observer_shares_the_main_flush(self):
+        # VERDICT r3 item 9: with an observer attached, the epoch's
+        # main decryption round verifies every emitted share through
+        # the cache-filling batched path, and the observer lane is pure
+        # cache hits — NO additional obligations are prefetched and no
+        # second flush runs for the observer.
+        n = 4
+        sim = VectorizedHoneyBadgerSim(
+            n, random.Random(111), mock=False,
+            verify_honest=False, emit_minimal=True,
+        )
+        contribs = {i: [b"sf-%d" % i] for i in range(n)}
+        res = sim.run_epoch(contribs, observe=True)
+        assert res.observer_batch.contributions == res.batch.contributions
+        # exactly one decryption flush served both lanes: the observer
+        # added zero new prefetched obligations (all were cached), so
+        # prefetched == the shares the main round verified
+        assert sim.be.stats.flushes == 1
+        assert sim.be.stats.prefetched == res.shares_verified
+        assert sim.be.stats.fallback_groups == 0
+
+
+class TestPipelinedEpochs:
+    """VERDICT r3 item 7: two epochs in flight (the reference
+    ``max_future_epochs`` window, ``honey_badger.rs:30-34``) — epoch
+    e+1's broadcast runs on a worker thread under epoch e's decryption
+    flush, with bit-identical outcomes to the sequential loop."""
+
+    @staticmethod
+    def _contribs(e, n):
+        return {i: [b"pl-%d-%d" % (e, i)] for i in range(n)}
+
+    def test_pipelined_matches_sequential_mock(self):
+        n, E = 7, 4
+        seq_sim = VectorizedHoneyBadgerSim(n, random.Random(120), mock=True)
+        seq = [
+            seq_sim.run_epoch(self._contribs(e, n)) for e in range(E)
+        ]
+        pipe_sim = VectorizedHoneyBadgerSim(n, random.Random(120), mock=True)
+        pipe = pipe_sim.run_epochs([self._contribs(e, n) for e in range(E)])
+        for a, b in zip(seq, pipe):
+            assert a.batch.epoch == b.batch.epoch
+            assert a.batch.contributions == b.batch.contributions
+            assert a.accepted == b.accepted
+
+    def test_pipelined_matches_sequential_real_bls(self):
+        n, E = 4, 3
+        seq_sim = VectorizedHoneyBadgerSim(n, random.Random(121), mock=False)
+        seq = [
+            seq_sim.run_epoch(self._contribs(e, n)) for e in range(E)
+        ]
+        pipe_sim = VectorizedHoneyBadgerSim(n, random.Random(121), mock=False)
+        pipe = pipe_sim.run_epochs([self._contribs(e, n) for e in range(E)])
+        for a, b in zip(seq, pipe):
+            assert a.batch.contributions == b.batch.contributions
+            assert a.accepted == b.accepted
+            assert a.shares_verified == b.shares_verified
+
+    def test_pipelined_with_adversaries(self):
+        n, E = 7, 3
+        dead, late = {6}, {2}
+        seq_sim = VectorizedHoneyBadgerSim(n, random.Random(122), mock=True)
+        seq = [
+            seq_sim.run_epoch(self._contribs(e, n), dead=dead, late=late)
+            for e in range(E)
+        ]
+        pipe_sim = VectorizedHoneyBadgerSim(n, random.Random(122), mock=True)
+        pipe = pipe_sim.run_epochs(
+            [self._contribs(e, n) for e in range(E)], dead=dead, late=late
+        )
+        for a, b in zip(seq, pipe):
+            assert a.batch.contributions == b.batch.contributions
+            assert a.accepted == b.accepted
+
+    def test_pipeline_false_falls_back(self):
+        n = 4
+        sim = VectorizedHoneyBadgerSim(n, random.Random(123), mock=True)
+        res = sim.run_epochs(
+            [self._contribs(e, n) for e in range(2)], pipeline=False
+        )
+        assert [r.batch.epoch for r in res] == [0, 1]
 
 
 class TestPerNodeQueues:
