@@ -66,6 +66,62 @@ class CpuBackend:
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         return g2_multi_exp(points, scalars)
 
+    # -- product-form MSM (the fused flush's dominant shape) ---------------
+
+    def g1_ship(self, points: Sequence[G1]):
+        """Begin moving ``points`` toward the MSM execution engine.
+
+        Device backends start the (asynchronous) wire transfer here so
+        it overlaps the caller's transcript hashing and coefficient
+        derivation; the host backend has nothing to move.  The returned
+        handle is accepted by :meth:`g1_msm_product_async` in place of
+        the point list."""
+        return points
+
+    def g1_msm_product_async(
+        self,
+        points,
+        s_coeffs: Sequence[int],
+        t_coeffs: Sequence[int],
+        group_sizes: Sequence[int],
+    ):
+        """Async ``Σ_g t_g · (Σ_{i∈g} sᵢ · Pᵢ)`` over group-major
+        ``points`` (``len(points) == sum(group_sizes)``; ``s_coeffs``
+        aligned per point, ``t_coeffs`` per group).
+
+        This is the fused flush's product-form aggregate
+        (``harness/batching.py``): mathematically equal to one flat MSM
+        with coefficients ``sᵢ·t_g mod r``, but the factored shape lets
+        a scan-based device kernel run HALF-width scalar muls (s is
+        96-bit where s·t is 192) — an advantage bucket-method host
+        Pippenger cannot mirror, since it already amortizes doublings.
+        Both evaluations agree exactly on r-torsion points (every
+        honestly-generated share); off-subgroup forgeries make the
+        enclosing check fail under either evaluation (up to the same
+        2⁻⁹⁶ Schwartz–Zippel bound), landing in the same per-item
+        fallback."""
+        points = list(points)
+        if not (
+            sum(group_sizes) == len(points) == len(s_coeffs)
+            and len(t_coeffs) == len(group_sizes)
+        ):
+            raise ValueError(
+                "product MSM shape mismatch: "
+                f"{len(points)} points, {len(s_coeffs)} s-coeffs, "
+                f"{len(t_coeffs)} t-coeffs over {len(group_sizes)} "
+                f"groups summing to {sum(group_sizes)}"
+            )
+        flat: List[int] = []
+        idx = 0
+        from . import fields as F
+
+        for t, size in zip(t_coeffs, group_sizes):
+            for _ in range(size):
+                flat.append((s_coeffs[idx] * t) % F.R)
+                idx += 1
+        result = self.g1_msm(points, flat)
+        return lambda: result
+
     # -- share verification ------------------------------------------------
     # Every protocol-level share check routes through these two methods
     # (``common_coin.py``, ``honey_badger.py``) so a batching façade can

@@ -358,7 +358,18 @@ class BatchingBackend:
                 pairs.append((-base, self.g2_msm(u_pks, u_coeffs)))
             return pairing_check([(agg_share_fin(), G2_GEN)] + pairs)
 
-        # product-form path: transcript binds every (pk, share, group)
+        # product-form path: transcript binds every (pk, share, group).
+        # Ship the share points FIRST — on a device backend the
+        # packed-wire transfer (the flush's largest data movement) then
+        # overlaps the transcript hashing and coefficient derivation
+        # below (VERDICT r3 item 1).
+        all_shares = [
+            ob.share.point
+            for _, _, members in pre
+            for ob, _, _ in members
+        ]
+        shipped = self.g1_ship(all_shares)
+
         from ..crypto.hashing import sha256
 
         transcript = sha256(
@@ -375,27 +386,32 @@ class BatchingBackend:
 
         s: Dict[bytes, int] = {}
         t: Dict[bytes, int] = {}
-        all_shares, all_coeffs = [], []
+        all_s: List[int] = []  # per-point sender coefficients
+        group_ts: List[int] = []  # per-group coefficients, pre order
+        group_sizes: List[int] = []
         # sender-set signature → [group keys]
         classes: Dict[Tuple[bytes, ...], List[bytes]] = {}
         group_info: Dict[bytes, Tuple[Any, List[Tuple[bytes, Any]]]] = {}
         for gkey, base, members in pre:
             t[gkey] = coeff(b"t" + gkey)
+            group_ts.append(t[gkey])
+            group_sizes.append(len(members))
             sender_pks: List[Tuple[bytes, Any]] = []
             for ob, pkb, _sb in members:
                 if pkb not in s:
                     s[pkb] = coeff(b"s" + pkb)
-                all_shares.append(ob.share.point)
-                all_coeffs.append((s[pkb] * t[gkey]) % T.R)
+                all_s.append(s[pkb])
                 sender_pks.append((pkb, ob.pk_share.point))
             sig = tuple(sorted(pkb for pkb, _ in sender_pks))
             classes.setdefault(sig, []).append(gkey)
             group_info[gkey] = (base, sender_pks)
 
-        # launch the k-point G1 MSM first (async): a device backend's
-        # tunnel transfer + kernel then run UNDER the host-side G2 MSMs
-        # and per-class base MSMs below (VERDICT r3 item 1)
-        agg_share_fin = self.g1_msm_async(all_shares, all_coeffs)
+        # launch the factored aggregate Σ_g t_g·(Σᵢ sᵢ·σᵢ) (async): a
+        # device backend runs HALF-width (96-bit) scalar muls plus
+        # per-group trees, overlapped with the host G2 MSMs below
+        agg_share_fin = self.g1_msm_product_async(
+            shipped, all_s, group_ts, group_sizes
+        )
         pairs = []
         for sig in sorted(classes):
             gkeys = classes[sig]

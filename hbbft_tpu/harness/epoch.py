@@ -86,6 +86,46 @@ class AgreementResult:
     coin_flips: int  # real threshold-coin flips executed
     crypto_flushes: int
     fault_log: FaultLog
+    diverged: bool = False  # a divergent epoch-0 schedule executed
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergentEpoch0:
+    """A two-view-class asynchronous schedule for agreement epoch 0 —
+    the delivery power of the reference's adversary
+    (``tests/network/mod.rs:151-173``): the network partitions the
+    correct nodes into classes A and B that receive epoch-0 ``BVal``
+    traffic in different orders, while ≤ f Byzantine ``equivocators``
+    send ``BVal(to_a)`` to class A and ``BVal(to_b)`` to class B and
+    stay silent otherwise.
+
+    Wave template (per affected instance; all delays are finite, so
+    this is a legal asynchronous schedule):
+
+    - W1: class A promptly receives every honest ``BVal(est)`` plus the
+      equivocators' ``to_a`` votes; relays fire; A's first
+      ``bin_values`` entry fixes its ``Aux`` value.
+    - W2: class B first receives every ``to_b``-valued ``BVal`` (honest
+      est and equivocator alike) plus its own members' est votes; the
+      opposite-valued votes from outside B are withheld; relays fire;
+      B's first ``bin_values`` entry (= ``to_b``) fixes its ``Aux``.
+    - W3: everything else is delivered (including the cross-class relay
+      waves) and the BVal relay rule runs to fixpoint in both views.
+    - W4: the ``Aux`` messages are delivered; each class terminates its
+      SBV instance against its fixpoint ``bin_values``.
+
+    Between W1 and W3 correct nodes in different classes hold
+    *different* ``bin_values`` — the state the uniform engine cannot
+    represent (VERDICT r3 item 4).  Epoch 0's coin is fixed ``true``
+    (``agreement.rs:314``), so no Conf exchange occurs and the epoch
+    outcome is decided per class from its SBV output; from epoch 1 the
+    schedule reverts to prompt uniform delivery with per-node
+    estimates (already supported by the array engine).
+    """
+
+    class_a: frozenset  # correct node ids in class A (rest of live = B)
+    equiv: Any  # Dict[sender id → (bool to_a, bool to_b)]
+    instances: frozenset  # affected instance ids
 
 
 class VectorizedAgreement:
@@ -136,12 +176,178 @@ class VectorizedAgreement:
             mock = not isinstance(ref.secret_key_share, T.SecretKeyShare)
         self.mock = mock
 
+    def _divergent_epoch0(self, est0, div: DivergentEpoch0, live):
+        """Evaluate one instance's epoch 0 under the two-class wave
+        template (class docstring), with exact SBV thresholds.
+
+        Returns ``(decided, est1)``: ``decided`` is the bool every
+        correct node decided at epoch 0 (or None), ``est1`` the
+        per-node epoch-1 estimates otherwise.  Raises ``ValueError``
+        when the schedule is invalid, non-divergent, or would leave
+        the two classes with different decision *timing* (a state the
+        scalar per-instance bookkeeping cannot represent)."""
+        f, N = self.f, self.N
+        equiv = dict(div.equiv)
+        honest = list(live)  # caller's run-local live, minus equiv
+        A = [nid for nid in honest if nid in div.class_a]
+        B = [nid for nid in honest if nid not in div.class_a]
+        if not A or not B:
+            raise ValueError("divergent classes must both be non-empty")
+        v_bs = {bool(tb) for _, tb in equiv.values()}
+        if len(v_bs) != 1:
+            raise ValueError("equivocators must share one to_b value")
+        v_b = v_bs.pop()
+        v_a = not v_b
+        estv = {
+            nid: bool(est0[nid]) if isinstance(est0, dict) else bool(est0)
+            for nid in honest
+        }
+
+        # sent_bval state: est counts as sent (sbv_broadcast.rs dedup)
+        sent: Dict[Any, Set[bool]] = {nid: {estv[nid]} for nid in honest}
+
+        def cnt(equiv_val_for_class):
+            """#distinct senders of each value visible: honest nodes
+            whose sent-set holds it + the equivocator votes this class
+            sees."""
+            return {
+                v: sum(1 for nid in honest if v in sent[nid])
+                + sum(
+                    1
+                    for votes in equiv.values()
+                    if equiv_val_for_class(votes) == v
+                )
+                for v in (False, True)
+            }
+
+        # -- W1: class A prompt view (v_b-valued relays withheld) -------
+        # visible: every honest est vote + equiv to_a votes + A's own
+        # v_a relays.  Guard: no A-member may want to relay v_b (its
+        # relay would be visible only to itself — per-node divergence
+        # inside a class, which the template forbids).
+        def cnt_a():
+            return cnt(lambda votes: bool(votes[0]))
+
+        changed = True
+        while changed:
+            changed = False
+            c = cnt_a()
+            if c[v_b] >= f + 1:
+                raise ValueError(
+                    "schedule invalid: class A reaches the relay "
+                    "threshold for the withheld value in wave 1"
+                )
+            if c[v_a] >= f + 1:
+                for nid in A:
+                    if v_a not in sent[nid]:
+                        sent[nid].add(v_a)
+                        changed = True
+        c = cnt_a()
+        if not (c[v_a] >= 2 * f + 1 and c[v_b] < 2 * f + 1):
+            raise ValueError(
+                "schedule non-divergent: class A's first bin_values "
+                "entry is not the prompt value"
+            )
+        aux_a = v_a
+
+        # -- W2: class B early view.  The template withholds EVERY
+        # v_a-valued BVal addressed to a B member (including B→B
+        # copies — the sequential partition filter holds them too), so
+        # the only v_a count any B node holds is its own self-handled
+        # est vote: 1 < f+1 ≤ 2f+1 for every f ≥ 1.  v_a can therefore
+        # never relay or enter bin_values early in B, no symmetric W1
+        # guard is needed, and B's first entry is v_b by construction
+        # (asserted below by the cascade check).
+        def cnt_b_early():
+            return sum(
+                1 for nid in honest if v_b in sent[nid]
+            ) + len(equiv)
+
+        changed = True
+        while changed:
+            changed = False
+            if cnt_b_early() >= f + 1:
+                for nid in B:
+                    if v_b not in sent[nid]:
+                        sent[nid].add(v_b)
+                        changed = True
+        if cnt_b_early() < 2 * f + 1:
+            raise ValueError(
+                "schedule non-divergent: class B's early cascade "
+                "never reaches bin_values"
+            )
+        aux_b = v_b
+
+        # -- W3: full delivery (equiv cross-votes excepted), joint
+        # relay fixpoint over both views ------------------------------
+        def cnt_x(is_a: bool):
+            return cnt(
+                (lambda votes: bool(votes[0]))
+                if is_a
+                else (lambda votes: v_b)
+            )
+
+        changed = True
+        while changed:
+            changed = False
+            for is_a, members in ((True, A), (False, B)):
+                c = cnt_x(is_a)
+                for v in (False, True):
+                    if c[v] >= f + 1:
+                        for nid in members:
+                            if v not in sent[nid]:
+                                sent[nid].add(v)
+                                changed = True
+        bins = {}
+        for is_a in (True, False):
+            c = cnt_x(is_a)
+            bins[is_a] = {v for v in (False, True) if c[v] >= 2 * f + 1}
+
+        # -- W4: Aux delivery and SBV termination ----------------------
+        aux_senders = {v: 0 for v in (False, True)}
+        aux_senders[aux_a] += len(A)
+        aux_senders[aux_b] += len(B)
+        outcome = {}
+        for is_a in (True, False):
+            bv = bins[is_a]
+            count = sum(aux_senders[v] for v in bv if aux_senders[v])
+            if count < N - f:
+                raise ValueError(
+                    "schedule stalls: SBV cannot terminate in class "
+                    + ("A" if is_a else "B")
+                )
+            vals = {v for v in bv if aux_senders[v]}
+            definite = next(iter(vals)) if len(vals) == 1 else None
+            # epoch 0 coin is fixed true; no Conf round
+            # (agreement.rs:314, _handle_sbvb_step with decided coin)
+            if definite is True:
+                outcome[is_a] = ("decide", True)
+            else:
+                outcome[is_a] = (
+                    "continue",
+                    definite if definite is not None else True,
+                )
+        kinds = {k for k, _ in outcome.values()}
+        if kinds == {"decide"}:
+            return True, None
+        if "decide" in kinds:
+            raise ValueError(
+                "schedule leads to per-class decision divergence at "
+                "epoch 0 — not representable by the scalar per-"
+                "instance epoch bookkeeping"
+            )
+        est1 = {}
+        for nid in honest:
+            est1[nid] = outcome[nid in div.class_a][1]
+        return None, est1
+
     def run(
         self,
         est0: Dict[Any, Any],
         adv_bval: Optional[Dict[Any, Tuple[int, int]]] = None,
         adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
         forged_coin: Optional[Set[Any]] = None,
+        divergent: Optional[DivergentEpoch0] = None,
     ) -> AgreementResult:
         """Run every instance to its decision.
 
@@ -173,13 +379,42 @@ class VectorizedAgreement:
                     "dead + forged_coin Byzantine nodes exceed the "
                     f"f={self.f} bound"
                 )
+        diverged = False
+        live = list(self.live)  # run-local: never mutate instance state
+        div_pre: Dict[Any, Tuple[Optional[bool], Optional[Dict]]] = {}
+        if divergent is not None:
+            equiv_ids = set(divergent.equiv)
+            if equiv_ids & self.dead:
+                raise ValueError("equivocators cannot also be dead")
+            if len(self.dead | equiv_ids | forged_coin) > self.f:
+                raise ValueError(
+                    "dead + equivocating + coin-forging Byzantine "
+                    f"nodes exceed the f={self.f} bound"
+                )
+            if set(divergent.instances) - set(self.instance_ids):
+                raise ValueError("divergent instances unknown")
+            # Equivocators speak only through their epoch-0 equivocation
+            # and are silent otherwise — for the rest of this run they
+            # are absent senders, exactly like SilentAdversary nodes.
+            live = [nid for nid in live if nid not in equiv_ids]
+            for iid in sorted(divergent.instances):
+                div_pre[iid] = self._divergent_epoch0(
+                    est0[iid], divergent, live
+                )
+            diverged = True
         P, N, f = self.P, self.N, self.f
-        n_live = len(self.live)
-        live_idx = {nid: i for i, nid in enumerate(self.live)}
+        n_live = len(live)
+        live_idx = {nid: i for i, nid in enumerate(live)}
 
         # est[p, j]: estimate of live node j in instance p
         est = np.zeros((P, n_live), dtype=np.int8)
         for p, iid in enumerate(self.instance_ids):
+            if iid in div_pre:
+                _, est1 = div_pre[iid]
+                if est1 is not None:
+                    for nid, b in est1.items():
+                        est[p, live_idx[nid]] = 1 if b else 0
+                continue
             v = est0[iid]
             if isinstance(v, dict):
                 for nid, b in v.items():
@@ -203,6 +438,13 @@ class VectorizedAgreement:
         epoch = np.zeros(P, dtype=np.int64)
         decided = np.full(P, -1, dtype=np.int8)
         decided_at = np.zeros(P, dtype=np.int64)
+        for p, iid in enumerate(self.instance_ids):
+            if iid in div_pre:
+                dec, _ = div_pre[iid]
+                if dec is not None:  # decided by every class at epoch 0
+                    decided[p] = 1 if dec else 0
+                else:  # rejoin the uniform engine at epoch 1
+                    epoch[p] = 1
         coin_flips = 0
         flushes = 0
         faults = FaultLog()
@@ -263,6 +505,7 @@ class VectorizedAgreement:
                     ],
                     faults,
                     forged=forged_coin,
+                    live=live,
                 )
                 flushes += nfl
                 coin_flips += len(real_ps)
@@ -298,6 +541,7 @@ class VectorizedAgreement:
             coin_flips=coin_flips,
             crypto_flushes=flushes,
             fault_log=faults,
+            diverged=diverged,
         )
 
     # -- batched real coin --------------------------------------------------
@@ -307,6 +551,7 @@ class VectorizedAgreement:
         nonces: List[Tuple[int, bytes]],
         faults: FaultLog,
         forged: Optional[Set[Any]] = None,
+        live: Optional[List[Any]] = None,
     ) -> Tuple[Dict[int, bool], int]:
         """One coin flip per (instance, nonce) — all instances' share
         verifications fused into a single RLC flush (grouped by nonce
@@ -315,6 +560,7 @@ class VectorizedAgreement:
         senders submit a wrong G1 point instead of their signature
         share (``run(forged_coin=...)``)."""
         forged = forged or set()
+        live = self.live if live is None else live
         pk_set = self.ref.public_key_set
         out: Dict[int, bool] = {}
         if self.mock:
@@ -323,7 +569,7 @@ class VectorizedAgreement:
                     self.ref.node_index(nid): self.netinfos[
                         nid
                     ].secret_key_share.sign(nonce)
-                    for nid in self.live
+                    for nid in live
                 }
                 sig = pk_set.combine_signatures(shares)
                 out[p] = sig.parity()
@@ -339,10 +585,10 @@ class VectorizedAgreement:
         for p, nonce in nonces:
             base = hash_to_g1(nonce, DST_SIG)
             signed = batch_sign_shares(
-                self.netinfos, self.live, nonce, base=base
+                self.netinfos, live, nonce, base=base
             )
             shares = {}
-            for nid in self.live:
+            for nid in live:
                 s = signed[nid]
                 if nid in forged:
                     # a wrong point on the curve: passes deserialization
@@ -358,7 +604,7 @@ class VectorizedAgreement:
         if not ok:  # a forged share broke the batch: per-share fallback
             for p, nonce in nonces:
                 valid = {}
-                for nid in self.live:
+                for nid in live:
                     s = per_inst[p][self.ref.node_index(nid)]
                     pk = self.ref.public_key_share(nid)
                     if self.ref.ops.verify_sig_share(pk, s, nonce):
@@ -545,6 +791,8 @@ class VectorizedHoneyBadgerSim:
         adv_bval: Optional[Dict[Any, Tuple[int, int]]] = None,
         adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
         forged_coin: Optional[Set[Any]] = None,
+        late_subset: Optional[Dict[Any, Set[Any]]] = None,
+        divergent: Optional[DivergentEpoch0] = None,
     ) -> EpochResult:
         """Advance every correct node through one complete epoch.
 
@@ -573,6 +821,16 @@ class VectorizedHoneyBadgerSim:
         ``forged_coin``: live Byzantine senders submitting forged
         threshold-coin signature shares on every real coin flip
         (``VectorizedAgreement.run`` semantics; real BLS only).
+        ``late_subset``: proposer → the set of nodes whose copy of that
+        proposer's broadcast completes BEFORE the agreement phase; the
+        rest receive it late (their agreement input is ``false``), but
+        the payload still reaches everyone eventually — the
+        subset-delivery schedule of the reference's asynchronous
+        network (``common_subset.rs``: each node inputs its agreement
+        when ITS broadcast instance outputs).
+        ``divergent``: a two-class epoch-0 schedule for the agreement
+        phase (``DivergentEpoch0``); its equivocators are silent in
+        every other phase (decryption treats them like ``dead``).
         """
         dead = set(dead or set())
         late = set(late or set())
@@ -612,6 +870,8 @@ class VectorizedHoneyBadgerSim:
             adv_bval=adv_bval,
             adv_aux=adv_aux,
             forged_coin=forged_coin,
+            late_subset=late_subset,
+            divergent=divergent,
             walls_head={"propose": _t_prop - _t0, "rbc": _t_rbc - _t_prop},
             diag=diag,
         )
@@ -629,6 +889,8 @@ class VectorizedHoneyBadgerSim:
         adv_bval: Optional[Dict[Any, Tuple[int, int]]] = None,
         adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
         forged_coin: Optional[Set[Any]] = None,
+        late_subset: Optional[Dict[Any, Set[Any]]] = None,
+        divergent: Optional[DivergentEpoch0] = None,
         walls_head: Optional[Dict[str, float]] = None,
         diag: Optional[Dict[str, bool]] = None,
     ) -> "EpochResult":
@@ -657,6 +919,21 @@ class VectorizedHoneyBadgerSim:
                 "fewer than N−f broadcasts delivered — common subset "
                 f"cannot terminate on this schedule ({hint})"
             )
+        late_subset = late_subset or {}
+        if set(late_subset) - set(delivered):
+            raise ValueError(
+                "late_subset proposers must have completed their "
+                "broadcast (they deliver late, not never)"
+            )
+        est0: Dict[Any, Any] = {}
+        for pid in self.netinfos:
+            if pid in late_subset:
+                subset = late_subset[pid]
+                est0[pid] = {
+                    nid: (nid in subset) for nid in self.netinfos
+                }
+            else:
+                est0[pid] = pid in delivered
         ag = VectorizedAgreement(
             self.netinfos,
             self.epoch,
@@ -665,12 +942,17 @@ class VectorizedHoneyBadgerSim:
             mock=self.mock,
         )
         res = ag.run(
-            {pid: (pid in delivered) for pid in self.netinfos},
+            est0,
             adv_bval=adv_bval,
             adv_aux=adv_aux,
             forged_coin=forged_coin,
+            divergent=divergent,
         )
         faults.merge(res.fault_log)
+        # divergent equivocators are Byzantine: silent in every later
+        # phase, exactly like dead nodes
+        if divergent is not None:
+            dead = dead | set(divergent.equiv)
         accepted = sorted(pid for pid, yes in res.decisions.items() if yes)
 
         _t_agree = _time.perf_counter()

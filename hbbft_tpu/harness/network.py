@@ -277,10 +277,19 @@ class TestNetwork:
         if node is not self.observer:
             self._note_obs(node, sender_id, message)
 
-    def release_held(self) -> None:
-        """Deliver every held message (the adversary's delays are
-        finite; call this to model their eventual arrival)."""
-        held, self.held_messages = self.held_messages, []
+    def release_held(self, predicate=None) -> None:
+        """Deliver held messages (the adversary's delays are finite;
+        call this to model their eventual arrival).  ``predicate(sender,
+        recipient, message)`` releases only the matching subset — the
+        staged-wave schedules of the partition adversaries (divergent-
+        view tests) release one wave at a time."""
+        if predicate is None:
+            held, self.held_messages = self.held_messages, []
+        else:
+            held, kept = [], []
+            for m in self.held_messages:  # one predicate call per message
+                (held if predicate(*m) else kept).append(m)
+            self.held_messages = kept
         for sender_id, recipient, message in held:
             node = (
                 self.observer

@@ -225,6 +225,52 @@ class TpuBackend(CpuBackend):
             return super().g2_msm(points, scalars)
         return ec_jax.g2_msm(points, scalars)
 
+    # -- product-form MSM ---------------------------------------------------
+
+    def g1_ship(self, points):
+        """Start the packed-wire transfer early (overlaps the caller's
+        transcript hashing — the flush ships points the moment they are
+        serialized).  Falls through to the plain list when the batch
+        would not route to the device anyway."""
+        points = list(points)
+        if (
+            self.mesh is None
+            and points
+            and self._g1_in_device_band(len(points))
+        ):
+            import jax
+
+            if jax.default_backend() == "tpu":
+                from . import packed_msm
+
+                return packed_msm.ship_points(points)
+        return points
+
+    def g1_msm_product_async(self, points, s_coeffs, t_coeffs, group_sizes):
+        from . import packed_msm
+
+        pts_list = (
+            points.points
+            if isinstance(points, packed_msm.ShippedPoints)
+            else list(points)
+        )
+        if (
+            self.mesh is None
+            and pts_list
+            and self._g1_in_device_band(len(pts_list))
+        ):
+            import jax
+
+            if jax.default_backend() == "tpu":
+                fin = packed_msm.g1_msm_product_async(
+                    points, s_coeffs, t_coeffs, group_sizes
+                )
+                if fin is not None:
+                    return fin
+        return super().g1_msm_product_async(
+            pts_list, s_coeffs, t_coeffs, group_sizes
+        )
+
     # -- batched share verification ---------------------------------------
 
     def batch_verify_shares(

@@ -36,6 +36,7 @@ bit-identical to the host path (asserted in ``tests/test_packed.py``).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
@@ -137,39 +138,108 @@ def _le_bits_to_limbs(le_bits: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(p * w, axis=-1, dtype=jnp.int32)
 
 
+def _assemble_points(
+    xl: jnp.ndarray, yl: jnp.ndarray, ident: jnp.ndarray
+) -> jnp.ndarray:
+    """(x, y) limbs + identity mask → [Kp, 3, L] projective points,
+    with flagged rows (infinity encodings, bucket padding) set to the
+    projective identity (0 : 1 : 0) — the one home for that encoding
+    across the compressed and uncompressed unpack paths."""
+    L = LB.FQ_LIMBS
+    Kp = xl.shape[0]
+    one = jnp.zeros((L,), jnp.int32).at[0].set(1)
+    yl = jnp.where(ident[:, None], one[None, :], yl)
+    xl = jnp.where(ident[:, None], jnp.int32(0), xl)
+    zl = jnp.zeros((Kp, L), jnp.int32).at[:, 0].set(
+        jnp.where(ident, 0, 1).astype(jnp.int32)
+    )
+    return jnp.stack([xl, yl, zl], axis=1)
+
+
+def _scalar_digits(sc_u8: jnp.ndarray) -> jnp.ndarray:
+    """[Kp, nb] scalar bytes → [Kp, 2·nb] 4-bit window digits."""
+    Kp, nb = sc_u8.shape
+    sbits = _bytes_to_bits_msb(sc_u8.astype(jnp.int32))
+    d = sbits.reshape(Kp, nb * 2, 4)
+    return (
+        (d[..., 0] << 3) | (d[..., 1] << 2) | (d[..., 2] << 1) | d[..., 3]
+    )
+
+
+def _tile_layout(pts: jnp.ndarray, dig: jnp.ndarray):
+    """[Kp, 3, L] + [Kp, nwin] → the kernel's ([G, 3, L, T], [G, nwin,
+    T]) tile-transposed layout."""
+    T = pallas_ec.TILE
+    Kp, _, L = pts.shape
+    nwin = dig.shape[1]
+    G = Kp // T
+    pts_t = pts.reshape(G, T, 3, L).transpose(0, 2, 3, 1)
+    dig_t = dig.reshape(G, T, nwin).transpose(0, 2, 1)
+    return pts_t, dig_t
+
+
+def _sqrt_chain(w: jnp.ndarray) -> jnp.ndarray:
+    """Batched square root in Fq: w^((p+1)/4) over [..., L] limbs
+    (valid because p ≡ 3 mod 4; w must be a quadratic residue, which
+    every x³+4 of an on-curve point is).  A fixed 379-bit
+    square-and-multiply — ~570 field muls, fully data-independent."""
+    f = LB.fq()
+    e = (LB.P + 1) // 4
+    bits = bin(e)[2:]  # msb-first, leading bit 1
+    acc = w
+    for b in bits[1:]:
+        acc = f.mul(acc, acc)
+        if b == "1":
+            acc = f.mul(acc, w)
+    return acc
+
+
+def _unpack_fn_compressed(
+    x_u8: jnp.ndarray, meta_u8: jnp.ndarray, sc_u8: jnp.ndarray
+):
+    """Compressed-wire unpack: [Kp, 48] x-bytes + [2, Kp/8] packed
+    meta bits (row 0: y parity, row 1: infinity/padding flag) +
+    [Kp, nb] scalar bytes → the kernel's (pts_t, dig_t) layout.
+
+    y is RECOVERED on device (y = sqrt(x³+4), sign-corrected against
+    the parity bit) — the tunnel ships 48+¼ bytes per point instead of
+    96, and the sqrt chain costs a fraction of the windowed kernel's
+    scan (measured r4).  Only points this process serialized itself
+    are shipped compressed (always on-curve), so the root always
+    exists."""
+    L = LB.FQ_LIMBS
+    f = LB.fq()
+    Kp = x_u8.shape[0]
+
+    xb = _bytes_to_bits_msb(x_u8.astype(jnp.int32))  # [Kp, 384]
+    xl = _le_bits_to_limbs(jnp.flip(xb, axis=1))
+    meta_bits = _bytes_to_bits_msb(meta_u8.astype(jnp.int32))  # [2, Kp]
+    parity = meta_bits[0, :Kp]
+    ident = meta_bits[1, :Kp].astype(bool)
+
+    four = jnp.zeros((L,), jnp.int32).at[0].set(4)
+    w = f.add(f.mul(f.mul(xl, xl), xl), four[None, :])
+    yl = _sqrt_chain(w)
+    # canonicalize to read the true parity bit, negate where it differs
+    y_canon = f.canon(yl)
+    neg = (y_canon[:, 0] & 1) != parity
+    yl = jnp.where(neg[:, None], f.neg(y_canon), y_canon)
+    pts = _assemble_points(xl, yl, ident)
+    return _tile_layout(pts, _scalar_digits(sc_u8))
+
+
 def _unpack_fn(pts_u8: jnp.ndarray, sc_u8: jnp.ndarray):
     """[Kp, 96] u8 + [Kp, nb] u8 → (pts_t [G, 3, L, T], dig_t [G, nwin, T]).
 
     All-zero point rows (the ``native.g1_wire`` infinity encoding, and
     the bucket padding) become the projective identity (0 : 1 : 0).
     """
-    L = LB.FQ_LIMBS
-    T = pallas_ec.TILE
-    Kp = pts_u8.shape[0]
-    nb = sc_u8.shape[1]
-    nwin = nb * 2
-    G = Kp // T
-
     b = _bytes_to_bits_msb(pts_u8.astype(jnp.int32))  # [Kp, 768]
     xl = _le_bits_to_limbs(jnp.flip(b[:, :384], axis=1))
     yl = _le_bits_to_limbs(jnp.flip(b[:, 384:], axis=1))
     ident = jnp.all(pts_u8 == 0, axis=1)
-    one = jnp.zeros((L,), jnp.int32).at[0].set(1)
-    yl = jnp.where(ident[:, None], one[None, :], yl)
-    zl = jnp.zeros((Kp, L), jnp.int32).at[:, 0].set(
-        jnp.where(ident, 0, 1).astype(jnp.int32)
-    )
-    pts = jnp.stack([xl, yl, zl], axis=1)  # [Kp, 3, L]
-
-    sbits = _bytes_to_bits_msb(sc_u8.astype(jnp.int32))  # [Kp, nb*8]
-    d = sbits.reshape(Kp, nwin, 4)
-    dig = (
-        (d[..., 0] << 3) | (d[..., 1] << 2) | (d[..., 2] << 1) | d[..., 3]
-    )
-
-    pts_t = pts.reshape(G, T, 3, L).transpose(0, 2, 3, 1)
-    dig_t = dig.reshape(G, T, nwin).transpose(0, 2, 1)
-    return pts_t, dig_t
+    pts = _assemble_points(xl, yl, ident)
+    return _tile_layout(pts, _scalar_digits(sc_u8))
 
 
 @functools.lru_cache(maxsize=None)
@@ -183,6 +253,19 @@ def _unpack_device(dev_pts, dev_sc):
             "unpack_g1_v1", _unpack_fn, dev_pts, dev_sc
         )
     return _unpack_jit()(dev_pts, dev_sc)
+
+
+@functools.lru_cache(maxsize=None)
+def _unpack_compressed_jit():
+    return jax.jit(_unpack_fn_compressed)
+
+
+def _unpack_compressed_device(dev_x, dev_meta, dev_sc):
+    if jax.default_backend() == "tpu":
+        return pallas_ec.cached_compiled(
+            "unpack_g1c_v1", _unpack_fn_compressed, dev_x, dev_meta, dev_sc
+        )
+    return _unpack_compressed_jit()(dev_x, dev_meta, dev_sc)
 
 
 def _msm_chunk_device(pts_u8, sc_u8, interpret: bool):
@@ -259,3 +342,189 @@ def g1_msm_packed(
 ) -> Any:
     """Blocking wrapper around :func:`g1_msm_packed_async`."""
     return g1_msm_packed_async(points, scalars, nbits, interpret)()
+
+
+# ---------------------------------------------------------------------------
+# Factored product-form MSM: Σ_g t_g · (Σ_{i∈g} sᵢ·Pᵢ)
+# ---------------------------------------------------------------------------
+# The fused flush's aggregate (``backend.g1_msm_product_async``
+# contract).  The device evaluates the factored form directly: one
+# 96-bit-scalar kernel pass (24 windows — HALF the 192-bit product
+# width), a per-group tree reduction on device, then the tiny t-MSM
+# over the G group sums on host.  A scan kernel pays per-point
+# doublings per window, so halving the window count halves its
+# dominant cost — structure host Pippenger cannot exploit.
+
+_S_BITS = 96  # product-form sender coefficients (batching.py coeff())
+
+
+def _use_compressed() -> bool:
+    """Compressed 48-byte-x transfer with on-device y recovery — the
+    default on real hardware (the tunnel is the bottleneck, measured
+    r4); ``HBBFT_TPU_COMPRESS=0`` forces the 96-byte path."""
+    return os.environ.get("HBBFT_TPU_COMPRESS", "1") != "0"
+
+
+class ShippedPoints:
+    """Points already marshalled and (asynchronously) in flight to the
+    device — ``backend.g1_ship``'s handle.  Keeps the host list so any
+    fallback path can still reach the original objects.
+
+    In compressed mode only the x coordinates cross the tunnel, plus
+    two packed bit-rows (y parity, infinity flag); y is recovered on
+    device.  The transfer starts ONLY for shapes the factored product
+    path accepts (total exactly on a tile bucket, one chunk) — for
+    anything else the bytes would be re-shipped with different padding
+    by whichever path ends up running, doubling the flush's dominant
+    data movement, so only the host marshalling is done eagerly."""
+
+    def __init__(self, points: List[Any]):
+        self.points = points
+        self.wires = g1_wires_batch(points)
+        self.compressed = (
+            _use_compressed() and jax.default_backend() == "tpu"
+        )
+        self.dev = None
+        self.dev_meta = None
+        k = len(points)
+        self.kp = _bucket_rows(k)
+        if (
+            jax.default_backend() == "tpu"
+            and self.kp == k
+            and k <= _MAX_CHUNK
+        ):
+            if self.compressed:
+                x, meta = compress_rows(self.wires, self.kp)
+                self.dev = jax.device_put(x)
+                self.dev_meta = jax.device_put(meta)
+            else:
+                self.dev = jax.device_put(self.wires)
+
+
+def compress_rows(wires: np.ndarray, kp: int) -> tuple:
+    """[k, 96] wires → ([kp, 48] x bytes, [2, kp/8] packed meta bits).
+    Padding rows (k..kp) are flagged infinity.  Meta row 0 is y parity
+    (last wire byte & 1), row 1 the infinity/padding flag (all-zero
+    wire — ``native.g1_wire``'s encoding)."""
+    k = wires.shape[0]
+    x = np.zeros((kp, 48), dtype=np.uint8)
+    x[:k] = wires[:, :48]
+    parity = np.zeros(kp, dtype=np.uint8)
+    parity[:k] = wires[:, 95] & 1
+    inf = np.ones(kp, dtype=np.uint8)
+    inf[:k] = (wires == 0).all(axis=1)
+    meta = np.stack([np.packbits(parity), np.packbits(inf)])
+    return x, meta
+
+
+def ship_points(points: Sequence[Any]) -> ShippedPoints:
+    return ShippedPoints(list(points))
+
+
+def _group_tree(prods: jnp.ndarray, n_groups: int) -> jnp.ndarray:
+    """[K, 3, L] (group-major, uniform group size) → [G, 3, L]: one
+    log₂ tree per group, all groups in parallel (the group axis rides
+    the kernel's batch dims)."""
+    from . import ec_jax
+
+    K = prods.shape[0]
+    n = K // n_groups
+    kern = ec_jax.g1_kernel()
+    x = jnp.swapaxes(
+        prods.reshape(n_groups, n, *prods.shape[1:]), 0, 1
+    )  # [n, G, 3, L]
+    m = 1
+    while m < n:
+        m <<= 1
+    if m != n:
+        x = jnp.concatenate(
+            [x, kern.identity((m - n, n_groups))], axis=0
+        )
+    while x.shape[0] > 1:
+        h = x.shape[0] // 2
+        x = kern.add(x[:h], x[h:])
+    return x[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _group_tree_jit():
+    return jax.jit(_group_tree, static_argnums=(1,))
+
+
+def _group_tree_device(prods, n_groups: int):
+    if jax.default_backend() == "tpu":
+        return pallas_ec.cached_compiled(
+            "gtree_g1_%d" % n_groups,
+            functools.partial(_group_tree, n_groups=n_groups),
+            prods,
+        )
+    return _group_tree_jit()(prods, n_groups)
+
+
+def g1_msm_product_async(
+    points,
+    s_coeffs: Sequence[int],
+    t_coeffs: Sequence[int],
+    group_sizes: Sequence[int],
+    interpret: Optional[bool] = None,
+) -> Optional[Callable[[], Any]]:
+    """Factored-form device MSM (``backend.g1_msm_product_async``
+    semantics).  Returns ``None`` when the batch shape does not fit the
+    device layout — non-uniform group sizes, or a total that does not
+    land exactly on a tile bucket (identity padding rows would bleed
+    into the last group's tree) — and the caller falls back to the
+    flat path.
+
+    Exactness: equal to the flat ``Σ (sᵢ·t_g mod r)·Pᵢ`` on r-torsion
+    points (scalars act mod r there); see the backend docstring for
+    the off-subgroup discussion."""
+    from ..crypto.backend import CpuBackend
+    from . import ec_jax
+
+    shipped = points if isinstance(points, ShippedPoints) else None
+    pts_list = shipped.points if shipped else list(points)
+    k = len(pts_list)
+    sizes = set(group_sizes)
+    if not pts_list or len(sizes) != 1:
+        return None
+    n = sizes.pop()
+    n_groups = len(group_sizes)
+    if n * n_groups != k or _bucket_rows(k) != k or k > _MAX_CHUNK:
+        return None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    nb = _S_BITS // 8
+    dev_sc = jax.device_put(scalar_bytes_batch(s_coeffs, nb))
+    if (
+        shipped is not None
+        and shipped.compressed
+        and shipped.dev is not None
+        and shipped.kp == k
+    ):
+        pts_t, dig_t = _unpack_compressed_device(
+            shipped.dev, shipped.dev_meta, dev_sc
+        )
+    elif shipped is not None and shipped.dev is not None and shipped.kp == k:
+        pts_t, dig_t = _unpack_device(shipped.dev, dev_sc)
+    else:
+        wires = shipped.wires if shipped else g1_wires_batch(pts_list)
+        if _use_compressed() and not interpret:
+            x, meta = compress_rows(wires, k)
+            pts_t, dig_t = _unpack_compressed_device(
+                jax.device_put(x), jax.device_put(meta), dev_sc
+            )
+        else:
+            pts_t, dig_t = _unpack_device(jax.device_put(wires), dev_sc)
+    out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
+    prods = pallas_ec._untile(out_t, k, k)
+    gsums = _group_tree_device(prods, n_groups)
+
+    t_list = list(t_coeffs)
+
+    def finalize():
+        arr = np.asarray(gsums)
+        group_pts = [ec_jax.g1_from_limbs(arr[i]) for i in range(n_groups)]
+        return CpuBackend().g1_msm(group_pts, t_list)
+
+    return finalize

@@ -421,13 +421,16 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
         jax.__version__,
         jax.devices()[0].device_kind,
     )
-    fname = (
-        "-".join(str(p) for p in key).replace(" ", "").replace("/", "_")
-        + ".palexe"
-    )
-    path = os.path.join(_exec_cache_dir(), fname)
+    def exec_path() -> str:
+        fname = (
+            "-".join(str(p) for p in key).replace(" ", "").replace("/", "_")
+            + ".palexe"
+        )
+        return os.path.join(_exec_cache_dir(), fname)
+
     loaded = _EXEC_MEM.get(key)
     if loaded is None:
+        path = exec_path()
         if os.path.exists(path):
             try:
                 from jax.experimental.serialize_executable import (
@@ -451,7 +454,7 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
         # jnp arrays were hidden const-inputs): recompile and replace
         compiled = jax.jit(fn).lower(*args).compile()
         _EXEC_MEM[key] = compiled
-        _save_exec(compiled, path)
+        _save_exec(compiled, exec_path())
         return compiled(*args)
 
 

@@ -139,6 +139,103 @@ def test_packed_msm_empty_and_zero_scalars(host_kernel):
     assert got == G1.infinity()
 
 
+def test_compressed_unpack_matches_uncompressed():
+    # 48-byte-x + parity/infinity bits must reconstruct exactly the
+    # limb layout of the 96-byte path (device sqrt + sign correction)
+    rng = random.Random(61)
+    k = 128
+    pts = _random_points(rng, k)  # includes one infinity
+    scalars = [rng.getrandbits(16) for _ in range(k)]
+    wires = packed_msm.g1_wires_batch(pts)
+    sc = packed_msm.scalar_bytes_batch(scalars, 2)
+    x, meta = packed_msm.compress_rows(wires, k)
+    ref_pts_t, ref_dig_t = packed_msm._unpack_fn(wires, sc)
+    got_pts_t, got_dig_t = packed_msm._unpack_fn_compressed(x, meta, sc)
+    # limb forms may differ (canonical vs redundant); compare points
+    from hbbft_tpu.ops import ec_jax
+
+    ref = np.asarray(ref_pts_t)
+    got = np.asarray(got_pts_t)
+    assert np.array_equal(np.asarray(got_dig_t), np.asarray(ref_dig_t))
+    G, _, L, T = ref.shape
+    for g in range(G):
+        for t in range(0, T, 17):  # sample lanes
+            a = ec_jax.g1_from_limbs(ref[g, :, :, t])
+            b = ec_jax.g1_from_limbs(got[g, :, :, t])
+            assert a == b, (g, t)
+
+
+def test_product_async_default_matches_flat():
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto import fields as F
+
+    rng = random.Random(41)
+    be = CpuBackend()
+    pts = _random_points(rng, 6, with_inf=False)
+    s = [rng.getrandbits(96) | 1 for _ in range(6)]
+    ts = [rng.getrandbits(96) | 1 for _ in range(2)]
+    fin = be.g1_msm_product_async(pts, s, ts, [3, 3])
+    flat = [
+        (s[i] * ts[g]) % F.R for g in range(2) for i in (3 * g, 3 * g + 1, 3 * g + 2)
+    ]
+    assert fin() == be.g1_msm(pts, flat)
+
+
+def test_packed_product_shape_fallbacks():
+    rng = random.Random(43)
+    pts = _random_points(rng, 6, with_inf=False)
+    s = [1] * 6
+    # non-uniform group sizes → None
+    assert packed_msm.g1_msm_product_async(pts, s, [1, 1], [2, 4]) is None
+    # total not on a tile-bucket boundary (6 != bucket_rows(6)) → None
+    assert packed_msm.g1_msm_product_async(pts, s, [1, 1, 1], [2, 2, 2]) is None
+    assert packed_msm.g1_msm_product_async([], [], [], []) is None
+
+
+def test_packed_product_matches_flat(host_kernel):
+    # uniform 2×128 groups: k = 256 lands exactly on the tile bucket,
+    # so the factored device layout applies (group trees + host t-MSM)
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto import fields as F
+
+    rng = random.Random(47)
+    k, G = 256, 2
+    base_pts = _random_points(rng, k, with_inf=True)
+    s = [rng.getrandbits(16) | 1 for _ in range(k)]
+    ts = [rng.getrandbits(16) | 1 for _ in range(G)]
+    sizes = [k // G] * G
+    fin = packed_msm.g1_msm_product_async(
+        base_pts, s, ts, sizes, interpret=True
+    )
+    assert fin is not None
+    n = k // G
+    flat = [
+        (s[g * n + i] * ts[g]) % F.R for g in range(G) for i in range(n)
+    ]
+    assert fin() == CpuBackend().g1_msm(base_pts, flat)
+
+
+def test_shipped_points_passthrough_cpu():
+    # on CPU g1_ship returns the plain list; the TpuBackend product
+    # seam still routes through the flat default and stays correct
+    from hbbft_tpu.ops.backend_tpu import TpuBackend
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto import fields as F
+
+    rng = random.Random(53)
+    be = TpuBackend()
+    be.G1_DEVICE_MIN = 0
+    be.G1_DEVICE_MAX = 1 << 62
+    pts = _random_points(rng, 4, with_inf=False)
+    shipped = be.g1_ship(pts)
+    assert shipped == pts  # no device in CPU tests
+    s = [3, 5, 7, 9]
+    ts = [11, 13]
+    fin = be.g1_msm_product_async(shipped, s, ts, [2, 2])
+    flat = [(s[0] * 11) % F.R, (s[1] * 11) % F.R, (s[2] * 13) % F.R, (s[3] * 13) % F.R]
+    assert fin() == CpuBackend().g1_msm(pts, flat)
+
+
 def test_backend_async_finalizer_cpu_route():
     """On CPU the TpuBackend async seam must fall back to the XLA limb
     path and still return correct results through the finalizer."""
