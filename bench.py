@@ -107,32 +107,16 @@ def bench_headline(k: int = 65536, iters: int = 3):
             )
         return obs
 
-    # device leg: routing band forced open so the packed-wire device
-    # path is exercised and measured regardless of shipping policy.
-    # MIN stays above the flush's tiny per-class base MSMs (~64
-    # points) — those are launch-latency-bound and belong on host in
-    # ANY sane device configuration.
-    device_inner = TpuBackend()
-    device_inner.G1_DEVICE_MIN = 2048
-    device_inner.G1_DEVICE_MAX = 1 << 62
-    BatchingBackend(inner=device_inner).prefetch(make_obs(b"warm"))
-    dev_dts = []
-    for i in range(iters):
-        obs = make_obs(b"epoch-%d" % i)
-        be = BatchingBackend(inner=device_inner)
-        t0 = time.perf_counter()
-        be.prefetch(obs)
-        dev_dts.append(time.perf_counter() - t0)
-        assert all(
-            be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
-            for o in obs
-        )
-        assert be.stats.fallback_items == 0
-    dev_dt = sum(dev_dts) / len(dev_dts)
+    import os
 
-    # shipping leg: the default measured routing policy (host Pippenger
-    # on this tunneled host — ops/backend_tpu.py routing table)
+    os.environ.setdefault("HBBFT_TPU_WARM", "1")  # bench may compile
+
+    # shipping leg: the default routing policy — since r4 the packed-
+    # wire device path (48 B/point compressed transfer, on-device
+    # unpack + factored 96-bit product scalars) takes the flush's
+    # G1 MSM; see ops/backend_tpu.py's measured routing table.
     ship_inner = TpuBackend()
+    BatchingBackend(inner=ship_inner).prefetch(make_obs(b"warm"))
     ship_dts = []
     for i in range(iters):
         obs = make_obs(b"ship-%d" % i)
@@ -145,11 +129,56 @@ def bench_headline(k: int = 65536, iters: int = 3):
             be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
             for o in obs
         )
+
+    # host leg: band forced shut so native host Pippenger runs the
+    # same flushes — the r3 shipping configuration, kept measured so
+    # the routing decision stays evidence-backed round over round
+    host_inner = TpuBackend()
+    host_inner.G1_DEVICE_MIN = 1 << 62
+    host_inner.G1_DEVICE_MAX = 1 << 62
+    host_dts = []
+    for i in range(iters):
+        obs = make_obs(b"host-%d" % i)
+        be = BatchingBackend(inner=host_inner)
+        t0 = time.perf_counter()
+        be.prefetch(obs)
+        host_dts.append(time.perf_counter() - t0)
+        assert be.stats.fallback_items == 0
+        assert all(
+            be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
+            for o in obs
+        )
+
+    # device-only leg: fraction forced to 1.0 so the pure device path
+    # is measured every round (the shipping leg is a hybrid; this row
+    # is the one that validates the routing-band decision)
+    dev_dts = []
+    prev_frac = os.environ.get("HBBFT_TPU_DEVICE_FRACTION")
+    os.environ["HBBFT_TPU_DEVICE_FRACTION"] = "1"
+    try:
+        for i in range(iters):
+            obs = make_obs(b"dev-%d" % i)
+            be = BatchingBackend(inner=TpuBackend())
+            t0 = time.perf_counter()
+            be.prefetch(obs)
+            dev_dts.append(time.perf_counter() - t0)
+            assert be.stats.fallback_items == 0
+            assert all(
+                be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
+                for o in obs
+            )
+    finally:
+        if prev_frac is None:
+            os.environ.pop("HBBFT_TPU_DEVICE_FRACTION", None)
+        else:
+            os.environ["HBBFT_TPU_DEVICE_FRACTION"] = prev_frac
     # the shared tunnel host shows ~1.5x run-to-run variance; the
     # median flush is the robust captured value, min/max recorded
     import statistics
 
     ship_dt = statistics.median(ship_dts)
+    host_dt = statistics.median(host_dts)
+    dev_dt = statistics.median(dev_dts)
 
     sample = 8
     ob0 = obs[:sample]
@@ -170,6 +199,8 @@ def bench_headline(k: int = 65536, iters: int = 3):
         flush_max_s=round(max(ship_dts), 2),
         device_flush_s=round(dev_dt, 2),
         device_rate=round(k / dev_dt, 1),
+        host_flush_s=round(host_dt, 2),
+        host_rate=round(k / host_dt, 1),
     )
 
 
@@ -664,6 +695,8 @@ def bench_hb_1024_real(nodes: int = 1024, epochs: int = 1, n_dead: int = 50):
     )
     from hbbft_tpu.ops.backend_tpu import TpuBackend
 
+    import statistics as _st
+
     rng = _r.Random(0x1024)
     t0 = time.perf_counter()
     sim = VectorizedHoneyBadgerSim(
@@ -671,28 +704,52 @@ def bench_hb_1024_real(nodes: int = 1024, epochs: int = 1, n_dead: int = 50):
         rng,
         mock=False,
         ops=TpuBackend(),
-        # reference simulator default profile: the virtual-time account
-        # then reports what this REAL-crypto epoch would cost on a
-        # 2 Mbit/s network (the cpu term is the measured wall)
-        hw=HwQuality.from_flags(lag_ms=100, bw_kbit_s=2000, cpu_pct=100),
     )
     setup_s = time.perf_counter() - t0
     dead = set(range(nodes - n_dead, nodes))
     contribs = {
         i: [b"real-%04d" % i] for i in range(nodes) if i not in dead
     }
-    sim.run_epoch(contribs, dead=dead)  # warm (compiles, table caches)
+    # cold first epoch: compile loads, comb tables, allocator warm-up
+    # are REAL deployment costs — reported separately, never averaged
+    # into the steady state (VERDICT r3 item 5)
     t0 = time.perf_counter()
+    res = sim.run_epoch(contribs, dead=dead)
+    cold_s = time.perf_counter() - t0
+    assert res.batch.contributions == contribs
+
+    # warm steady state, sequential epochs
+    seq_dts = []
     shares = 0
     for _ in range(epochs):
+        t0 = time.perf_counter()
         res = sim.run_epoch(contribs, dead=dead)
+        seq_dts.append(time.perf_counter() - t0)
         assert res.batch.contributions == contribs
         shares += res.shares_verified
-    dt = (time.perf_counter() - t0) / epochs
+    warm_dt = _st.median(seq_dts)
+
+    # pipelined epochs: two in flight (run_epochs — epoch e+1's
+    # broadcast under epoch e's decryption flush; VERDICT r3 item 7)
+    t0 = time.perf_counter()
+    ress = sim.run_epochs([contribs] * epochs, dead=dead)
+    pipe_dt = (time.perf_counter() - t0) / epochs
+    assert all(r.batch.contributions == contribs for r in ress)
     # the fused flush must not have silently degraded to the per-group
     # fallback (a device failure would otherwise masquerade as a
     # measurement — the round-3 OOM lesson)
     assert sim.be.stats.fallback_groups == 0, sim.be.stats
+
+    # virtual-time account: one epoch on an hw-profiled sim over the
+    # SAME keys (reference simulator default profile — what this
+    # real-crypto epoch costs on a 2 Mbit/s network)
+    vsim = VectorizedHoneyBadgerSim.from_netinfos(
+        sim.netinfos,
+        _r.Random(0x1025),
+        mock=False,
+        hw=HwQuality.from_flags(lag_ms=100, bw_kbit_s=2000, cpu_pct=100),
+    )
+    v = vsim.run_epoch(contribs, dead=dead).virtual
 
     # sequential anchor: real-BLS n=4 virtual-time sim, quadratic
     stats, wall, _ = simulate_queueing_honey_badger(
@@ -701,14 +758,20 @@ def bench_hb_1024_real(nodes: int = 1024, epochs: int = 1, n_dead: int = 50):
     )
     seq4 = len(stats.rows) / wall
     seq_est = seq4 * (4.0 / nodes) ** 2
-    v = res.virtual
+    best_dt = min(warm_dt, pipe_dt)
     return _emit(
         "hb_1024_real_s_per_epoch",
-        dt,
+        best_dt,
         "s",
-        vs_baseline=(1.0 / dt) / seq_est,
+        vs_baseline=(1.0 / best_dt) / seq_est,
         nodes=nodes,
         dead=n_dead,
+        epochs=epochs,
+        cold_s=round(cold_s, 1),
+        warm_median_s=round(warm_dt, 1),
+        warm_min_s=round(min(seq_dts), 1),
+        warm_max_s=round(max(seq_dts), 1),
+        pipelined_s=round(pipe_dt, 1),
         shares_per_epoch=shares // epochs,
         setup_s=round(setup_s, 1),
         seq4_epochs_per_s=round(seq4, 3),
@@ -983,6 +1046,213 @@ def bench_dkg_256(nodes: int = 256):
     )
 
 
+def bench_dkg_verified_256(nodes: int = 256):
+    """VERDICT r3 item 6: the FULLY-VERIFIED fused DKG at the scale
+    the elided row ships — every row check (N² cells) and every ack
+    value check (N³ cells) settled by the single trilinear-RLC G2 MSM,
+    at N=256 (degree-85 bivariate polynomials).  Also asserts the
+    elided and verified runs produce byte-identical keys (same seed),
+    closing the 'argued equivalent' → 'measured equivalent' gap at the
+    quoted scale."""
+    import random as _r
+
+    from hbbft_tpu.harness.dkg import VectorizedDkg
+
+    t = (nodes - 1) // 3
+    dkg = VectorizedDkg(list(range(nodes)), t, _r.Random(0xD8), mock=False)
+    t0 = time.perf_counter()
+    res = dkg.run(verify_honest=True)
+    dt = time.perf_counter() - t0
+    assert res.fault_log.is_empty() and len(res.complete) == nodes
+
+    # elided twin over the same seed: identical outputs
+    dkg2 = VectorizedDkg(list(range(nodes)), t, _r.Random(0xD8), mock=False)
+    t0 = time.perf_counter()
+    res2 = dkg2.run(verify_honest=False)
+    elided_dt = time.perf_counter() - t0
+    assert res.pk_set.public_key().to_bytes() == res2.pk_set.public_key().to_bytes()
+    assert all(
+        res.shares[i].scalar == res2.shares[i].scalar for i in range(nodes)
+    )
+    return _emit(
+        "dkg_verified_256_s",
+        dt,
+        "s",
+        nodes=nodes,
+        threshold=t,
+        checks=res.row_checks + res.value_checks,
+        msm_points=res.msm_points,
+        elided_twin_s=round(elided_dt, 1),
+        elided_equal=True,
+        crypto="real",
+    )
+
+
+def bench_dkg_1024(nodes: int = 1024):
+    """VERDICT r3 item 2: the dealerless DKG at the north-star N —
+    degree-341 bivariate dealing (the ``BivarPoly``/commitment work of
+    ``sync_key_gen.rs:268-299`` at SURVEY §7 scale), value grids and
+    key generation on real BLS12-381.  Honest checks elided
+    (annotated; the verification plane is measured at N=256 by
+    ``dkg_verified_256``), with a vs-sequential extrapolation from the
+    measured per-part/per-ack sequential costs at N=64."""
+    import random as _r
+
+    from hbbft_tpu.crypto import threshold as T
+    from hbbft_tpu.harness.dkg import VectorizedDkg
+    from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen
+
+    t = (nodes - 1) // 3
+    dkg = VectorizedDkg(list(range(nodes)), t, _r.Random(0xDA), mock=False)
+    t0 = time.perf_counter()
+    res = dkg.run(verify_honest=False)
+    dt = time.perf_counter() - t0
+    assert len(res.complete) == nodes and len(res.shares) == nodes
+    # the generated keys work: sign + combine round-trip
+    shares = {i: res.shares[i].sign(b"dkg1024") for i in range(t + 1)}
+    sig = res.pk_set.combine_signatures(shares)
+    assert res.pk_set.verify_signature(sig, b"dkg1024")
+
+    # sequential anchor at a measurable size: one part + one ack at
+    # n=64, scaled by the reference's cost model (handle_part ~ n·t
+    # commitment evaluations; handle_ack ~ t field ops; network-wide
+    # N nodes × (N parts + N² acks), all ~quadratic in N on top)
+    small = 64
+    ts = (small - 1) // 3
+    sec = {i: T.SecretKey.random(_r.Random(3000 + i)) for i in range(small)}
+    pub = {i: sec[i].public_key() for i in range(small)}
+    dealer = SyncKeyGen(0, sec[0], pub, ts, _r.Random(5))
+    receiver = SyncKeyGen(1, sec[1], pub, ts, _r.Random(6))
+    t0 = time.perf_counter()
+    ack, faults = receiver.handle_part(0, dealer.our_part, rng=_r.Random(7))
+    part_s = time.perf_counter() - t0
+    assert ack is not None and faults.is_empty()
+    receiver.parts[0].acks.discard(1)
+    t0 = time.perf_counter()
+    assert receiver.handle_ack(1, ack).is_empty()
+    ack_s = time.perf_counter() - t0
+    scale = (nodes / small) ** 2  # per-op cost grows ~N² (t ~ N rows × N cols)
+    seq_est = nodes * (
+        nodes * part_s * scale + nodes * nodes * ack_s * (nodes / small)
+    )
+    return _emit(
+        "dkg_1024_s",
+        dt,
+        "s",
+        vs_baseline=seq_est / dt,
+        nodes=nodes,
+        threshold=t,
+        elided=True,
+        seq_est_s=round(seq_est, 1),
+        crypto="real",
+    )
+
+
+def bench_churn_1024(nodes: int = 1024):
+    """VERDICT r3 item 2: the full membership-change cycle at the
+    north-star N on real BLS12-381 — f+1 signed votes on-chain →
+    Remove wins → degree-341 dealerless DKG over the new set → era
+    restart → one epoch committed under the NEW keys
+    (``dynamic_honey_badger.rs:300-338`` at SURVEY §7 scale).  DKG
+    honest checks elided; epoch crypto ``verify_honest=False,
+    emit_minimal=True`` (annotated)."""
+    import random as _r
+
+    from hbbft_tpu.harness.dynamic import VectorizedDynamicSim
+    from hbbft_tpu.protocols.change import Complete, Remove
+
+    rng = _r.Random(0xC5)
+    t0 = time.perf_counter()
+    sim = VectorizedDynamicSim(
+        nodes,
+        rng,
+        mock=False,
+        verify_honest=False,
+        emit_minimal=True,
+    )
+    setup_s = time.perf_counter() - t0
+    f = (nodes - 1) // 3
+    for v in range(f + 1):
+        sim.vote_for(v, Remove(nodes - 1))
+    t0 = time.perf_counter()
+    r1 = sim.run_epoch({i: [b"c-%d" % i] for i in range(nodes)})
+    era_switch_s = time.perf_counter() - t0
+    assert isinstance(r1.change, Complete) and sim.era == 1
+    t0 = time.perf_counter()
+    r2 = sim.run_epoch({i: [b"d-%d" % i] for i in sim.validators})
+    next_epoch_s = time.perf_counter() - t0
+    assert len(r2.batch) == nodes - 1
+    return _emit(
+        "churn_1024_s",
+        era_switch_s + next_epoch_s,
+        "s",
+        nodes=nodes,
+        era_switch_s=round(era_switch_s, 1),
+        next_epoch_s=round(next_epoch_s, 1),
+        setup_s=round(setup_s, 1),
+        crypto="real",
+        dkg_elided=True,
+        verify_honest=False,
+        emit_minimal=True,
+    )
+
+
+def bench_qhb_dyn_1024_real(nodes: int = 1024, n_dead: int = 50):
+    """VERDICT r3 item 2: the dynamic queueing stack at N=1024 on REAL
+    BLS12-381 (the mock-crypto ``qhb_dyn_1024`` row's missing real
+    twin): votes, on-chain DKG and an era switch run mid-measurement
+    with genuine threshold decryption per epoch.  Protocol-plane
+    elisions annotated (``verify_honest=False, emit_minimal=True``)."""
+    import random as _r
+
+    from hbbft_tpu.harness.dynamic import VectorizedDynamicQueueingSim
+    from hbbft_tpu.protocols.change import Complete, Remove
+
+    rng = _r.Random(0x5D2)
+    t0 = time.perf_counter()
+    qsim = VectorizedDynamicQueueingSim(
+        nodes,
+        rng,
+        batch_size=nodes,
+        mock=False,
+        verify_honest=False,
+        emit_minimal=True,
+    )
+    qsim.input_all([b"tx-%06d" % i for i in range(4 * nodes)])
+    setup_s = time.perf_counter() - t0
+    dead = set(range(nodes - n_dead - 1, nodes - 1))
+    qsim.run_epoch(dead=dead)  # warm
+    f = (nodes - 1) // 3
+    for v in qsim.validators[: f + 1]:
+        qsim.vote_for(v, Remove(nodes - 1))
+    t0 = time.perf_counter()
+    committed = 0
+    churn_epoch = None
+    epochs = 3
+    for e in range(epochs):
+        res = qsim.run_epoch(dead=dead)
+        committed += len(res.batch)
+        if isinstance(res.change, Complete):
+            churn_epoch = e
+    dt = (time.perf_counter() - t0) / epochs
+    assert churn_epoch is not None and qsim.era == 1
+    assert (nodes - 1) not in qsim.validators
+    return _emit(
+        "qhb_dyn_1024_real_s_per_epoch",
+        dt,
+        "s",
+        nodes=nodes,
+        dead=n_dead,
+        txs_per_epoch=committed // epochs,
+        churn_at_epoch=churn_epoch,
+        eras=qsim.era + 1,
+        setup_s=round(setup_s, 1),
+        crypto="real",
+        verify_honest=False,
+        emit_minimal=True,
+    )
+
+
 def bench_churn_256(nodes: int = 256):
     """A full membership-change cycle at N=256 on real BLS12-381
     through the vectorized dynamic layer (``harness/dynamic.py``):
@@ -1069,7 +1339,11 @@ SUITE = {
     "hb_1024_latency": bench_hb_1024_latency,
     "dkg_verified": bench_dkg_verified,
     "dkg_256": bench_dkg_256,
+    "dkg_verified_256": bench_dkg_verified_256,
+    "dkg_1024": bench_dkg_1024,
     "churn_256": bench_churn_256,
+    "churn_1024": bench_churn_1024,
+    "qhb_dyn_1024_real": bench_qhb_dyn_1024_real,
     "broadcast_vec_1024": bench_broadcast_vec_1024,
     "hb_epoch64_real": bench_hb_epoch64_real,
 }
@@ -1081,6 +1355,11 @@ def main() -> None:
     import os
 
     import jax
+
+    # the bench is a warming entry point: new device shapes MAY pay
+    # their one-time compile here (production routing never does —
+    # ops/backend_tpu._device_g1_msm falls back to host when cold)
+    os.environ.setdefault("HBBFT_TPU_WARM", "1")
 
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
     os.makedirs(cache, exist_ok=True)

@@ -68,14 +68,21 @@ class CpuBackend:
 
     # -- product-form MSM (the fused flush's dominant shape) ---------------
 
-    def g1_ship(self, points: Sequence[G1]):
+    def g1_ship(
+        self,
+        points: Sequence[G1],
+        group_sizes: Optional[Sequence[int]] = None,
+    ):
         """Begin moving ``points`` toward the MSM execution engine.
 
         Device backends start the (asynchronous) wire transfer here so
         it overlaps the caller's transcript hashing and coefficient
-        derivation; the host backend has nothing to move.  The returned
-        handle is accepted by :meth:`g1_msm_product_async` in place of
-        the point list."""
+        derivation; the host backend has nothing to move.
+        ``group_sizes`` (when the caller knows the flush's group
+        structure) lets a device backend check shape conformance AND
+        that the factored path's executables are warm before
+        committing bytes to the wire.  The returned handle is accepted
+        by :meth:`g1_msm_product_async` in place of the point list."""
         return points
 
     def g1_msm_product_async(

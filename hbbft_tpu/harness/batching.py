@@ -368,7 +368,9 @@ class BatchingBackend:
             for _, _, members in pre
             for ob, _, _ in members
         ]
-        shipped = self.g1_ship(all_shares)
+        shipped = self.g1_ship(
+            all_shares, group_sizes=[len(m) for _, _, m in pre]
+        )
 
         from ..crypto.hashing import sha256
 

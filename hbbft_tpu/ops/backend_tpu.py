@@ -118,24 +118,26 @@ class TpuBackend(CpuBackend):
     # so the device takes everything it can.  All paths are exact.
 
     # G1 MSM routing band [G1_DEVICE_MIN, G1_DEVICE_MAX] — outside it
-    # the native host Pippenger runs.  Measured r3 END-TO-END on this
-    # remote-tunnel host (wire→limb marshalling + ~460 B/point tunnel
-    # transfer + the chunked tree reduction included, warm):
+    # the native host Pippenger runs.  Re-measured r4 END-TO-END after
+    # the packed-wire redesign (48-96 B/point transfer with on-device
+    # unpack, factored 96-bit product scalars, executable disk cache)
+    # at the fused-flush shape K=65,536:
     #
-    #     K        device            host Pippenger
-    #     8,192     1.2 s (6.9k/s)    0.25 s (33k/s)
-    #     65,536    2.7 s (24k/s)     1.3 s  (50k/s)
-    #     262,144   38 s  (6.9k/s)    6.5 s  (40k/s)
+    #   - idle host: device ≈ 2.7-3.5 s/MSM vs host Pippenger
+    #     ≈ 2.7-3.8 s — parity (the r3 expanded-limb path lost 3-15×;
+    #     see git history for the old table);
+    #   - loaded host (anything sharing the single CPU core): device
+    #     4.1-4.9 s/flush vs host 5.0-7.0 s — device wins;
+    #   - the SHIPPING flush splits the factored product across BOTH
+    #     engines concurrently (packed_msm._device_fraction), so it is
+    #     ≥ the better engine under either regime.
     #
-    # The windowed kernel's COMPUTE beats Pippenger beyond ~6k points
-    # (67.5k pts/s at 64k — BASELINE kernel table), but on this host
-    # the fixed marshal/transfer/reduction overhead never amortizes,
-    # so the band ships EMPTY: correctness stays gated by the hardware
-    # smoke suite and the per-round headline device leg, and a
-    # locally-attached deployment (transfer ~100× cheaper) re-opens
-    # the band via HBBFT_TPU_G1_DEVICE_MIN/MAX.  Policy, not
-    # architecture.
-    G1_DEVICE_MIN = 1 << 62
+    # Small MSMs stay launch-latency-bound, so the band opens at 16k.
+    # A shape inside the band still falls back to host unless its
+    # executables are warm (``_device_g1_msm`` → None): production
+    # paths never pay a cold multi-minute Mosaic compile; warming
+    # entry points (bench, hardware smoke) set HBBFT_TPU_WARM=1.
+    G1_DEVICE_MIN = 1 << 14
     G1_DEVICE_MAX = 1 << 62
     # a mesh-configured backend shards MSMs at or above this size;
     # smaller ones stay on the fast host path (a tiny MSM should not
@@ -178,7 +180,10 @@ class TpuBackend(CpuBackend):
             return ec_jax.g1_from_limbs(self._sharded_g1(pts_t, dig_t))
         if not self._g1_in_device_band(len(points)):
             return super().g1_msm(points, scalars)
-        return self._device_g1_msm(points, scalars)()
+        fin = self._device_g1_msm(points, scalars)
+        if fin is None:  # no warm executables for this shape
+            return super().g1_msm(points, scalars)
+        return fin()
 
     def _g1_in_device_band(self, k: int) -> bool:
         """One home for the host/device G1 routing decision (shared by
@@ -191,11 +196,13 @@ class TpuBackend(CpuBackend):
 
     @staticmethod
     def _device_g1_msm(points, scalars):
-        """Launch the device G1 MSM, returning a finalizer.  On real
-        TPU hardware this is the packed-wire path (96 B/point over the
-        tunnel, on-device unpack — ``ops/packed_msm.py``); on CPU
-        (tests, interpret mode) the XLA limb path keeps its fast
-        compiles."""
+        """Launch the device G1 MSM, returning a finalizer — or None
+        when the shape has no warm executables (cold Mosaic compiles
+        are minutes each; the caller falls back to the host path, and
+        warming entry points — ``HBBFT_TPU_WARM=1`` — compile new
+        shapes).  On real TPU this is the packed-wire path
+        (``ops/packed_msm.py``); on CPU (tests, interpret mode) the
+        XLA limb path keeps its fast compiles."""
         import jax
 
         if jax.default_backend() == "tpu":
@@ -215,7 +222,9 @@ class TpuBackend(CpuBackend):
             and points
             and self._g1_in_device_band(len(points))
         ):
-            return self._device_g1_msm(points, scalars)
+            fin = self._device_g1_msm(points, scalars)
+            if fin is not None:
+                return fin
         result = self.g1_msm(points, scalars)
         return lambda: result
 
@@ -227,7 +236,7 @@ class TpuBackend(CpuBackend):
 
     # -- product-form MSM ---------------------------------------------------
 
-    def g1_ship(self, points):
+    def g1_ship(self, points, group_sizes=None):
         """Start the packed-wire transfer early (overlaps the caller's
         transcript hashing — the flush ships points the moment they are
         serialized).  Falls through to the plain list when the batch
@@ -243,7 +252,7 @@ class TpuBackend(CpuBackend):
             if jax.default_backend() == "tpu":
                 from . import packed_msm
 
-                return packed_msm.ship_points(points)
+                return packed_msm.ship_points(points, group_sizes)
         return points
 
     def g1_msm_product_async(self, points, s_coeffs, t_coeffs, group_sizes):

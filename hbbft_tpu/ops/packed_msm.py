@@ -64,6 +64,64 @@ def _bucket_rows(k: int) -> int:
     return pallas_ec._bucket_tiles(max(1, -(-k // pallas_ec.TILE))) * pallas_ec.TILE
 
 
+def _allow_compile() -> bool:
+    """Cold Mosaic/XLA compiles are minutes each on this class of host;
+    production routing only uses shapes with warm executables unless a
+    warming entry point (bench, hardware smoke) sets HBBFT_TPU_WARM=1."""
+    return os.environ.get("HBBFT_TPU_WARM", "0") == "1"
+
+
+def _tree_parts(kp: int):
+    """The executable-cache keys the tree reduction will need."""
+    L = LB.FQ_LIMBS
+    chunk = pallas_ec._TREE_CHUNK_G1
+    if kp <= chunk:
+        return [("tree_g1", (((kp, 3, L), "int32"),))]
+    out = [("tree_g1", (((chunk, 3, L), "int32"),))]
+    out.append(("tree_g1", (((kp // chunk, 3, L), "int32"),)))
+    return out
+
+
+def _flat_ready(kp: int, nb: int) -> bool:
+    """All executables of one flat packed chunk are warm."""
+    L = LB.FQ_LIMBS
+    T = pallas_ec.TILE
+    G = kp // T
+    checks = [
+        ("unpack_g1_v1", (((kp, 96), "uint8"), ((kp, nb), "uint8"))),
+        ("win_g1", ((G, 3, L, T), (G, nb * 2, T))),
+    ] + _tree_parts(kp)
+    return all(pallas_ec.exec_available(n, p) for n, p in checks)
+
+
+def _product_ready(kp: int, n_groups: int, compressed: bool) -> bool:
+    """All executables of the factored product path are warm."""
+    L = LB.FQ_LIMBS
+    T = pallas_ec.TILE
+    G = kp // T
+    nb = _S_BITS // 8
+    if compressed:
+        unpack = (
+            "unpack_g1c_v1",
+            (
+                ((kp, 48), "uint8"),
+                ((2, kp // 8), "uint8"),
+                ((kp, nb), "uint8"),
+            ),
+        )
+    else:
+        unpack = (
+            "unpack_g1_v1",
+            (((kp, 96), "uint8"), ((kp, nb), "uint8")),
+        )
+    checks = [
+        unpack,
+        ("win_g1", ((G, 3, L, T), (G, nb * 2, T))),
+        ("gtree_g1_%d" % n_groups, (((kp, 3, L), "int32"),)),
+    ]
+    return all(pallas_ec.exec_available(n, p) for n, p in checks)
+
+
 # ---------------------------------------------------------------------------
 # Host-side marshalling: points/scalars → packed wire bytes
 # ---------------------------------------------------------------------------
@@ -289,7 +347,7 @@ def g1_msm_packed_async(
     scalars: Sequence[int],
     nbits: Optional[int] = None,
     interpret: Optional[bool] = None,
-) -> Callable[[], Any]:
+) -> Optional[Callable[[], Any]]:
     """Enqueue the MSM on device and return a zero-arg finalizer.
 
     The finalizer blocks on the device result and returns the host G1
@@ -307,6 +365,12 @@ def g1_msm_packed_async(
     w = ec_jax._width(scalars, nbits)
     nb = -(-w // 8)
     k = len(points)
+    if not interpret and not _allow_compile():
+        # cold-compile guard: every chunk shape must be warm
+        for lo in range(0, k, _MAX_CHUNK):
+            kc = min(_MAX_CHUNK, k - lo)
+            if not _flat_ready(_bucket_rows(kc), nb):
+                return None
     wires = g1_wires_batch(points)
     sc = scalar_bytes_batch(scalars, nb)
 
@@ -341,7 +405,13 @@ def g1_msm_packed(
     interpret: Optional[bool] = None,
 ) -> Any:
     """Blocking wrapper around :func:`g1_msm_packed_async`."""
-    return g1_msm_packed_async(points, scalars, nbits, interpret)()
+    fin = g1_msm_packed_async(points, scalars, nbits, interpret)
+    if fin is None:
+        raise RuntimeError(
+            "packed MSM executables are cold for this shape — warm "
+            "them with HBBFT_TPU_WARM=1 or route to the host path"
+        )
+    return fin()
 
 
 # ---------------------------------------------------------------------------
@@ -359,10 +429,58 @@ _S_BITS = 96  # product-form sender coefficients (batching.py coeff())
 
 
 def _use_compressed() -> bool:
-    """Compressed 48-byte-x transfer with on-device y recovery — the
-    default on real hardware (the tunnel is the bottleneck, measured
-    r4); ``HBBFT_TPU_COMPRESS=0`` forces the 96-byte path."""
-    return os.environ.get("HBBFT_TPU_COMPRESS", "1") != "0"
+    """Compressed 48-byte-x transfer with on-device y recovery
+    (``HBBFT_TPU_COMPRESS=1``).  Measured r4: the batched sqrt chain
+    costs ~1-2 s at K=64k — more than the ~0.3 s of transfer it saves
+    on an idle tunnel — so the 96-byte path ships as default; a
+    deployment whose link is the bottleneck (the loaded-tunnel case,
+    where transfer dominated 3×) flips the switch."""
+    return os.environ.get("HBBFT_TPU_COMPRESS", "0") == "1"
+
+
+def _device_fraction() -> float:
+    """The share of a product-form flush's groups the DEVICE takes;
+    the rest run native host Pippenger on the CPU **simultaneously**
+    (the host half computes inside the finalizer while the device half
+    is in flight).  The two engines are independent resources on this
+    host — a hybrid split beats either alone (measured r4).  Tunable
+    via HBBFT_TPU_DEVICE_FRACTION (0 = all host, 1 = all device)."""
+    import math
+
+    try:
+        rho = float(os.environ.get("HBBFT_TPU_DEVICE_FRACTION", "0.5"))
+    except ValueError:
+        return 0.5
+    return rho if math.isfinite(rho) else 0.5
+
+
+# Largest device share of one product flush: the per-group tree is a
+# single unrolled program (no chunking), so its row count stays at the
+# scale proven on hardware — 2^16 rows compiles in ~2 min and fits
+# HBM comfortably; 2^18 is the 197 s / ~GB-intermediates regime the
+# flat path chunks at 2^14 to avoid.
+_MAX_GTREE = 1 << 16
+
+
+def _split_groups(k: int, n_groups: int) -> tuple:
+    """(g_dev, k_dev): how many LEADING groups of a uniform-group
+    product flush the device takes.  k_dev must land exactly on a tile
+    bucket (no padding rows bleeding into the host part) and within
+    the proven per-group-tree scale (``_MAX_GTREE``); the largest
+    conforming split at or below the device fraction wins.  (0, 0) =
+    no device share."""
+    if n_groups <= 0 or k % n_groups:
+        return 0, 0
+    n = k // n_groups
+    rho = _device_fraction()
+    if rho <= 0.0:
+        return 0, 0
+    want = n_groups if rho >= 0.999 else max(0, int(n_groups * rho))
+    for g in range(min(want, n_groups), 0, -1):
+        kd = n * g
+        if kd <= _MAX_GTREE and _bucket_rows(kd) == kd:
+            return g, kd
+    return 0, 0
 
 
 class ShippedPoints:
@@ -378,7 +496,9 @@ class ShippedPoints:
     by whichever path ends up running, doubling the flush's dominant
     data movement, so only the host marshalling is done eagerly."""
 
-    def __init__(self, points: List[Any]):
+    def __init__(
+        self, points: List[Any], group_sizes: Optional[Sequence[int]] = None
+    ):
         self.points = points
         self.wires = g1_wires_batch(points)
         self.compressed = (
@@ -386,19 +506,27 @@ class ShippedPoints:
         )
         self.dev = None
         self.dev_meta = None
+        self.g_dev = 0
+        self.k_dev = 0
         k = len(points)
-        self.kp = _bucket_rows(k)
         if (
-            jax.default_backend() == "tpu"
-            and self.kp == k
-            and k <= _MAX_CHUNK
+            jax.default_backend() != "tpu"
+            or not group_sizes
+            or len(set(group_sizes)) != 1  # factored path needs uniform
         ):
+            return
+        g_dev, k_dev = _split_groups(k, len(group_sizes))
+        if g_dev and (
+            _allow_compile()
+            or _product_ready(k_dev, g_dev, self.compressed)
+        ):
+            self.g_dev, self.k_dev = g_dev, k_dev
             if self.compressed:
-                x, meta = compress_rows(self.wires, self.kp)
+                x, meta = compress_rows(self.wires[:k_dev], k_dev)
                 self.dev = jax.device_put(x)
                 self.dev_meta = jax.device_put(meta)
             else:
-                self.dev = jax.device_put(self.wires)
+                self.dev = jax.device_put(self.wires[:k_dev])
 
 
 def compress_rows(wires: np.ndarray, kp: int) -> tuple:
@@ -417,8 +545,10 @@ def compress_rows(wires: np.ndarray, kp: int) -> tuple:
     return x, meta
 
 
-def ship_points(points: Sequence[Any]) -> ShippedPoints:
-    return ShippedPoints(list(points))
+def ship_points(
+    points: Sequence[Any], group_sizes: Optional[Sequence[int]] = None
+) -> ShippedPoints:
+    return ShippedPoints(list(points), group_sizes)
 
 
 def _group_tree(prods: jnp.ndarray, n_groups: int) -> jnp.ndarray:
@@ -468,17 +598,21 @@ def g1_msm_product_async(
     group_sizes: Sequence[int],
     interpret: Optional[bool] = None,
 ) -> Optional[Callable[[], Any]]:
-    """Factored-form device MSM (``backend.g1_msm_product_async``
-    semantics).  Returns ``None`` when the batch shape does not fit the
-    device layout — non-uniform group sizes, or a total that does not
-    land exactly on a tile bucket (identity padding rows would bleed
-    into the last group's tree) — and the caller falls back to the
-    flat path.
+    """Factored-form HYBRID MSM (``backend.g1_msm_product_async``
+    semantics): the leading ``g_dev`` groups run on the device
+    (packed transfer → windowed kernel → per-group trees), the rest
+    run native host Pippenger INSIDE the finalizer while the device
+    half is in flight — both engines busy simultaneously
+    (``_device_fraction``).  Returns ``None`` when no conforming
+    device share exists (non-uniform group sizes, no bucket-aligned
+    prefix, cold executables) and the caller falls back to the flat
+    path.
 
     Exactness: equal to the flat ``Σ (sᵢ·t_g mod r)·Pᵢ`` on r-torsion
     points (scalars act mod r there); see the backend docstring for
     the off-subgroup discussion."""
     from ..crypto.backend import CpuBackend
+    from ..crypto import fields as F
     from . import ec_jax
 
     shipped = points if isinstance(points, ShippedPoints) else None
@@ -489,42 +623,70 @@ def g1_msm_product_async(
         return None
     n = sizes.pop()
     n_groups = len(group_sizes)
-    if n * n_groups != k or _bucket_rows(k) != k or k > _MAX_CHUNK:
+    if n * n_groups != k:
         return None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    nb = _S_BITS // 8
-    dev_sc = jax.device_put(scalar_bytes_batch(s_coeffs, nb))
-    if (
-        shipped is not None
-        and shipped.compressed
-        and shipped.dev is not None
-        and shipped.kp == k
-    ):
-        pts_t, dig_t = _unpack_compressed_device(
-            shipped.dev, shipped.dev_meta, dev_sc
-        )
-    elif shipped is not None and shipped.dev is not None and shipped.kp == k:
-        pts_t, dig_t = _unpack_device(shipped.dev, dev_sc)
+    if shipped is not None and shipped.g_dev:
+        g_dev, k_dev = shipped.g_dev, shipped.k_dev
+        compressed = shipped.compressed
     else:
-        wires = shipped.wires if shipped else g1_wires_batch(pts_list)
-        if _use_compressed() and not interpret:
-            x, meta = compress_rows(wires, k)
+        g_dev, k_dev = _split_groups(k, n_groups)
+        compressed = _use_compressed() and not interpret
+        if g_dev == 0:
+            return None
+        if (
+            not interpret
+            and not _allow_compile()
+            and not _product_ready(k_dev, g_dev, compressed)
+        ):
+            return None
+
+    nb = _S_BITS // 8
+    dev_sc = jax.device_put(scalar_bytes_batch(s_coeffs[:k_dev], nb))
+    if shipped is not None and shipped.dev is not None:
+        if compressed:
+            pts_t, dig_t = _unpack_compressed_device(
+                shipped.dev, shipped.dev_meta, dev_sc
+            )
+        else:
+            pts_t, dig_t = _unpack_device(shipped.dev, dev_sc)
+    else:
+        wires = (
+            shipped.wires[:k_dev]
+            if shipped
+            else g1_wires_batch(pts_list[:k_dev])
+        )
+        if compressed and not interpret:
+            x, meta = compress_rows(wires, k_dev)
             pts_t, dig_t = _unpack_compressed_device(
                 jax.device_put(x), jax.device_put(meta), dev_sc
             )
         else:
             pts_t, dig_t = _unpack_device(jax.device_put(wires), dev_sc)
     out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
-    prods = pallas_ec._untile(out_t, k, k)
-    gsums = _group_tree_device(prods, n_groups)
+    prods = pallas_ec._untile(out_t, k_dev, k_dev)
+    gsums = _group_tree_device(prods, g_dev)
 
     t_list = list(t_coeffs)
+    host_pts = pts_list[k_dev:]
+    host_flat = None
+    if host_pts:
+        host_flat = [
+            (s_coeffs[k_dev + i] * t_list[g_dev + i // n]) % F.R
+            for i in range(k - k_dev)
+        ]
 
     def finalize():
+        # host half FIRST: native Pippenger runs while the device half
+        # is still in flight; only then block on the device result
+        host_sum = (
+            CpuBackend().g1_msm(host_pts, host_flat) if host_pts else None
+        )
         arr = np.asarray(gsums)
-        group_pts = [ec_jax.g1_from_limbs(arr[i]) for i in range(n_groups)]
-        return CpuBackend().g1_msm(group_pts, t_list)
+        group_pts = [ec_jax.g1_from_limbs(arr[i]) for i in range(g_dev)]
+        dev_sum = CpuBackend().g1_msm(group_pts, t_list[:g_dev])
+        return dev_sum + host_sum if host_sum is not None else dev_sum
 
     return finalize

@@ -415,18 +415,10 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
         key_parts = tuple(
             (tuple(a.shape), str(getattr(a, "dtype", ""))) for a in args
         )
-    key = (
-        name,
-        *key_parts,
-        jax.__version__,
-        jax.devices()[0].device_kind,
-    )
+    key = _exec_key(name, key_parts)
+
     def exec_path() -> str:
-        fname = (
-            "-".join(str(p) for p in key).replace(" ", "").replace("/", "_")
-            + ".palexe"
-        )
-        return os.path.join(_exec_cache_dir(), fname)
+        return os.path.join(_exec_cache_dir(), _exec_fname(key))
 
     loaded = _EXEC_MEM.get(key)
     if loaded is None:
@@ -456,6 +448,42 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
         _EXEC_MEM[key] = compiled
         _save_exec(compiled, exec_path())
         return compiled(*args)
+
+
+def _exec_key(name: str, key_parts) -> tuple:
+    """The executable-cache key — ONE home shared by ``cached_compiled``
+    and ``exec_available`` so the cold-compile guard can never drift
+    from the cache it guards."""
+    return (
+        name,
+        *key_parts,
+        jax.__version__,
+        jax.devices()[0].device_kind,
+    )
+
+
+def _exec_fname(key: tuple) -> str:
+    return (
+        "-".join(str(p) for p in key).replace(" ", "").replace("/", "_")
+        + ".palexe"
+    )
+
+
+def exec_available(name: str, key_parts) -> bool:
+    """True when ``cached_compiled(name, …, key_parts=…)`` would run
+    WITHOUT compiling — in-memory or on disk.  Routing uses this to
+    keep cold Mosaic compiles (minutes each) off production paths: a
+    shape with no warm executable falls back to the host, and only
+    explicit warming (``HBBFT_TPU_WARM=1`` — bench, hardware smoke)
+    compiles new shapes."""
+    import os
+
+    key = _exec_key(name, key_parts)
+    if key in _EXEC_MEM:
+        return True
+    return os.path.exists(
+        os.path.join(_exec_cache_dir(), _exec_fname(key))
+    )
 
 
 def _save_exec(compiled, path: str) -> None:
