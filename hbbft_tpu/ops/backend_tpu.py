@@ -63,7 +63,12 @@ class TpuBackend(CpuBackend):
         self._sharded_g1 = None
         # env overrides are read here (not at import) so operators and
         # tests can set them after the module loads
-        for attr in ("G1_DEVICE_MIN", "G1_DEVICE_MAX", "G1_MESH_MIN"):
+        for attr in (
+            "G1_DEVICE_MIN",
+            "G1_DEVICE_MAX",
+            "G1_FLAT_MAX",
+            "G1_MESH_MIN",
+        ):
             env = os.environ.get("HBBFT_TPU_" + attr)
             if env is not None:
                 setattr(self, attr, int(env))
@@ -139,6 +144,13 @@ class TpuBackend(CpuBackend):
     # entry points (bench, hardware smoke) set HBBFT_TPU_WARM=1.
     G1_DEVICE_MIN = 1 << 14
     G1_DEVICE_MAX = 1 << 62
+    # FLAT (ungrouped) MSMs above this stay host-side: past ~2^17 the
+    # chunked flat path's transfer + per-chunk trees lose to native
+    # Pippenger (r4 measured — hb_1024_real's 948k-point flushes ran
+    # 4× 262k flat chunks and lost).  Product-form flushes are NOT
+    # capped here: their hybrid split sizes its own device share
+    # (``packed_msm._split_plan``).
+    G1_FLAT_MAX = 1 << 17
     # a mesh-configured backend shards MSMs at or above this size;
     # smaller ones stay on the fast host path (a tiny MSM should not
     # pay a shard_map dispatch over the interconnect)
@@ -178,21 +190,24 @@ class TpuBackend(CpuBackend):
             )
             pts_t, dig_t, _, _ = pallas_ec._tile_transpose(pts, digits)
             return ec_jax.g1_from_limbs(self._sharded_g1(pts_t, dig_t))
-        if not self._g1_in_device_band(len(points)):
+        if not self._g1_in_device_band(len(points), flat=True):
             return super().g1_msm(points, scalars)
         fin = self._device_g1_msm(points, scalars)
         if fin is None:  # no warm executables for this shape
             return super().g1_msm(points, scalars)
         return fin()
 
-    def _g1_in_device_band(self, k: int) -> bool:
+    def _g1_in_device_band(self, k: int, flat: bool = False) -> bool:
         """One home for the host/device G1 routing decision (shared by
         the sync and async entries so they can never drift): the device
         takes a batch when no native host path exists, or when k falls
-        inside the measured routing band."""
-        return not self._native_host() or (
-            self.G1_DEVICE_MIN <= k <= self.G1_DEVICE_MAX
-        )
+        inside the measured routing band.  ``flat`` applies the extra
+        upper cap of the ungrouped chunked path (``G1_FLAT_MAX``)."""
+        if not self._native_host():
+            return True
+        if flat and k > self.G1_FLAT_MAX:
+            return False
+        return self.G1_DEVICE_MIN <= k <= self.G1_DEVICE_MAX
 
     @staticmethod
     def _device_g1_msm(points, scalars):
@@ -220,7 +235,7 @@ class TpuBackend(CpuBackend):
         if (
             self.mesh is None
             and points
-            and self._g1_in_device_band(len(points))
+            and self._g1_in_device_band(len(points), flat=True)
         ):
             fin = self._device_g1_msm(points, scalars)
             if fin is not None:

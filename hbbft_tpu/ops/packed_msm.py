@@ -94,10 +94,16 @@ def _flat_ready(kp: int, nb: int) -> bool:
     return all(pallas_ec.exec_available(n, p) for n, p in checks)
 
 
-def _product_ready(kp: int, n_groups: int, compressed: bool) -> bool:
-    """All executables of the factored product path are warm."""
+def _product_ready(kd: int, n_groups: int, compressed: bool) -> bool:
+    """All executables of ONE factored-product device chunk are warm.
+
+    ``kd`` is the chunk's true point count (``n_groups`` × group size);
+    the transfer/unpack/kernel run on the bucket-padded ``kp`` rows and
+    the padding is sliced off before the per-group tree, so the tree's
+    executable is keyed on the exact ``kd``."""
     L = LB.FQ_LIMBS
     T = pallas_ec.TILE
+    kp = _bucket_rows(kd)
     G = kp // T
     nb = _S_BITS // 8
     if compressed:
@@ -117,7 +123,7 @@ def _product_ready(kp: int, n_groups: int, compressed: bool) -> bool:
     checks = [
         unpack,
         ("win_g1", ((G, 3, L, T), (G, nb * 2, T))),
-        ("gtree_g1_%d" % n_groups, (((kp, 3, L), "int32"),)),
+        ("gtree_g1_%d" % n_groups, (((kd, 3, L), "int32"),)),
     ]
     return all(pallas_ec.exec_available(n, p) for n, p in checks)
 
@@ -462,25 +468,31 @@ def _device_fraction() -> float:
 _MAX_GTREE = 1 << 16
 
 
-def _split_groups(k: int, n_groups: int) -> tuple:
-    """(g_dev, k_dev): how many LEADING groups of a uniform-group
-    product flush the device takes.  k_dev must land exactly on a tile
-    bucket (no padding rows bleeding into the host part) and within
-    the proven per-group-tree scale (``_MAX_GTREE``); the largest
-    conforming split at or below the device fraction wins.  (0, 0) =
-    no device share."""
+def _split_plan(k: int, n_groups: int) -> List[int]:
+    """Group-counts of the device chunks of a uniform-group product
+    flush (the LEADING ``sum(plan)`` groups run on device, the rest on
+    host).  Each chunk stays within the proven per-group-tree scale
+    (``_MAX_GTREE`` rows); its transfer/kernel rows are bucket-padded
+    and the padding sliced off before the tree, so group sizes need NOT
+    land on a tile bucket (the r4 `hb_1024_real` finding: 974-point
+    groups never do, and requiring it sent 948k-point flushes down the
+    losing flat path).  All full chunks share one shape — one warm
+    executable set serves the whole flush.  [] = no device share."""
     if n_groups <= 0 or k % n_groups:
-        return 0, 0
+        return []
     n = k // n_groups
     rho = _device_fraction()
     if rho <= 0.0:
-        return 0, 0
+        return []
     want = n_groups if rho >= 0.999 else max(0, int(n_groups * rho))
-    for g in range(min(want, n_groups), 0, -1):
-        kd = n * g
-        if kd <= _MAX_GTREE and _bucket_rows(kd) == kd:
-            return g, kd
-    return 0, 0
+    if want == 0:
+        return []
+    g_c = min(want, max(1, _MAX_GTREE // n))
+    if g_c * n > _MAX_GTREE:
+        return []  # a single group alone exceeds the proven tree scale
+    # no remainder chunk alongside full ones: it would add a second
+    # (cold) executable shape for under one chunk of work
+    return [g_c] * (want // g_c)
 
 
 class ShippedPoints:
@@ -490,22 +502,19 @@ class ShippedPoints:
 
     In compressed mode only the x coordinates cross the tunnel, plus
     two packed bit-rows (y parity, infinity flag); y is recovered on
-    device.  The transfer starts ONLY for shapes the factored product
-    path accepts (total exactly on a tile bucket, one chunk) — for
-    anything else the bytes would be re-shipped with different padding
-    by whichever path ends up running, doubling the flush's dominant
-    data movement, so only the host marshalling is done eagerly."""
+    device.  The transfer starts ONLY for the device chunks of the
+    factored product plan (uniform groups, warm executables) — each
+    chunk ships bucket-padded exactly as the product path will consume
+    it, so no byte crosses the tunnel twice."""
 
     def __init__(
         self, points: List[Any], group_sizes: Optional[Sequence[int]] = None
     ):
         self.points = points
-        self.wires = g1_wires_batch(points)
         self.compressed = (
             _use_compressed() and jax.default_backend() == "tpu"
         )
-        self.dev = None
-        self.dev_meta = None
+        self.chunks: List[tuple] = []  # (g, kd, dev, dev_meta)
         self.g_dev = 0
         self.k_dev = 0
         k = len(points)
@@ -515,18 +524,44 @@ class ShippedPoints:
             or len(set(group_sizes)) != 1  # factored path needs uniform
         ):
             return
-        g_dev, k_dev = _split_groups(k, len(group_sizes))
-        if g_dev and (
-            _allow_compile()
-            or _product_ready(k_dev, g_dev, self.compressed)
+        n = k // len(group_sizes)
+        plan = _split_plan(k, len(group_sizes))
+        if not plan:
+            return
+        if not _allow_compile() and not all(
+            _product_ready(g * n, g, self.compressed) for g in plan
         ):
-            self.g_dev, self.k_dev = g_dev, k_dev
-            if self.compressed:
-                x, meta = compress_rows(self.wires[:k_dev], k_dev)
-                self.dev = jax.device_put(x)
-                self.dev_meta = jax.device_put(meta)
-            else:
-                self.dev = jax.device_put(self.wires[:k_dev])
+            return  # cold shapes — the flush will run host-side
+        # only the device prefix is marshalled: the host tail goes
+        # through native Pippenger's own (memoized) wire encoding, so
+        # serializing it here would be pure wasted flush-path time
+        k_dev = sum(plan) * n
+        wires = g1_wires_batch(points[:k_dev])
+        lo = 0
+        for g in plan:
+            kd = g * n
+            dev, dev_meta = _put_chunk(
+                wires[lo : lo + kd], kd, _bucket_rows(kd), self.compressed
+            )
+            self.chunks.append((g, kd, dev, dev_meta))
+            lo += kd
+        self.g_dev = sum(plan)
+        self.k_dev = lo
+
+
+def _put_chunk(wires: np.ndarray, kd: int, kp: int, compressed: bool):
+    """Pad one device chunk's wires to the ``kp`` bucket and start its
+    transfer — (dev, dev_meta); the ONE home for the pad/compress/ship
+    step shared by the eager (``ShippedPoints``) and lazy
+    (``g1_msm_product_async`` fallback) marshalling paths."""
+    if compressed:
+        x, meta = compress_rows(wires, kp)
+        return jax.device_put(x), jax.device_put(meta)
+    if kp != kd:
+        wires = np.concatenate(
+            [wires, np.zeros((kp - kd, 96), dtype=np.uint8)]
+        )
+    return jax.device_put(wires), None
 
 
 def compress_rows(wires: np.ndarray, kp: int) -> tuple:
@@ -599,14 +634,14 @@ def g1_msm_product_async(
     interpret: Optional[bool] = None,
 ) -> Optional[Callable[[], Any]]:
     """Factored-form HYBRID MSM (``backend.g1_msm_product_async``
-    semantics): the leading ``g_dev`` groups run on the device
-    (packed transfer → windowed kernel → per-group trees), the rest
-    run native host Pippenger INSIDE the finalizer while the device
-    half is in flight — both engines busy simultaneously
-    (``_device_fraction``).  Returns ``None`` when no conforming
-    device share exists (non-uniform group sizes, no bucket-aligned
-    prefix, cold executables) and the caller falls back to the flat
-    path.
+    semantics): the leading ``sum(plan)`` groups run on the device in
+    uniform-shape chunks (packed transfer → windowed kernel →
+    bucket-padding slice → per-group trees), the rest run native host
+    Pippenger INSIDE the finalizer while the device chunks are in
+    flight — both engines busy simultaneously (``_device_fraction``).
+    Returns ``None`` when no conforming device share exists
+    (non-uniform group sizes, a single group past the tree scale, cold
+    executables) and the caller falls back to the flat/host path.
 
     Exactness: equal to the flat ``Σ (sᵢ·t_g mod r)·Pᵢ`` on r-torsion
     points (scalars act mod r there); see the backend docstring for
@@ -628,64 +663,89 @@ def g1_msm_product_async(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    if shipped is not None and shipped.g_dev:
-        g_dev, k_dev = shipped.g_dev, shipped.k_dev
+    if shipped is not None:
+        chunks = shipped.chunks
         compressed = shipped.compressed
+        if not chunks:
+            return None
     else:
-        g_dev, k_dev = _split_groups(k, n_groups)
+        plan = _split_plan(k, n_groups)
         compressed = _use_compressed() and not interpret
-        if g_dev == 0:
+        if not plan:
             return None
         if (
             not interpret
             and not _allow_compile()
-            and not _product_ready(k_dev, g_dev, compressed)
+            and not all(
+                _product_ready(g * n, g, compressed) for g in plan
+            )
         ):
             return None
+        chunks = [(g, g * n, None, None) for g in plan]
 
     nb = _S_BITS // 8
-    dev_sc = jax.device_put(scalar_bytes_batch(s_coeffs[:k_dev], nb))
-    if shipped is not None and shipped.dev is not None:
-        if compressed:
-            pts_t, dig_t = _unpack_compressed_device(
-                shipped.dev, shipped.dev_meta, dev_sc
+    k_dev = sum(kd for _, kd, _, _ in chunks)
+    sc = scalar_bytes_batch(s_coeffs[:k_dev], nb)
+    gsums = []
+    g_dev = 0
+    lo = 0
+    for g, kd, dev, dev_meta in chunks:
+        kp = _bucket_rows(kd)
+        sc_chunk = sc[lo : lo + kd]
+        if kp != kd:
+            sc_chunk = np.concatenate(
+                [sc_chunk, np.zeros((kp - kd, nb), dtype=np.uint8)]
             )
+        dev_sc = jax.device_put(sc_chunk)
+        if dev is not None:
+            if compressed:
+                pts_t, dig_t = _unpack_compressed_device(
+                    dev, dev_meta, dev_sc
+                )
+            else:
+                pts_t, dig_t = _unpack_device(dev, dev_sc)
         else:
-            pts_t, dig_t = _unpack_device(shipped.dev, dev_sc)
-    else:
-        wires = (
-            shipped.wires[:k_dev]
-            if shipped
-            else g1_wires_batch(pts_list[:k_dev])
-        )
-        if compressed and not interpret:
-            x, meta = compress_rows(wires, k_dev)
-            pts_t, dig_t = _unpack_compressed_device(
-                jax.device_put(x), jax.device_put(meta), dev_sc
+            dev, dev_meta = _put_chunk(
+                g1_wires_batch(pts_list[lo : lo + kd]),
+                kd,
+                kp,
+                compressed and not interpret,
             )
-        else:
-            pts_t, dig_t = _unpack_device(jax.device_put(wires), dev_sc)
-    out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
-    prods = pallas_ec._untile(out_t, k_dev, k_dev)
-    gsums = _group_tree_device(prods, g_dev)
+            if dev_meta is not None:
+                pts_t, dig_t = _unpack_compressed_device(
+                    dev, dev_meta, dev_sc
+                )
+            else:
+                pts_t, dig_t = _unpack_device(dev, dev_sc)
+        out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
+        prods = pallas_ec._untile(out_t, kd, kp)  # slice the padding
+        gsums.append(_group_tree_device(prods, g))
+        g_dev += g
+        lo += kd
 
     t_list = list(t_coeffs)
     host_pts = pts_list[k_dev:]
-    host_flat = None
-    if host_pts:
-        host_flat = [
-            (s_coeffs[k_dev + i] * t_list[g_dev + i // n]) % F.R
-            for i in range(k - k_dev)
-        ]
+    s_tail = list(s_coeffs[k_dev:])  # snapshot against caller mutation
 
     def finalize():
-        # host half FIRST: native Pippenger runs while the device half
-        # is still in flight; only then block on the device result
-        host_sum = (
-            CpuBackend().g1_msm(host_pts, host_flat) if host_pts else None
-        )
-        arr = np.asarray(gsums)
-        group_pts = [ec_jax.g1_from_limbs(arr[i]) for i in range(g_dev)]
+        # host half FIRST: native Pippenger runs while the device
+        # chunks are still in flight; only then block on their results.
+        # The flat coefficient products are built HERE, not at launch —
+        # launch-time work delays the caller's G2 MSMs/pairings, the
+        # exact overlap the async contract exists to provide.
+        host_sum = None
+        if host_pts:
+            host_flat = [
+                (s_tail[i] * t_list[g_dev + i // n]) % F.R
+                for i in range(k - k_dev)
+            ]
+            host_sum = CpuBackend().g1_msm(host_pts, host_flat)
+        group_pts = []
+        for gs in gsums:
+            arr = np.asarray(gs)
+            group_pts.extend(
+                ec_jax.g1_from_limbs(arr[i]) for i in range(arr.shape[0])
+            )
         dev_sum = CpuBackend().g1_msm(group_pts, t_list[:g_dev])
         return dev_sum + host_sum if host_sum is not None else dev_sum
 

@@ -28,6 +28,11 @@ pytestmark = pytest.mark.skipif(
     "(HBBFT_TPU_HW=1, outside the CPU-forced CI)",
 )
 
+if _ON_TPU:  # the smoke gate is a warming entry point (backend_tpu.py)
+    import os
+
+    os.environ.setdefault("HBBFT_TPU_WARM", "1")
+
 
 def _fr_scalars(rng, k):
     from hbbft_tpu.ops import limbs as LB
@@ -85,13 +90,90 @@ class TestWindowedKernelsHw:
         assert got == g1_multi_exp(pts, scalars)
 
 
+class TestPackedHw:
+    """Round-4 shipping paths: packed-wire transfer + on-device unpack
+    (flat and compressed) and the hybrid factored product split."""
+
+    def test_packed_flat_matches_host(self):
+        from hbbft_tpu import native as NT
+        from hbbft_tpu.crypto.backend import CpuBackend
+        from hbbft_tpu.crypto.curve import G1, G1_GEN
+        from hbbft_tpu.ops import limbs as LB, packed_msm
+
+        rng = random.Random(0x55)
+        k = 65536  # the headline bucket (warm executables)
+        base = G1_GEN * rng.randrange(1, LB.R)
+        xs = [rng.randrange(1, LB.R) for _ in range(k)]
+        pts = [
+            NT.g1_unwire(w, G1)
+            for w in NT.g1_mul_many(NT.g1_wire(base), xs)
+        ]
+        scalars = [rng.getrandbits(192) % LB.R for _ in range(k)]
+        got = packed_msm.g1_msm_packed(pts, scalars, nbits=192)
+        assert got == CpuBackend().g1_msm(pts, scalars)
+
+    def test_hybrid_product_split_matches_host(self):
+        from hbbft_tpu import native as NT
+        from hbbft_tpu.crypto import fields as F
+        from hbbft_tpu.crypto.backend import CpuBackend
+        from hbbft_tpu.crypto.curve import G1, G1_GEN
+        from hbbft_tpu.ops import limbs as LB, packed_msm
+
+        rng = random.Random(0x56)
+        G, n = 16, 4096  # kd = 8·4096 = 32768: warm kernel/unpack shapes
+        k = G * n
+        base = G1_GEN * rng.randrange(1, LB.R)
+        xs = [rng.randrange(1, LB.R) for _ in range(k)]
+        pts = [
+            NT.g1_unwire(w, G1)
+            for w in NT.g1_mul_many(NT.g1_wire(base), xs)
+        ]
+        s = [rng.getrandbits(96) | 1 for _ in range(k)]
+        ts = [rng.getrandbits(96) | 1 for _ in range(G)]
+        fin = packed_msm.g1_msm_product_async(pts, s, ts, [n] * G)
+        assert fin is not None  # a device share must exist on hw
+        flat = [
+            (s[g * n + i] * ts[g]) % F.R for g in range(G) for i in range(n)
+        ]
+        assert fin() == CpuBackend().g1_msm(pts, flat)
+
+    def test_compressed_unpack_on_device(self):
+        # 48-byte x + device sqrt reconstructs the same points as the
+        # 96-byte path (sign + infinity handling) on the real chip
+        import jax
+
+        from hbbft_tpu.crypto.curve import G1, G1_GEN
+        from hbbft_tpu.ops import ec_jax, packed_msm
+
+        rng = random.Random(0x57)
+        k = 128
+        pts = [G1_GEN * rng.randrange(1, 1 << 64) for _ in range(k)]
+        pts[3] = G1.infinity()
+        scalars = [rng.getrandbits(96) | 1 for _ in range(k)]
+        wires = packed_msm.g1_wires_batch(pts)
+        sc = packed_msm.scalar_bytes_batch(scalars, 12)
+        x, meta = packed_msm.compress_rows(wires, k)
+        ref_t, ref_d = packed_msm._unpack_device(
+            jax.device_put(wires), jax.device_put(sc)
+        )
+        got_t, got_d = packed_msm._unpack_compressed_device(
+            jax.device_put(x), jax.device_put(meta), jax.device_put(sc)
+        )
+        assert np.array_equal(np.asarray(got_d), np.asarray(ref_d))
+        ref = np.asarray(ref_t)
+        got = np.asarray(got_t)
+        for t in range(0, 128, 13):
+            a = ec_jax.g1_from_limbs(ref[0, :, :, t])
+            b = ec_jax.g1_from_limbs(got[0, :, :, t])
+            assert a == b, t
+
+
 class TestBackendRoutingHw:
     def test_backend_batch_verify_on_device(self):
         """The TpuBackend's fused share verification with the G1
-        routing band forced open (the shipping band is empty on this
-        host — ops/backend_tpu.py) agrees with ground truth, so a
-        marshalling/kernel regression in the device leg cannot hide
-        behind host routing."""
+        routing band forced open below the shipping threshold agrees
+        with ground truth, so a marshalling/kernel regression in the
+        device leg cannot hide behind host routing."""
         from hbbft_tpu.crypto.curve import G2_GEN
         from hbbft_tpu.crypto.hashing import hash_to_g1
         from hbbft_tpu.ops import limbs as LB

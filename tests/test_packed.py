@@ -181,15 +181,138 @@ def test_product_async_default_matches_flat():
     assert fin() == be.g1_msm(pts, flat)
 
 
-def test_packed_product_shape_fallbacks():
+def test_packed_product_shape_fallbacks(monkeypatch):
     rng = random.Random(43)
     pts = _random_points(rng, 6, with_inf=False)
     s = [1] * 6
     # non-uniform group sizes → None
     assert packed_msm.g1_msm_product_async(pts, s, [1, 1], [2, 4]) is None
-    # total not on a tile-bucket boundary (6 != bucket_rows(6)) → None
-    assert packed_msm.g1_msm_product_async(pts, s, [1, 1, 1], [2, 2, 2]) is None
     assert packed_msm.g1_msm_product_async([], [], [], []) is None
+    # a single group past the proven per-group-tree scale → None
+    # (fraction 1 so the want>0 path actually reaches the guard)
+    monkeypatch.setattr(packed_msm, "_MAX_GTREE", 4)
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "1")
+    assert (
+        packed_msm.g1_msm_product_async(pts, s, [1], [6]) is None
+    )
+    # device fraction 0 → all-host, no device share
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "0")
+    assert (
+        packed_msm.g1_msm_product_async(pts, s, [1, 1, 1], [2, 2, 2])
+        is None
+    )
+
+
+def test_ready_predicates_mirror_cached_keys(monkeypatch):
+    """``_flat_ready``/``_product_ready`` must probe EXACTLY the
+    executable keys the device paths build — any drift (a renamed
+    kernel, a changed digit width, a different tree chunking) makes
+    ``exec_available`` probe keys that are never written, and on
+    production hosts (no ``HBBFT_TPU_WARM``) the device path then
+    silently falls back to host Pippenger forever."""
+    import jax
+
+    built = []
+
+    def rec_cc(name, fn, *args, key_parts=None):
+        if key_parts is None:
+            key_parts = tuple(
+                (tuple(a.shape), str(getattr(a, "dtype", "")))
+                for a in args
+            )
+        built.append(pallas_ec._exec_key(name, key_parts))
+        return jax.jit(fn)(*args)
+
+    def rec_tiles(name, kernel, pts_t, aux_t):
+        built.append(
+            pallas_ec._exec_key(
+                name, (tuple(pts_t.shape), tuple(aux_t.shape))
+            )
+        )
+        return _host_windowed_tiles(pts_t, aux_t, True)
+
+    monkeypatch.setattr(pallas_ec, "cached_compiled", rec_cc)
+    monkeypatch.setattr(pallas_ec, "_cached_tiles", rec_tiles)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("HBBFT_TPU_WARM", "1")
+
+    rng = random.Random(67)
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto import fields as F
+
+    # flat path, k=5 → kp=128, nb=2
+    pts = _random_points(rng, 5)
+    scalars = [rng.getrandbits(16) for _ in range(5)]
+    got = packed_msm.g1_msm_packed(pts, scalars, nbits=16)
+    assert got == CpuBackend().g1_msm(pts, scalars)
+
+    # product path, 4 groups of 3 → plan [2], kd=6 padded to kp=128
+    k, G = 12, 4
+    ppts = _random_points(rng, k, with_inf=False)
+    s = [rng.getrandbits(16) | 1 for _ in range(k)]
+    ts = [rng.getrandbits(16) | 1 for _ in range(G)]
+    fin = packed_msm.g1_msm_product_async(ppts, s, ts, [3] * G)
+    assert fin is not None
+    flat = [(s[g * 3 + i] * ts[g]) % F.R for g in range(G) for i in range(3)]
+    assert fin() == CpuBackend().g1_msm(ppts, flat)
+
+    # the predicates must probe exactly the keys the paths built
+    probes = []
+    monkeypatch.setattr(
+        pallas_ec,
+        "exec_available",
+        lambda name, kp: probes.append(pallas_ec._exec_key(name, kp))
+        or True,
+    )
+    assert packed_msm._flat_ready(128, 2)
+    assert packed_msm._product_ready(6, 2, False)
+    assert set(built) == set(probes), (
+        sorted(set(built) - set(probes)),
+        sorted(set(probes) - set(built)),
+    )
+
+
+def test_split_plan_shapes(monkeypatch):
+    # headline flush 64×1024: one bucket-exact chunk at the device
+    # fraction (the measured r4 hybrid configuration)
+    assert packed_msm._split_plan(65536, 64) == [32]
+    # hb_1024_real flush 974×974: uniform padded chunks within the
+    # per-group-tree scale — 7 × 67 groups ≈ 48% of points on device
+    assert packed_msm._split_plan(948676, 974) == [67] * 7
+    assert all(
+        g * 974 <= packed_msm._MAX_GTREE
+        for g in packed_msm._split_plan(948676, 974)
+    )
+    # full device fraction takes (nearly) everything, uniform shapes
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "1")
+    plan = packed_msm._split_plan(948676, 974)
+    assert sum(plan) == 938 and len(set(plan)) == 1
+    # ragged totals (not divisible by the group count) → no share
+    assert packed_msm._split_plan(7, 3) == []
+
+
+def test_packed_product_padded_groups(host_kernel):
+    # group sizes that never land on a tile bucket (the hb_1024_real
+    # shape family): the device chunk is bucket-padded and the padding
+    # sliced off before the per-group tree — results must still equal
+    # the flat host MSM, with the trailing groups on host Pippenger
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto import fields as F
+
+    rng = random.Random(59)
+    G, n = 4, 3  # k = 12; plan takes 2 leading groups (kd=6 → kp=128)
+    k = G * n
+    pts = _random_points(rng, k, with_inf=True)
+    s = [rng.getrandbits(16) | 1 for _ in range(k)]
+    ts = [rng.getrandbits(16) | 1 for _ in range(G)]
+    fin = packed_msm.g1_msm_product_async(
+        pts, s, ts, [n] * G, interpret=True
+    )
+    assert fin is not None
+    flat = [
+        (s[g * n + i] * ts[g]) % F.R for g in range(G) for i in range(n)
+    ]
+    assert fin() == CpuBackend().g1_msm(pts, flat)
 
 
 def test_packed_product_matches_flat(host_kernel):
