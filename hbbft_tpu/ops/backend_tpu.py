@@ -134,8 +134,12 @@ class TpuBackend(CpuBackend):
     #   - loaded host (anything sharing the single CPU core): device
     #     4.1-4.9 s/flush vs host 5.0-7.0 s — device wins;
     #   - the SHIPPING flush splits the factored product across BOTH
-    #     engines concurrently (packed_msm._device_fraction), so it is
-    #     ≥ the better engine under either regime.
+    #     engines concurrently at the measured balance point
+    #     (packed_msm.learned_fraction / _adapt — a rate-balance
+    #     controller solves per-shape for the split where the device
+    #     half finishes just as the host half does, from EMA rate
+    #     estimates), so it tracks the better split under either
+    #     regime instead of pinning a compile-time constant.
     #
     # Small MSMs stay launch-latency-bound, so the band opens at 16k.
     # A shape inside the band still falls back to host unless its

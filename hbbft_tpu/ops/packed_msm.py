@@ -444,20 +444,168 @@ def _use_compressed() -> bool:
     return os.environ.get("HBBFT_TPU_COMPRESS", "0") == "1"
 
 
-def _device_fraction() -> float:
-    """The share of a product-form flush's groups the DEVICE takes;
-    the rest run native host Pippenger on the CPU **simultaneously**
-    (the host half computes inside the finalizer while the device half
-    is in flight).  The two engines are independent resources on this
-    host — a hybrid split beats either alone (measured r4).  Tunable
-    via HBBFT_TPU_DEVICE_FRACTION (0 = all host, 1 = all device)."""
+def _env_fraction() -> Optional[float]:
+    """Operator override for the device share of a product flush
+    (HBBFT_TPU_DEVICE_FRACTION, 0 = all host, 1 = all device).  When
+    set it pins EVERY shape and disables the measured controller below
+    — the bench uses it to force the pure-engine comparison legs."""
     import math
 
+    env = os.environ.get("HBBFT_TPU_DEVICE_FRACTION")
+    if env is None:
+        return None
     try:
-        rho = float(os.environ.get("HBBFT_TPU_DEVICE_FRACTION", "0.5"))
+        rho = float(env)
     except ValueError:
-        return 0.5
-    return rho if math.isfinite(rho) else 0.5
+        return None  # malformed override: fall back to the controller
+    return rho if math.isfinite(rho) else None
+
+
+# Measured host/device balance, per flush shape ("n:n_groups" →
+# {"rho", "d", "h"}).  The finalizer's controller (``_adapt``) keeps
+# EMA estimates of each engine's end-to-end rate (points/s) and solves
+# for the split where the device half (which also covers the caller's
+# overlapped G2/pairing work) finishes just as the host half does —
+# the split then tracks the *actual* load regime (idle vs contended
+# CPU, tunnel weather) instead of a compile-time constant, and the
+# hybrid flush stays ≥ the better single engine in either regime.
+# Persisted next to the executable cache so a fresh process starts
+# from the last measured balance instead of 0.5.
+_RHO_DEFAULT = 0.5
+_RHO_STATE: Optional[dict] = None
+
+
+def _rho_path() -> str:
+    from . import pallas_ec
+
+    return os.path.join(pallas_ec._exec_cache_dir(), "device_fraction.json")
+
+
+def _rho_state() -> dict:
+    global _RHO_STATE
+    if _RHO_STATE is None:
+        import json
+
+        state: dict = {}
+        try:
+            with open(_rho_path()) as fh:
+                raw = json.load(fh)
+        except Exception:
+            raw = {}
+        for k, v in raw.items() if isinstance(raw, dict) else ():
+            try:  # per-entry: one malformed entry must not drop the rest
+                if isinstance(v, dict):
+                    if 0.0 < float(v.get("rho", -1)) < 1.0:
+                        state[str(k)] = {
+                            "rho": float(v["rho"]),
+                            "d": float(v["d"]) if v.get("d") else None,
+                            "h": float(v["h"]) if v.get("h") else None,
+                        }
+                elif 0.0 < float(v) < 1.0:  # legacy bare-rho entries
+                    state[str(k)] = {"rho": float(v), "d": None, "h": None}
+            except (TypeError, ValueError):
+                continue
+        _RHO_STATE = state
+    return _RHO_STATE
+
+
+def _save_rho() -> None:
+    import json
+
+    try:
+        path = _rho_path()
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as fh:
+            json.dump(_rho_state(), fh)
+        os.replace(tmp, path)
+    except Exception:
+        pass  # best-effort: losing the hint only costs re-convergence
+
+
+def learned_fraction(n: int, n_groups: int) -> float:
+    """The device fraction a flush of ``n_groups`` groups of ``n``
+    points would use right now (env override or learned balance)."""
+    env = _env_fraction()
+    if env is not None:
+        return env
+    v = _rho_state().get("%d:%d" % (n, n_groups))
+    if v is None:
+        return _RHO_DEFAULT
+    if isinstance(v, dict):
+        return v.get("rho", _RHO_DEFAULT)
+    return float(v)
+
+
+def _adapt(
+    n: int,
+    n_groups: int,
+    k_dev: int,
+    k_host: int,
+    t_caller: float,
+    t_host: float,
+    t_wait: float,
+) -> None:
+    """One rate-balance step from one hybrid flush's measurements.
+
+    ``t_caller`` is the launch→finalize gap (the caller's G2 MSMs +
+    pairings that the device half overlaps), ``t_host`` the finalizer's
+    host-Pippenger wall, ``t_wait`` the residual block on the device
+    chunks afterwards.  The device half was in flight for at most
+    ``t_caller + t_host + t_wait``; when it made the finalizer wait
+    that bound is exact and updates the device-rate EMA ``d``, when it
+    finished early it is only a LOWER bound on the rate (raise ``d``
+    if it beats the estimate, never lower it).  The host rate ``h`` is
+    exact every flush.  The next split solves
+
+        rho·K/d  =  t_caller + (1-rho)·K/h
+
+    (device half finishes just as the host half does, the device
+    covering the caller's overlapped work for free), i.e.
+    ``rho* = (t_caller + K/h) / (K/d + K/h)`` — converging in a
+    couple of flushes and re-converging when the load regime shifts,
+    with no dead band and no oscillating fixed step."""
+    key = "%d:%d" % (n, n_groups)
+    state = _rho_state()
+    st = state.get(key)
+    if not isinstance(st, dict):
+        st = {"rho": st if isinstance(st, float) else _RHO_DEFAULT,
+              "d": None, "h": None}
+        state[key] = st
+    h_obs = k_host / max(t_host, 1e-6)
+    st["h"] = h_obs if st["h"] is None else 0.5 * st["h"] + 0.5 * h_obs
+    t_dev = max(t_caller + t_host + t_wait, 1e-6)
+    d_obs = k_dev / t_dev
+    if t_wait > 0.01:
+        if st["d"] is None:
+            st["d"] = d_obs
+        else:
+            # slew-rate clip: a single pathological flush (tunnel
+            # stall, one-off contention spike) moves the estimate by
+            # at most 3× — repeated genuine regime shifts still
+            # converge geometrically
+            d_obs = min(max(d_obs, st["d"] / 3.0), st["d"] * 3.0)
+            st["d"] = 0.5 * st["d"] + 0.5 * d_obs
+        st["age"] = 0
+    else:
+        # early finish: only a LOWER bound on the device rate — raise
+        # the estimate if beaten, and count staleness (small shares
+        # yield weak bounds, so a poisoned estimate could otherwise
+        # never recover)
+        if st["d"] is None or d_obs > st["d"]:
+            st["d"] = d_obs
+        st["age"] = st.get("age", 0) + 1
+    K = float(k_dev + k_host)
+    d, h = st["d"], st["h"]
+    if d and h and K:
+        rho = (t_caller + K / h) / (K / d + K / h)
+        st["rho"] = min(0.95, max(0.05, rho))
+    if t_wait <= 0.01 and st.get("age", 0) >= 4:
+        # the device-rate sample is stale (four straight early
+        # finishes): explore one step up — if it overshoots, the very
+        # next flush produces an exact straggle sample and re-solves
+        st["rho"] = min(0.95, st["rho"] + 0.1)
+        st["age"] = 0
+    _save_rho()
 
 
 # Largest device share of one product flush: the per-group tree is a
@@ -471,28 +619,55 @@ _MAX_GTREE = 1 << 16
 def _split_plan(k: int, n_groups: int) -> List[int]:
     """Group-counts of the device chunks of a uniform-group product
     flush (the LEADING ``sum(plan)`` groups run on device, the rest on
-    host).  Each chunk stays within the proven per-group-tree scale
+    host).  Plans are whole quanta only, so even a forced fraction of
+    1 covers at most ``q·(n_groups//q)`` groups — a remainder smaller
+    than one quantum stays host-side rather than adding a second
+    (cold) executable shape; "device-only" comparison legs are exact
+    when ``q | n_groups`` (the headline shape) and ~96% device
+    otherwise.  Each chunk stays within the proven per-group-tree scale
     (``_MAX_GTREE`` rows); its transfer/kernel rows are bucket-padded
     and the padding sliced off before the tree, so group sizes need NOT
     land on a tile bucket (the r4 `hb_1024_real` finding: 974-point
     groups never do, and requiring it sent 948k-point flushes down the
-    losing flat path).  All full chunks share one shape — one warm
-    executable set serves the whole flush.  [] = no device share."""
+    losing flat path).  The chunk quantum ``q`` depends only on the
+    flush SHAPE, never on the device fraction, so the adaptive
+    controller (``_adapt``) moves the split without ever leaving the
+    warm-executable lattice — one shape serves every fraction.
+    [] = no device share."""
     if n_groups <= 0 or k % n_groups:
         return []
     n = k // n_groups
-    rho = _device_fraction()
+    cap = _MAX_GTREE // n
+    if cap == 0:
+        return []  # a single group alone exceeds the proven tree scale
+    rho = learned_fraction(n, n_groups)
     if rho <= 0.0:
         return []
-    want = n_groups if rho >= 0.999 else max(0, int(n_groups * rho))
-    if want == 0:
+    # quantum: ≥8 steps of fraction resolution when the tree scale
+    # allows it, capped so every chunk stays within _MAX_GTREE rows
+    q = min(cap, max(1, n_groups // 8))
+    m_max = n_groups // q
+    if _env_fraction() is None:
+        # adaptive mode: keep BOTH engines measurable every flush so
+        # the controller can always re-balance — reserve one host
+        # chunk at the top (a plan covering all groups would empty the
+        # host tail and freeze `_adapt` at full-device forever) and
+        # keep one device chunk at the bottom (an all-host plan never
+        # reaches the finalizer's measurement at all).  A shape whose
+        # only possible plan covers everything (single group) cannot
+        # be balanced and stays host-side.
+        if q * m_max >= n_groups:
+            m_max -= 1
+        if m_max < 1:
+            return []
+        m = max(1, min(int(round(n_groups * min(rho, 1.0) / q)), m_max))
+    else:
+        m = min(int(round(n_groups * min(rho, 1.0) / q)), m_max)
+    if m <= 0:
         return []
-    g_c = min(want, max(1, _MAX_GTREE // n))
-    if g_c * n > _MAX_GTREE:
-        return []  # a single group alone exceeds the proven tree scale
     # no remainder chunk alongside full ones: it would add a second
     # (cold) executable shape for under one chunk of work
-    return [g_c] * (want // g_c)
+    return [q] * m
 
 
 class ShippedPoints:
@@ -638,7 +813,8 @@ def g1_msm_product_async(
     uniform-shape chunks (packed transfer → windowed kernel →
     bucket-padding slice → per-group trees), the rest run native host
     Pippenger INSIDE the finalizer while the device chunks are in
-    flight — both engines busy simultaneously (``_device_fraction``).
+    flight — both engines busy simultaneously, split at the measured
+    balance point (``learned_fraction`` / ``_adapt``).
     Returns ``None`` when no conforming device share exists
     (non-uniform group sizes, a single group past the tree scale, cold
     executables) and the caller falls back to the flat/host path.
@@ -697,26 +873,15 @@ def g1_msm_product_async(
                 [sc_chunk, np.zeros((kp - kd, nb), dtype=np.uint8)]
             )
         dev_sc = jax.device_put(sc_chunk)
-        if dev is not None:
-            if compressed:
-                pts_t, dig_t = _unpack_compressed_device(
-                    dev, dev_meta, dev_sc
-                )
-            else:
-                pts_t, dig_t = _unpack_device(dev, dev_sc)
-        else:
+        if dev is None:  # lazy marshalling (no ShippedPoints handle)
             dev, dev_meta = _put_chunk(
-                g1_wires_batch(pts_list[lo : lo + kd]),
-                kd,
-                kp,
-                compressed and not interpret,
+                g1_wires_batch(pts_list[lo : lo + kd]), kd, kp, compressed
             )
-            if dev_meta is not None:
-                pts_t, dig_t = _unpack_compressed_device(
-                    dev, dev_meta, dev_sc
-                )
-            else:
-                pts_t, dig_t = _unpack_device(dev, dev_sc)
+        # _put_chunk returns meta iff compressed, on both paths
+        if dev_meta is not None:
+            pts_t, dig_t = _unpack_compressed_device(dev, dev_meta, dev_sc)
+        else:
+            pts_t, dig_t = _unpack_device(dev, dev_sc)
         out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
         prods = pallas_ec._untile(out_t, kd, kp)  # slice the padding
         gsums.append(_group_tree_device(prods, g))
@@ -726,6 +891,9 @@ def g1_msm_product_async(
     t_list = list(t_coeffs)
     host_pts = pts_list[k_dev:]
     s_tail = list(s_coeffs[k_dev:])  # snapshot against caller mutation
+    import time
+
+    t_launch = time.perf_counter()
 
     def finalize():
         # host half FIRST: native Pippenger runs while the device
@@ -733,6 +901,8 @@ def g1_msm_product_async(
         # The flat coefficient products are built HERE, not at launch —
         # launch-time work delays the caller's G2 MSMs/pairings, the
         # exact overlap the async contract exists to provide.
+        t_caller = time.perf_counter() - t_launch
+        t0 = time.perf_counter()
         host_sum = None
         if host_pts:
             host_flat = [
@@ -740,9 +910,16 @@ def g1_msm_product_async(
                 for i in range(k - k_dev)
             ]
             host_sum = CpuBackend().g1_msm(host_pts, host_flat)
+        t_host = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        arrs = [np.asarray(gs) for gs in gsums]  # blocks on the device
+        t_wait = time.perf_counter() - t0
+        if host_pts and not interpret and _env_fraction() is None:
+            _adapt(
+                n, n_groups, k_dev, k - k_dev, t_caller, t_host, t_wait
+            )
         group_pts = []
-        for gs in gsums:
-            arr = np.asarray(gs)
+        for arr in arrs:
             group_pts.extend(
                 ec_jax.g1_from_limbs(arr[i]) for i in range(arr.shape[0])
             )

@@ -120,7 +120,7 @@ class TestPackedHw:
         from hbbft_tpu.ops import limbs as LB, packed_msm
 
         rng = random.Random(0x56)
-        G, n = 16, 4096  # kd = 8·4096 = 32768: warm kernel/unpack shapes
+        G, n = 16, 4096  # 2-group chunks, kd = 2·4096 = 8192 each
         k = G * n
         base = G1_GEN * rng.randrange(1, LB.R)
         xs = [rng.randrange(1, LB.R) for _ in range(k)]

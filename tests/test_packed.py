@@ -235,6 +235,7 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
     monkeypatch.setattr(pallas_ec, "_cached_tiles", rec_tiles)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setenv("HBBFT_TPU_WARM", "1")
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "0.5")
 
     rng = random.Random(67)
     from hbbft_tpu.crypto.backend import CpuBackend
@@ -246,7 +247,7 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
     got = packed_msm.g1_msm_packed(pts, scalars, nbits=16)
     assert got == CpuBackend().g1_msm(pts, scalars)
 
-    # product path, 4 groups of 3 → plan [2], kd=6 padded to kp=128
+    # product path, 4 groups of 3 → plan [1, 1], kd=3 padded to kp=128
     k, G = 12, 4
     ppts = _random_points(rng, k, with_inf=False)
     s = [rng.getrandbits(16) | 1 for _ in range(k)]
@@ -265,7 +266,7 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
         or True,
     )
     assert packed_msm._flat_ready(128, 2)
-    assert packed_msm._product_ready(6, 2, False)
+    assert packed_msm._product_ready(3, 1, False)
     assert set(built) == set(probes), (
         sorted(set(built) - set(probes)),
         sorted(set(probes) - set(built)),
@@ -273,9 +274,11 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
 
 
 def test_split_plan_shapes(monkeypatch):
-    # headline flush 64×1024: one bucket-exact chunk at the device
-    # fraction (the measured r4 hybrid configuration)
-    assert packed_msm._split_plan(65536, 64) == [32]
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "0.5")
+    # headline flush 64×1024: the quantum is shape-only (8 groups), so
+    # the adaptive fraction moves the split without leaving the
+    # warm-executable lattice — at 0.5, four 8-group chunks
+    assert packed_msm._split_plan(65536, 64) == [8] * 4
     # hb_1024_real flush 974×974: uniform padded chunks within the
     # per-group-tree scale — 7 × 67 groups ≈ 48% of points on device
     assert packed_msm._split_plan(948676, 974) == [67] * 7
@@ -287,8 +290,84 @@ def test_split_plan_shapes(monkeypatch):
     monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "1")
     plan = packed_msm._split_plan(948676, 974)
     assert sum(plan) == 938 and len(set(plan)) == 1
+    assert packed_msm._split_plan(65536, 64) == [8] * 8
     # ragged totals (not divisible by the group count) → no share
     assert packed_msm._split_plan(7, 3) == []
+
+
+def test_adaptive_fraction_controller(monkeypatch):
+    """The rate-balance controller: exact device-rate samples when the
+    device straggles, lower-bound-only raises when it finishes early,
+    and the solved split stays clamped away from the all-or-nothing
+    edges (a pathological regime must stay recoverable)."""
+    monkeypatch.delenv("HBBFT_TPU_DEVICE_FRACTION", raising=False)
+    monkeypatch.setattr(packed_msm, "_RHO_STATE", {})
+    monkeypatch.setattr(packed_msm, "_save_rho", lambda: None)
+    n, g = 1024, 64
+    K = 65536
+    assert packed_msm.learned_fraction(n, g) == 0.5
+    # device straggled 1 s past a 1 s host half (0.5 s caller overlap):
+    # exact rate sample d = K/2 / 2.5, h = K/2 / 1.0 → the solved
+    # balance rho* = (0.5 + K/h)/(K/d + K/h) = 2.5/7
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 1.0)
+    rho1 = packed_msm.learned_fraction(n, g)
+    assert abs(rho1 - 2.5 / 7.0) < 1e-6
+    # device finished early at a small share: only a LOWER bound on its
+    # rate, weaker than the current estimate → no movement
+    packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.75, 0.0)
+    assert abs(packed_msm.learned_fraction(n, g) - rho1) < 1e-6
+    # a STRONG early finish raises the device-rate floor → share up
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 0.5, 0.0)
+    assert packed_msm.learned_fraction(n, g) > rho1
+    # ceiling: an absurdly fast device still caps at 0.95
+    packed_msm._adapt(n, g, 60000, 5536, 0.0, 0.01, 0.0)
+    assert packed_msm.learned_fraction(n, g) <= 0.95
+    # floor: a collapsed device rate clamps at 0.05, not 0 — and the
+    # slew-rate clip bounds one pathological flush's damage to 3×
+    packed_msm._rho_state()["%d:%d" % (n, g)] = {
+        "rho": 0.5, "d": 30000.0, "h": 30000.0
+    }
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.0, 1.0, 46.0)
+    st = packed_msm._rho_state()["%d:%d" % (n, g)]
+    assert st["d"] == 0.5 * 30000 + 0.5 * 10000  # clipped at d/3
+    packed_msm._rho_state()["%d:%d" % (n, g)] = {
+        "rho": 0.5, "d": 100.0, "h": 1e9
+    }
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.0, 0.001, 10.0)
+    assert packed_msm.learned_fraction(n, g) == 0.05
+    # staleness exploration: four straight early finishes with weak
+    # lower bounds bump the share up a step, so a poisoned (too-low)
+    # device estimate always regains contact with the straggle
+    # frontier and re-solves from a fresh exact sample
+    packed_msm._rho_state()["%d:%d" % (n, g)] = {
+        "rho": 0.11, "d": 5000.0, "h": 46000.0
+    }
+    for _ in range(4):
+        packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.2, 0.0)
+    assert packed_msm.learned_fraction(n, g) > 0.15
+    # adaptive plans must keep BOTH engines measurable: even at the
+    # rho ceiling one host chunk is reserved, and even at the floor
+    # one device chunk survives — so _adapt always runs again and no
+    # regime shift can freeze the controller (review finding r4)
+    packed_msm._rho_state()["1024:64"] = 0.95
+    assert packed_msm._split_plan(65536, 64) == [8] * 7  # not 8: host tail
+    packed_msm._rho_state()["1024:64"] = 0.10
+    assert packed_msm._split_plan(65536, 64) == [8]  # floor keeps one
+    # a single-group flush cannot be balanced (no host tail possible):
+    # adaptive mode keeps it host-side rather than freezing at 100%
+    assert packed_msm._split_plan(2048, 1) == []
+    # env override pins every shape, bypasses the learned state, and
+    # may take the whole flush (the bench's device-only leg)
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "0.75")
+    assert packed_msm.learned_fraction(n, g) == 0.75
+    assert packed_msm.learned_fraction(7, 7) == 0.75
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "1")
+    assert packed_msm._split_plan(65536, 64) == [8] * 8
+    # malformed override: fall back to the learned state, not 0.5-pin
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "half")
+    assert packed_msm.learned_fraction(n, g) == 0.10
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "nan")
+    assert packed_msm.learned_fraction(n, g) == 0.10
 
 
 def test_packed_product_padded_groups(host_kernel):
