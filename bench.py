@@ -1045,6 +1045,7 @@ def bench_dkg_256(nodes: int = 256):
         nodes=nodes,
         threshold=t,
         elided=True,
+        engine=res.engine,
         crypto="real",
     )
 
@@ -1068,10 +1069,12 @@ def bench_dkg_verified_256(nodes: int = 256):
     dt = time.perf_counter() - t0
     assert res.fault_log.is_empty() and len(res.complete) == nodes
 
-    # elided twin over the same seed: identical outputs
+    # elided twin over the same seed: identical outputs (host engine —
+    # the equality being asserted is elided-vs-verified, so both runs
+    # must draw the same dealer polynomial streams)
     dkg2 = VectorizedDkg(list(range(nodes)), t, _r.Random(0xD8), mock=False)
     t0 = time.perf_counter()
-    res2 = dkg2.run(verify_honest=False)
+    res2 = dkg2.run(verify_honest=False, engine="host")
     elided_dt = time.perf_counter() - t0
     assert res.pk_set.public_key().to_bytes() == res2.pk_set.public_key().to_bytes()
     assert all(
@@ -1146,6 +1149,7 @@ def bench_dkg_1024(nodes: int = 1024):
         nodes=nodes,
         threshold=t,
         elided=True,
+        engine=res.engine,
         seq_est_s=round(seq_est, 1),
         crypto="real",
     )

@@ -1,0 +1,235 @@
+"""Device Fr (BLS12-381 scalar field) matrix products on the MXU.
+
+The DKG's dealing plane is matrix multiplication over Fr: row grids
+``ROWS_d = POW·C_d`` and value grids ``VAL_d = ROWS_d·POWᵀ``
+(``harness/dkg.py``, the vectorized form of the per-node evaluation
+work in ``sync_key_gen.rs:268-299``).  At N=1024 (degree-341 bivariate
+polynomials) that is ~2·10¹¹ Fr multiplications — hours on the native
+single-core host path (measured: the N=1024 DKG exceeds 2 h).  This
+module maps the same algebra onto the TPU's systolic array:
+
+- Fr elements are **8-bit limb vectors** (``FR_LIMBS = 33`` limbs,
+  little-endian, a redundant representation closed under the fold:
+  any 33-limb value < 2^264, congruent mod r).  8-bit limbs are the
+  MXU's native int8 operand width.
+- An [m,k]×[k,p] Fr product becomes ONE ``dot_general`` over u8 limbs
+  with int32 accumulation — ``P[m,a,p,b] = Σ_k A[m,k,a]·B[k,p,b]`` —
+  i.e. an (m·33)×k×(p·33) int8 matmul the MXU tiles natively,
+  followed by cheap vector work: diagonal-sum into convolution
+  positions, a carry sweep to base-256 digits, and a fold of the
+  digits above position 32 through precomputed ``2^(8j) mod r``
+  tables back into 33 limbs.
+- Exactness: products ≤ 255², accumulated over ≤ k·33 terms — int32
+  holds for k ≤ 971 (asserted; the DKG contracts k = t+1 ≤ 342).
+  Every step is integer-exact; the representation is reduced to
+  canonical form (``% r``) only at the host boundary.
+
+Fold-bound argument (why 33 limbs is a fixed point): after the carry
+sweep the product has ≤ 70 base-256 digits.  Folding every digit at
+position ≥ 32 through ``K_j = 2^(8(32+j)) mod r < 2^255`` leaves
+``lo < 2^256`` plus ≤ 38 terms ≤ 255·2^255 each → < 2^269 (34
+digits); a second fold (≤ 2 terms) → < 2^256 + 2^264; a third fold
+(terms d₃₂ ≤ 255, d₃₃ ≤ 1) → < 2^256 + 256·2^255 < 2^264 — closed at
+33 limbs.  Three post-carry folds therefore suffice for ANY input
+pair, and a fourth is never needed.
+
+No Pallas: everything is plain XLA (fast server-side compiles, runs
+on the CPU backend for tests).  The matmul is where the FLOPs are and
+XLA tiles it onto the MXU; hand-scheduling the rest would fight the
+compiler for the ~2% that is vector work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import fields as F
+
+R = F.R
+FR_LIMBS = 33  # 8-bit limbs; values < 2^264, congruent mod r
+_MAX_K = 971  # int32 accumulation bound: 255² · k · 33 < 2^31
+
+
+def _fold_table(offset: int, count: int) -> np.ndarray:
+    """[count, FR_LIMBS] u8 — row j holds ``2^(8·(offset+j)) mod r``
+    as little-endian bytes (canonical, so the top limb is 0)."""
+    out = np.zeros((count, FR_LIMBS), dtype=np.uint8)
+    for j in range(count):
+        k = pow(2, 8 * (offset + j), R)
+        out[j] = np.frombuffer(
+            k.to_bytes(FR_LIMBS, "little"), dtype=np.uint8
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host ↔ limb conversions
+# ---------------------------------------------------------------------------
+
+
+def fr_to_limbs(vals: Sequence[int]) -> np.ndarray:
+    """Python ints (any size; reduced mod r) → [n, FR_LIMBS] u8."""
+    out = np.empty((len(vals), FR_LIMBS), dtype=np.uint8)
+    for i, v in enumerate(vals):
+        out[i] = np.frombuffer(
+            int(v % R).to_bytes(FR_LIMBS, "little"), dtype=np.uint8
+        )
+    return out
+
+
+def limbs_to_fr(arr: np.ndarray) -> List[int]:
+    """[..., FR_LIMBS] u8 → canonical ints mod r (host reduction)."""
+    flat = np.asarray(arr, dtype=np.uint8).reshape(-1, FR_LIMBS)
+    raw = flat.tobytes()
+    step = FR_LIMBS
+    return [
+        int.from_bytes(raw[i * step : (i + 1) * step], "little") % R
+        for i in range(flat.shape[0])
+    ]
+
+
+def be32_to_limbs(buf: np.ndarray) -> np.ndarray:
+    """The native layout ([n·32] u8, 32-byte big-endian words —
+    ``harness/dkg._fr_bytes``) → [n, FR_LIMBS] u8 little-endian."""
+    b = np.asarray(buf, dtype=np.uint8).reshape(-1, 32)
+    le = b[:, ::-1]
+    out = np.zeros((le.shape[0], FR_LIMBS), dtype=np.uint8)
+    out[:, :32] = le
+    return out
+
+
+def limbs_to_be32(arr: np.ndarray) -> np.ndarray:
+    """[..., FR_LIMBS] u8 → [n·32] u8 of canonical 32-byte big-endian
+    words (the native ``fr_matmul`` buffer layout)."""
+    vals = limbs_to_fr(arr)
+    return np.frombuffer(
+        b"".join(v.to_bytes(32, "big") for v in vals), dtype=np.uint8
+    ).copy()
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (plain XLA)
+# ---------------------------------------------------------------------------
+
+
+def _carry_sweep(digits: jnp.ndarray) -> jnp.ndarray:
+    """[..., D] int32 (non-negative) → [..., D+4] u8 base-256 digits.
+    The running carry after any position is ≤ (max term)/255 ≈ 2^23,
+    so 4 extra digits always absorb it."""
+
+    def step(carry, d):
+        tot = carry + d
+        return tot >> 8, (tot & 0xFF).astype(jnp.uint8)
+
+    xs = jnp.moveaxis(digits, -1, 0)
+    carry, ys = jax.lax.scan(step, jnp.zeros(digits.shape[:-1], jnp.int32), xs)
+    out = jnp.moveaxis(ys, 0, -1)
+    tail = []
+    for _ in range(4):
+        tail.append((carry & 0xFF).astype(jnp.uint8))
+        carry = carry >> 8
+    return jnp.concatenate([out] + [t[..., None] for t in tail], axis=-1)
+
+
+def _fold_once(digits: jnp.ndarray) -> jnp.ndarray:
+    """One fold+carry: digits [..., D] u8 (D > FR_LIMBS) →
+    [..., ≤ max(FR_LIMBS, D-?)+] u8 with every position ≥ 32 folded
+    through ``2^(8j) mod r``.  Preserves the value mod r."""
+    D = digits.shape[-1]
+    hi_n = D - 32
+    lo = digits[..., :32].astype(jnp.int32)
+    hi = digits[..., 32:]
+    table = jnp.asarray(_fold_table(32, hi_n))  # [hi_n, FR_LIMBS]
+    folded = jax.lax.dot_general(
+        hi.astype(jnp.int32),
+        table.astype(jnp.int32),
+        (((hi.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [..., FR_LIMBS]
+    summed = folded.at[..., :32].add(lo)
+    return _carry_sweep(summed)
+
+
+def _reduce_digits(digits: jnp.ndarray) -> jnp.ndarray:
+    """int32 convolution limbs → [..., FR_LIMBS] u8 (< 2^264, ≡ mod r).
+    Carry sweep then three folds (see the module-doc bound: three
+    always suffice); trailing guaranteed-zero digits are sliced off."""
+    d = _carry_sweep(digits)
+    for _ in range(3):
+        d = _fold_once(d)
+    return d[..., :FR_LIMBS]
+
+
+def _matmul_limbs(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[m, k, L] u8 × [k, p, L] u8 → [m, p, L] u8 (≡ product mod r).
+
+    The dot_general is the MXU part: contracting k with free limb
+    axes is an (m·L)×k×(p·L) int8 matmul."""
+    k = a.shape[1]
+    if k > _MAX_K:
+        raise ValueError("contraction %d exceeds int32-safe bound" % k)
+    prod = jax.lax.dot_general(
+        a.astype(jnp.uint8),
+        b.astype(jnp.uint8),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [m, La, p, Lb]
+    m, L, p = prod.shape[0], prod.shape[1], prod.shape[2]
+    conv = jnp.zeros((m, p, 2 * L - 1), jnp.int32)
+    for sh in range(L):  # limb a=sh contributes at positions sh+b
+        conv = conv.at[..., sh : sh + L].add(prod[:, sh, :, :])
+    return _reduce_digits(conv)
+
+
+@functools.lru_cache(maxsize=None)
+def _matmul_jit():
+    return jax.jit(_matmul_limbs)
+
+
+def fr_matmul_device(a: np.ndarray, b: np.ndarray) -> jnp.ndarray:
+    """Device Fr matmul on limb arrays ([m,k,L] × [k,p,L] u8); returns
+    the device array ([m,p,L] u8, values < 2^264 ≡ mod r)."""
+    return _matmul_jit()(jnp.asarray(a), jnp.asarray(b))
+
+
+def _add_limbs(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise Fr addition of limb tensors (fold keeps 33 limbs)."""
+    s = a.astype(jnp.int32) + b.astype(jnp.int32)
+    return _reduce_digits(s)
+
+
+@functools.lru_cache(maxsize=None)
+def _add_jit():
+    return jax.jit(_add_limbs)
+
+
+def fr_add_device(a, b) -> jnp.ndarray:
+    return _add_jit()(jnp.asarray(a), jnp.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Uniform sampling mod r (for on-device dealing at scale)
+# ---------------------------------------------------------------------------
+
+
+def _sample_limbs(key, shape) -> jnp.ndarray:
+    """Uniform Fr samples: 48 random bytes folded mod r (statistical
+    distance < 2^-129 from uniform), as [..., FR_LIMBS] u8."""
+    raw = jax.random.randint(
+        key, tuple(shape) + (48,), 0, 256, dtype=jnp.int32
+    )
+    return _reduce_digits(raw)
+
+
+@functools.lru_cache(maxsize=None)
+def _sample_jit():
+    return jax.jit(_sample_limbs, static_argnums=(1,))
+
+
+def sample_fr_device(key, shape) -> jnp.ndarray:
+    return _sample_jit()(key, tuple(shape))

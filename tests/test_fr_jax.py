@@ -1,0 +1,120 @@
+"""Device Fr matmul (``ops/fr_jax.py``) — exactness against the
+native host path and against plain Python big-int arithmetic,
+including adversarial-magnitude limb inputs (the redundant 33-limb
+representation's worst case)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.crypto import fields as F
+from hbbft_tpu.ops import fr_jax
+
+R = F.R
+
+
+def _rand_fr(rng, n):
+    return [rng.randrange(R) for _ in range(n)]
+
+
+def test_limb_roundtrip():
+    rng = random.Random(1)
+    vals = [0, 1, R - 1] + _rand_fr(rng, 5)
+    limbs = fr_jax.fr_to_limbs(vals)
+    assert limbs.shape == (8, fr_jax.FR_LIMBS)
+    assert fr_jax.limbs_to_fr(limbs) == vals
+
+
+def test_be32_roundtrip():
+    rng = random.Random(2)
+    vals = _rand_fr(rng, 6)
+    be = np.frombuffer(
+        b"".join(v.to_bytes(32, "big") for v in vals), dtype=np.uint8
+    )
+    limbs = fr_jax.be32_to_limbs(be)
+    assert fr_jax.limbs_to_fr(limbs) == vals
+    assert np.array_equal(fr_jax.limbs_to_be32(limbs), be)
+
+
+def test_matmul_matches_bigint():
+    rng = random.Random(3)
+    m, k, p = 3, 5, 4
+    A = [_rand_fr(rng, k) for _ in range(m)]
+    B = [_rand_fr(rng, p) for _ in range(k)]
+    a = fr_jax.fr_to_limbs([x for row in A for x in row]).reshape(
+        m, k, fr_jax.FR_LIMBS
+    )
+    b = fr_jax.fr_to_limbs([x for row in B for x in row]).reshape(
+        k, p, fr_jax.FR_LIMBS
+    )
+    got = fr_jax.limbs_to_fr(np.asarray(fr_jax.fr_matmul_device(a, b)))
+    want = [
+        sum(A[i][t] * B[t][j] for t in range(k)) % R
+        for i in range(m)
+        for j in range(p)
+    ]
+    assert got == want
+
+
+def test_matmul_matches_native():
+    from hbbft_tpu import native as NT
+
+    if not NT.available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(4)
+    m, k, p = 4, 7, 6
+    A = _rand_fr(rng, m * k)
+    B = _rand_fr(rng, k * p)
+    abuf = np.frombuffer(
+        b"".join(v.to_bytes(32, "big") for v in A), dtype=np.uint8
+    ).copy()
+    bbuf = np.frombuffer(
+        b"".join(v.to_bytes(32, "big") for v in B), dtype=np.uint8
+    ).copy()
+    want = NT.fr_matmul(abuf, bbuf, m, k, p)
+    a = fr_jax.be32_to_limbs(abuf).reshape(m, k, fr_jax.FR_LIMBS)
+    b = fr_jax.be32_to_limbs(bbuf).reshape(k, p, fr_jax.FR_LIMBS)
+    got = fr_jax.limbs_to_be32(np.asarray(fr_jax.fr_matmul_device(a, b)))
+    assert np.array_equal(got, np.asarray(want))
+
+
+def test_matmul_redundant_worst_case():
+    # all-0xFF limb inputs (value 2^264-1, far above r) through the
+    # matmul: the fold bound must hold and results stay exact mod r
+    m, k, p = 2, 3, 2
+    a = np.full((m, k, fr_jax.FR_LIMBS), 0xFF, dtype=np.uint8)
+    b = np.full((k, p, fr_jax.FR_LIMBS), 0xFF, dtype=np.uint8)
+    out = np.asarray(fr_jax.fr_matmul_device(a, b))
+    assert out.shape == (m, p, fr_jax.FR_LIMBS)
+    v = (2**264 - 1) % R
+    want = (k * v * v) % R
+    assert fr_jax.limbs_to_fr(out) == [want] * (m * p)
+
+
+def test_matmul_contraction_bound():
+    a = np.zeros((1, fr_jax._MAX_K + 1, fr_jax.FR_LIMBS), dtype=np.uint8)
+    b = np.zeros((fr_jax._MAX_K + 1, 1, fr_jax.FR_LIMBS), dtype=np.uint8)
+    with pytest.raises(ValueError):
+        fr_jax.fr_matmul_device(a, b)
+
+
+def test_add_device():
+    rng = random.Random(5)
+    xs = _rand_fr(rng, 4)
+    ys = _rand_fr(rng, 4)
+    a = fr_jax.fr_to_limbs(xs)
+    b = fr_jax.fr_to_limbs(ys)
+    got = fr_jax.limbs_to_fr(np.asarray(fr_jax.fr_add_device(a, b)))
+    assert got == [(x + y) % R for x, y in zip(xs, ys)]
+
+
+def test_sample_shape_and_range():
+    import jax
+
+    key = jax.random.PRNGKey(7)
+    s = np.asarray(fr_jax.sample_fr_device(key, (3, 2)))
+    assert s.shape == (3, 2, fr_jax.FR_LIMBS)
+    vals = fr_jax.limbs_to_fr(s)
+    assert all(0 <= v < R for v in vals)
+    assert len(set(vals)) == len(vals)  # overwhelmingly distinct
