@@ -145,16 +145,21 @@ class NetworkInfo(Generic[N]):
             sk_set = T.SecretKeySet.random(num_faulty, rng)
             sec_keys = {nid: T.SecretKey.random(rng) for nid in ids}
         pk_set = sk_set.public_keys()
-        if hasattr(pk_set, "precompute_shares"):
-            # one range evaluation for all validator indices (the
-            # shared pk_set memoizes, so every NetworkInfo below hits
-            # the cache instead of re-evaluating the commitment)
+        key_shares = [sk_set.secret_key_share(i) for i in range(len(ids))]
+        if hasattr(pk_set, "seed_share_cache_from_scalars"):
+            # the dealer holds every share scalar: one shared-base
+            # comb pass fills the cache every NetworkInfo below hits
+            # (identical points to evaluating the commitment)
+            pk_set.seed_share_cache_from_scalars(
+                {i: ks.scalar for i, ks in enumerate(key_shares)}
+            )
+        elif hasattr(pk_set, "precompute_shares"):
             pk_set.precompute_shares(len(ids))
         pub_keys = {nid: sk.public_key() for nid, sk in sec_keys.items()}
         return {
             nid: NetworkInfo(
                 nid,
-                sk_set.secret_key_share(i),
+                key_shares[i],
                 sec_keys[nid],
                 pk_set,
                 pub_keys,

@@ -324,6 +324,43 @@ class PublicKeySet:
         for i in missing:
             self.public_key_share(i)
 
+    def seed_share_cache_from_scalars(self, scalars) -> None:
+        """Co-simulation fast path: fill the share cache from KNOWN
+        share scalars — for consistently generated keys (a dealt
+        ``SecretKeySet`` or a completed DKG) the commitment evaluation
+        satisfies ``commitment.evaluate(i+1) == G2·share_i``, so each
+        cached point costs one shared-base comb multiplication instead
+        of a (t+1)-point MSM (~300× less group work at N=1024; the
+        era-switch's NetworkInfo rebuild was dominated by this).  The
+        caller must hold the scalars legitimately (the co-simulation
+        deals or co-simulates the DKG centrally); a real node cannot
+        take this path — it runs ``precompute_shares`` instead.
+        ``scalars``: index → share scalar."""
+        from .. import native as NT
+
+        cache = self._share_cache()
+        missing = sorted(i for i in scalars if i not in cache)
+        if not missing:
+            return
+        if NT.available():
+            import numpy as np
+
+            ks = np.frombuffer(
+                b"".join(
+                    int(scalars[i] % R).to_bytes(32, "big")
+                    for i in missing
+                ),
+                dtype=np.uint8,
+            )
+            raw = NT.g2_mul_many_raw(NT.g2_wire(G2_GEN), ks).tobytes()
+            for j, i in enumerate(missing):
+                cache[i] = PublicKeyShare(
+                    NT.g2_unwire(raw[j * 192 : (j + 1) * 192], G2)
+                )
+            return
+        for i in missing:
+            cache[i] = PublicKeyShare(G2_GEN * scalars[i])
+
     # -- combination ------------------------------------------------------
 
     def combine_signatures(

@@ -324,6 +324,16 @@ class VectorizedDynamicSim:
             new_set, threshold, self.rng, mock=self.mock, ops=self.ops
         )
         out = dkg.run(verify_honest=self.dkg_verify_honest)
+        if not self.mock and hasattr(
+            out.pk_set, "seed_share_cache_from_scalars"
+        ):
+            # the co-simulation holds every dealt share scalar, so the
+            # N commitment evaluations the NetworkInfo rebuild would
+            # trigger collapse to one shared-base comb pass
+            ordered = sorted(new_set)
+            out.pk_set.seed_share_cache_from_scalars(
+                {i: out.shares[nid].scalar for i, nid in enumerate(ordered)}
+            )
         pub_keys = {nid: self.pub_keys[nid] for nid in new_set}
         netinfos = {
             nid: NetworkInfo(

@@ -317,3 +317,25 @@ class TestCiphertextAttacks:
         # share verification is U-bound, not V-bound — the validity
         # check is what stops V-mauling (documented in Ciphertext)
         assert pks.public_key_share(0).verify_decryption_share(share, ct)
+
+
+def test_seed_share_cache_from_scalars_matches_eval():
+    # the co-simulation's seeded cache must hold byte-identical points
+    # to the commitment evaluation a real node performs
+    import random
+
+    from hbbft_tpu.crypto import threshold as T
+
+    rng = random.Random(77)
+    sk_set = T.SecretKeySet.random(2, rng)
+    pk = sk_set.public_keys()
+    seeded = T.PublicKeySet(pk.commitment, pk.master_g1)
+    n = 5
+    seeded.seed_share_cache_from_scalars(
+        {i: sk_set.secret_key_share(i).scalar for i in range(n)}
+    )
+    for i in range(n):
+        assert (
+            seeded.public_key_share(i).point.to_bytes()
+            == pk.public_key_share(i).point.to_bytes()
+        )
