@@ -575,7 +575,12 @@ def _adapt(
     st["h"] = h_obs if st["h"] is None else 0.5 * st["h"] + 0.5 * h_obs
     t_dev = max(t_caller + t_host + t_wait, 1e-6)
     d_obs = k_dev / t_dev
-    if t_wait > 0.01:
+    # "the device made us wait" must mean more than the tunnel's RPC
+    # floor (~20-100 ms on np.asarray even when compute finished long
+    # ago), or every flush masquerades as an exact straggle sample and
+    # the estimator can never distinguish bound from measurement
+    straggled = t_wait > 0.15 + 0.02 * t_host
+    if straggled:
         if st["d"] is None:
             st["d"] = d_obs
         else:
@@ -598,11 +603,33 @@ def _adapt(
     d, h = st["d"], st["h"]
     if d and h and K:
         rho = (t_caller + K / h) / (K / d + K / h)
+        if straggled and rho < st["rho"] - 1e-9:
+            # a probe overshot and paid a straggle to learn it:
+            # exponential backoff on further probing of this shape
+            st["iv"] = min(st.get("iv", 2) * 2, 16)
+        elif rho > st["rho"] + 1e-9:
+            st["iv"] = 2  # the frontier moved up: probe eagerly again
+        if not straggled:
+            # the device finished early, so d is only a lower bound:
+            # its solution may push the share UP but never down —
+            # otherwise every staleness probe would be undone by the
+            # next flush's weak-bound re-solve and the share could
+            # never climb back to the straggle frontier
+            rho = max(rho, st["rho"])
         st["rho"] = min(0.95, max(0.05, rho))
-    if t_wait <= 0.01 and st.get("age", 0) >= 4:
-        # the device-rate sample is stale (four straight early
-        # finishes): explore one step up — if it overshoots, the very
-        # next flush produces an exact straggle sample and re-solves
+    if (
+        not straggled
+        and d
+        and st.get("age", 0) >= st.get("iv", 2)
+        and (st["rho"] + 0.1) * K / d > 0.15 + 0.02 * t_host
+    ):
+        # the device-rate sample is stale (straight early finishes):
+        # explore one step up — if it overshoots, the next straggle
+        # sample re-solves and backs the probe cadence off.  The last
+        # condition keeps the ratchet measurable: when even the probed
+        # share's estimated device time sits inside the wait deadband,
+        # a straggle could never be observed and further probing would
+        # climb blindly to the ceiling — stay put instead
         st["rho"] = min(0.95, st["rho"] + 0.1)
         st["age"] = 0
     _save_rho()

@@ -335,16 +335,42 @@ def test_adaptive_fraction_controller(monkeypatch):
     }
     packed_msm._adapt(n, g, K // 2, K // 2, 0.0, 0.001, 10.0)
     assert packed_msm.learned_fraction(n, g) == 0.05
-    # staleness exploration: four straight early finishes with weak
-    # lower bounds bump the share up a step, so a poisoned (too-low)
-    # device estimate always regains contact with the straggle
-    # frontier and re-solves from a fresh exact sample
+    # staleness exploration: every `iv` straight early finishes (2 by
+    # default) bump the share up a step, so a poisoned (too-low)
+    # device estimate regains contact with the straggle frontier and
+    # re-solves from a fresh exact sample
     packed_msm._rho_state()["%d:%d" % (n, g)] = {
         "rho": 0.11, "d": 5000.0, "h": 46000.0
     }
     for _ in range(4):
         packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.2, 0.0)
-    assert packed_msm.learned_fraction(n, g) > 0.15
+    probed = packed_msm.learned_fraction(n, g)
+    assert probed > 0.15
+    # a further early finish must NOT undo the probe: weak lower
+    # bounds may only push the share up, never back down
+    packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.2, 0.0)
+    assert packed_msm.learned_fraction(n, g) >= probed
+    # an overshooting probe pays ONE straggle, re-solves down, and
+    # backs off the probe cadence exponentially (no perpetual
+    # oscillation around the frontier)
+    st = packed_msm._rho_state()["%d:%d" % (n, g)]
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 1.0)
+    assert st["iv"] == 4
+    st["rho"] = 0.5  # force a clearly over-provisioned share
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 2.0)
+    assert st["iv"] == 8
+    # unmeasurable shapes never ratchet: when even the probed share's
+    # estimated device time sits inside the wait deadband, the probe
+    # is withheld (a tiny flush must not climb blindly to 0.95)
+    packed_msm._rho_state()["%d:%d" % (n, g)] = {
+        "rho": 0.11, "d": 1e9, "h": 1e6
+    }
+    for _ in range(6):
+        packed_msm._adapt(n, g, 64, 512, 0.001, 0.001, 0.0)
+    # d huge → estimated probe time ~0 → no probes; and the solve with
+    # the huge-d lower bound may raise rho on its own merits only
+    st2 = packed_msm._rho_state()["%d:%d" % (n, g)]
+    assert st2.get("age", 0) >= 2  # probes were withheld, not consumed
     # adaptive plans must keep BOTH engines measurable: even at the
     # rho ceiling one host chunk is reserved, and even at the floor
     # one device chunk survives — so _adapt always runs again and no
