@@ -500,6 +500,12 @@ def _rho_state() -> dict:
                             "rho": float(v["rho"]),
                             "d": float(v["d"]) if v.get("d") else None,
                             "h": float(v["h"]) if v.get("h") else None,
+                            # probe cadence survives restarts too — a
+                            # backed-off shape must not resume
+                            # aggressive probing on every process start
+                            "age": int(v.get("age", 0)),
+                            "iv": int(v.get("iv", 2)),
+                            "probed": bool(v.get("probed", False)),
                         }
                 elif 0.0 < float(v) < 1.0:  # legacy bare-rho entries
                     state[str(k)] = {"rho": float(v), "d": None, "h": None}
@@ -578,8 +584,10 @@ def _adapt(
     # "the device made us wait" must mean more than the tunnel's RPC
     # floor (~20-100 ms on np.asarray even when compute finished long
     # ago), or every flush masquerades as an exact straggle sample and
-    # the estimator can never distinguish bound from measurement
-    straggled = t_wait > 0.15 + 0.02 * t_host
+    # the estimator can never distinguish bound from measurement.  The
+    # same deadband gates the probe's measurability check below.
+    deadband = 0.15 + 0.02 * t_host
+    straggled = t_wait > deadband
     if straggled:
         if st["d"] is None:
             st["d"] = d_obs
@@ -603,12 +611,18 @@ def _adapt(
     d, h = st["d"], st["h"]
     if d and h and K:
         rho = (t_caller + K / h) / (K / d + K / h)
-        if straggled and rho < st["rho"] - 1e-9:
-            # a probe overshot and paid a straggle to learn it:
-            # exponential backoff on further probing of this shape
-            st["iv"] = min(st.get("iv", 2) * 2, 16)
-        elif rho > st["rho"] + 1e-9:
-            st["iv"] = 2  # the frontier moved up: probe eagerly again
+        if straggled:
+            if rho < st["rho"] - 1e-9 and st.get("probed"):
+                # a PROBE overshot and paid a straggle to learn it:
+                # exponential backoff on further probing of this shape
+                # (ordinary downward convergence — no probe since the
+                # last straggle — must not degrade the cadence)
+                st["iv"] = min(st.get("iv", 2) * 2, 16)
+            st["probed"] = False
+        elif rho > st["rho"] + 0.05:
+            # the frontier moved up MATERIALLY (a fraction of the
+            # probe step, not EMA jitter): probe eagerly again
+            st["iv"] = 2
         if not straggled:
             # the device finished early, so d is only a lower bound:
             # its solution may push the share UP but never down —
@@ -621,7 +635,7 @@ def _adapt(
         not straggled
         and d
         and st.get("age", 0) >= st.get("iv", 2)
-        and (st["rho"] + 0.1) * K / d > 0.15 + 0.02 * t_host
+        and (st["rho"] + 0.1) * K / d > deadband
     ):
         # the device-rate sample is stale (straight early finishes):
         # explore one step up — if it overshoots, the next straggle
@@ -632,6 +646,7 @@ def _adapt(
         # climb blindly to the ceiling — stay put instead
         st["rho"] = min(0.95, st["rho"] + 0.1)
         st["age"] = 0
+        st["probed"] = True
     _save_rho()
 
 
