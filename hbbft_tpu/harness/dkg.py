@@ -194,6 +194,7 @@ class VectorizedDkg:
             and not adversarial
             and engine != "host"
             and (engine == "device" or self._device_auto())
+            and self._device_capable()
         ):
             return self._run_real_device(coeffs)
         return self._run_real(
@@ -218,6 +219,16 @@ class VectorizedDkg:
             return jax.default_backend() == "tpu"
         except Exception:
             return False
+
+    def _device_capable(self) -> bool:
+        """The u8-limb matmul's int32 accumulation bound caps the
+        contraction size at ``fr_jax._MAX_K``; past it (N ≳ 2914 at
+        t = N/3) the device engine would raise mid-DKG, so auto- and
+        explicit routing both fall back to the host engine
+        (ADVICE r4 #2)."""
+        from ..ops import fr_jax as FJ
+
+        return self.t + 1 <= FJ._MAX_K
 
     # -- mock --------------------------------------------------------------
 
@@ -532,9 +543,18 @@ class VectorizedDkg:
         digest = jnp.zeros((), jnp.int32)
         if coeffs is None:
             run_step = jax.jit(step_sampled)
-            keys = jax.random.split(
-                jax.random.PRNGKey(self.rng.getrandbits(63)), n
-            )
+            # chain 8×32 bits of caller entropy into the threefry key
+            # (a bare PRNGKey(getrandbits(63)) capped the whole era's
+            # key material at 63 bits of seed entropy — ADVICE r4 #1).
+            # The key STATE is still 64 bits, an inherent threefry
+            # limit: sampled device dealing is for benchmarks and
+            # co-simulation; a production deployment supplies host-
+            # drawn ``coeffs`` (SyncKeyGen's path) for full-entropy
+            # key material.
+            key = jax.random.PRNGKey(self.rng.getrandbits(32))
+            for _ in range(7):
+                key = jax.random.fold_in(key, self.rng.getrandbits(32))
+            keys = jax.random.split(key, n)
             for d in range(n):
                 share_acc, row0_acc, digest = run_step(
                     keys[d], share_acc, row0_acc, digest

@@ -313,6 +313,9 @@ class DecryptionRound:
         default_factory=dict
     )  # proposer → {sender → share}: the network-visible share traffic
     # (honest + forged) — what an observer sees on the wire
+    phases: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )  # wall seconds: staging / emit / flush / lookup / combine
 
 
 class VectorizedHoneyBadgerRound:
@@ -488,13 +491,19 @@ def decrypt_round(
         ]
         emit_senders = set(honest_live[: num_faulty + 1])
 
+    import time as _time
+
+    phases: Dict[str, float] = {}
+    _t0 = _time.perf_counter()
     sorted_cts = sorted(ciphertexts.items())
     if shares is None:
         shares = _stage_real_shares(
             netinfos, sorted_cts, dead, forged, emit_senders
         )
+    phases["staging"] = _time.perf_counter() - _t0
 
     # 1. share emission (per-node local work)
+    _t0 = _time.perf_counter()
     faults = FaultLog()
     emitted: Dict[Any, Dict[Any, Any]] = {}
     valid: Dict[Any, Dict[Any, Any]] = {}
@@ -542,17 +551,24 @@ def decrypt_round(
                 emitted.setdefault(pid, {})[nid] = share
             entries.append((pid, nid, DecObligation(pk, share, ct)))
 
+    phases["emit"] = _time.perf_counter() - _t0
+
     # 2. one grouped verification flush for everything still in question
+    _t0 = _time.perf_counter()
     be.prefetch(ob for _, _, ob in entries)
+    phases["flush"] = _time.perf_counter() - _t0
     n_verified = len(entries)
+    _t0 = _time.perf_counter()
     for pid, nid, ob in entries:
         if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
             valid.setdefault(pid, {})[nid] = ob.share
         elif nid not in flagged:
             flagged.add(nid)
             faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
+    phases["lookup"] = _time.perf_counter() - _t0
 
     # 3. combine per proposer (unique result from any t+1 shares)
+    _t0 = _time.perf_counter()
     out: Dict[Any, bytes] = {}
     for pid, ct in sorted_cts:
         by_idx = {
@@ -562,9 +578,11 @@ def decrypt_round(
             faults.add(pid, FaultKind.SHARE_DECRYPTION_FAILED)
             continue
         out[pid] = pk_set.combine_decryption_shares(by_idx, ct)
+    phases["combine"] = _time.perf_counter() - _t0
     return DecryptionRound(
         contributions=out,
         fault_log=faults,
         shares_verified=n_verified,
         emitted=emitted,
+        phases=phases,
     )

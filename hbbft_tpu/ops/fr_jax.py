@@ -52,7 +52,12 @@ from ..crypto import fields as F
 
 R = F.R
 FR_LIMBS = 33  # 8-bit limbs; values < 2^264, congruent mod r
-_MAX_K = 971  # int32 accumulation bound: 255² · k · 33 < 2^31
+# int32 accumulation bound, INCLUDING the carry-sweep addend (the
+# running carry ≈ max_digit/255 is added to a digit before its shift):
+# 255² · k · 33 · (1 + 1/255) < 2^31 — at k=971 the worst case is
+# ≈ 2.092e9, ~2.6% under the ceiling (ADVICE r4 #3: the carry
+# headroom is part of the invariant a future k-bound edit must check)
+_MAX_K = 971
 
 
 def _fold_table(offset: int, count: int) -> np.ndarray:

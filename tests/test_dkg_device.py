@@ -76,3 +76,14 @@ def test_engine_routing_defaults(monkeypatch):
         engine="device",
     )
     assert with_adv.engine == "host"
+
+
+def test_engine_routing_capability_gate(monkeypatch):
+    # past the u8-matmul contraction bound (fr_jax._MAX_K) the device
+    # engine would raise mid-DKG; auto AND explicit routing fall back
+    # to the host engine instead (ADVICE r4 #2)
+    from hbbft_tpu.ops import fr_jax
+
+    monkeypatch.setattr(fr_jax, "_MAX_K", 1)
+    res = _mk(4, 1, 0xD3).run(verify_honest=False, engine="device")
+    assert res.engine == "host"

@@ -247,7 +247,8 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
     got = packed_msm.g1_msm_packed(pts, scalars, nbits=16)
     assert got == CpuBackend().g1_msm(pts, scalars)
 
-    # product path, 4 groups of 3 → plan [1, 1], kd=3 padded to kp=128
+    # product path, 4 groups of 3 → plan [2] (one ladder chunk of two
+    # quanta), kd=6 padded to kp=128
     k, G = 12, 4
     ppts = _random_points(rng, k, with_inf=False)
     s = [rng.getrandbits(16) | 1 for _ in range(k)]
@@ -266,7 +267,7 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
         or True,
     )
     assert packed_msm._flat_ready(128, 2)
-    assert packed_msm._product_ready(3, 1, False)
+    assert packed_msm._product_ready(6, 2, False)
     assert set(built) == set(probes), (
         sorted(set(built) - set(probes)),
         sorted(set(probes) - set(built)),
@@ -275,122 +276,101 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
 
 def test_split_plan_shapes(monkeypatch):
     monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "0.5")
-    # headline flush 64×1024: the quantum is shape-only (8 groups), so
-    # the adaptive fraction moves the split without leaving the
-    # warm-executable lattice — at 0.5, four 8-group chunks
-    assert packed_msm._split_plan(65536, 64) == [8] * 4
+    # headline flush 64×1024: the quantum is shape-only (4 groups —
+    # 16 steps of resolution since r5), and the chosen quanta pack
+    # into the FEWEST ladder chunks (each chunk pays a tunnel RPC
+    # floor — the r5 A/B: 16×4-group chunks 2.24 s vs 2×32 0.6-1.2 s)
+    assert packed_msm._split_plan(65536, 64) == [32]
     # hb_1024_real flush 974×974: uniform padded chunks within the
-    # per-group-tree scale — 7 × 67 groups ≈ 48% of points on device
-    assert packed_msm._split_plan(948676, 974) == [67] * 7
+    # per-group-tree scale (the 2q/8q rungs exceed the 67-group cap)
+    assert packed_msm._split_plan(948676, 974) == [60] * 8
     assert all(
         g * 974 <= packed_msm._MAX_GTREE
         for g in packed_msm._split_plan(948676, 974)
     )
-    # full device fraction takes (nearly) everything, uniform shapes
+    # full device fraction takes (nearly) everything
     monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "1")
     plan = packed_msm._split_plan(948676, 974)
-    assert sum(plan) == 938 and len(set(plan)) == 1
-    assert packed_msm._split_plan(65536, 64) == [8] * 8
+    assert sum(plan) == 960 and len(set(plan)) == 1
+    assert packed_msm._split_plan(65536, 64) == [32, 32]
+    # a non-ladder quantum count decomposes largest-first
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "0.82")
+    assert packed_msm._split_plan(65536, 64) == [32, 8, 8, 4]
     # ragged totals (not divisible by the group count) → no share
     assert packed_msm._split_plan(7, 3) == []
 
 
 def test_adaptive_fraction_controller(monkeypatch):
-    """The rate-balance controller: exact device-rate samples when the
-    device straggles, lower-bound-only raises when it finishes early,
-    and the solved split stays clamped away from the all-or-nothing
-    edges (a pathological regime must stay recoverable)."""
+    """The r5 rate-balance controller: EXACT device- and host-rate
+    samples every flush (the waiter thread stamps the device wall, so
+    no straggle-gating, no probe ratchet), EMA smoothing with a 3×
+    slew clip, and a split that may cover the whole flush."""
     monkeypatch.delenv("HBBFT_TPU_DEVICE_FRACTION", raising=False)
     monkeypatch.setattr(packed_msm, "_RHO_STATE", {})
     monkeypatch.setattr(packed_msm, "_save_rho", lambda: None)
     n, g = 1024, 64
     K = 65536
     assert packed_msm.learned_fraction(n, g) == 0.5
-    # device straggled 1 s past a 1 s host half (0.5 s caller overlap):
-    # exact rate sample d = K/2 / 2.5, h = K/2 / 1.0 → the solved
-    # balance rho* = (0.5 + K/h)/(K/d + K/h) = 2.5/7
-    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 1.0)
+    # equal halves: device wall 2.5 s (the waiter's stamp, launch →
+    # group sums on host), host 1.0 s, caller overlap 0.5 s →
+    # d = K/2 / 2.5, h = K/2 / 1.0 →
+    # rho* = (0.5 + K/h)/(K/d + K/h) = 2.5/7
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 2.5)
     rho1 = packed_msm.learned_fraction(n, g)
     assert abs(rho1 - 2.5 / 7.0) < 1e-6
-    # device finished early at a small share: only a LOWER bound on its
-    # rate, weaker than the current estimate → no movement
-    packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.75, 0.0)
-    assert abs(packed_msm.learned_fraction(n, g) - rho1) < 1e-6
-    # a STRONG early finish raises the device-rate floor → share up
-    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 0.5, 0.0)
+    # a faster device wall is an exact sample DOWNWARD too — the EMA
+    # moves and the share climbs (r4 could only raise `d` on straggle)
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 0.5)
     assert packed_msm.learned_fraction(n, g) > rho1
-    # ceiling: an absurdly fast device still caps at 0.95
-    packed_msm._adapt(n, g, 60000, 5536, 0.0, 0.01, 0.0)
-    assert packed_msm.learned_fraction(n, g) <= 0.95
-    # floor: a collapsed device rate clamps at 0.05, not 0 — and the
+    # ceiling is 1.0 now: a decisively faster device takes everything
+    packed_msm._rho_state()["%d:%d" % (n, g)] = {
+        "rho": 0.5, "d": 1e9, "h": 100.0, "hage": 0
+    }
+    packed_msm._adapt(n, g, K // 2, K // 2, 0.0, 300.0, 0.01)
+    assert packed_msm.learned_fraction(n, g) > 0.999
+    # floor: a collapsed device rate clamps at 0.02, not 0 — and the
     # slew-rate clip bounds one pathological flush's damage to 3×
     packed_msm._rho_state()["%d:%d" % (n, g)] = {
-        "rho": 0.5, "d": 30000.0, "h": 30000.0
+        "rho": 0.5, "d": 30000.0, "h": 30000.0, "hage": 0
     }
     packed_msm._adapt(n, g, K // 2, K // 2, 0.0, 1.0, 46.0)
     st = packed_msm._rho_state()["%d:%d" % (n, g)]
     assert st["d"] == 0.5 * 30000 + 0.5 * 10000  # clipped at d/3
     packed_msm._rho_state()["%d:%d" % (n, g)] = {
-        "rho": 0.5, "d": 100.0, "h": 1e9
+        "rho": 0.5, "d": 100.0, "h": 1e9, "hage": 0
     }
     packed_msm._adapt(n, g, K // 2, K // 2, 0.0, 0.001, 10.0)
-    assert packed_msm.learned_fraction(n, g) == 0.05
-    # staleness exploration: every `iv` straight early finishes (2 by
-    # default) bump the share up a step, so a poisoned (too-low)
-    # device estimate regains contact with the straggle frontier and
-    # re-solves from a fresh exact sample
+    assert packed_msm.learned_fraction(n, g) == 0.02
+    # an all-device flush (k_host = 0) cannot sample the host rate:
+    # hage counts the staleness, a host flush resets it
     packed_msm._rho_state()["%d:%d" % (n, g)] = {
-        "rho": 0.11, "d": 5000.0, "h": 46000.0
+        "rho": 1.0, "d": 30000.0, "h": 30000.0, "hage": 0
     }
-    for _ in range(4):
-        packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.2, 0.0)
-    probed = packed_msm.learned_fraction(n, g)
-    assert probed > 0.15
-    # a further early finish must NOT undo the probe: weak lower
-    # bounds may only push the share up, never back down
-    packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.2, 0.0)
-    assert packed_msm.learned_fraction(n, g) >= probed
-    # an overshooting probe pays ONE straggle, re-solves down, and
-    # backs off the probe cadence exponentially (no perpetual
-    # oscillation around the frontier); ordinary downward convergence
-    # WITHOUT a preceding probe must not degrade the cadence
+    for i in range(3):
+        packed_msm._adapt(n, g, K, 0, 0.1, 0.0, 2.0)
     st = packed_msm._rho_state()["%d:%d" % (n, g)]
-    assert st.get("probed")  # the staleness loop above ended on a probe
-    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 1.0)
-    assert st["iv"] == 4 and not st.get("probed")
-    # a plain (non-probe) straggle re-solve leaves the cadence alone
-    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 2.0)
-    assert st["iv"] == 4
-    # next probe cycle: iv=4 early finishes → probe fires → straggle
-    # overshoot doubles the backoff again
-    st["rho"] = 0.5
-    for _ in range(4):
-        packed_msm._adapt(n, g, 8192, K - 8192, 0.5, 1.2, 0.0)
-    assert st.get("probed") and st["rho"] > 0.5
-    packed_msm._adapt(n, g, K // 2, K // 2, 0.5, 1.0, 2.0)
-    assert st["iv"] == 8
-    # unmeasurable shapes never ratchet: when even the probed share's
-    # estimated device time sits inside the wait deadband, the probe
-    # is withheld (a tiny flush must not climb blindly to 0.95)
-    packed_msm._rho_state()["%d:%d" % (n, g)] = {
-        "rho": 0.11, "d": 1e9, "h": 1e6
-    }
-    for _ in range(6):
-        packed_msm._adapt(n, g, 64, 512, 0.001, 0.001, 0.0)
-    # d huge → estimated probe time ~0 → no probes; and the solve with
-    # the huge-d lower bound may raise rho on its own merits only.
-    # age accumulating through ALL six flushes proves no probe ever
-    # fired (a firing probe resets age to 0)
-    st2 = packed_msm._rho_state()["%d:%d" % (n, g)]
-    assert st2.get("age", 0) >= 6
-    # adaptive plans must keep BOTH engines measurable: even at the
-    # rho ceiling one host chunk is reserved, and even at the floor
-    # one device chunk survives — so _adapt always runs again and no
-    # regime shift can freeze the controller (review finding r4)
-    packed_msm._rho_state()["1024:64"] = 0.95
-    assert packed_msm._split_plan(65536, 64) == [8] * 7  # not 8: host tail
+    assert st["hage"] == 3
+    packed_msm._adapt(n, g, K - 4096, 4096, 0.1, 0.15, 2.0)
+    assert st["hage"] == 0
+    # seed_rates: the bench's forced-leg medians land as exact rates
+    # and re-solve the split (r4 threw them away)
+    packed_msm.seed_rates(n, g, d=34640.0, h=29472.0)
+    st = packed_msm._rho_state()["%d:%d" % (n, g)]
+    assert st["d"] == 34640.0 and st["h"] == 29472.0
+    assert abs(st["rho"] - 34640.0 / (34640.0 + 29472.0)) < 1e-9
+    # adaptive plans keep one device chunk at the floor (an all-host
+    # plan never reaches the finalizer's measurement), and may cover
+    # EVERYTHING at the ceiling — until the host rate goes stale, at
+    # which point one quantum is handed back as a host probe
     packed_msm._rho_state()["1024:64"] = 0.10
-    assert packed_msm._split_plan(65536, 64) == [8]  # floor keeps one
+    assert packed_msm._split_plan(65536, 64) == [8]
+    packed_msm._rho_state()["1024:64"] = {
+        "rho": 1.0, "d": 34640.0, "h": 29472.0, "hage": 0
+    }
+    assert packed_msm._split_plan(65536, 64) == [32, 32]  # full device
+    packed_msm._rho_state()["1024:64"]["hage"] = packed_msm._HOST_PROBE_IV
+    # host probe: one quantum handed back, rest packed largest-first
+    assert packed_msm._split_plan(65536, 64) == [32, 8, 8, 8, 4]
     # a single-group flush cannot be balanced (no host tail possible):
     # adaptive mode keeps it host-side rather than freezing at 100%
     assert packed_msm._split_plan(2048, 1) == []
@@ -400,8 +380,9 @@ def test_adaptive_fraction_controller(monkeypatch):
     assert packed_msm.learned_fraction(n, g) == 0.75
     assert packed_msm.learned_fraction(7, 7) == 0.75
     monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "1")
-    assert packed_msm._split_plan(65536, 64) == [8] * 8
+    assert packed_msm._split_plan(65536, 64) == [32, 32]
     # malformed override: fall back to the learned state, not 0.5-pin
+    packed_msm._rho_state()["1024:64"] = 0.10
     monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "half")
     assert packed_msm.learned_fraction(n, g) == 0.10
     monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "nan")
