@@ -82,11 +82,16 @@ class AgreementResult:
     """All P instances' outcomes."""
 
     decisions: Dict[Any, bool]  # instance id → decided bit
-    epochs_used: Dict[Any, int]  # instance id → deciding epoch
+    epochs_used: Dict[Any, int]  # instance id → deciding epoch (the
+    # LAST class's, under a divergent schedule)
     coin_flips: int  # real threshold-coin flips executed
     crypto_flushes: int
     fault_log: FaultLog
-    diverged: bool = False  # a divergent epoch-0 schedule executed
+    diverged: bool = False  # a divergent schedule executed
+    class_epochs: Dict[Any, Tuple[int, ...]] = dataclasses.field(
+        default_factory=dict
+    )  # instance id → per-view-class deciding epochs
+    # (``DivergentSchedule`` instances only)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,6 +131,102 @@ class DivergentEpoch0:
     class_a: frozenset  # correct node ids in class A (rest of live = B)
     equiv: Any  # Dict[sender id → (bool to_a, bool to_b)]
     instances: frozenset  # affected instance ids
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassDirective:
+    """One view-class's delivery schedule for one agreement epoch
+    (``DivergentSchedule``).
+
+    ``withhold``: a BVal value whose epoch traffic (est votes and
+    relays alike, from every sender but the node itself) the adversary
+    delays past this class's early wave — the class's first
+    ``bin_values`` entry is then forced by the visible cascade.  All
+    delayed messages still arrive within the epoch (the full wave), so
+    this is a legal asynchronous schedule, not message loss.
+
+    ``aux_counted``: the Aux prefix this class's members count toward
+    SBV termination, as ``((value, n_senders), ...)`` — the adversary
+    delivers exactly these first, so ``vals`` is their value set even
+    when later auxes would have widened it.  Validated against
+    availability (senders must exist), ``bin_values`` membership, and
+    the N−f threshold.  ``None`` = prompt full delivery."""
+
+    withhold: Optional[bool] = None
+    aux_counted: Optional[Tuple[Tuple[bool, int], ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergentSchedule:
+    """A MULTI-EPOCH multi-class asynchronous schedule — the carried-
+    state generalization of :class:`DivergentEpoch0` (VERDICT r4
+    missing #3 / next-4): view-classes keep their own ``bin_values``,
+    sent-sets and Aux counts as engine state ACROSS agreement epochs,
+    and may decide the same instance at different epochs.  The
+    reference surface is the adversary's full per-message delivery
+    power (``tests/network/mod.rs:151-173``) exercised through the
+    threshold-relevant degrees of freedom: which BVal wave a class
+    sees first, which Aux prefix it counts, and when Terms arrive.
+
+    ``classes``: partition of the correct live nodes into view
+    classes (any count ≥ 2).
+    ``equiv``: Byzantine equivocators — node id → one epoch-0 BVal
+    value PER CLASS (silent from epoch 1, like ``DivergentEpoch0``).
+    ``equiv_aux``: equivocators also send the matching per-class Aux
+    at epoch 0 (a Byzantine Aux counts only where its value entered
+    that class's ``bin_values`` — exactly the sequential rule).
+    ``directives``: epoch → per-class :class:`ClassDirective` row;
+    epochs without a row run prompt uniform delivery.  Classes that
+    DECIDE broadcast ``Term``s, which count as BVal+Aux+Conf for the
+    still-running classes and trigger expedited termination at f+1
+    (``agreement.rs:213-228``) — the mechanism that lets a slow class
+    decide at a LATER epoch than a fast one without a coin.
+    ``instances``: affected instance ids (the rest of the epoch rides
+    the uniform array path unchanged).
+
+    Residual scope limits (raised, never silently mis-modeled):
+    undecided classes advance in lockstep (divergent decision TIMING
+    comes from per-class decisions, not per-class epoch counters), and
+    a real-coin epoch (≡ 2 mod 3) requires the undecided classes to
+    have re-converged to one view."""
+
+    classes: Tuple[frozenset, ...]
+    equiv: Any  # Mapping[node id → Tuple[bool, ...]] (one per class)
+    instances: frozenset
+    equiv_aux: bool = False
+    directives: Any = dataclasses.field(default_factory=dict)
+    # Mapping[int epoch → Tuple[Optional[ClassDirective], ...]]
+
+
+class _DivState:
+    """Carried per-instance view-class state (``DivergentSchedule``):
+    per-class decisions and Term sets persist across agreement epochs;
+    sent/bin/aux state is rebuilt each epoch from the carried
+    estimates exactly as ``SbvBroadcast.clear`` re-seeds the
+    sequential instance."""
+
+    __slots__ = ("classes", "est", "decided", "decided_at", "terms",
+                 "epoch")
+
+    def __init__(self, classes: List[List[Any]], est: Dict[Any, bool]):
+        self.classes = classes
+        self.est = dict(est)
+        self.decided: List[Optional[bool]] = [None] * len(classes)
+        self.decided_at: List[int] = [-1] * len(classes)
+        self.terms: Dict[Any, bool] = {}
+        self.epoch = 0
+
+    def done(self) -> bool:
+        return all(d is not None for d in self.decided)
+
+    def value(self) -> bool:
+        vs = {d for d in self.decided if d is not None}
+        if len(vs) != 1:
+            raise RuntimeError(
+                "agreement safety violated across view classes: %r"
+                % (self.decided,)
+            )
+        return vs.pop()
 
 
 class VectorizedAgreement:
@@ -341,6 +442,211 @@ class VectorizedAgreement:
             est1[nid] = outcome[nid in div.class_a][1]
         return None, est1
 
+    def _div_round(
+        self,
+        vs: _DivState,
+        sched: DivergentSchedule,
+        coin: Optional[bool],
+    ) -> None:
+        """Advance one :class:`DivergentSchedule` instance by ONE
+        agreement epoch, mutating the carried state ``vs``.
+
+        Exact threshold evaluation per class (relay f+1, bin_values
+        2f+1, SBV termination at N−f counted Auxes — the
+        ``sbv_broadcast.py`` constants), with decided classes
+        contributing Terms as permanent BVal+Aux senders and the f+1
+        expedited-termination rule checked first
+        (``agreement.rs:213-228``).  Every infeasible directive raises
+        rather than silently executing an impossible schedule."""
+        f, N = self.f, self.N
+        epoch = vs.epoch
+        C = len(vs.classes)
+        # -- expedited termination on queued Terms (epoch ≥ 1) ---------
+        if epoch >= 1:
+            for v in (False, True):
+                if sum(1 for tv in vs.terms.values() if tv is v) >= f + 1:
+                    for c in range(C):
+                        if vs.decided[c] is None:
+                            vs.decided[c] = v
+                            vs.decided_at[c] = epoch
+        if vs.done():
+            return
+        und = [c for c in range(C) if vs.decided[c] is None]
+        honest = [nid for c in und for nid in vs.classes[c]]
+        equiv = dict(sched.equiv) if epoch == 0 else {}
+        row = dict(sched.directives).get(epoch)
+        directives: List[Optional[ClassDirective]] = [
+            row[c] if row is not None else None for c in range(C)
+        ]
+        if coin is None:
+            raise ValueError(
+                "real-coin epoch %d reached without a coin value "
+                "(fewer than f+1 undecided honest senders?)" % epoch
+            )
+
+        def term_cnt(v: bool) -> int:
+            return sum(1 for tv in vs.terms.values() if tv is v)
+
+        def equiv_cnt(c: int, v: bool) -> int:
+            return sum(
+                1 for votes in equiv.values() if bool(votes[c]) is v
+            )
+
+        sent: Dict[Any, Set[bool]] = {
+            nid: {vs.est[nid]} for nid in honest
+        }
+
+        def cnt(c: int, v: bool, withheld: Optional[bool]) -> Dict[Any, int]:
+            """Per-node visible sender count of BVal(v) for class c
+            members (the withheld value is visible only from the node
+            itself)."""
+            if withheld is not None and v is withheld:
+                return {
+                    nid: (1 if v in sent[nid] else 0)
+                    for nid in vs.classes[c]
+                }
+            base = (
+                sum(1 for j in honest if v in sent[j])
+                + term_cnt(v)
+                + equiv_cnt(c, v)
+            )
+            return {nid: base for nid in vs.classes[c]}
+
+        def relay_fixpoint(cs, withhelds):
+            changed = True
+            while changed:
+                changed = False
+                for c in cs:
+                    for v in (False, True):
+                        per = cnt(c, v, withhelds[c])
+                        for nid in vs.classes[c]:
+                            if per[nid] >= f + 1 and v not in sent[nid]:
+                                sent[nid].add(v)
+                                changed = True
+
+        def bins_of(c: int, withheld: Optional[bool]) -> Set[bool]:
+            out = set()
+            for v in (False, True):
+                per = cnt(c, v, withheld)
+                if per and max(per.values()) >= 2 * f + 1:
+                    out.add(v)
+            return out
+
+        # -- early wave: per class, in class order, with withholds -----
+        aux: Dict[Any, bool] = {}
+        for c in und:
+            w = directives[c].withhold if directives[c] else None
+            if w is None:
+                continue
+            relay_fixpoint([c], {c: w})
+            early = bins_of(c, w)
+            if not early:
+                raise ValueError(
+                    "withhold directive leaves class %d with empty "
+                    "early bin_values at epoch %d" % (c, epoch)
+                )
+            for nid in vs.classes[c]:
+                aux[nid] = (
+                    vs.est[nid]
+                    if vs.est[nid] in early
+                    else min(early)
+                )
+
+        # -- full wave: joint relay fixpoint, everything delivered -----
+        relay_fixpoint(und, {c: None for c in und})
+        bins = {c: bins_of(c, None) for c in und}
+        for c in und:
+            if not bins[c]:
+                raise ValueError(
+                    "class %d reaches no bin_values entry at epoch %d "
+                    "— SBV cannot terminate" % (c, epoch)
+                )
+            for nid in vs.classes[c]:
+                if nid not in aux:
+                    aux[nid] = (
+                        vs.est[nid]
+                        if vs.est[nid] in bins[c]
+                        else min(bins[c])
+                    )
+
+        # -- Aux counting / SBV termination per class ------------------
+        vals: Dict[int, Set[bool]] = {}
+        for c in und:
+            avail = {
+                v: sum(1 for nid in honest if aux[nid] is v)
+                + term_cnt(v)
+                + (
+                    equiv_cnt(c, v)
+                    if (sched.equiv_aux and epoch == 0)
+                    else 0
+                )
+                for v in (False, True)
+            }
+            counted = (
+                directives[c].aux_counted if directives[c] else None
+            )
+            if counted is not None:
+                total = 0
+                vset: Set[bool] = set()
+                for v, k in counted:
+                    v = bool(v)
+                    if k > avail[v]:
+                        raise ValueError(
+                            "aux_counted wants %d Aux(%s) for class %d "
+                            "but only %d senders exist" % (k, v, c, avail[v])
+                        )
+                    if v not in bins[c]:
+                        raise ValueError(
+                            "aux_counted value %s not in class %d "
+                            "bin_values %r" % (v, c, bins[c])
+                        )
+                    total += k
+                    if k > 0:
+                        vset.add(v)
+                if total < N - f:
+                    raise ValueError(
+                        "aux_counted prefix (%d) below the N-f=%d SBV "
+                        "termination threshold" % (total, N - f)
+                    )
+                vals[c] = vset
+            else:
+                total = sum(avail[v] for v in bins[c])
+                if total < N - f:
+                    raise ValueError(
+                        "class %d counts %d Auxes in bin_values — SBV "
+                        "cannot reach N-f=%d" % (c, total, N - f)
+                    )
+                vals[c] = {v for v in bins[c] if avail[v] > 0}
+
+        # -- real-coin epochs require a re-converged view --------------
+        if epoch % 3 == 2 and len({frozenset(vals[c]) for c in und}) > 1:
+            raise ValueError(
+                "real-coin epoch %d with divergent vals across classes "
+                "— the Conf exchange is not modeled for that state"
+                % epoch
+            )
+
+        # -- decide / continue (two-phase: Terms visible next epoch) ---
+        for c in und:
+            vset = vals[c]
+            definite = next(iter(vset)) if len(vset) == 1 else None
+            if definite is not None and definite is coin:
+                vs.decided[c] = definite
+                vs.decided_at[c] = epoch
+                for nid in vs.classes[c]:
+                    vs.terms[nid] = definite
+            else:
+                nxt = definite if definite is not None else coin
+                for nid in vs.classes[c]:
+                    vs.est[nid] = nxt
+        dec_vals = {d for d in vs.decided if d is not None}
+        if len(dec_vals) > 1:
+            raise RuntimeError(
+                "agreement safety violated across view classes: %r"
+                % (vs.decided,)
+            )
+        vs.epoch += 1
+
     def run(
         self,
         est0: Dict[Any, Any],
@@ -348,6 +654,7 @@ class VectorizedAgreement:
         adv_aux: Optional[Dict[Any, Tuple[int, int]]] = None,
         forged_coin: Optional[Set[Any]] = None,
         divergent: Optional[DivergentEpoch0] = None,
+        div_schedule: Optional[DivergentSchedule] = None,
     ) -> AgreementResult:
         """Run every instance to its decision.
 
@@ -381,6 +688,57 @@ class VectorizedAgreement:
                 )
         diverged = False
         live = list(self.live)  # run-local: never mutate instance state
+        div_states: Dict[int, _DivState] = {}
+        class_epochs: Dict[Any, Tuple[int, ...]] = {}
+        if div_schedule is not None:
+            if divergent is not None:
+                raise ValueError(
+                    "divergent and div_schedule are mutually exclusive"
+                )
+            sch = div_schedule
+            equiv_ids = set(sch.equiv)
+            if equiv_ids & self.dead:
+                raise ValueError("equivocators cannot also be dead")
+            if len(self.dead | equiv_ids | forged_coin) > self.f:
+                raise ValueError(
+                    "dead + equivocating + coin-forging Byzantine "
+                    f"nodes exceed the f={self.f} bound"
+                )
+            if set(sch.instances) - set(self.instance_ids):
+                raise ValueError("divergent instances unknown")
+            if any(len(votes) != len(sch.classes) for votes in
+                   dict(sch.equiv).values()):
+                raise ValueError(
+                    "each equivocator needs one BVal value per class"
+                )
+            if any(
+                len(row) != len(sch.classes)
+                for row in dict(sch.directives).values()
+            ):
+                raise ValueError(
+                    "each directive row needs one entry per class "
+                    "(None for prompt delivery)"
+                )
+            live = [nid for nid in live if nid not in equiv_ids]
+            members = [m for cl in sch.classes for m in cl]
+            if sorted(members) != sorted(live) or any(
+                not cl for cl in sch.classes
+            ):
+                raise ValueError(
+                    "classes must partition the correct live nodes "
+                    "into non-empty sets"
+                )
+            cls_lists = [sorted(cl) for cl in sch.classes]
+            for p, iid in enumerate(self.instance_ids):
+                if iid not in sch.instances:
+                    continue
+                v = est0[iid]
+                est = {
+                    nid: bool(v[nid]) if isinstance(v, dict) else bool(v)
+                    for nid in live
+                }
+                div_states[p] = _DivState(cls_lists, est)
+            diverged = True
         div_pre: Dict[Any, Tuple[Optional[bool], Optional[Dict]]] = {}
         if divergent is not None:
             equiv_ids = set(divergent.equiv)
@@ -448,11 +806,15 @@ class VectorizedAgreement:
         coin_flips = 0
         flushes = 0
         faults = FaultLog()
+        is_div = np.zeros(P, dtype=bool)
+        for p in div_states:
+            is_div[p] = True
 
         for _ in range(self.MAX_EPOCHS):
             active = decided < 0
             if not active.any():
                 break
+            arr_active = active & ~is_div
             # --- SBV broadcast round (sbv_broadcast.py thresholds) ----
             # Initial BVal counts: each live node multicasts BVal(est).
             cnt = np.zeros((P, 2), dtype=np.int64)
@@ -484,7 +846,7 @@ class VectorizedAgreement:
             sched = epoch % 3
             coin = np.zeros(P, dtype=np.int8)
             coin[sched == 0] = 1
-            need_real = active & (sched == 2)
+            need_real = arr_active & (sched == 2)
             if need_real.any():
                 real_ps = np.flatnonzero(need_real)
                 values, nfl = self._flip_coins(
@@ -515,14 +877,61 @@ class VectorizedAgreement:
             # --- decide or next epoch (agreement.rs:291-310) ----------
             definite = has1 ^ has0  # exactly one value in vals
             def_val = np.where(has1 & ~has0, 1, 0).astype(np.int8)
-            decide_now = active & definite & (def_val == coin)
+            decide_now = arr_active & definite & (def_val == coin)
             decided[decide_now] = def_val[decide_now]
             decided_at[decide_now] = epoch[decide_now]
-            cont = active & ~decide_now
+            cont = arr_active & ~decide_now
             # est' = the definite value, else the coin
             new_est = np.where(definite, def_val, coin)  # [P]
             est[cont, :] = new_est[cont, None]
             epoch[cont] += 1
+
+            # --- divergent view-class instances (carried state) -------
+            for p, vs in sorted(div_states.items()):
+                if vs.done():
+                    continue
+                e = vs.epoch
+                if e % 3 == 0:
+                    c_val: Optional[bool] = True
+                elif e % 3 == 1:
+                    c_val = False
+                else:
+                    # real coin: shares come from the still-running
+                    # honest nodes only (decided classes terminated
+                    # this instance; equivocators are Byzantine)
+                    senders = [
+                        nid
+                        for ci in range(len(vs.classes))
+                        if vs.decided[ci] is None
+                        for nid in vs.classes[ci]
+                    ]
+                    c_val = None
+                    if len(senders) >= self.f + 1:
+                        iid = self.instance_ids[p]
+                        idx = self.ref.node_index(iid)
+                        nonce = make_nonce(
+                            self.ref.invocation_id(),
+                            self.session_id,
+                            idx if idx is not None else int(p),
+                            e,
+                        )
+                        values, nfl = self._flip_coins(
+                            [(int(p), nonce)],
+                            faults,
+                            forged=forged_coin,
+                            live=senders,
+                        )
+                        flushes += nfl
+                        coin_flips += 1
+                        c_val = values.get(int(p))
+                self._div_round(vs, div_schedule, c_val)
+                if vs.done():
+                    val = vs.value()
+                    decided[p] = 1 if val else 0
+                    decided_at[p] = max(vs.decided_at)
+                    class_epochs[self.instance_ids[p]] = tuple(
+                        vs.decided_at
+                    )
 
         if (decided < 0).any():
             raise RuntimeError(
@@ -542,6 +951,7 @@ class VectorizedAgreement:
             crypto_flushes=flushes,
             fault_log=faults,
             diverged=diverged,
+            class_epochs=class_epochs,
         )
 
     # -- batched real coin --------------------------------------------------
@@ -797,6 +1207,7 @@ class VectorizedHoneyBadgerSim:
         forged_coin: Optional[Set[Any]] = None,
         late_subset: Optional[Dict[Any, Set[Any]]] = None,
         divergent: Optional[DivergentEpoch0] = None,
+        div_schedule: Optional[DivergentSchedule] = None,
     ) -> EpochResult:
         """Advance every correct node through one complete epoch.
 
@@ -876,6 +1287,7 @@ class VectorizedHoneyBadgerSim:
             forged_coin=forged_coin,
             late_subset=late_subset,
             divergent=divergent,
+            div_schedule=div_schedule,
             walls_head={"propose": _t_prop - _t0, "rbc": _t_rbc - _t_prop},
             diag=diag,
         )
@@ -895,6 +1307,7 @@ class VectorizedHoneyBadgerSim:
         forged_coin: Optional[Set[Any]] = None,
         late_subset: Optional[Dict[Any, Set[Any]]] = None,
         divergent: Optional[DivergentEpoch0] = None,
+        div_schedule: Optional[DivergentSchedule] = None,
         walls_head: Optional[Dict[str, float]] = None,
         diag: Optional[Dict[str, bool]] = None,
     ) -> "EpochResult":
@@ -951,12 +1364,15 @@ class VectorizedHoneyBadgerSim:
             adv_aux=adv_aux,
             forged_coin=forged_coin,
             divergent=divergent,
+            div_schedule=div_schedule,
         )
         faults.merge(res.fault_log)
         # divergent equivocators are Byzantine: silent in every later
         # phase, exactly like dead nodes
         if divergent is not None:
             dead = dead | set(divergent.equiv)
+        if div_schedule is not None:
+            dead = dead | set(div_schedule.equiv)
         accepted = sorted(pid for pid, yes in res.decisions.items() if yes)
 
         _t_agree = _time.perf_counter()

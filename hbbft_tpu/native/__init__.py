@@ -100,6 +100,14 @@ def _bind(cdll):
     cdll.hb_g1_mul_many.restype = None
     cdll.hb_g2_msm.argtypes = [ctypes.c_uint64, b, b, u8p]
     cdll.hb_g2_msm.restype = None
+    cdll.hb_g1_mul_outer.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, b, u8p, u8p,
+    ]
+    cdll.hb_g1_mul_outer.restype = None
+    cdll.hb_g1_msm_many.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, u8p, u8p, u8p,
+    ]
+    cdll.hb_g1_msm_many.restype = None
     cdll.hb_g2_poly_eval_range.argtypes = [
         ctypes.c_uint64, b, ctypes.c_uint64, b, u8p,
     ]
@@ -352,6 +360,40 @@ def g1_mul_many(pt_wire: bytes, ks) -> list:
     lib.hb_g1_mul_many(n, pt_wire, kbuf, _as_u8p(out))
     raw = out.tobytes()
     return [raw[i * 96 : (i + 1) * 96] for i in range(n)]
+
+
+def g1_mul_outer_raw(bases_wire: bytes, ks_be: np.ndarray) -> np.ndarray:
+    """out[b][s] = ks[s]·base_b for every (base, scalar) pair — the
+    whole epoch staging matrix in one native call (per-base fixed-base
+    comb, shared scalar buffer).  ``bases_wire``: n_bases×96 B;
+    ``ks_be``: uint8 array of n_scalars×32 big-endian scalars.
+    Returns the raw n_bases×n_scalars×96 wire buffer, base-major."""
+    ks_be = np.ascontiguousarray(ks_be, dtype=np.uint8).reshape(-1)
+    n_scalars = len(ks_be) // 32
+    n_bases = len(bases_wire) // 96
+    out = np.empty(n_bases * n_scalars * 96, dtype=np.uint8)
+    lib.hb_g1_mul_outer(
+        n_bases, n_scalars, bases_wire, _as_u8p(ks_be), _as_u8p(out)
+    )
+    return out
+
+
+def g1_msm_many_raw(
+    n_msms: int, n_pts: int, pts_buf: np.ndarray, ks_be: np.ndarray
+) -> np.ndarray:
+    """Many MSMs over ONE shared scalar vector (the Lagrange-combine
+    shape) — wires in, wires out, one ctypes crossing.  ``pts_buf``:
+    uint8 n_msms×n_pts×96 row-major; ``ks_be``: n_pts×32 big-endian.
+    Returns the raw n_msms×96 result buffer."""
+    pts_buf = np.ascontiguousarray(pts_buf, dtype=np.uint8).reshape(-1)
+    ks_be = np.ascontiguousarray(ks_be, dtype=np.uint8).reshape(-1)
+    if len(pts_buf) != n_msms * n_pts * 96 or len(ks_be) != n_pts * 32:
+        raise ValueError("g1_msm_many buffer shape mismatch")
+    out = np.empty(n_msms * 96, dtype=np.uint8)
+    lib.hb_g1_msm_many(
+        n_msms, n_pts, _as_u8p(pts_buf), _as_u8p(ks_be), _as_u8p(out)
+    )
+    return out
 
 
 def g2_mul(pt_wire: bytes, k: int) -> bytes:

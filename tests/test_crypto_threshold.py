@@ -60,6 +60,38 @@ class TestThresholdSignatures:
         assert not pkset.public_key_share(1).verify_signature_share(s0, msg)
 
 
+def test_combine_decryption_shares_many_matches_per_row():
+    """The batched combine (one native call per shared valid-index
+    subset) is bit-identical to per-row combines — including rows
+    whose subset differs (the Byzantine knock-out case), which take
+    the fallback path."""
+    rng = random.Random(0xC01)
+    sks = T.SecretKeySet.random(2, rng)
+    pkset = sks.public_keys()
+    pk = pkset.public_key()
+    cts, rows = [], []
+    for p in range(9):
+        ct = pk.encrypt(b"many-%d" % p, rng)
+        cts.append(ct)
+        senders = (
+            range(3) if p != 4 else (1, 2, 3)  # row 4: different subset
+        )
+        rows.append(
+            {
+                i: sks.secret_key_share(i).decrypt_share_no_verify(ct)
+                for i in senders
+            }
+        )
+    got = pkset.combine_decryption_shares_many(rows, cts)
+    for p in range(9):
+        assert got[p] == pkset.combine_decryption_shares(rows[p], cts[p])
+        assert got[p] == b"many-%d" % p
+    with pytest.raises(ValueError, match="not enough"):
+        pkset.combine_decryption_shares_many(
+            [{0: rows[0][0]}], [cts[0]]
+        )
+
+
 class TestThresholdEncryption:
     def test_roundtrip_and_validity(self, keyset):
         sks, pkset, rng = keyset

@@ -7,6 +7,7 @@ asserts across its own nodes (``tests/honey_badger.rs:163-186``),
 extended across *execution engines*.
 """
 
+import dataclasses
 import random
 
 import pytest
@@ -915,6 +916,315 @@ class TestDivergentViews:
                     equiv={5: (True, False), 6: (True, False)},
                     instances=frozenset({0}),
                 ),
+            )
+
+
+class TestMultiEpochDivergence:
+    """VERDICT r4 next-4: divergence as CARRIED engine state — view
+    classes with their own bin_values/Aux counts persisting across
+    agreement epochs (``DivergentSchedule``), deciding the same
+    instance at DIFFERENT epochs, cross-checked against the sequential
+    ``TestNetwork`` driven by a matching partition adversary.
+
+    Scenario (n=11, f=3): honest 0–7 (ests 0–3 True, 4–7 False),
+    Byzantine 8–10 equivocate epoch-0 BVal AND Aux per class.  Class
+    A = {0..4} sees the prompt true wave, counts an 8-true Aux prefix
+    (5 honest + 3 Byzantine) and decides true at epoch 0; class
+    B = {5,6,7} sees the false cascade first, counts a {6 false,
+    2 true} prefix, continues with est = coin, and decides true at
+    epoch 1 via f+1 Terms from A (expedited termination,
+    ``agreement.rs:213-228``)."""
+
+    A = frozenset({0, 1, 2, 3, 4})
+    B = frozenset({5, 6, 7})
+    EQUIV = (8, 9, 10)
+
+    def _schedule(self):
+        from hbbft_tpu.harness.epoch import (
+            ClassDirective,
+            DivergentSchedule,
+        )
+
+        return DivergentSchedule(
+            classes=(self.A, self.B),
+            equiv={e: (True, False) for e in self.EQUIV},
+            equiv_aux=True,
+            directives={
+                0: (
+                    ClassDirective(
+                        withhold=False, aux_counted=((True, 8),)
+                    ),
+                    ClassDirective(
+                        withhold=True,
+                        aux_counted=((False, 6), (True, 2)),
+                    ),
+                )
+            },
+            instances=frozenset({0}),
+        )
+
+    def _est0(self):
+        return {0: {nid: nid < 4 for nid in range(8)}}
+
+    def _vectorized(self, mock, seed):
+        from hbbft_tpu.core.network_info import NetworkInfo
+        from hbbft_tpu.harness.epoch import VectorizedAgreement
+
+        netinfos = NetworkInfo.generate_map(
+            list(range(11)), random.Random(seed), mock=mock
+        )
+        ag = VectorizedAgreement(netinfos, 0, [0])
+        res = ag.run(self._est0(), div_schedule=self._schedule())
+        assert res.diverged
+        return res
+
+    def _sequential(self, mock, seed):
+        from hbbft_tpu.core.step import Target, TargetedMessage
+        from hbbft_tpu.harness.network import (
+            Adversary,
+            MessageScheduler,
+            MessageWithSender,
+            TestNetwork,
+        )
+        from hbbft_tpu.protocols.agreement import (
+            Agreement,
+            AgreementMessage,
+            SbvContent,
+        )
+        from hbbft_tpu.protocols.sbv_broadcast import Aux, BVal
+        from hbbft_tpu.protocols.bool_set import BoolSet
+
+        A, B = self.A, self.B
+
+        class EquivAdversary(Adversary):
+            """Per-class epoch-0 BVal AND Aux equivocation (True wave
+            to class A, False wave to class B), silent after."""
+
+            def __init__(self, rng):
+                self.scheduler = MessageScheduler(
+                    MessageScheduler.FIRST, rng
+                )
+                self.sent = False
+                self.adv_ids = []
+
+            def init(self, all_nodes, adv_netinfos):
+                self.adv_ids = sorted(adv_netinfos)
+
+            def pick_node(self, nodes):
+                return self.scheduler.pick_node(nodes)
+
+            def push_message(self, sender_id, tm):
+                pass
+
+            def step(self):
+                if self.sent:
+                    return []
+                self.sent = True
+                out = []
+                for adv in self.adv_ids:
+                    for members, val in ((A, True), (B, False)):
+                        for r in sorted(members):
+                            for inner in (BVal(val), Aux(val)):
+                                out.append(
+                                    MessageWithSender(
+                                        adv,
+                                        TargetedMessage(
+                                            Target.to(r),
+                                            AgreementMessage(
+                                                0, SbvContent(inner)
+                                            ),
+                                        ),
+                                    )
+                                )
+                return out
+
+        def bval_msg(m, val):
+            return (
+                isinstance(m, AgreementMessage)
+                and m.epoch == 0
+                and isinstance(m.content, SbvContent)
+                and isinstance(m.content.msg, BVal)
+                and m.content.msg.value is val
+            )
+
+        def aux_msg(m, val=None):
+            return (
+                isinstance(m, AgreementMessage)
+                and m.epoch == 0
+                and isinstance(m.content, SbvContent)
+                and isinstance(m.content.msg, Aux)
+                and (val is None or m.content.msg.value is val)
+            )
+
+        phase = {"n": 1}
+
+        def filt(sender, recipient, m):
+            # W-early: each class sees only its wave — the opposite
+            # BVal value is withheld (from every sender but self), and
+            # cross-class Auxes are withheld so each class counts
+            # exactly the adversary's chosen prefix
+            if recipient == TestNetwork.OBSERVER_ID:
+                return True
+            if phase["n"] <= 1 and bval_msg(m, False) and recipient in A:
+                return False
+            if phase["n"] <= 1 and bval_msg(m, True) and recipient in B:
+                return False
+            if (
+                phase["n"] <= 2
+                and aux_msg(m)
+                and sender not in self.EQUIV
+                and (sender in A) != (recipient in A)
+            ):
+                return False
+            return True
+
+        rng = random.Random(seed)
+        net = TestNetwork(
+            8,
+            3,
+            lambda advs: EquivAdversary(random.Random(seed + 1)),
+            lambda ni: Agreement(ni, 0, 0),
+            rng,
+            mock_crypto=mock,
+            message_filter=filt,
+        )
+        for nid in range(8):
+            net.input(nid, nid < 4)
+
+        def drain():
+            while net.any_busy():
+                net.step()
+
+        drain()
+        # W-early complete: the classes hold different bin_values, and
+        # class A has already terminated SBV on its 8-true Aux prefix
+        # and decided at epoch 0
+        for nid in sorted(B):
+            assert net.nodes[
+                nid
+            ].algo.sbv_broadcast.bin_values == BoolSet.single(False)
+        for nid in sorted(A):
+            assert net.nodes[nid].algo.decision is True
+            assert net.nodes[nid].algo.epoch == 0
+
+        phase["n"] = 2  # full BVal delivery; Auxes still class-local
+        net.release_held(lambda s, r, m: bval_msg(m, True) or bval_msg(m, False))
+        drain()
+        for nid in sorted(B):
+            assert (
+                net.nodes[nid].algo.sbv_broadcast.bin_values
+                == BoolSet.both()
+            )
+
+        # release exactly TWO true-Auxes to class B: its counted set
+        # becomes {6 false, 2 true} → vals = {false, true} → continue
+        phase["n"] = 3
+        net.release_held(
+            lambda s, r, m: aux_msg(m, True) and r in B and s in {0, 1}
+        )
+        drain()
+        phase["n"] = 4
+        net.release_held()
+        net.step_until(
+            lambda: all(n.terminated() for n in net.nodes.values())
+        )
+        decisions = {nid: net.nodes[nid].algo.decision for nid in range(8)}
+        epochs = {nid: net.nodes[nid].algo.epoch for nid in range(8)}
+        assert set(decisions.values()) == {True}
+        return decisions, epochs
+
+    @pytest.mark.parametrize("mock", [True, False])
+    def test_cross_engine_divergent_decision_epochs(self, mock):
+        seed = 0xDD if mock else 0xDE
+        seq_dec, seq_epochs = self._sequential(mock, seed)
+        res = self._vectorized(mock, seed)
+        assert res.decisions[0] is True
+        # per-class deciding epochs: A at 0, B at 1 — in BOTH engines
+        assert res.class_epochs[0] == (0, 1)
+        assert res.epochs_used[0] == 1
+        for nid in sorted(self.A):
+            assert seq_epochs[nid] == 0
+        for nid in sorted(self.B):
+            assert seq_epochs[nid] == 1
+
+    def test_epoch_batches_with_divergent_timing(self):
+        # a FULL epoch where two classes decide instance `p` at
+        # different agreement epochs; the batch is bit-identical to
+        # the uniform twin's (same est0 skeleton, no equivocation —
+        # both decide TRUE, one at (0,1), one later via the coin path)
+        n, p = 11, 6
+        contribs = {i: [b"md-%02d" % i] for i in range(n)}
+        late = {p: {0, 1, 2, 3}}
+        sched = self._schedule()
+        sched = dataclasses.replace(sched, instances=frozenset({p}))
+        sim = VectorizedHoneyBadgerSim(n, random.Random(0xEA), mock=True)
+        res = sim.run_epoch(contribs, late_subset=late, div_schedule=sched)
+        assert res.accepted == sorted(range(n))
+        twin = VectorizedHoneyBadgerSim(n, random.Random(0xEA), mock=True)
+        res2 = twin.run_epoch(contribs, late_subset=late)
+        assert res.batch.contributions == res2.batch.contributions
+        assert res.accepted == res2.accepted
+
+    def test_schedule_validation(self):
+        from hbbft_tpu.core.network_info import NetworkInfo
+        from hbbft_tpu.harness.epoch import (
+            ClassDirective,
+            DivergentSchedule,
+            VectorizedAgreement,
+        )
+
+        netinfos = NetworkInfo.generate_map(
+            list(range(11)), random.Random(5), mock=True
+        )
+
+        def run(**kw):
+            sched = dataclasses.replace(self._schedule(), **kw)
+            return VectorizedAgreement(netinfos, 0, [0]).run(
+                self._est0(), div_schedule=sched
+            )
+
+        # classes must partition the correct live nodes
+        with pytest.raises(ValueError, match="partition"):
+            run(classes=(self.A, frozenset({5, 6})))
+        # directive rows must give one entry per class
+        with pytest.raises(ValueError, match="per class"):
+            run(
+                directives={
+                    0: (ClassDirective(withhold=False),)
+                }
+            )
+        # equivocator rows must give one value per class
+        with pytest.raises(ValueError, match="per class"):
+            run(equiv={8: (True,), 9: (True, False), 10: (True, False)})
+        # an aux prefix below N-f cannot terminate SBV
+        with pytest.raises(ValueError, match="termination threshold"):
+            run(
+                directives={
+                    0: (
+                        ClassDirective(
+                            withhold=False, aux_counted=((True, 6),)
+                        ),
+                        ClassDirective(
+                            withhold=True,
+                            aux_counted=((False, 6), (True, 2)),
+                        ),
+                    )
+                }
+            )
+        # a prefix wanting more senders than exist is infeasible
+        with pytest.raises(ValueError, match="senders exist"):
+            run(
+                directives={
+                    0: (
+                        ClassDirective(
+                            withhold=False, aux_counted=((True, 9),)
+                        ),
+                        ClassDirective(
+                            withhold=True,
+                            aux_counted=((False, 6), (True, 2)),
+                        ),
+                    )
+                }
             )
 
 

@@ -218,6 +218,70 @@ def test_g1_mul_many_comb_paths():
         assert w == inf
 
 
+def test_g1_mul_outer_matches_per_base():
+    """The one-call staging matrix: out[b][s] = ks[s]·base_b equals
+    the per-base g1_mul_many results byte-for-byte."""
+    import random
+
+    import numpy as np
+
+    from hbbft_tpu import native as NT
+    from hbbft_tpu.crypto.curve import G1_GEN
+
+    if not NT.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = random.Random(0xC0C)
+    bases = [G1_GEN * rng.randrange(1, 1 << 60) for _ in range(3)]
+    ks = [rng.randrange(0, 1 << 255) for _ in range(20)]
+    kbuf = np.frombuffer(
+        b"".join(int(k).to_bytes(32, "big") for k in ks), dtype=np.uint8
+    )
+    raw = NT.g1_mul_outer_raw(
+        b"".join(NT.g1_wire(b) for b in bases), kbuf
+    ).tobytes()
+    for b, base in enumerate(bases):
+        expect = NT.g1_mul_many(NT.g1_wire(base), ks)
+        for s in range(len(ks)):
+            off = (b * len(ks) + s) * 96
+            assert raw[off : off + 96] == expect[s], (b, s)
+
+
+def test_g1_msm_many_matches_per_msm():
+    """Many MSMs over one shared scalar vector: each row equals the
+    single-MSM result byte-for-byte."""
+    import random
+
+    import numpy as np
+
+    from hbbft_tpu import native as NT
+    from hbbft_tpu.crypto.curve import G1_GEN
+
+    if not NT.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = random.Random(0xC0D)
+    n_msms, n_pts = 5, 7
+    rows = [
+        [G1_GEN * rng.randrange(1, 1 << 60) for _ in range(n_pts)]
+        for _ in range(n_msms)
+    ]
+    ks = [rng.randrange(1, 1 << 255) for _ in range(n_pts)]
+    kbuf = np.frombuffer(
+        b"".join(int(k).to_bytes(32, "big") for k in ks), dtype=np.uint8
+    )
+    pts = np.frombuffer(
+        b"".join(NT.g1_wire(p) for row in rows for p in row),
+        dtype=np.uint8,
+    )
+    raw = NT.g1_msm_many_raw(n_msms, n_pts, pts, kbuf).tobytes()
+    for m, row in enumerate(rows):
+        expect = NT.g1_msm([NT.g1_wire(p) for p in row], ks)
+        assert raw[m * 96 : (m + 1) * 96] == expect, m
+
+
 def test_g2_poly_eval_range_matches_per_index():
     """Forward-difference range evaluation at the kernel boundary:
     every shape class — n > ncoeffs (difference path), n <= ncoeffs

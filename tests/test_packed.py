@@ -389,6 +389,61 @@ def test_adaptive_fraction_controller(monkeypatch):
     assert packed_msm.learned_fraction(n, g) == 0.10
 
 
+def test_compressed_mode_controller(monkeypatch):
+    """The compressed-transfer flip is MEASURED per shape (VERDICT r4
+    next-8): separate device-rate EMAs for the 96-byte and 48-byte
+    wires, a periodic trial flush, and the faster mode ships."""
+    import jax
+
+    monkeypatch.delenv("HBBFT_TPU_COMPRESS", raising=False)
+    monkeypatch.delenv("HBBFT_TPU_DEVICE_FRACTION", raising=False)
+    monkeypatch.setattr(packed_msm, "_RHO_STATE", {})
+    monkeypatch.setattr(packed_msm, "_save_rho", lambda: None)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(packed_msm, "_product_ready", lambda *a: True)
+    n, g = 1024, 64
+    K = 65536
+    plan = [32, 32]
+    # no measured state yet → uncompressed default
+    assert not packed_msm._choose_compressed(n, g, plan)
+    # after the first uncompressed sample, dc is unknown → trial fires
+    packed_msm._adapt(n, g, K, 0, 0.1, 0.0, 1.0)
+    assert packed_msm._choose_compressed(n, g, plan)
+    # trial measured SLOWER → ship uncompressed between probes
+    packed_msm._adapt(n, g, K, 0, 0.1, 0.0, 2.0, compressed=True)
+    assert not packed_msm._choose_compressed(n, g, plan)
+    # the probe interval elapses → another trial
+    for _ in range(packed_msm._COMPRESS_PROBE_IV):
+        packed_msm._adapt(n, g, K, 0, 0.1, 0.0, 1.0)
+    assert packed_msm._choose_compressed(n, g, plan)
+    # a compressed-wins regime (link-bound tunnel) ships compressed
+    st = packed_msm._rho_state()["%d:%d" % (n, g)]
+    st["dc"] = st["d"] * 2
+    st["cage"] = 0
+    assert packed_msm._choose_compressed(n, g, plan)
+    # symmetric staleness: a compressed-winning streak must still
+    # re-probe the UNCOMPRESSED wire (the tunnel idling again would
+    # otherwise never be detected)
+    for _ in range(packed_msm._COMPRESS_PROBE_IV):
+        packed_msm._adapt(n, g, K, 0, 0.1, 0.0, 1.0, compressed=True)
+    assert not packed_msm._choose_compressed(n, g, plan)
+    packed_msm._adapt(n, g, K, 0, 0.1, 0.0, 1.0)  # uncompressed sample
+    st = packed_msm._rho_state()["%d:%d" % (n, g)]
+    assert st["dage"] == 0
+    # seeding never degrades a converged (higher) engine estimate:
+    # leg medians are end-to-end lower bounds
+    st["d"], st["h"] = 77000.0, 31000.0
+    packed_msm.seed_rates(n, g, d=34640.0, h=29472.0)
+    assert st["d"] == 77000.0 and st["h"] == 31000.0
+    packed_msm.seed_rates(n, g, d=90000.0, h=40000.0)
+    assert st["d"] == 90000.0 and st["h"] == 40000.0
+    # operator pin overrides measurement both ways
+    monkeypatch.setenv("HBBFT_TPU_COMPRESS", "0")
+    assert not packed_msm._choose_compressed(n, g, plan)
+    monkeypatch.setenv("HBBFT_TPU_COMPRESS", "1")
+    assert packed_msm._choose_compressed(n, g, plan)
+
+
 def test_packed_product_padded_groups(host_kernel):
     # group sizes that never land on a tile bucket (the hb_1024_real
     # shape family): the device chunk is bucket-padded and the padding

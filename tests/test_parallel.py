@@ -124,3 +124,19 @@ class TestShardedWindowedMsm:
             pts, scalars, mesh=mesh8, nbits=16, interpret=True
         )
         assert got == g1_multi_exp(pts, scalars)
+
+    def test_packed_wire_matches_host(self, mesh8, rng):
+        """The r5 packed-wire mesh transfer (96 B wire + scalar bytes,
+        per-shard on-device unpack): ragged batch padded with the
+        infinity encoding, result equal to the host MSM."""
+        from hbbft_tpu.crypto.curve import G1
+        from hbbft_tpu.ops import ec_jax as EC2, packed_msm
+
+        pts = [G1_GEN * rng.randrange(1, 1 << 30) for _ in range(13)]
+        pts[5] = G1.infinity()
+        scalars = [rng.randrange(1, 1 << 16) for _ in range(13)]
+        run = M.sharded_packed_msm_fn(mesh8, interpret=True)
+        wires = packed_msm.g1_wires_batch(pts)
+        sc = packed_msm.scalar_bytes_batch(scalars, 2)
+        got = EC2.g1_from_limbs(run(wires, sc))
+        assert got == g1_multi_exp(pts, scalars)
