@@ -829,6 +829,49 @@ def bench_hb_1024_real(nodes: int = 1024, epochs: int = 3, n_dead: int = 50):
     )
 
 
+def bench_hb_1024_observer(nodes: int = 1024, n_dead: int = 50):
+    """VERDICT r4 next-9: the shared-flush observer lane at north-star
+    N.  One warm epoch plain and one with ``observe=True`` on the same
+    sim (both fully verified): the observer — a non-validator with no
+    key share, reference ``tests/network/mod.rs:402-420`` — derives
+    its batch from the network-visible share traffic alone, riding the
+    SAME cache-filling flush (r3 design, tested at small n in
+    ``test_epoch_vec.py``), so the epoch-cost delta should be ~0."""
+    import random as _r
+
+    from hbbft_tpu.harness.epoch import VectorizedHoneyBadgerSim
+    from hbbft_tpu.ops.backend_tpu import TpuBackend
+
+    rng = _r.Random(0x0B5)
+    sim = VectorizedHoneyBadgerSim(nodes, rng, mock=False, ops=TpuBackend())
+    dead = set(range(nodes - n_dead, nodes))
+    contribs = {
+        i: [b"obs-%04d" % i] for i in range(nodes) if i not in dead
+    }
+    sim.run_epoch(contribs, dead=dead)  # warm-up (compiles, combs)
+    t0 = time.perf_counter()
+    plain = sim.run_epoch(contribs, dead=dead)
+    plain_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    obs = sim.run_epoch(contribs, dead=dead, observe=True)
+    obs_dt = time.perf_counter() - t0
+    assert obs.observer_batch is not None
+    assert obs.observer_batch.contributions == obs.batch.contributions
+    assert plain.batch.contributions == contribs
+    # a device failure must not masquerade as a measurement
+    assert sim.be.stats.fallback_groups == 0, sim.be.stats
+    return _emit(
+        "hb_1024_observer_delta_pct",
+        100.0 * (obs_dt - plain_dt) / plain_dt,
+        "%",
+        nodes=nodes,
+        plain_epoch_s=round(plain_dt, 1),
+        observed_epoch_s=round(obs_dt, 1),
+        observer_equal=True,
+        crypto="real",
+    )
+
+
 def bench_qhb_1024_txrate(nodes: int = 1024, batch: int = 65536, n_dead: int = 50):
     """BASELINE north-star throughput metric: tx/sec at N=1024.  Same
     full stack as ``qhb_1024`` with the reference's batch-size knob
@@ -1392,6 +1435,7 @@ SUITE = {
     "qhb_1024": bench_qhb_1024,
     "qhb_1024_txrate": bench_qhb_1024_txrate,
     "hb_1024_real": bench_hb_1024_real,
+    "hb_1024_observer": bench_hb_1024_observer,
     "qhb_dyn_1024": bench_qhb_dyn_1024,
     "hb_1024_latency": bench_hb_1024_latency,
     "dkg_verified": bench_dkg_verified,

@@ -687,9 +687,32 @@ class VectorizedDkg:
                 + alpha2[d] * _fr_ints(bvg)[0]
             ) % R
 
-        lhs_wire = NT.g2_msm(pts, scalars)
+        lhs_wire = self._g2_msm_wires(pts, scalars)
         rhs_wire = NT.g2_mul(NT.g2_wire(G2_GEN), total)
         return lhs_wire == rhs_wire, len(pts)
+
+    @staticmethod
+    def _g2_msm_wires(pts, scalars) -> bytes:
+        """The fused check's G2 MSM.  At verification scale (≥ 2¹⁶
+        commitment entries) a real TPU runs the packed-wire device
+        path — 192 B/point transfer + on-device unpack to the windowed
+        Fq2 kernel (re-running r4's 'device G2 loses everywhere'
+        routing decision, which predates the packed transfer,
+        VERDICT r4 next-3) — falling back to native host Pippenger
+        when executables are cold.  Both paths are exact; results are
+        byte-identical wires."""
+        from .. import native as NT
+
+        if len(pts) >= (1 << 16):
+            import jax
+
+            if jax.default_backend() == "tpu":
+                from ..ops import packed_msm
+
+                fin = packed_msm.g2_msm_packed_wires_async(pts, scalars)
+                if fin is not None:
+                    return fin()
+        return NT.g2_msm(pts, scalars)
 
     # -- exact per-item checks (sequential semantics) ----------------------
 

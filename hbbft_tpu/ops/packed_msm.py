@@ -71,26 +71,40 @@ def _allow_compile() -> bool:
     return os.environ.get("HBBFT_TPU_WARM", "0") == "1"
 
 
-def _tree_parts(kp: int):
-    """The executable-cache keys the tree reduction will need."""
+def _tree_parts(kp: int, g2: bool = False):
+    """The executable-cache keys the tree reduction will need — one
+    home for both groups (the shapes differ only in the Fq2 axis and
+    the chunk constant)."""
     L = LB.FQ_LIMBS
-    chunk = pallas_ec._TREE_CHUNK_G1
+    chunk = pallas_ec._TREE_CHUNK_G2 if g2 else pallas_ec._TREE_CHUNK_G1
+    name = "tree_g2" if g2 else "tree_g1"
+    mid = (3, 2, L) if g2 else (3, L)
     if kp <= chunk:
-        return [("tree_g1", (((kp, 3, L), "int32"),))]
-    out = [("tree_g1", (((chunk, 3, L), "int32"),))]
-    out.append(("tree_g1", (((kp // chunk, 3, L), "int32"),)))
-    return out
+        return [(name, (((kp,) + mid, "int32"),))]
+    return [
+        (name, (((chunk,) + mid, "int32"),)),
+        (name, (((kp // chunk,) + mid, "int32"),)),
+    ]
 
 
-def _flat_ready(kp: int, nb: int) -> bool:
-    """All executables of one flat packed chunk are warm."""
+def _flat_ready(kp: int, nb: int, g2: bool = False) -> bool:
+    """All executables of one flat packed chunk are warm (G1 or G2 —
+    the guard keys mirror exactly what the device path will build, so
+    the two groups share one home and cannot drift separately)."""
     L = LB.FQ_LIMBS
     T = pallas_ec.TILE
     G = kp // T
-    checks = [
-        ("unpack_g1_v1", (((kp, 96), "uint8"), ((kp, nb), "uint8"))),
-        ("win_g1", ((G, 3, L, T), (G, nb * 2, T))),
-    ] + _tree_parts(kp)
+    if g2:
+        checks = [
+            ("unpack_g2_v1", (((kp, 192), "uint8"), ((kp, nb), "uint8"))),
+            ("win_g2", ((G, 3, 2, L, T), (G, nb * 2, T))),
+        ]
+    else:
+        checks = [
+            ("unpack_g1_v1", (((kp, 96), "uint8"), ((kp, nb), "uint8"))),
+            ("win_g1", ((G, 3, L, T), (G, nb * 2, T))),
+        ]
+    checks += _tree_parts(kp, g2)
     return all(pallas_ec.exec_available(n, p) for n, p in checks)
 
 
@@ -205,16 +219,20 @@ def _le_bits_to_limbs(le_bits: jnp.ndarray) -> jnp.ndarray:
 def _assemble_points(
     xl: jnp.ndarray, yl: jnp.ndarray, ident: jnp.ndarray
 ) -> jnp.ndarray:
-    """(x, y) limbs + identity mask → [Kp, 3, L] projective points,
-    with flagged rows (infinity encodings, bucket padding) set to the
-    projective identity (0 : 1 : 0) — the one home for that encoding
-    across the compressed and uncompressed unpack paths."""
-    L = LB.FQ_LIMBS
+    """(x, y) coordinate limbs ([Kp, L] for G1, [Kp, 2, L] for G2) +
+    identity mask → [Kp, 3, (2,) L] projective points, with flagged
+    rows (infinity encodings, bucket padding) set to the projective
+    identity (0 : 1 : 0) — the ONE home for that encoding across the
+    uncompressed, compressed, and G2 unpack paths."""
     Kp = xl.shape[0]
-    one = jnp.zeros((L,), jnp.int32).at[0].set(1)
-    yl = jnp.where(ident[:, None], one[None, :], yl)
-    xl = jnp.where(ident[:, None], jnp.int32(0), xl)
-    zl = jnp.zeros((Kp, L), jnp.int32).at[:, 0].set(
+    coord = xl.shape[1:]
+    one = jnp.zeros(coord, jnp.int32)
+    one = one.at[(0,) * len(coord)].set(1)
+    mask = ident.reshape((Kp,) + (1,) * len(coord))
+    yl = jnp.where(mask, one[None], yl)
+    xl = jnp.where(mask, jnp.int32(0), xl)
+    zl = jnp.zeros_like(xl)
+    zl = zl.at[(slice(None),) + (0,) * len(coord)].set(
         jnp.where(ident, 0, 1).astype(jnp.int32)
     )
     return jnp.stack([xl, yl, zl], axis=1)
@@ -231,13 +249,15 @@ def _scalar_digits(sc_u8: jnp.ndarray) -> jnp.ndarray:
 
 
 def _tile_layout(pts: jnp.ndarray, dig: jnp.ndarray):
-    """[Kp, 3, L] + [Kp, nwin] → the kernel's ([G, 3, L, T], [G, nwin,
-    T]) tile-transposed layout."""
+    """[Kp, 3, (2,) L] + [Kp, nwin] → the kernel's tile-transposed
+    ([G, 3, (2,) L, T], [G, nwin, T]) layout, G1 and G2 alike."""
     T = pallas_ec.TILE
-    Kp, _, L = pts.shape
+    Kp = pts.shape[0]
+    mid = pts.shape[1:]
     nwin = dig.shape[1]
     G = Kp // T
-    pts_t = pts.reshape(G, T, 3, L).transpose(0, 2, 3, 1)
+    perm = (0,) + tuple(range(2, 2 + len(mid))) + (1,)
+    pts_t = pts.reshape((G, T) + mid).transpose(perm)
     dig_t = dig.reshape(G, T, nwin).transpose(0, 2, 1)
     return pts_t, dig_t
 
@@ -418,6 +438,116 @@ def g1_msm_packed(
             "them with HBBFT_TPU_WARM=1 or route to the host path"
         )
     return fin()
+
+
+# ---------------------------------------------------------------------------
+# Packed-wire G2 MSM (flat) — the DKG verification plane's shape
+# ---------------------------------------------------------------------------
+# The fused trilinear-RLC check (``harness/dkg.py``) settles every
+# row/value cell of a verified DKG in ONE huge G2 MSM over commitment
+# entries it already holds as 192-byte wires.  r4 routed G2 host-side
+# by a measurement that PREDATES the packed-wire transfer (the device
+# lost on ~1.3 KB/point expanded limbs); this path re-runs that
+# decision with the same treatment G1 got: wire bytes across the
+# tunnel (192 B/point + 32 B scalars), on-device unpack to the
+# windowed Fq2 kernel's tile layout, per-chunk tree reductions.
+
+# [K, 3, 2, L] int32 ≈ 912 B/point on device plus ~3× tree
+# intermediates: 2^17-point chunks stay comfortably inside HBM and
+# keep the per-chunk tunnel floor amortized over ~25 MB transfers.
+_MAX_CHUNK_G2 = 1 << 17
+
+
+def _unpack_fn_g2(pts_u8: jnp.ndarray, sc_u8: jnp.ndarray):
+    """[Kp, 192] u8 (x.c0‖x.c1‖y.c0‖y.c1, big-endian — exactly
+    ``native.g2_wire``) + [Kp, nb] u8 scalars → the G2 kernel's
+    ([G, 3, 2, L, T], [G, nwin, T]) layout; all-zero rows (infinity
+    encoding, chunk padding) become the projective identity via the
+    shared ``_assemble_points`` home."""
+    b = _bytes_to_bits_msb(pts_u8.astype(jnp.int32))  # [Kp, 1536]
+    comps = [
+        _le_bits_to_limbs(jnp.flip(b[:, i * 384 : (i + 1) * 384], axis=1))
+        for i in range(4)
+    ]
+    x = jnp.stack([comps[0], comps[1]], axis=1)  # [Kp, 2, L]
+    y = jnp.stack([comps[2], comps[3]], axis=1)
+    ident = jnp.all(pts_u8 == 0, axis=1)
+    pts = _assemble_points(x, y, ident)  # [Kp, 3, 2, L]
+    return _tile_layout(pts, _scalar_digits(sc_u8))
+
+
+@functools.lru_cache(maxsize=None)
+def _unpack_g2_jit():
+    return jax.jit(_unpack_fn_g2)
+
+
+def _unpack_g2_device(dev_pts, dev_sc):
+    if jax.default_backend() == "tpu":
+        return pallas_ec.cached_compiled(
+            "unpack_g2_v1", _unpack_fn_g2, dev_pts, dev_sc
+        )
+    return _unpack_g2_jit()(dev_pts, dev_sc)
+
+
+def g2_msm_packed_wires_async(
+    wires: Sequence[bytes],
+    scalars: Sequence[int],
+    interpret: Optional[bool] = None,
+    nbits: int = 255,
+) -> Optional[Callable[[], bytes]]:
+    """Enqueue a flat G2 MSM over raw 192-byte wire encodings and
+    return a finalizer yielding the result as a wire (the DKG plane
+    keeps everything as buffers).  Returns ``None`` when executables
+    are cold outside warming mode — the caller stays host-side.
+    ``nbits`` defaults to full-width Fr (the trilinear-RLC products);
+    tests narrow it to keep CPU interpret mode tractable."""
+    from . import ec_jax
+
+    k = len(wires)
+    if k == 0:
+        return lambda: b"\x00" * 192
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb = -(-nbits // 8)
+    if not interpret and not _allow_compile():
+        for lo in range(0, k, _MAX_CHUNK_G2):
+            kc = min(_MAX_CHUNK_G2, k - lo)
+            if not _flat_ready(_bucket_rows(kc), nb, g2=True):
+                return None
+    pts_u8 = np.frombuffer(b"".join(wires), dtype=np.uint8).reshape(
+        k, 192
+    )
+    sc = LB.scalars_to_be_bytes(list(scalars), nb)
+
+    partials = []
+    for lo in range(0, k, _MAX_CHUNK_G2):
+        chunk = pts_u8[lo : lo + _MAX_CHUNK_G2]
+        sc_chunk = sc[lo : lo + _MAX_CHUNK_G2]
+        kc = chunk.shape[0]
+        kp = _bucket_rows(kc)
+        if kp != kc:
+            chunk = np.concatenate(
+                [chunk, np.zeros((kp - kc, 192), dtype=np.uint8)]
+            )
+            sc_chunk = np.concatenate(
+                [sc_chunk, np.zeros((kp - kc, nb), dtype=np.uint8)]
+            )
+        dev_pts = jax.device_put(chunk)
+        dev_sc = jax.device_put(sc_chunk)
+        pts_t, dig_t = _unpack_g2_device(dev_pts, dev_sc)
+        out_t = pallas_ec._windowed_g2_tiles(pts_t, dig_t, interpret)
+        prods = pallas_ec._untile(out_t, kp, kp)
+        partials.append(pallas_ec._tree_sum_chunked(prods, g2=True))
+
+    def finalize() -> bytes:
+        from .. import native as NT
+
+        acc = ec_jax.g2_from_limbs(partials[0])
+        for part in partials[1:]:
+            acc = acc + ec_jax.g2_from_limbs(part)
+        return NT.g2_wire(acc)  # pure-Python wire encode (no lib call)
+
+    return finalize
 
 
 # ---------------------------------------------------------------------------
