@@ -869,6 +869,12 @@ def bench_hb_1024_observer(nodes: int = 1024, n_dead: int = 50):
         observed_epoch_s=round(obs_dt, 1),
         observer_equal=True,
         crypto="real",
+        plain_phases={
+            k: round(v, 1) for k, v in (plain.phases or {}).items()
+        },
+        observed_phases={
+            k: round(v, 1) for k, v in (obs.phases or {}).items()
+        },
     )
 
 

@@ -1130,16 +1130,28 @@ static void comb_mul_many(uint64_t n, const uint8_t* p, const uint8_t* ks,
   const int wbits = (n >= 256) ? 8 : 4;
   const int nwin = 256 / wbits;  // windows per 256-bit scalar
   const int tmax = (1 << wbits) - 1;  // nonzero digits per window
-  // T[j][d-1] = d * 2^(wbits*j) * P
-  static thread_local std::vector<Jac<F>> table;
-  table.assign(nwin * tmax, jac_infinity<F>());
+  // T[j][d-1] = d * 2^(wbits*j) * P.  The window bases 2^(wbits·j)·P
+  // are normalized to affine first (one batch inversion), so the
+  // ~nwin·tmax row fills run MIXED adds (11 field muls) instead of
+  // full Jacobian adds (16) — at the epoch staging shape (974 bases
+  // per epoch) the table build was ~20% of the whole call.
+  std::vector<Jac<F>> pows(nwin);
   Jac<F> cur = jac_madd(jac_infinity<F>(), a);  // P as Jacobian
   for (int j = 0; j < nwin; ++j) {
-    table[j * tmax] = cur;
-    for (int d = 2; d <= tmax; ++d)
-      table[j * tmax + d - 1] = jac_add(table[j * tmax + d - 2], cur);
+    pows[j] = cur;
     if (j < nwin - 1)
       for (int t = 0; t < wbits; ++t) cur = jac_double(cur);
+  }
+  static thread_local std::vector<Aff<F>> pow_aff;
+  jac_batch_to_aff(pows, pow_aff);
+  static thread_local std::vector<Jac<F>> table;
+  table.assign(nwin * tmax, jac_infinity<F>());
+  for (int j = 0; j < nwin; ++j) {
+    Jac<F> acc = jac_madd(jac_infinity<F>(), pow_aff[j]);
+    for (int d = 1; d <= tmax; ++d) {
+      table[j * tmax + d - 1] = acc;
+      if (d < tmax) acc = jac_madd(acc, pow_aff[j]);
+    }
   }
   static thread_local std::vector<Aff<F>> table_aff;
   jac_batch_to_aff(table, table_aff);
