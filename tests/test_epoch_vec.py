@@ -1147,6 +1147,40 @@ class TestMultiEpochDivergence:
         for nid in sorted(self.B):
             assert seq_epochs[nid] == 1
 
+    def test_three_view_classes(self):
+        # >2 classes (the r4 unrepresentability): split B into two
+        # classes with the same early wave — A decides at epoch 0 on
+        # its 8-true Aux prefix; B1 and B2 each count a {6 false,
+        # 2 true} prefix, continue, and decide at epoch 1 via A's five
+        # Terms.  Aux availability counts ALL honest undecided nodes,
+        # so the per-class prefixes stay feasible after the split.
+        from hbbft_tpu.core.network_info import NetworkInfo
+        from hbbft_tpu.harness.epoch import (
+            ClassDirective,
+            DivergentSchedule,
+            VectorizedAgreement,
+        )
+
+        d0 = ClassDirective(withhold=False, aux_counted=((True, 8),))
+        db = ClassDirective(
+            withhold=True, aux_counted=((False, 6), (True, 2))
+        )
+        sched = DivergentSchedule(
+            classes=(self.A, frozenset({5, 6}), frozenset({7})),
+            equiv={e: (True, False, False) for e in self.EQUIV},
+            equiv_aux=True,
+            directives={0: (d0, db, db)},
+            instances=frozenset({0}),
+        )
+        netinfos = NetworkInfo.generate_map(
+            list(range(11)), random.Random(0xD3C), mock=True
+        )
+        res = VectorizedAgreement(netinfos, 0, [0]).run(
+            self._est0(), div_schedule=sched
+        )
+        assert res.decisions[0] is True
+        assert res.class_epochs[0] == (0, 1, 1)
+
     def test_epoch_batches_with_divergent_timing(self):
         # a FULL epoch where two classes decide instance `p` at
         # different agreement epochs; the batch is bit-identical to
