@@ -1181,6 +1181,49 @@ class TestMultiEpochDivergence:
         assert res.decisions[0] is True
         assert res.class_epochs[0] == (0, 1, 1)
 
+    @pytest.mark.parametrize("mock", [True, False])
+    def test_divergent_continuation_reaches_real_coin(self, mock):
+        # neither class decides at epoch 0 (both count a {true, false}
+        # Aux prefix), the carried state runs the uniform continuation
+        # through epoch 1 (fixed-false coin, unanimous true — no
+        # decision) and reaches the REAL coin at epoch 2 with the
+        # still-running honest nodes as the share senders; whichever
+        # way the coin lands, both classes decide true at epoch 2 or 3
+        # together.  Exercises _div_round's batched-coin integration.
+        from hbbft_tpu.core.network_info import NetworkInfo
+        from hbbft_tpu.harness.epoch import (
+            ClassDirective,
+            DivergentSchedule,
+            VectorizedAgreement,
+        )
+
+        sched = dataclasses.replace(
+            self._schedule(),
+            directives={
+                0: (
+                    ClassDirective(
+                        withhold=False,
+                        aux_counted=((True, 7), (False, 1)),
+                    ),
+                    ClassDirective(
+                        withhold=True,
+                        aux_counted=((False, 6), (True, 2)),
+                    ),
+                )
+            },
+        )
+        netinfos = NetworkInfo.generate_map(
+            list(range(11)), random.Random(0xDC0), mock=mock
+        )
+        res = VectorizedAgreement(netinfos, 0, [0]).run(
+            self._est0(), div_schedule=sched
+        )
+        assert res.decisions[0] is True
+        assert res.coin_flips >= 1
+        e = res.epochs_used[0]
+        assert e in (2, 3)
+        assert res.class_epochs[0] == (e, e)
+
     def test_epoch_batches_with_divergent_timing(self):
         # a FULL epoch where two classes decide instance `p` at
         # different agreement epochs; the batch is bit-identical to
