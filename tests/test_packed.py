@@ -300,6 +300,63 @@ def test_split_plan_shapes(monkeypatch):
     assert packed_msm._split_plan(7, 3) == []
 
 
+def test_split_plan_warm_filtering(monkeypatch):
+    """On a real TPU outside warming mode, ladder sizes without warm
+    executables are skipped — smaller warm chunks take their place —
+    so production never pays a cold multi-minute Mosaic compile."""
+    import jax
+
+    monkeypatch.setenv("HBBFT_TPU_DEVICE_FRACTION", "1")
+    monkeypatch.delenv("HBBFT_TPU_WARM", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # only the 8-group (2q) chunk shape is warm: the 32-group rung is
+    # filtered out and the plan decomposes with what remains
+    monkeypatch.setattr(
+        packed_msm,
+        "_product_ready",
+        lambda kd, g, compressed: g == 8,
+    )
+    assert packed_msm._split_plan(65536, 64) == [8] * 8
+    # nothing warm at all: the quantum survives as the last resort and
+    # the caller's own readiness check routes the flush host-side
+    monkeypatch.setattr(
+        packed_msm, "_product_ready", lambda kd, g, compressed: False
+    )
+    assert packed_msm._split_plan(65536, 64) == [4] * 16
+    # warming mode uses the full ladder regardless of cache state
+    monkeypatch.setenv("HBBFT_TPU_WARM", "1")
+    assert packed_msm._split_plan(65536, 64) == [32, 32]
+
+
+def test_rho_state_file_roundtrip(tmp_path, monkeypatch):
+    """The persisted controller state (rho/d/h/hage/dc/cage/dage)
+    survives a save/load cycle, tolerates legacy bare-rho entries, and
+    drops malformed rows without losing the rest."""
+    import json
+
+    path = tmp_path / "device_fraction.json"
+    monkeypatch.setattr(packed_msm, "_rho_path", lambda: str(path))
+    monkeypatch.setattr(packed_msm, "_RHO_STATE", None)
+    state = packed_msm._rho_state()
+    state["1024:64"] = {
+        "rho": 0.75, "d": 93061.4, "h": 38141.1, "hage": 2,
+        "dc": 2038.7, "cage": 5, "dage": 1,
+    }
+    packed_msm._save_rho()
+    raw = json.loads(path.read_text())
+    raw["974:974"] = 0.25  # legacy bare-rho entry
+    raw["bad"] = {"rho": "soup"}  # malformed: must not drop the rest
+    path.write_text(json.dumps(raw))
+    monkeypatch.setattr(packed_msm, "_RHO_STATE", None)
+    st = packed_msm._rho_state()
+    assert st["1024:64"] == {
+        "rho": 0.75, "d": 93061.4, "h": 38141.1, "hage": 2,
+        "dc": 2038.7, "cage": 5, "dage": 1,
+    }
+    assert st["974:974"]["rho"] == 0.25
+    assert "bad" not in st
+
+
 def test_adaptive_fraction_controller(monkeypatch):
     """The r5 rate-balance controller: EXACT device- and host-rate
     samples every flush (the waiter thread stamps the device wall, so
