@@ -75,8 +75,15 @@ def bench_headline(k: int = 65536, iters: int = 5):
     from hbbft_tpu.crypto import threshold as T
     from hbbft_tpu.crypto.curve import G2_GEN
     from hbbft_tpu.harness.batching import BatchingBackend, DecObligation
+    from hbbft_tpu.obs import recorder as obsrec
     from hbbft_tpu.ops import limbs as LB
     from hbbft_tpu.ops.backend_tpu import TpuBackend
+
+    # Leg timings ride the obs recorder: spans land in the JSONL trace
+    # when --trace is set, and the span's .dur replaces the ad-hoc
+    # perf_counter pairs either way (a local unsinked recorder when
+    # tracing is off — identical timing source, no file)
+    rec = obsrec.active() or obsrec.Recorder()
 
     rng = random.Random(0xBEEF)
     n_nodes = min(1024, k)
@@ -130,9 +137,9 @@ def bench_headline(k: int = 65536, iters: int = 5):
     for i in range(iters):
         obs = make_obs(b"host-%d" % i)
         be = BatchingBackend(inner=host_inner)
-        t0 = time.perf_counter()
-        be.prefetch(obs)
-        host_dts.append(time.perf_counter() - t0)
+        with rec.span("bench.flush", leg="host", i=i, k=k) as sp:
+            be.prefetch(obs)
+        host_dts.append(sp.dur)
         assert be.stats.fallback_items == 0
         assert all(
             be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
@@ -149,9 +156,9 @@ def bench_headline(k: int = 65536, iters: int = 5):
         for i in range(iters):
             obs = make_obs(b"dev-%d" % i)
             be = BatchingBackend(inner=TpuBackend())
-            t0 = time.perf_counter()
-            be.prefetch(obs)
-            dev_dts.append(time.perf_counter() - t0)
+            with rec.span("bench.flush", leg="device", i=i, k=k) as sp:
+                be.prefetch(obs)
+            dev_dts.append(sp.dur)
             assert be.stats.fallback_items == 0
             assert all(
                 be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
@@ -185,9 +192,9 @@ def bench_headline(k: int = 65536, iters: int = 5):
     for i in range(iters):
         obs = make_obs(b"ship-%d" % i)
         be = BatchingBackend(inner=ship_inner)
-        t0 = time.perf_counter()
-        be.prefetch(obs)
-        ship_dts.append(time.perf_counter() - t0)
+        with rec.span("bench.flush", leg="ship", i=i, k=k) as sp:
+            be.prefetch(obs)
+        ship_dts.append(sp.dur)
         assert be.stats.fallback_items == 0
         assert all(
             be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
@@ -198,7 +205,8 @@ def bench_headline(k: int = 65536, iters: int = 5):
             for k, v in (
                 getattr(be, "last_flush_phases", None) or {}
             ).items()
-        }  # final (converged) flush's stage walls
+        }  # final (converged) flush's stage walls (also on the trace's
+        # flush events, one per iteration, when --trace is set)
     ship_dt = statistics.median(ship_dts)
 
     # vs_baseline denominator: the sequential per-share path over a
@@ -206,10 +214,10 @@ def bench_headline(k: int = 65536, iters: int = 5):
     # swung the ratio 124–197× across captures — VERDICT r4 next-6)
     sample = min(64, len(obs))
     ob0 = obs[:sample]
-    t0 = time.perf_counter()
-    for o in ob0:
-        assert o.pk_share.verify_decryption_share(o.share, o.ciphertext)
-    cpu_rate = sample / (time.perf_counter() - t0)
+    with rec.span("bench.cpu_sample", n=sample) as sp:
+        for o in ob0:
+            assert o.pk_share.verify_decryption_share(o.share, o.ciphertext)
+    cpu_rate = sample / sp.dur
     rate = k / ship_dt
     st = packed_msm._rho_state().get("%d:%d" % (n_nodes, groups))
     ctl = st if isinstance(st, dict) else {}
@@ -1480,14 +1488,29 @@ def main() -> None:
     p.add_argument(
         "--k", type=int, default=65536, help="headline batch size"
     )
+    p.add_argument(
+        "--trace",
+        metavar="PATH",
+        default=None,
+        help="write a JSONL observability trace (hbbft_tpu.obs) to PATH; "
+        "summarize with `python -m hbbft_tpu.obs.report PATH`",
+    )
     args = p.parse_args()
-    if args.config:
-        SUITE[args.config]()
-    elif args.suite:
-        for name in SUITE:
-            SUITE[name]()
-    else:
-        bench_headline(k=args.k)
+    if args.trace:
+        from hbbft_tpu.obs import recorder as obsrec
+
+        obsrec.enable(args.trace)
+    try:
+        if args.config:
+            SUITE[args.config]()
+        elif args.suite:
+            for name in SUITE:
+                SUITE[name]()
+        else:
+            bench_headline(k=args.k)
+    finally:
+        if args.trace:
+            obsrec.disable()
 
 
 if __name__ == "__main__":

@@ -60,7 +60,21 @@ def main() -> None:
         "latency under the --lag/--bw/--cpu hardware profile "
         "(the reference table's Min/MaxTime at co-simulation scale)",
     )
+    p.add_argument(
+        "--trace",
+        metavar="PATH",
+        default=None,
+        help="write a JSONL observability trace to PATH "
+        "(summarize with `python -m hbbft_tpu.obs.report PATH`)",
+    )
     args = p.parse_args()
+    if args.trace:
+        from hbbft_tpu import obs
+
+        obs.enable(args.trace)
+        import atexit
+
+        atexit.register(obs.disable)
 
     if 3 * args.faulty >= args.nodes:
         p.error("requires 3·f < n")

@@ -16,6 +16,8 @@ import enum
 import logging
 from typing import Any, Iterator, List
 
+from ..obs import recorder as _obs
+
 # The framework's observability channel (reference: `log` crate macros
 # throughout, enabled via RUST_LOG=hbbft=debug — here: configure
 # ``logging.getLogger("hbbft_tpu")`` with a handler + DEBUG level).
@@ -79,8 +81,14 @@ class Fault:
     node_id: Any
     kind: FaultKind
 
+    def compact(self) -> str:
+        """THE stable compact form — ``<node_id!r>:<KIND_NAME>`` — used
+        by ``__repr__``, the debug log and the ``fault`` trace event,
+        so fault telemetry is greppable and byte-stable across runs."""
+        return f"{self.node_id!r}:{self.kind.name}"
+
     def __repr__(self) -> str:
-        return f"Fault({self.node_id!r}, {self.kind.name})"
+        return f"Fault({self.compact()})"
 
 
 class FaultLog:
@@ -93,11 +101,24 @@ class FaultLog:
 
     @classmethod
     def init(cls, node_id: Any, kind: FaultKind) -> "FaultLog":
-        return cls([Fault(node_id, kind)])
+        # routed through append so every fault creation point shares
+        # the same debug-log + trace-telemetry path
+        fl = cls()
+        fl.append(Fault(node_id, kind))
+        return fl
 
     def append(self, fault: Fault) -> None:
         if log.isEnabledFor(logging.DEBUG):
-            log.debug("fault: node %r %s", fault.node_id, fault.kind.value)
+            log.debug("fault: %s (%s)", fault.compact(), fault.kind.value)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "fault",
+                fault=fault.compact(),
+                node=fault.node_id,
+                kind=fault.kind.name,
+            )
+            rec.count(f"fault.{fault.kind.name}")
         self._faults.append(fault)
 
     def add(self, node_id: Any, kind: FaultKind) -> None:

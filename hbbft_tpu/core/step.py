@@ -11,6 +11,13 @@ TPU-native re-design of the reference's core runtime types
   ``output`` values, a ``FaultLog`` of observed Byzantine behaviour, and
   outgoing ``messages`` the *caller* must deliver.
 
+Observability: every fault a Step accumulates (``add_fault`` /
+``from_fault``) routes through ``FaultLog.append``, which — when a
+trace recorder is installed (``hbbft_tpu.obs``) — emits a ``fault``
+telemetry event in the stable compact form ``<node!r>:<KIND>`` and
+bumps the per-kind fault counter.  Protocol handlers need no extra
+instrumentation.
+
 Everything here is plain data: protocol instances stay pure, sans-IO
 state machines, which is what lets the TPU backend batch the crypto of
 thousands of instances into single fused device launches without
@@ -170,6 +177,7 @@ class Step(Generic[O, M]):
         return child.output
 
     def add_fault(self, node_id: Any, kind: Any) -> "Step[O, M]":
+        # FaultLog.append carries the debug-log + trace-telemetry hook
         self.fault_log.append(Fault(node_id, kind))
         return self
 

@@ -65,6 +65,7 @@ import numpy as np
 from ..core.fault import FaultKind, FaultLog
 from ..core.network_info import NetworkInfo
 from ..core.serialize import dumps, loads
+from ..obs import recorder as _obs
 from ..crypto import threshold as T
 from ..crypto.merkle import MerkleTree as _PyMerkleTree
 from ..protocols.common_coin import make_nonce
@@ -1446,6 +1447,16 @@ class VectorizedHoneyBadgerSim:
             phases["observer"] = _time.perf_counter() - _t0
             for k, v in (getattr(self, "_obs_phases", None) or {}).items():
                 phases["observer_" + k] = v
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "epoch_phases",
+                epoch=self.epoch,
+                phases={k: round(v, 6) for k, v in phases.items()},
+                shares=dec.shares_verified,
+                coin_flips=res.coin_flips,
+                faults=len(faults),
+            )
         self.epoch += 1
         return EpochResult(
             batch=batch,

@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..core.network_info import NetworkInfo
 from ..core.serialize import dumps
 from ..core.step import Step
+from ..obs import recorder as _obs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +112,15 @@ class SimNode:
         step = self.algo.handle_message(sender_id, message)
         elapsed = _time.perf_counter() - start
         self.time += elapsed * 100.0 / self.hw.cpu_factor
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "msg_handle",
+                node=self.id,
+                vt=round(self.time, 9),
+                wall=round(elapsed, 9),
+                size=size,
+            )
         self._send_output_and_msgs(step, self.time)
 
     def handle_input(self, value) -> None:
@@ -214,18 +224,45 @@ class SimNetwork:
             self._dispatch(nid, arrival, target, message, size)
 
     def _dispatch(self, sender_id, arrival, target, message, size) -> None:
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "msg_send",
+                src=sender_id,
+                size=size,
+                vt=round(arrival, 9),
+                kind="all" if target.is_all else "node",
+            )
         if target.is_all:
             for nid, node in self.nodes.items():
                 if nid != sender_id:
                     node.add_message(arrival, sender_id, message, size)
                     self._note_obs(node, sender_id, message)
                     self._push_event(nid)
+                    if rec is not None and not node.dead:
+                        rec.event(
+                            "msg_deliver",
+                            src=sender_id,
+                            dst=nid,
+                            size=size,
+                            vt=round(arrival, 9),
+                            kind="all",
+                        )
         else:
             node = self.nodes.get(target.node)
             if node is not None:
                 node.add_message(arrival, sender_id, message, size)
                 self._note_obs(node, sender_id, message)
                 self._push_event(target.node)
+                if rec is not None and not node.dead:
+                    rec.event(
+                        "msg_deliver",
+                        src=sender_id,
+                        dst=target.node,
+                        size=size,
+                        vt=round(arrival, 9),
+                        kind="node",
+                    )
 
     def _note_obs(self, node: SimNode, sender_id, message) -> None:
         """Extract the message's crypto obligations once, at enqueue
@@ -330,8 +367,25 @@ class EpochRow:
     msgs_per_node: int
     bytes_per_node: int
 
+    def as_dict(self) -> Dict[str, Any]:
+        """The structured form of this row — the single source both the
+        text table formatting and the trace ``epoch`` event consume."""
+        return dataclasses.asdict(self)
+
 
 class EpochStats:
+    # (title, format) per column, keyed by the EpochRow field the value
+    # comes from — header and row rendering consume the same spec, so
+    # the text table and the structured rows can never drift
+    _COLUMNS = (
+        ("epoch", "Epoch", "{:>5}", lambda d: d["epoch"]),
+        ("min_time", "MinTime", "{:>7.0f}ms", lambda d: d["min_time"] * 1000),
+        ("max_time", "MaxTime", "{:>7.0f}ms", lambda d: d["max_time"] * 1000),
+        ("txs", "Txs", "{:>5}", lambda d: d["txs"]),
+        ("msgs_per_node", "Msgs/Node", "{:>9}", lambda d: d["msgs_per_node"]),
+        ("bytes_per_node", "Size/Node", "{:>9}B", lambda d: d["bytes_per_node"]),
+    )
+
     def __init__(self, network: SimNetwork):
         self.network = network
         self._per_epoch: Dict[int, Dict[Any, Tuple[float, Any]]] = {}
@@ -339,10 +393,17 @@ class EpochStats:
         self._num_live = len(network.live_nodes())
 
     def add(self, nid, time: float, batch) -> Optional[EpochRow]:
+        rec = _obs.ACTIVE
+        if rec is not None and batch.epoch not in self._per_epoch:
+            rec.event("epoch_start", epoch=batch.epoch, vt=round(time, 9))
         nodes = self._per_epoch.setdefault(batch.epoch, {})
         if nid in nodes:
             return None
         nodes[nid] = (time, batch)
+        if rec is not None:
+            rec.event(
+                "epoch_decide", epoch=batch.epoch, node=nid, vt=round(time, 9)
+            )
         if len(nodes) < self._num_live:
             return None
         times = [t for t, _ in nodes.values()]
@@ -357,15 +418,23 @@ class EpochStats:
             self.network.message_size() // n,
         )
         self.rows.append(row)
+        if rec is not None:
+            rec.event("epoch", **row.as_dict())
         return row
+
+    def rows_as_dicts(self) -> List[Dict[str, Any]]:
+        return [r.as_dict() for r in self.rows]
 
     def header(self) -> str:
         return f"{'Epoch':>5} {'MinTime':>8} {'MaxTime':>8} {'Txs':>5} {'Msgs/Node':>9} {'Size/Node':>10}"
 
-    def format_row(self, row: EpochRow) -> str:
-        return (
-            f"{row.epoch:>5} {row.min_time*1000:>7.0f}ms {row.max_time*1000:>7.0f}ms "
-            f"{row.txs:>5} {row.msgs_per_node:>9} {row.bytes_per_node:>9}B"
+    def format_row(self, row) -> str:
+        """Render one row — accepts an :class:`EpochRow` or its
+        :meth:`~EpochRow.as_dict` form (both feed the same column
+        spec)."""
+        d = row.as_dict() if isinstance(row, EpochRow) else dict(row)
+        return " ".join(
+            fmt.format(value(d)) for _, _, fmt, value in self._COLUMNS
         )
 
 

@@ -1,0 +1,48 @@
+"""Structured tracing + metrics for the hbbft_tpu stack.
+
+The observability layer has three parts:
+
+- :mod:`hbbft_tpu.obs.recorder` — a near-zero-overhead recorder with
+  span timers (context manager + decorator), counters and histograms.
+  No-op by default: instrumented hot paths pay exactly one module
+  attribute check (``recorder.ACTIVE is None``) when tracing is off.
+- Structured JSONL trace export with a stable event schema (epoch
+  start/decide, message send/deliver, crypto flush spans with batch
+  occupancy, fault telemetry, device-op routing decisions).
+- :mod:`hbbft_tpu.obs.report` — the trace summarizer CLI::
+
+      python -m hbbft_tpu.obs.report trace.jsonl
+
+Enable tracing programmatically::
+
+    from hbbft_tpu import obs
+    obs.enable("trace.jsonl")
+    ...   # run simulations / flushes / epochs
+    obs.disable()
+
+or pass ``--trace trace.jsonl`` to ``bench.py`` /
+``examples/simulation.py``.  ``enable(..., jax_annotations=True)`` (or
+``HBBFT_TPU_TRACE_JAX=1``) additionally wraps every span in a
+``jax.profiler.TraceAnnotation`` so TPU profiles carry protocol-level
+span names.
+"""
+
+from .recorder import (  # noqa: F401
+    Recorder,
+    SCHEMA_VERSION,
+    active,
+    disable,
+    enable,
+    span,
+    traced,
+)
+
+__all__ = [
+    "Recorder",
+    "SCHEMA_VERSION",
+    "active",
+    "disable",
+    "enable",
+    "span",
+    "traced",
+]

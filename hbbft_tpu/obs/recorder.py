@@ -1,0 +1,316 @@
+"""The trace recorder — span timers, counters, histograms, JSONL export.
+
+Design constraints (ISSUE 1 tentpole):
+
+- **Near-zero overhead when off.**  Instrumented call sites read the
+  module global ``ACTIVE`` once and branch on ``is None`` — no object
+  allocation, no dict lookup, no context-manager machinery on the
+  no-op path.  Protocol hot loops (``SimNode.handle_message``,
+  ``SimNetwork._dispatch``, ``FaultLog.append``) stay within noise of
+  the untraced build.
+- **Stable event schema.**  Every event is one JSON object per line
+  with at least ``{"ev": <type>, "t": <seconds since trace start>}``.
+  Event types in use across the stack (consumed by
+  :mod:`hbbft_tpu.obs.report`):
+
+  ==================  =====================================================
+  ``trace_start``     schema version + wall-clock anchor
+  ``span``            named timed region: ``name, t, dur, depth`` + attrs
+  ``msg_send``        simulator dispatch: ``src, size, vt, kind`` (all/node)
+  ``msg_deliver``     per-recipient enqueue: ``src, dst, size, vt, kind``
+  ``msg_handle``      one handled message: ``node, vt, wall, size``
+  ``epoch_start``     first batch output seen for an epoch: ``epoch, vt``
+  ``epoch_decide``    one node's batch for an epoch: ``epoch, node, vt``
+  ``epoch``           completed epoch row (all live nodes decided):
+                      ``epoch, min_time, max_time, txs, msgs_per_node,
+                      bytes_per_node``
+  ``epoch_phases``    vectorized epoch driver wall-clock breakdown:
+                      ``epoch, phases{...}, shares, coin_flips, faults``
+  ``flush``           one crypto batch flush: ``queued, shipped, real,
+                      inline, cached, occupancy, dur, groups, phases``
+  ``device_op``       one MSM routing decision: ``op, k, engine``
+  ``fault``           one attributed Byzantine fault: ``fault`` (the
+                      stable compact form ``<node!r>:<KIND>``), ``node,
+                      kind``
+  ``counter``         final counter values (emitted on close)
+  ``hist``            histogram summaries (emitted on close)
+  ``trace_end``       total event count + duration
+  ==================  =====================================================
+
+- **Streaming JSONL.**  With a ``path``, events are written as they
+  happen (line-buffered), so a crashed run still leaves a readable
+  trace.  Events are also kept in ``Recorder.events`` for in-process
+  inspection (tests, bench).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time as _time
+from typing import Any, Callable, Dict, IO, List, Optional
+
+SCHEMA_VERSION = 1
+
+# THE hot-path gate: instrumented modules do
+#     rec = _obs.ACTIVE
+#     if rec is not None: rec.event(...)
+# Rebinding happens only in enable()/disable().
+ACTIVE: Optional["Recorder"] = None
+
+
+def _jsonable(v: Any) -> Any:
+    """Coerce arbitrary attribute values to JSON-safe ones: primitives
+    pass through, bytes hex-encode, containers recurse, anything else
+    becomes its ``repr`` (node ids in this codebase are ints/strs, but
+    the schema must never crash on an exotic one)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return repr(v)
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of a pre-sorted non-empty list."""
+    i = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[i]
+
+
+class Span:
+    """A named timed region.  Context manager; ``dur`` holds the
+    elapsed seconds after exit (used by bench to keep its medians while
+    the same timing lands in the trace)."""
+
+    __slots__ = ("rec", "name", "attrs", "t0", "dur", "depth", "_ann")
+
+    def __init__(self, rec: "Recorder", name: str, attrs: Dict[str, Any]):
+        self.rec = rec
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+        self.dur = 0.0
+        self.depth = 0
+        self._ann = None
+
+    def __enter__(self) -> "Span":
+        self.depth = self.rec._enter_span()
+        if self.rec._jax:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._ann = TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self.t0 = self.rec.now()
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        self.dur = self.rec.now() - self.t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(et, ev, tb)
+            except Exception:
+                pass
+        self.rec._exit_span()
+        self.rec.event(
+            "span",
+            t=self.t0,
+            name=self.name,
+            dur=round(self.dur, 9),
+            depth=self.depth,
+            **self.attrs,
+        )
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span returned by the module-level :func:`span` when
+    tracing is off (``dur`` stays 0.0 — callers that need wall time
+    regardless hold their own :class:`Recorder`)."""
+
+    dur = 0.0
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Recorder:
+    """Collects events, counters and histogram samples; optionally
+    streams events to a JSONL file as they are recorded.
+
+    Thread-safe: the batching backend's async MSM finalizers run on
+    waiter threads, so event append takes a lock (only paid when
+    tracing is on)."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        *,
+        jax_annotations: bool = False,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self._clock = clock or _time.perf_counter
+        self._t0 = self._clock()
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.events: List[Dict[str, Any]] = []
+        self.counters: Dict[str, int] = {}
+        self._hists: Dict[str, List[float]] = {}
+        self.path = path
+        self._sink: Optional[IO[str]] = (
+            open(path, "w", buffering=1) if path else None
+        )
+        self._jax = jax_annotations or bool(
+            os.environ.get("HBBFT_TPU_TRACE_JAX")
+        )
+        self._closed = False
+        self.event(
+            "trace_start", schema=SCHEMA_VERSION, wall_unix=round(_time.time(), 3)
+        )
+
+    # -- time ---------------------------------------------------------------
+
+    def now(self) -> float:
+        return self._clock() - self._t0
+
+    # -- events -------------------------------------------------------------
+
+    def event(self, ev: str, *, t: Optional[float] = None, **fields) -> Dict[str, Any]:
+        row: Dict[str, Any] = {
+            "ev": ev,
+            "t": round(self.now() if t is None else t, 9),
+        }
+        for k, v in fields.items():
+            row[k] = _jsonable(v)
+        with self._lock:
+            self.events.append(row)
+            if self._sink is not None:
+                self._sink.write(json.dumps(row, separators=(",", ":")) + "\n")
+        return row
+
+    # -- counters / histograms ---------------------------------------------
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one histogram sample (summarized on :meth:`close`)."""
+        with self._lock:
+            self._hists.setdefault(name, []).append(float(value))
+
+    # -- spans --------------------------------------------------------------
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def _enter_span(self) -> int:
+        d = getattr(self._tls, "depth", 0)
+        self._tls.depth = d + 1
+        return d
+
+    def _exit_span(self) -> None:
+        self._tls.depth = max(0, getattr(self._tls, "depth", 1) - 1)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Emit counter + histogram summaries and ``trace_end``, then
+        close the sink.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for name in sorted(self.counters):
+            self.event("counter", name=name, value=self.counters[name])
+        for name in sorted(self._hists):
+            vals = sorted(self._hists[name])
+            self.event(
+                "hist",
+                name=name,
+                count=len(vals),
+                min=round(vals[0], 9),
+                p50=round(_pct(vals, 0.50), 9),
+                p90=round(_pct(vals, 0.90), 9),
+                max=round(vals[-1], 9),
+                sum=round(sum(vals), 9),
+            )
+        self.event("trace_end", events=len(self.events) + 1, dur=round(self.now(), 9))
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+
+# ---------------------------------------------------------------------------
+# Module-level switchboard
+# ---------------------------------------------------------------------------
+
+
+def active() -> Optional[Recorder]:
+    """The installed recorder, or None when tracing is off."""
+    return ACTIVE
+
+
+def enable(
+    path: Optional[str] = None,
+    *,
+    jax_annotations: bool = False,
+    clock: Optional[Callable[[], float]] = None,
+) -> Recorder:
+    """Install a recorder as the process-wide trace sink.  A previously
+    installed recorder is closed first."""
+    global ACTIVE
+    if ACTIVE is not None:
+        ACTIVE.close()
+    ACTIVE = Recorder(path, jax_annotations=jax_annotations, clock=clock)
+    return ACTIVE
+
+
+def disable() -> Optional[Recorder]:
+    """Uninstall and close the active recorder; returns it (its
+    in-memory ``events`` stay readable after close)."""
+    global ACTIVE
+    rec, ACTIVE = ACTIVE, None
+    if rec is not None:
+        rec.close()
+    return rec
+
+
+def span(name: str, **attrs):
+    """Module-level span helper: a real span when tracing is on, a
+    shared no-op context manager otherwise."""
+    rec = ACTIVE
+    return rec.span(name, **attrs) if rec is not None else _NULL_SPAN
+
+
+def traced(name: Optional[str] = None, **attrs):
+    """Decorator form of :func:`span`: times every call of the wrapped
+    function when tracing is on; passes straight through otherwise."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            rec = ACTIVE
+            if rec is None:
+                return fn(*args, **kwargs)
+            with rec.span(label, **attrs):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,364 @@
+"""Trace report CLI — summarize a JSONL trace written by the recorder.
+
+    python -m hbbft_tpu.obs.report trace.jsonl
+    python -m hbbft_tpu.obs.report trace.jsonl --json
+
+Prints, from the stable event schema (:mod:`hbbft_tpu.obs.recorder`):
+
+- epoch-latency distribution (the reference table's Min/MaxTime,
+  aggregated),
+- per-node message histograms (deliveries and bytes),
+- crypto-batch occupancy (queued vs shipped per flush, phase walls),
+- device-op routing counts (which engine each MSM size landed on),
+- fault summaries per kind and per node,
+- span aggregates and final counter/histogram values.
+
+``--json`` emits the same summary as one machine-readable JSON object
+(what the tests consume).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+from .recorder import _pct
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL trace; unparsable lines are counted, not fatal (a
+    killed run may leave a torn final line)."""
+    events: List[Dict[str, Any]] = []
+    bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(ev, dict) and "ev" in ev:
+                events.append(ev)
+            else:
+                bad += 1
+    if bad:
+        events.append({"ev": "_parse_errors", "t": 0.0, "count": bad})
+    return events
+
+
+def _dist(vals: List[float]) -> Dict[str, float]:
+    vals = sorted(vals)
+    return {
+        "count": len(vals),
+        "min": vals[0],
+        "p50": _pct(vals, 0.50),
+        "p90": _pct(vals, 0.90),
+        "max": vals[-1],
+        "mean": sum(vals) / len(vals),
+    }
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a parsed event list into the report structure."""
+    by_ev: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for e in events:
+        by_ev[e["ev"]].append(e)
+
+    out: Dict[str, Any] = {
+        "schema": (by_ev["trace_start"][0].get("schema") if by_ev["trace_start"] else None),
+        "events": len(events),
+        "duration_s": (by_ev["trace_end"][-1].get("dur") if by_ev["trace_end"] else None),
+    }
+
+    # -- epochs -------------------------------------------------------------
+    rows = by_ev["epoch"]
+    if rows:
+        out["epochs"] = {
+            "count": len(rows),
+            "txs": sum(r.get("txs", 0) for r in rows),
+            "latency": _dist([r["max_time"] for r in rows if "max_time" in r]),
+            "min_latency": _dist([r["min_time"] for r in rows if "min_time" in r]),
+            "rows": rows,
+        }
+    phases_rows = by_ev["epoch_phases"]
+    if phases_rows:
+        totals: Dict[str, float] = defaultdict(float)
+        for r in phases_rows:
+            for k, v in (r.get("phases") or {}).items():
+                totals[k] += float(v)
+        out["epoch_phases"] = {
+            "count": len(phases_rows),
+            "phase_totals_s": dict(sorted(totals.items())),
+        }
+
+    # -- messages -----------------------------------------------------------
+    sends = by_ev["msg_send"]
+    delivers = by_ev["msg_deliver"]
+    handles = by_ev["msg_handle"]
+    if sends or delivers or handles:
+        per_node: Dict[str, Dict[str, int]] = defaultdict(
+            lambda: {"msgs": 0, "bytes": 0}
+        )
+        for d in delivers:
+            node = per_node[str(d.get("dst"))]
+            node["msgs"] += 1
+            node["bytes"] += int(d.get("size", 0))
+        out["messages"] = {
+            "sends": len(sends),
+            "broadcast_sends": sum(1 for s in sends if s.get("kind") == "all"),
+            "delivered": len(delivers),
+            "handled": len(handles),
+            "bytes_sent": sum(int(s.get("size", 0)) for s in sends),
+            "bytes_delivered": sum(int(d.get("size", 0)) for d in delivers),
+            "per_node": dict(sorted(per_node.items())),
+        }
+        if handles:
+            out["messages"]["handle_wall"] = _dist(
+                [float(h.get("wall", 0.0)) for h in handles]
+            )
+
+    # -- crypto flushes -----------------------------------------------------
+    flushes = by_ev["flush"]
+    if flushes:
+        queued = sum(int(f.get("queued", 0)) for f in flushes)
+        shipped = sum(int(f.get("shipped", 0)) for f in flushes)
+        phase_totals: Dict[str, float] = defaultdict(float)
+        for f in flushes:
+            for k, v in (f.get("phases") or {}).items():
+                phase_totals[k] += float(v)
+        out["flushes"] = {
+            "count": len(flushes),
+            "queued": queued,
+            "shipped": shipped,
+            "occupancy": round(shipped / queued, 4) if queued else None,
+            "batch": _dist([float(f.get("shipped", 0)) for f in flushes]),
+            "dur": _dist([float(f.get("dur", 0.0)) for f in flushes]),
+            "phase_totals_s": dict(sorted(phase_totals.items())),
+        }
+
+    # -- device ops ---------------------------------------------------------
+    ops = by_ev["device_op"]
+    if ops:
+        per: Dict[str, Dict[str, Any]] = {}
+        for o in ops:
+            key = "%s/%s" % (o.get("op"), o.get("engine"))
+            slot = per.setdefault(key, {"count": 0, "k": []})
+            slot["count"] += 1
+            slot["k"].append(int(o.get("k", 0)))
+        out["device_ops"] = {
+            key: {"count": s["count"], "k": _dist([float(x) for x in s["k"]])}
+            for key, s in sorted(per.items())
+        }
+
+    # -- faults -------------------------------------------------------------
+    faults = by_ev["fault"]
+    if faults:
+        by_kind: Dict[str, int] = defaultdict(int)
+        by_node: Dict[str, int] = defaultdict(int)
+        for f in faults:
+            by_kind[str(f.get("kind"))] += 1
+            by_node[str(f.get("node"))] += 1
+        out["faults"] = {
+            "count": len(faults),
+            "by_kind": dict(sorted(by_kind.items())),
+            "by_node": dict(sorted(by_node.items())),
+        }
+
+    # -- spans / counters / hists ------------------------------------------
+    spans = by_ev["span"]
+    if spans:
+        agg: Dict[str, List[float]] = defaultdict(list)
+        for s in spans:
+            agg[str(s.get("name"))].append(float(s.get("dur", 0.0)))
+        out["spans"] = {
+            name: {"count": len(durs), "total_s": sum(durs), "dur": _dist(durs)}
+            for name, durs in sorted(agg.items())
+        }
+    if by_ev["counter"]:
+        out["counters"] = {
+            str(c.get("name")): c.get("value") for c in by_ev["counter"]
+        }
+    if by_ev["hist"]:
+        out["hists"] = {
+            str(h.get("name")): {
+                k: h.get(k) for k in ("count", "min", "p50", "p90", "max", "sum")
+            }
+            for h in by_ev["hist"]
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Text rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_dist(d: Dict[str, float], scale: float = 1.0, unit: str = "") -> str:
+    return "min %.3f%s  p50 %.3f%s  p90 %.3f%s  max %.3f%s" % (
+        d["min"] * scale,
+        unit,
+        d["p50"] * scale,
+        unit,
+        d["p90"] * scale,
+        unit,
+        d["max"] * scale,
+        unit,
+    )
+
+
+def _bar(n: int, peak: int, width: int = 24) -> str:
+    if peak <= 0:
+        return ""
+    return "#" * max(1, round(width * n / peak)) if n else ""
+
+
+def render(s: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    add = lines.append
+    add(
+        "trace: %d events%s (schema v%s)"
+        % (
+            s.get("events", 0),
+            (" over %.3fs" % s["duration_s"]) if s.get("duration_s") else "",
+            s.get("schema"),
+        )
+    )
+
+    ep = s.get("epochs")
+    if ep:
+        add("")
+        add("Epoch latency (%d epochs, %d txs)" % (ep["count"], ep["txs"]))
+        add("  max_time: " + _fmt_dist(ep["latency"], 1000.0, "ms"))
+        add("  min_time: " + _fmt_dist(ep["min_latency"], 1000.0, "ms"))
+    eph = s.get("epoch_phases")
+    if eph:
+        add("")
+        add("Epoch phases (%d epochs, wall seconds, summed)" % eph["count"])
+        for k, v in sorted(
+            eph["phase_totals_s"].items(), key=lambda kv: -kv[1]
+        )[:12]:
+            add("  %-24s %8.3fs" % (k, v))
+
+    msg = s.get("messages")
+    if msg:
+        add("")
+        add(
+            "Messages: %d sent (%d broadcast), %d delivered, %d handled, %d B delivered"
+            % (
+                msg["sends"],
+                msg["broadcast_sends"],
+                msg["delivered"],
+                msg["handled"],
+                msg["bytes_delivered"],
+            )
+        )
+        per = msg["per_node"]
+        if per:
+            peak = max(v["msgs"] for v in per.values())
+            add("  per-node deliveries:")
+            for node, v in per.items():
+                add(
+                    "    %-8s %7d msgs %10d B  %s"
+                    % (node, v["msgs"], v["bytes"], _bar(v["msgs"], peak))
+                )
+
+    fl = s.get("flushes")
+    if fl:
+        add("")
+        add(
+            "Crypto flushes: %d flushes, %d/%d shipped/queued (occupancy %s)"
+            % (
+                fl["count"],
+                fl["shipped"],
+                fl["queued"],
+                ("%.1f%%" % (100 * fl["occupancy"])) if fl["occupancy"] is not None else "n/a",
+            )
+        )
+        add("  batch size: " + _fmt_dist(fl["batch"]))
+        add("  flush wall: " + _fmt_dist(fl["dur"], 1000.0, "ms"))
+        if fl["phase_totals_s"]:
+            add("  phase walls (summed):")
+            for k, v in sorted(
+                fl["phase_totals_s"].items(), key=lambda kv: -kv[1]
+            ):
+                add("    %-12s %8.3fs" % (k, v))
+
+    dev = s.get("device_ops")
+    if dev:
+        add("")
+        add("Device ops (op/engine):")
+        for key, v in dev.items():
+            add(
+                "  %-24s %6d calls  k p50 %d"
+                % (key, v["count"], int(v["k"]["p50"]))
+            )
+
+    fa = s.get("faults")
+    if fa:
+        add("")
+        add("Faults: %d attributed" % fa["count"])
+        for kind, n in sorted(fa["by_kind"].items(), key=lambda kv: -kv[1]):
+            add("  %-40s %6d" % (kind, n))
+        add("  by node: " + ", ".join(
+            "%s: %d" % (node, n) for node, n in fa["by_node"].items()
+        ))
+
+    sp = s.get("spans")
+    if sp:
+        add("")
+        add("Spans:")
+        for name, v in sorted(sp.items(), key=lambda kv: -kv[1]["total_s"])[:16]:
+            add(
+                "  %-32s %6d calls %9.3fs total  p50 %.3fms"
+                % (name, v["count"], v["total_s"], v["dur"]["p50"] * 1000)
+            )
+
+    if s.get("counters"):
+        add("")
+        add("Counters:")
+        for name, v in s["counters"].items():
+            add("  %-40s %10s" % (name, v))
+    if s.get("hists"):
+        add("")
+        add("Histograms:")
+        for name, h in s["hists"].items():
+            add(
+                "  %-32s n=%-6d min %.4g  p50 %.4g  p90 %.4g  max %.4g"
+                % (name, h["count"], h["min"], h["p50"], h["p90"], h["max"])
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m hbbft_tpu.obs.report", description=__doc__
+    )
+    p.add_argument("trace", help="JSONL trace file written by the recorder")
+    p.add_argument(
+        "--json", action="store_true", help="emit the summary as one JSON object"
+    )
+    args = p.parse_args(argv)
+    events = load_events(args.trace)
+    summary = summarize(events)
+    try:
+        if args.json:
+            # rows are bulky; the JSON consumer can re-derive them from
+            # the trace
+            summary.get("epochs", {}).pop("rows", None)
+            print(json.dumps(summary, indent=2, sort_keys=True))
+        else:
+            print(render(summary))
+    except BrokenPipeError:
+        # `report trace.jsonl | head` is a normal way to skim a summary
+        sys.stderr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..crypto.backend import CpuBackend
 from ..crypto.curve import G1, G2, G1_GEN, G2_GEN
+from ..obs import recorder as _obs
 from ..crypto.hashing import sha256
 from ..crypto.merkle import MerkleTree
 from ..crypto.pairing import pairing_check
@@ -175,6 +176,7 @@ class TpuBackend(CpuBackend):
 
     def g1_msm(self, points: Sequence[G1], scalars: Sequence[int]) -> G1:
         points, scalars = list(points), list(scalars)
+        rec = _obs.ACTIVE
         # Mesh path first: an explicitly mesh-configured backend shards
         # its G1 MSMs — the 4-bit windowed Pallas kernel under
         # shard_map (parallel/mesh.sharded_windowed_msm_fn); per-chip
@@ -185,6 +187,8 @@ class TpuBackend(CpuBackend):
             from ..parallel import mesh as M
             from . import packed_msm
 
+            if rec is not None:
+                rec.event("device_op", op="g1_msm", k=len(points), engine="mesh")
             if self._sharded_g1 is None:
                 # r5: the mesh path ships the PACKED wire (96 B/point
                 # + scalar bytes, on-device unpack per shard) — the r4
@@ -197,10 +201,18 @@ class TpuBackend(CpuBackend):
             sc = packed_msm.scalar_bytes_batch(scalars, -(-w // 8))
             return ec_jax.g1_from_limbs(self._sharded_g1(wires, sc))
         if not self._g1_in_device_band(len(points), flat=True):
+            if rec is not None:
+                rec.event("device_op", op="g1_msm", k=len(points), engine="host")
             return super().g1_msm(points, scalars)
         fin = self._device_g1_msm(points, scalars)
         if fin is None:  # no warm executables for this shape
+            if rec is not None:
+                rec.event(
+                    "device_op", op="g1_msm", k=len(points), engine="host_cold"
+                )
             return super().g1_msm(points, scalars)
+        if rec is not None:
+            rec.event("device_op", op="g1_msm", k=len(points), engine="device")
         return fin()
 
     def _g1_in_device_band(self, k: int, flat: bool = False) -> bool:
@@ -251,8 +263,13 @@ class TpuBackend(CpuBackend):
 
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         points, scalars = list(points), list(scalars)
+        rec = _obs.ACTIVE
         if self._native_host() and len(points) < self.G2_DEVICE_MIN:
+            if rec is not None:
+                rec.event("device_op", op="g2_msm", k=len(points), engine="host")
             return super().g2_msm(points, scalars)
+        if rec is not None:
+            rec.event("device_op", op="g2_msm", k=len(points), engine="device")
         return ec_jax.g2_msm(points, scalars)
 
     # -- product-form MSM ---------------------------------------------------
@@ -284,6 +301,7 @@ class TpuBackend(CpuBackend):
             if isinstance(points, packed_msm.ShippedPoints)
             else list(points)
         )
+        rec = _obs.ACTIVE
         if (
             self.mesh is None
             and pts_list
@@ -296,7 +314,18 @@ class TpuBackend(CpuBackend):
                     points, s_coeffs, t_coeffs, group_sizes
                 )
                 if fin is not None:
+                    if rec is not None:
+                        rec.event(
+                            "device_op",
+                            op="g1_msm_product",
+                            k=len(pts_list),
+                            engine="device",
+                        )
                     return fin
+        if rec is not None:
+            rec.event(
+                "device_op", op="g1_msm_product", k=len(pts_list), engine="host"
+            )
         return super().g1_msm_product_async(
             pts_list, s_coeffs, t_coeffs, group_sizes
         )

@@ -1,0 +1,355 @@
+"""Tests for the observability layer (``hbbft_tpu/obs/``): recorder
+span semantics, JSONL round-trip of the event schema, no-op-mode
+silence, fault telemetry stability, and the simulation → trace →
+report-CLI pipeline end to end."""
+
+import json
+import random
+
+import pytest
+
+from hbbft_tpu.core.fault import Fault, FaultKind, FaultLog
+from hbbft_tpu.core.step import Step
+from hbbft_tpu.obs import recorder as obs
+from hbbft_tpu.obs import report
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    """Every test starts and ends with tracing off."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _events(rec, ev=None):
+    if ev is None:
+        return rec.events
+    return [e for e in rec.events if e["ev"] == ev]
+
+
+# ---------------------------------------------------------------------------
+# Recorder core
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_timing_monotonicity():
+    rec = obs.enable()
+    with rec.span("outer", tag="a") as outer:
+        t_mid = rec.now()
+        with rec.span("inner") as inner:
+            pass
+    obs.disable()
+
+    spans = {e["name"]: e for e in _events(rec, "span")}
+    assert set(spans) == {"outer", "inner"}
+    assert spans["outer"]["depth"] == 0
+    assert spans["inner"]["depth"] == 1
+    # attrs survive
+    assert spans["outer"]["tag"] == "a"
+    # nesting: inner starts after outer, inner duration fits inside
+    assert spans["inner"]["t"] >= spans["outer"]["t"]
+    assert inner.dur <= outer.dur
+    assert 0.0 <= spans["outer"]["t"] <= t_mid
+    # event stream timestamps are monotone for sequential events
+    e1 = rec.event("a")
+    e2 = rec.event("b")
+    assert e1["t"] <= e2["t"]
+    # durations are non-negative and spans completed inner-first
+    names_in_order = [e["name"] for e in _events(rec, "span")]
+    assert names_in_order == ["inner", "outer"]
+
+
+def test_traced_decorator_on_and_off():
+    calls = []
+
+    @obs.traced("decorated.fn", layer="test")
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    assert fn(3) == 6  # tracing off: passes straight through
+    rec = obs.enable()
+    assert fn(4) == 8
+    obs.disable()
+    assert calls == [3, 4]
+    (span,) = _events(rec, "span")
+    assert span["name"] == "decorated.fn" and span["layer"] == "test"
+
+
+def test_counters_and_histograms_summarized_on_close(tmp_path):
+    path = tmp_path / "t.jsonl"
+    rec = obs.enable(str(path))
+    rec.count("widgets")
+    rec.count("widgets", 2)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        rec.observe("lat", v)
+    obs.disable()
+
+    events = report.load_events(str(path))
+    counters = [e for e in events if e["ev"] == "counter"]
+    assert counters == [
+        {"ev": "counter", "t": counters[0]["t"], "name": "widgets", "value": 3}
+    ]
+    (hist,) = [e for e in events if e["ev"] == "hist"]
+    assert hist["name"] == "lat"
+    assert hist["count"] == 4
+    assert hist["min"] == 1.0 and hist["max"] == 4.0 and hist["sum"] == 10.0
+    assert events[-1]["ev"] == "trace_end"
+
+
+def test_jsonl_roundtrip_every_event_type(tmp_path):
+    """One event of every schema type goes through the file and comes
+    back with its fields and types intact."""
+    path = tmp_path / "all.jsonl"
+    rec = obs.enable(str(path))
+    with rec.span("s", k=5):
+        pass
+    rec.event("msg_send", src=0, size=17, vt=0.25, kind="all")
+    rec.event("msg_deliver", src=0, dst=1, size=17, vt=0.25, kind="all")
+    rec.event("msg_handle", node=1, vt=0.5, wall=0.001, size=17)
+    rec.event("epoch_start", epoch=0, vt=0.1)
+    rec.event("epoch_decide", epoch=0, node=1, vt=0.9)
+    rec.event(
+        "epoch",
+        epoch=0,
+        min_time=0.5,
+        max_time=0.9,
+        txs=10,
+        msgs_per_node=4,
+        bytes_per_node=256,
+    )
+    rec.event("epoch_phases", epoch=0, phases={"rbc": 0.5}, shares=12)
+    rec.event(
+        "flush",
+        queued=10,
+        shipped=8,
+        real=8,
+        inline=0,
+        occupancy=0.8,
+        groups=2,
+        dur=0.01,
+        fallback_groups=0,
+        phases={"ship": 0.002},
+    )
+    rec.event("device_op", op="g1_msm", k=4096, engine="device")
+    rec.event("fault", fault="1:INVALID_PROOF", node=1, kind="INVALID_PROOF")
+    # non-JSON-native values are coerced, not fatal
+    rec.event("weird", blob=b"\x00\x01", obj=object(), seq=(1, 2))
+    rec.count("c")
+    rec.observe("h", 1.5)
+    obs.disable()
+
+    events = report.load_events(str(path))
+    by_ev = {e["ev"]: e for e in events}
+    expected = {
+        "trace_start",
+        "span",
+        "msg_send",
+        "msg_deliver",
+        "msg_handle",
+        "epoch_start",
+        "epoch_decide",
+        "epoch",
+        "epoch_phases",
+        "flush",
+        "device_op",
+        "fault",
+        "weird",
+        "counter",
+        "hist",
+        "trace_end",
+    }
+    assert expected <= set(by_ev)
+    assert by_ev["trace_start"]["schema"] == obs.SCHEMA_VERSION
+    assert by_ev["epoch"]["txs"] == 10 and by_ev["epoch"]["max_time"] == 0.9
+    assert by_ev["flush"]["phases"] == {"ship": 0.002}
+    assert by_ev["weird"]["blob"] == "0001"  # bytes → hex
+    assert by_ev["weird"]["seq"] == [1, 2]
+    assert isinstance(by_ev["weird"]["obj"], str)  # repr fallback
+    # every line in the file is valid standalone JSON
+    with open(path) as f:
+        for line in f:
+            assert isinstance(json.loads(line), dict)
+    # summarize() accepts the full schema without error
+    s = report.summarize(events)
+    assert s["epochs"]["count"] == 1
+    assert s["flushes"]["occupancy"] == 0.8
+    assert s["faults"]["by_kind"] == {"INVALID_PROOF": 1}
+    assert s["device_ops"]["g1_msm/device"]["count"] == 1
+
+
+def test_noop_mode_adds_zero_events():
+    """With no recorder installed, instrumented code paths run normally
+    and record nothing anywhere."""
+    from hbbft_tpu.harness.simulation import simulate_queueing_honey_badger
+
+    assert obs.active() is None
+    bystander = obs.Recorder()  # constructed but NOT installed
+    baseline = len(bystander.events)
+    stats, _, _ = simulate_queueing_honey_badger(
+        num_nodes=4, num_txs=8, batch_size=4, rng=random.Random(7)
+    )
+    assert stats.rows  # the run did real work
+    fl = FaultLog.init("x", FaultKind.MULTIPLE_ECHOS)  # fault path, untraced
+    assert len(fl) == 1
+    assert obs.active() is None
+    assert len(bystander.events) == baseline
+    # module-level span helper is the shared null span when off
+    with obs.span("nothing") as sp:
+        pass
+    assert sp.dur == 0.0 and len(bystander.events) == baseline
+
+
+# ---------------------------------------------------------------------------
+# Fault telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_fault_repr_single_stable_compact_form():
+    f = Fault("a", FaultKind.INVALID_PROOF)
+    assert f.compact() == "'a':INVALID_PROOF"
+    assert repr(f) == "Fault('a':INVALID_PROOF)"
+    assert repr(FaultKind.INVALID_PROOF) == "FaultKind.INVALID_PROOF"
+    # int node ids too — byte-stable either way
+    assert Fault(3, FaultKind.DUPLICATE_BVAL).compact() == "3:DUPLICATE_BVAL"
+
+
+def test_fault_events_from_every_creation_path():
+    rec = obs.enable()
+    FaultLog.init(1, FaultKind.INVALID_PROOF)
+    Step.from_fault(2, FaultKind.MULTIPLE_ECHOS)
+    Step().add_fault(3, FaultKind.DUPLICATE_AUX)
+    log = FaultLog()
+    log.add(4, FaultKind.INVALID_MESSAGE)
+    obs.disable()
+
+    faults = _events(rec, "fault")
+    assert [e["fault"] for e in faults] == [
+        "1:INVALID_PROOF",
+        "2:MULTIPLE_ECHOS",
+        "3:DUPLICATE_AUX",
+        "4:INVALID_MESSAGE",
+    ]
+    assert rec.counters["fault.INVALID_PROOF"] == 1
+    # merge moves already-recorded faults without double-counting
+    rec2 = obs.enable()
+    merged = FaultLog()
+    merged.merge(FaultLog.init(9, FaultKind.DUPLICATE_CONF))
+    obs.disable()
+    assert len(_events(rec2, "fault")) == 1
+
+
+# ---------------------------------------------------------------------------
+# Instrumented subsystems end to end
+# ---------------------------------------------------------------------------
+
+
+def _mock_obligations(n=6):
+    from hbbft_tpu.crypto.mock import MockSecretKeySet
+    from hbbft_tpu.harness.batching import SigObligation
+
+    sks = MockSecretKeySet.random(1, random.Random(5))
+    pks = sks.public_keys()
+    msg = b"obs-flush"
+    return [
+        SigObligation(pks.public_key_share(i), sks.secret_key_share(i).sign(msg), msg)
+        for i in range(n)
+    ]
+
+
+def test_flush_event_occupancy_and_cache():
+    from hbbft_tpu.harness.batching import BatchingBackend
+
+    rec = obs.enable()
+    be = BatchingBackend()
+    obligations = _mock_obligations(6)
+    be.prefetch(obligations)
+    be.prefetch(obligations)  # second flush: everything cached
+    obs.disable()
+
+    first, second = _events(rec, "flush")
+    assert first["queued"] == 6 and first["shipped"] == 6
+    assert first["occupancy"] == 1.0 and first["inline"] == 6
+    assert second["queued"] == 6 and second["shipped"] == 0
+    assert rec.counters["flush.count"] == 1  # only the real flush counts
+
+
+def test_epoch_stats_structured_rows():
+    """format_row consumes the structured dict row and renders the same
+    bytes as the dataclass form."""
+    from hbbft_tpu.harness.simulation import EpochRow, EpochStats
+
+    row = EpochRow(3, 0.5123, 1.25, 100, 42, 9000)
+    d = row.as_dict()
+    assert d == {
+        "epoch": 3,
+        "min_time": 0.5123,
+        "max_time": 1.25,
+        "txs": 100,
+        "msgs_per_node": 42,
+        "bytes_per_node": 9000,
+    }
+    stats = object.__new__(EpochStats)  # formatting needs no network
+    text_from_row = stats.format_row(row)
+    text_from_dict = stats.format_row(d)
+    assert text_from_row == text_from_dict
+    assert text_from_row == (
+        "    3     512ms    1250ms   100        42      9000B"
+    )
+    header = stats.header()
+    assert header.split() == [
+        "Epoch", "MinTime", "MaxTime", "Txs", "Msgs/Node", "Size/Node",
+    ]
+
+
+def test_simulation_smoke_trace_and_report_cli(tmp_path, capsys):
+    """A small simulation run emits epoch/message/flush events the
+    report CLI can parse and summarize."""
+    from hbbft_tpu.harness.batching import BatchingBackend
+    from hbbft_tpu.harness.simulation import simulate_queueing_honey_badger
+
+    path = tmp_path / "trace.jsonl"
+    obs.enable(str(path))
+    stats, _, _ = simulate_queueing_honey_badger(
+        num_nodes=4, num_txs=12, batch_size=6, rng=random.Random(0)
+    )
+    # mock crypto keeps the façade out of the sim loop; drive one flush
+    # directly so the trace carries the crypto-batching surface too
+    BatchingBackend().prefetch(_mock_obligations(8))
+    obs.disable()
+
+    events = report.load_events(str(path))
+    kinds = {e["ev"] for e in events}
+    assert {"msg_send", "msg_deliver", "msg_handle", "epoch_start",
+            "epoch_decide", "epoch", "flush"} <= kinds
+
+    s = report.summarize(events)
+    assert s["epochs"]["count"] == len(stats.rows) >= 1
+    # trace rows match the in-process structured rows exactly
+    assert s["epochs"]["rows"][0]["txs"] == stats.rows[0].txs
+    assert s["messages"]["delivered"] > 0
+    assert set(s["messages"]["per_node"]) == {"0", "1", "2", "3"}
+    assert s["flushes"]["count"] == 1 and s["flushes"]["shipped"] == 8
+
+    # the CLI renders it (text and --json modes)
+    assert report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    for needle in ("Epoch latency", "Messages", "Crypto flushes", "trace:"):
+        assert needle in out, out
+    assert report.main([str(path), "--json"]) == 0
+    js = json.loads(capsys.readouterr().out)
+    assert js["epochs"]["count"] == len(stats.rows)
+
+
+def test_trace_survives_torn_final_line(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    rec = obs.enable(str(path))
+    rec.event("msg_send", src=0, size=1, vt=0.0, kind="node")
+    obs.disable()
+    with open(path, "a") as f:
+        f.write('{"ev": "msg_send", "src": 1, ')  # killed mid-write
+    events = report.load_events(str(path))
+    assert any(e["ev"] == "msg_send" for e in events)
+    assert any(e["ev"] == "_parse_errors" for e in events)
+    report.summarize(events)  # no crash
