@@ -1,0 +1,41 @@
+"""badgerlint — AST-based invariant checks for the hbbft_tpu tree.
+
+The paper's contract is that the ``DistAlgorithm`` state machines stay
+byte-identical and deterministic while the heavy math moves to batched
+TPU kernels.  Nothing in Python *enforces* that contract, so this
+package does, at commit time: a small AST-visitor framework plus one
+rule module per invariant class (see :mod:`hbbft_tpu.analysis.rules`).
+
+Usage::
+
+    python -m hbbft_tpu.analysis [--json] [paths...]
+
+Suppression: append ``# lint: ok(<rule>)`` to the flagged line (or put
+it on the line directly above).  Pre-existing violations that are
+intentional live in the checked-in baseline
+(``hbbft_tpu/analysis/baseline.json``) with a justification string.
+"""
+
+from __future__ import annotations
+
+from .core import (
+    Baseline,
+    FileContext,
+    Rule,
+    Violation,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from .rules import all_rules
+
+__all__ = [
+    "Baseline",
+    "FileContext",
+    "Rule",
+    "Violation",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,137 @@
+"""The badgerlint CLI.
+
+::
+
+    python -m hbbft_tpu.analysis [paths...]          # human output
+    python -m hbbft_tpu.analysis --json [paths...]   # CI / pre-commit
+    python -m hbbft_tpu.analysis --write-baseline    # re-baseline (reviewed!)
+
+Exit codes: 0 clean (baselined violations allowed), 1 new violations
+or parse errors, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import Baseline, Violation, lint_paths
+from .rules import all_rules
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+
+
+def _default_paths() -> List[str]:
+    """The hbbft_tpu package directory itself."""
+    return [os.path.dirname(_HERE)]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hbbft_tpu.analysis",
+        description="badgerlint — AST invariant checks for hbbft_tpu",
+    )
+    parser.add_argument(
+        "paths", nargs="*", help="files/dirs to lint (default: the package)"
+    )
+    parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file (default: the checked-in one)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report baselined violations as failures too",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        metavar="JUSTIFICATION",
+        help="write every current violation to the baseline file with "
+        "this justification and exit 0",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule names to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:14s} {r.description}")
+        return 0
+    if args.select:
+        wanted = {s.strip() for s in args.select.split(",")}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    violations, errors = lint_paths(paths, rules)
+
+    if args.write_baseline is not None:
+        bl = Baseline.from_violations(violations, args.write_baseline)
+        bl.save(args.baseline)
+        print(
+            f"wrote {len(bl.entries)} baseline entr"
+            f"{'y' if len(bl.entries) == 1 else 'ies'} to {args.baseline}"
+        )
+        return 0
+
+    baseline = Baseline()
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = Baseline.load(args.baseline)
+    new, baselined = baseline.split(violations)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "violations": [v.as_dict() for v in new],
+                    "baselined": [v.as_dict() for v in baselined],
+                    "errors": errors,
+                    "counts": _counts(new),
+                    "ok": not new and not errors,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for v in new:
+            print(v.render())
+        for e in errors:
+            print(e)
+        if new or errors:
+            print(
+                f"\n{len(new)} violation(s)"
+                + (f", {len(errors)} parse error(s)" if errors else "")
+                + (f" ({len(baselined)} baselined)" if baselined else "")
+            )
+        else:
+            suffix = f" ({len(baselined)} baselined)" if baselined else ""
+            print(f"clean{suffix}")
+    return 1 if (new or errors) else 0
+
+
+def _counts(violations: List[Violation]) -> dict:
+    counts: dict = {}
+    for v in violations:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    return counts

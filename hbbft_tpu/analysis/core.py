@@ -1,0 +1,270 @@
+"""The badgerlint framework: violations, rules, suppression, baseline.
+
+Design (kept deliberately small — this is a project lint, not a
+general one):
+
+- A :class:`Rule` owns a name, a human description, and a path scope
+  (package-relative prefixes).  ``check(ctx)`` yields
+  :class:`Violation`\\ s for one parsed file.
+- Paths are normalized **relative to the package root** (the part
+  after ``hbbft_tpu/``), so rule scopes and baseline entries are
+  stable no matter where the tree is checked out or which directory
+  the CLI is invoked from.  Files outside the package (tests,
+  examples) get their path relative to the scan root and match no
+  scoped rule unless a rule opts in.
+- Suppression is per-line: ``# lint: ok(<rule>)`` on the flagged line
+  or the line directly above silences that rule there.  Suppressions
+  are counted so the CLI can report them.
+- The baseline is a checked-in JSON list of intentional violations,
+  matched by ``(rule, path, message)`` — line numbers are excluded so
+  unrelated edits don't invalidate entries.  Every entry carries a
+  mandatory ``justification`` string.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+# The package this lint suite is scoped to (directory name on disk).
+PACKAGE_NAME = "hbbft_tpu"
+
+_SUPPRESS_PREFIX = "# lint: ok("
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule hit.  ``path`` is package-relative and POSIX-style."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line/col excluded on purpose (see module
+        doc)."""
+        return (self.rule, self.path, self.message)
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class FileContext:
+    """Everything a rule needs about one source file."""
+
+    def __init__(self, relpath: str, source: str, tree: Optional[ast.Module] = None):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source)
+
+    def in_dirs(self, prefixes: Sequence[str]) -> bool:
+        return any(self.relpath.startswith(p) for p in prefixes)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """``# lint: ok(rule)`` on the line or the line above."""
+        for text in (self.line_text(lineno), self.line_text(lineno - 1)):
+            idx = text.find(_SUPPRESS_PREFIX)
+            while idx != -1:
+                end = text.find(")", idx)
+                if end != -1:
+                    names = text[idx + len(_SUPPRESS_PREFIX) : end]
+                    for name in names.split(","):
+                        if name.strip() in (rule, "*"):
+                            return True
+                idx = text.find(_SUPPRESS_PREFIX, idx + 1)
+        return False
+
+
+class Rule:
+    """Base class: subclasses set ``name``, ``description``, ``scope``
+    (package-relative path prefixes; empty tuple = every file) and
+    implement :meth:`check`."""
+
+    name: str = ""
+    description: str = ""
+    scope: Tuple[str, ...] = ()
+
+    def applies(self, ctx: FileContext) -> bool:
+        return not self.scope or ctx.in_dirs(self.scope)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def violation(
+        self, ctx: FileContext, node: ast.AST, message: str
+    ) -> Violation:
+        return Violation(
+            rule=self.name,
+            path=ctx.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+class Baseline:
+    """The checked-in list of intentional violations.
+
+    File format: ``{"version": 1, "entries": [{"rule", "path",
+    "message", "justification"}, ...]}``.  An entry with an empty
+    justification is rejected at load time — the whole point is that
+    every baselined violation says *why* it is fine.
+    """
+
+    def __init__(self, entries: Optional[List[Dict[str, str]]] = None):
+        self.entries: List[Dict[str, str]] = list(entries or [])
+        self._index: Dict[Tuple[str, str, str], Dict[str, str]] = {
+            (e["rule"], e["path"], e["message"]): e for e in self.entries
+        }
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r") as fh:
+            data = json.load(fh)
+        entries = data.get("entries", [])
+        for e in entries:
+            for field in ("rule", "path", "message", "justification"):
+                if not e.get(field):
+                    raise ValueError(
+                        f"baseline entry missing {field!r}: {e!r}"
+                    )
+        return cls(entries)
+
+    @classmethod
+    def from_violations(
+        cls, violations: Iterable[Violation], justification: str
+    ) -> "Baseline":
+        entries = [
+            {
+                "rule": v.rule,
+                "path": v.path,
+                "message": v.message,
+                "justification": justification,
+            }
+            for v in violations
+        ]
+        # de-dup while preserving order (several lines may share a key)
+        seen = set()
+        uniq = []
+        for e in entries:
+            k = (e["rule"], e["path"], e["message"])
+            if k not in seen:
+                seen.add(k)
+                uniq.append(e)
+        return cls(uniq)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(
+                {"version": 1, "entries": self.entries}, fh, indent=2
+            )
+            fh.write("\n")
+
+    def covers(self, v: Violation) -> bool:
+        return v.key() in self._index
+
+    def split(
+        self, violations: Sequence[Violation]
+    ) -> Tuple[List[Violation], List[Violation]]:
+        """→ (new, baselined)."""
+        new, old = [], []
+        for v in violations:
+            (old if self.covers(v) else new).append(v)
+        return new, old
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+
+
+def lint_source(
+    source: str,
+    relpath: str,
+    rules: Sequence[Rule],
+) -> List[Violation]:
+    """Lint one in-memory source blob under a pretend package-relative
+    path (the fixture-test entry point)."""
+    ctx = FileContext(relpath, source)
+    out: List[Violation] = []
+    for rule in rules:
+        if not rule.applies(ctx):
+            continue
+        for v in rule.check(ctx):
+            if not ctx.suppressed(v.rule, v.line):
+                out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def lint_file(path: str, relpath: str, rules: Sequence[Rule]) -> List[Violation]:
+    with tokenize.open(path) as fh:  # honors coding declarations
+        source = fh.read()
+    return lint_source(source, relpath, rules)
+
+
+def _package_relpath(abspath: str, root: str) -> str:
+    """Path component after the ``hbbft_tpu`` package dir if the file
+    is inside it, else the path relative to the scan root."""
+    norm = abspath.replace(os.sep, "/")
+    marker = "/" + PACKAGE_NAME + "/"
+    idx = norm.rfind(marker)
+    if idx != -1:
+        return norm[idx + len(marker) :]
+    return os.path.relpath(abspath, root).replace(os.sep, "/")
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[Tuple[str, str]]:
+    """Yield ``(abspath, package_relpath)`` for every .py under the
+    given files/directories, sorted for deterministic output."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in ("__pycache__", ".git")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        files.append(os.path.join(dirpath, fn))
+        elif p.endswith(".py"):
+            files.append(p)
+    root = os.path.commonpath([os.path.abspath(p) for p in paths]) if paths else "."
+    if os.path.isfile(root):
+        root = os.path.dirname(root)
+    for f in sorted(set(files)):
+        yield os.path.abspath(f), _package_relpath(os.path.abspath(f), root)
+
+
+def lint_paths(
+    paths: Sequence[str], rules: Sequence[Rule]
+) -> Tuple[List[Violation], List[str]]:
+    """Lint every file under ``paths`` → (violations, parse_errors)."""
+    violations: List[Violation] = []
+    errors: List[str] = []
+    for abspath, relpath in iter_python_files(paths):
+        try:
+            violations.extend(lint_file(abspath, relpath, rules))
+        except SyntaxError as exc:
+            errors.append(f"{relpath}: syntax error: {exc}")
+    return violations, errors

@@ -1,0 +1,42 @@
+"""Rule registry — one module per invariant class.
+
+========================  ==================================================
+``determinism``           no ambient entropy / wall clocks / ``id()`` in
+                          protocol + core state machines
+``ordered-iter``          no bare set / ``dict.keys()`` iteration on
+                          message-emitting or fault-logging paths
+``device-sync``           no host-device sync (``.item()``, ``int()``,
+                          ``np.asarray``, ``jax.device_get``) inside
+                          ``@jit`` regions
+``dtype-width``           integer matmuls declare their accumulator;
+                          narrow-cast products widen first; constants fit
+                          the declared dtype
+``layering``              the SURVEY layer map's import direction
+``obs-schema``            every ``recorder.event(...)`` call site matches
+                          the stable JSONL schema (``obs/schema.py``)
+========================  ==================================================
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule
+from .determinism import DeterminismRule
+from .device_sync import DeviceSyncRule
+from .dtype_width import DtypeWidthRule
+from .layering import LayeringRule
+from .obs_schema import ObsSchemaRule
+from .ordering import OrderedIterRule
+
+
+def all_rules() -> List[Rule]:
+    """A fresh instance of every registered rule, stable order."""
+    return [
+        DeterminismRule(),
+        OrderedIterRule(),
+        DeviceSyncRule(),
+        DtypeWidthRule(),
+        LayeringRule(),
+        ObsSchemaRule(),
+    ]

@@ -1,0 +1,116 @@
+"""Rule ``determinism`` — protocol state machines take no ambient
+entropy.
+
+The ``DistAlgorithm`` contract (SURVEY layer map, L1–L4) is a pure
+message → state-transition → message machine: two replicas fed the
+identical message sequence must emit byte-identical steps.  Anything
+that reads the environment breaks that silently:
+
+- ``random.Random()`` with no seed (and the module-level ``random.*``
+  helpers, which share the globally seeded instance);
+- wall clocks (``time.time``, ``datetime.now`` and friends) — virtual
+  time belongs to the harness, never to protocol logic;
+- OS entropy (``os.urandom``, ``secrets``, ``uuid.uuid4``);
+- ``id()`` — CPython address-derived, differs per process, and any
+  ordering or keying built on it diverges across replicas.
+
+Injected RNGs (an ``rng`` parameter / attribute) are fine — the caller
+owns determinism; seeded ``random.Random(seed)`` is fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import call_name
+
+# module-level helpers of the global (ambient-seeded) random instance
+_GLOBAL_RANDOM = {
+    "random.random",
+    "random.randrange",
+    "random.randint",
+    "random.choice",
+    "random.choices",
+    "random.shuffle",
+    "random.sample",
+    "random.getrandbits",
+    "random.seed",
+    "random.uniform",
+}
+
+_FORBIDDEN_CALLS = {
+    "time.time": "wall clock",
+    "time.time_ns": "wall clock",
+    "time.monotonic": "process clock",
+    "time.perf_counter": "process clock",
+    "datetime.now": "wall clock",
+    "datetime.utcnow": "wall clock",
+    "datetime.today": "wall clock",
+    "datetime.datetime.now": "wall clock",
+    "datetime.datetime.utcnow": "wall clock",
+    "datetime.date.today": "wall clock",
+    "os.urandom": "OS entropy",
+    "uuid.uuid4": "OS entropy",
+    "uuid.uuid1": "host/clock-derived",
+    "secrets.token_bytes": "OS entropy",
+    "secrets.token_hex": "OS entropy",
+    "secrets.randbits": "OS entropy",
+}
+
+
+class DeterminismRule(Rule):
+    name = "determinism"
+    description = (
+        "protocol/core state machines must not read ambient entropy, "
+        "wall clocks, or id()"
+    )
+    scope = ("protocols/", "core/")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            if name in ("random.Random", "Random") and not node.args:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        "unseeded random.Random() — inject an rng or "
+                        "derive a deterministic seed "
+                        "(NetworkInfo.default_rng)",
+                    )
+                )
+            elif name in _GLOBAL_RANDOM:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f"{name}() uses the ambient-seeded global RNG — "
+                        "inject an rng instance",
+                    )
+                )
+            elif name in _FORBIDDEN_CALLS:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f"{name}() ({_FORBIDDEN_CALLS[name]}) inside "
+                        "deterministic protocol code",
+                    )
+                )
+            elif name == "id" and len(node.args) == 1:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        "id() is address-derived and differs per process "
+                        "— never order or key on it",
+                    )
+                )
+        return out

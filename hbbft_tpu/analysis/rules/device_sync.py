@@ -1,0 +1,144 @@
+"""Rule ``device-sync`` — no host synchronization inside jit regions.
+
+A ``.item()`` / ``int()`` / ``float()`` / ``np.asarray`` /
+``jax.device_get`` on a traced value inside a ``@jit``-compiled
+function either fails at trace time (ConcretizationTypeError) or —
+worse — silently bakes a trace-time constant into the compiled
+executable.  Either way the batched kernel no longer computes what the
+protocol layer thinks it does.  The rule finds jit regions two ways:
+
+- decorators: ``@jax.jit``, ``@jit``,
+  ``@functools.partial(jax.jit, ...)`` / ``@partial(jax.jit, ...)``;
+- wrap sites: any ``jax.jit(f)`` / ``jit(f, ...)`` call whose first
+  argument is a plain name marks the function ``f`` defined in the
+  same file.
+
+``int()``/``float()`` on shape arithmetic (an argument mentioning
+``.shape``, ``len()``, ``.ndim``) and on literal constants is allowed
+— those are static under tracing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name
+
+_NUMPY_SYNC = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "onp.asarray",
+    "onp.array",
+}
+
+_JIT_NAMES = {"jax.jit", "jit"}
+
+
+def _decorated_jit(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name = dotted_name(dec)
+        if name in _JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            cn = dotted_name(dec.func)
+            if cn in _JIT_NAMES:
+                return True
+            if cn in ("functools.partial", "partial") and dec.args:
+                if dotted_name(dec.args[0]) in _JIT_NAMES:
+                    return True
+    return False
+
+
+def _jit_wrapped_names(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES:
+            if node.args and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+    return names
+
+
+def _mentions_static(node: ast.AST) -> bool:
+    """Shape-ish expressions are static under tracing."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim", "size"):
+            return True
+        if isinstance(sub, ast.Call) and dotted_name(sub.func) == "len":
+            return True
+        if isinstance(sub, ast.Constant):
+            return True
+    return False
+
+
+class DeviceSyncRule(Rule):
+    name = "device-sync"
+    description = (
+        "no .item()/int()/float()/np.asarray/jax.device_get on traced "
+        "values inside @jit functions"
+    )
+    scope = ("ops/", "harness/", "parallel/")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        wrapped = _jit_wrapped_names(ctx.tree)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (_decorated_jit(fn) or fn.name in wrapped):
+                continue
+            out.extend(self._check_jit_body(ctx, fn))
+        return out
+
+    def _check_jit_body(self, ctx: FileContext, fn: ast.AST) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                out.append(
+                    self.violation(
+                        ctx, node, ".item() forces a device sync inside @jit"
+                    )
+                )
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == (
+                "block_until_ready"
+            ):
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        ".block_until_ready() inside @jit is a trace-time "
+                        "no-op or a sync — hoist it to the caller",
+                    )
+                )
+            elif name in ("jax.device_get", "device_get"):
+                out.append(
+                    self.violation(
+                        ctx, node, "jax.device_get inside @jit forces a sync"
+                    )
+                )
+            elif name in _NUMPY_SYNC:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f"{name} materializes a traced value on host "
+                        "inside @jit — use jnp",
+                    )
+                )
+            elif name in ("int", "float", "bool") and len(node.args) == 1:
+                if not _mentions_static(node.args[0]):
+                    out.append(
+                        self.violation(
+                            ctx,
+                            node,
+                            f"{name}() on a (possibly traced) value inside "
+                            "@jit — concretization hazard",
+                        )
+                    )
+        return out

@@ -1,0 +1,178 @@
+"""Rule ``dtype-width`` — integer kernels declare their accumulators.
+
+The limb kernels carry their correctness in arithmetic bounds (38·2²⁴
+< 2³¹ in ``limbs.py``, 255²·k·33 < 2³¹ in ``fr_jax.py``): every
+multiply-accumulate must *state* the wide accumulator, and every
+constant must fit the dtype it is stored in, or the bound silently
+breaks on the next edit.  Concretely:
+
+- ``jax.lax.dot_general`` / ``jnp.einsum`` in the limb modules must
+  pass ``preferred_element_type=...`` — without it XLA accumulates
+  int8/uint8 operands in their own width on some backends, and the
+  convolution sums wrap;
+- a product of two narrow-cast operands
+  (``x.astype(jnp.uint8) * y``) overflows the narrow dtype before any
+  accumulator sees it — widen first, multiply after;
+- integer literals passed to an integer-dtype constructor
+  (``np.int32(x)``, ``jnp.array(x, dtype=jnp.int8)``, ``jnp.full(...,
+  fill, dtype=...)``) must fit the declared dtype.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name
+
+_MACC = {"jax.lax.dot_general", "lax.dot_general", "jnp.einsum", "jax.numpy.einsum"}
+
+_NARROW = {"int8", "uint8", "int16", "uint16"}
+
+_INT_RANGES = {
+    "int8": (-(2**7), 2**7 - 1),
+    "uint8": (0, 2**8 - 1),
+    "int16": (-(2**15), 2**15 - 1),
+    "uint16": (0, 2**16 - 1),
+    "int32": (-(2**31), 2**31 - 1),
+    "uint32": (0, 2**32 - 1),
+}
+
+
+def _dtype_suffix(node: ast.AST) -> Optional[str]:
+    """``jnp.uint8`` / ``np.int32`` / ``"int8"`` → the bare dtype name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _INT_RANGES else None
+    name = dotted_name(node)
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    return tail if tail in _INT_RANGES else None
+
+
+def _narrow_cast(node: ast.AST) -> Optional[str]:
+    """dtype name if ``node`` is ``<expr>.astype(<narrow dtype>)``."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+        and node.args
+    ):
+        dt = _dtype_suffix(node.args[0])
+        if dt in _NARROW:
+            return dt
+    return None
+
+
+def _int_literal(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and type(node.value) is int:
+        return node.value
+    if (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+        and type(node.operand.value) is int
+    ):
+        return -node.operand.value
+    return None
+
+
+class DtypeWidthRule(Rule):
+    name = "dtype-width"
+    description = (
+        "limb kernels: declare matmul accumulators, widen before "
+        "multiply, constants fit their dtype"
+    )
+    scope = (
+        "ops/limbs.py",
+        "ops/fr_jax.py",
+        "ops/ec_jax.py",
+        "ops/gf256_jax.py",
+        "ops/packed_msm.py",
+        "ops/pallas_ec.py",
+        "ops/sha256_jax.py",
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in _MACC:
+                    kwargs = {kw.arg for kw in node.keywords}
+                    if "preferred_element_type" not in kwargs and None not in kwargs:
+                        out.append(
+                            self.violation(
+                                ctx,
+                                node,
+                                f"{name} without preferred_element_type — "
+                                "the integer accumulator width is "
+                                "backend-defined",
+                            )
+                        )
+                else:
+                    out.extend(self._check_constant_fits(ctx, node))
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+                ldt = _narrow_cast(node.left)
+                rdt = _narrow_cast(node.right)
+                if ldt and rdt:
+                    out.append(
+                        self.violation(
+                            ctx,
+                            node,
+                            f"product of {ldt}×{rdt} narrow casts wraps "
+                            "before accumulation — widen before multiply",
+                        )
+                    )
+        return out
+
+    def _check_constant_fits(
+        self, ctx: FileContext, node: ast.Call
+    ) -> List[Violation]:
+        """``np.int8(300)`` / ``jnp.array(big, dtype=jnp.int32)`` /
+        ``jnp.full(shape, fill, dtype=...)``."""
+        name = dotted_name(node.func)
+        if name is None:
+            return []
+        tail = name.rsplit(".", 1)[-1]
+        dtype: Optional[str] = None
+        value_args: List[ast.AST] = []
+        if tail in _INT_RANGES and node.args:
+            # direct constructor: np.int32(x)
+            dtype = tail
+            value_args = list(node.args)
+        elif tail in ("array", "asarray", "full"):
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dtype = _dtype_suffix(kw.value)
+            if dtype is None and tail == "full" and len(node.args) >= 3:
+                dtype = _dtype_suffix(node.args[2])
+            if dtype is None:
+                return []
+            value_args = list(node.args[1:2] if tail == "full" else node.args[:1])
+        else:
+            return []
+        lo, hi = _INT_RANGES[dtype]
+        out: List[Violation] = []
+        for arg in value_args:
+            folded = set()  # Constant operands already folded into a USub
+            for sub in ast.walk(arg):
+                if sub in folded:
+                    continue
+                lit = _int_literal(sub)
+                if lit is None:
+                    continue
+                if isinstance(sub, ast.UnaryOp):
+                    folded.add(sub.operand)
+                if not (lo <= lit <= hi):
+                    out.append(
+                        self.violation(
+                            ctx,
+                            node,
+                            f"constant {lit} does not fit declared "
+                            f"dtype {dtype}",
+                        )
+                    )
+                    break
+        return out

@@ -1,0 +1,116 @@
+"""Rule ``layering`` — the SURVEY layer map's import direction.
+
+SURVEY §1: crypto primitives (L0) sit below the core runtime (L1),
+protocols (L2–L4) sit on core+crypto, and the harness/transport layer
+(L5) sits on everything — *never* the other way around.  The batched
+device kernels (``ops/``, ``parallel/``) are the L0 accelerator plane:
+they may know about crypto types, but an ``ops`` module importing the
+harness (or a protocol importing the transport) inverts the
+dependency arrow and couples a pure kernel to runtime policy.
+
+The matrix below is the allow-list of intra-package imports by
+top-level directory.  ``analysis`` (this tool) and the package root
+are unconstrained importers; unknown future directories are
+unconstrained until added here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import FileContext, Rule, Violation
+
+# importer layer -> importee layers it may use
+ALLOWED: Dict[str, Set[str]] = {
+    "crypto": {"crypto", "core", "native", "obs"},
+    "ops": {"ops", "crypto", "native", "obs", "parallel"},
+    "parallel": {"parallel", "ops", "crypto", "native", "obs"},
+    "native": {"native", "core", "crypto"},
+    "core": {"core", "crypto", "native", "obs"},
+    "obs": {"obs"},
+    "protocols": {"protocols", "core", "crypto", "obs"},
+    "harness": {
+        "harness",
+        "protocols",
+        "core",
+        "crypto",
+        "ops",
+        "parallel",
+        "native",
+        "obs",
+        "transport",
+    },
+    "transport": {"transport", "protocols", "core", "crypto", "obs"},
+    # "analysis" and "<root>" deliberately absent: unconstrained.
+}
+
+
+def _layer_of(relpath: str) -> str:
+    return relpath.split("/", 1)[0] if "/" in relpath else "<root>"
+
+
+def _import_target_layer(
+    node: ast.ImportFrom, relpath: str
+) -> Optional[str]:
+    """Top-level package dir an intra-package import lands in, or None
+    for external imports."""
+    if node.level == 0:
+        mod = node.module or ""
+        if mod == "hbbft_tpu":
+            return "<root>"
+        if mod.startswith("hbbft_tpu."):
+            return mod.split(".")[1]
+        return None
+    # relative: resolve against the file's package position
+    pkg_parts = relpath.split("/")[:-1]  # dirs above the module
+    up = node.level - 1
+    if up > len(pkg_parts):
+        return None  # escapes the package — not ours to judge
+    base = pkg_parts[: len(pkg_parts) - up]
+    mod_parts = (node.module or "").split(".") if node.module else []
+    target = base + mod_parts
+    if not target:
+        return "<root>"
+    return target[0]
+
+
+class LayeringRule(Rule):
+    name = "layering"
+    description = "imports must follow the SURVEY layer map (no upward imports)"
+    scope = ()  # every file in the package
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        importer = _layer_of(ctx.relpath)
+        allowed = ALLOWED.get(importer)
+        if allowed is None:
+            return []
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            targets: List[Optional[str]] = []
+            if isinstance(node, ast.ImportFrom):
+                t = _import_target_layer(node, ctx.relpath)
+                if t == "<root>":
+                    # ``from .. import ops`` — the names ARE the layers
+                    targets.extend(alias.name for alias in node.names)
+                else:
+                    targets.append(t)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "hbbft_tpu":
+                        targets.append("<root>")
+                    elif alias.name.startswith("hbbft_tpu."):
+                        targets.append(alias.name.split(".")[1])
+            for t in targets:
+                if t is None or t == "<root>" or t == importer:
+                    continue
+                if t not in allowed:
+                    out.append(
+                        self.violation(
+                            ctx,
+                            node,
+                            f"layer {importer!r} must not import layer "
+                            f"{t!r} (SURVEY layer map)",
+                        )
+                    )
+        return out

@@ -1,0 +1,82 @@
+"""Rule ``obs-schema`` — every emitted event matches schema v1.
+
+The JSONL trace is a stable interface: the report CLI, tests, and any
+downstream dashboards key on the field sets documented in
+:mod:`hbbft_tpu.obs.schema`.  A call site that misspells a field,
+drops a required one, or invents an event type silently breaks every
+consumer.  This rule checks each ``<recorder>.event("<type>", ...)``
+call site in the tree against the authoritative table:
+
+- the event type (first positional argument, a string literal) must be
+  registered;
+- keyword fields must be in the type's allowed set (``t`` — an
+  explicit timestamp override — is always allowed);
+- required fields must all be present, unless the call uses a ``**``
+  splat (then only the named subset is checked).
+
+Method name + string-literal first argument is the match heuristic;
+no other ``.event(...)`` API exists in the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ...obs import schema as _schema
+from ..core import FileContext, Rule, Violation
+
+
+class ObsSchemaRule(Rule):
+    name = "obs-schema"
+    description = "recorder.event() call sites must match the stable JSONL schema"
+    scope = ()  # every file: emit sites span ops/, harness/, core/, transport/
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            ev = node.args[0].value
+            spec = _schema.EVENTS.get(ev)
+            if spec is None:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f"unknown event type {ev!r} — register it in "
+                        "obs/schema.py",
+                    )
+                )
+                continue
+            names = {kw.arg for kw in node.keywords if kw.arg is not None}
+            has_splat = any(kw.arg is None for kw in node.keywords)
+            if not spec.open:
+                for field in sorted(names - spec.allowed - {"t"}):
+                    out.append(
+                        self.violation(
+                            ctx,
+                            node,
+                            f"event {ev!r}: field {field!r} is not in "
+                            "the schema",
+                        )
+                    )
+            if not has_splat:
+                missing = spec.required - names
+                if missing:
+                    out.append(
+                        self.violation(
+                            ctx,
+                            node,
+                            f"event {ev!r}: missing required field(s) "
+                            f"{', '.join(sorted(missing))}",
+                        )
+                    )
+        return out

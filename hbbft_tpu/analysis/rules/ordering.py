@@ -1,0 +1,178 @@
+"""Rule ``ordered-iter`` — no unordered iteration on emitting paths.
+
+Python ``set`` iteration order is derived from hashes and insertion
+history; for ``str`` keys it additionally varies with the per-process
+hash seed (PYTHONHASHSEED).  A protocol that iterates a bare set while
+deciding *which messages to emit, in which order* (or which faults to
+log) produces different wire behavior on identical inputs — the exact
+silent-nondeterminism class Thetacrypt calls out as the dominant
+failure mode of threshold-crypto services.  ``dict.keys()`` is
+insertion-ordered, which is deterministic only if every replica
+inserted in the same order — on message-driven maps that is the same
+hazard, so it is flagged on emitting paths too.
+
+Heuristics (project-scale, not a type checker):
+
+- set-typed values are names/attributes assigned ``set()``, a set
+  literal, a ``Set[...]``/``set`` annotation, or the result of a call
+  to ``set(...)`` / ``.difference()`` / ``.union()`` /
+  ``.intersection()``;
+- bare **set** iteration is flagged anywhere in protocol code — set
+  order is hash-derived, so there is no deterministic-by-construction
+  case;
+- **``dict.keys()``** iteration is flagged only inside an *emitting
+  function* (one that mentions ``send_all`` / ``send_to`` /
+  ``add_fault`` / ``from_fault`` / ``FaultLog`` or is annotated
+  ``-> Step``) — insertion order is per-replica-deterministic, so it
+  is only hazardous where the order reaches the wire or the fault
+  log;
+- wrapping the iterable in ``sorted(...)`` clears the flag.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Set, Tuple
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name
+
+_EMIT_MARKERS = {"send_all", "send_to", "add_fault", "from_fault"}
+_SET_RETURNING_METHODS = {"difference", "union", "intersection", "symmetric_difference"}
+
+
+def _is_set_annotation(node: ast.AST) -> bool:
+    base = node
+    if isinstance(node, ast.Subscript):
+        base = node.value
+    name = dotted_name(base)
+    return name in ("Set", "set", "typing.Set", "FrozenSet", "frozenset")
+
+
+def _collect_set_names(tree: ast.AST) -> Set[str]:
+    """Names (``x`` or ``self.x``) bound to set values anywhere in the
+    file — class attributes and locals alike (one namespace; false
+    sharing across classes is acceptable for a project lint)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        target = None
+        value = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+            value = node.value
+            if _is_set_annotation(node.annotation):
+                tn = dotted_name(target)
+                if tn:
+                    names.add(tn)
+        elif isinstance(node, ast.arg) and node.annotation is not None:
+            if _is_set_annotation(node.annotation):
+                names.add(node.arg)
+            continue
+        if target is None:
+            continue
+        tn = dotted_name(target)
+        if not tn:
+            continue
+        if isinstance(value, ast.Set):
+            names.add(tn)
+        elif isinstance(value, ast.Call):
+            cn = dotted_name(value.func)
+            if cn == "set" or cn == "frozenset":
+                names.add(tn)
+            elif (
+                isinstance(value.func, ast.Attribute)
+                and value.func.attr in _SET_RETURNING_METHODS
+            ):
+                names.add(tn)
+    return names
+
+
+def _walk_own_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body without descending into nested defs
+    (those are linted as their own functions)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_emitting(fn: ast.AST) -> bool:
+    ret = getattr(fn, "returns", None)
+    if ret is not None and dotted_name(ret) == "Step":
+        return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in _EMIT_MARKERS:
+            return True
+        if isinstance(node, ast.Name) and node.id in ("FaultLog",):
+            return True
+    return False
+
+
+def _unordered_reason(it: ast.AST, set_names: Set[str]) -> Tuple[str, bool]:
+    """→ (why this iterable is unordered or '', needs_emitting_path)."""
+    if isinstance(it, ast.Set):
+        return "set literal", False
+    if isinstance(it, ast.Call):
+        cn = dotted_name(it.func)
+        if cn in ("set", "frozenset"):
+            return f"{cn}(...) result", False
+        if isinstance(it.func, ast.Attribute):
+            if it.func.attr == "keys":
+                return (
+                    "dict.keys() (insertion-ordered, differs across replicas)",
+                    True,
+                )
+            if it.func.attr in _SET_RETURNING_METHODS:
+                return f".{it.func.attr}() result (a set)", False
+        return "", False
+    name = dotted_name(it)
+    if name and name in set_names:
+        return f"set-typed {name!r}", False
+    return "", False
+
+
+class OrderedIterRule(Rule):
+    name = "ordered-iter"
+    description = (
+        "no bare set / dict.keys() iteration where message emission "
+        "or fault logging depends on the order"
+    )
+    scope = ("protocols/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        set_names = _collect_set_names(ctx.tree)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            emitting = _is_emitting(fn)
+            for sub in _walk_own_body(fn):
+                iters: List[ast.AST] = []
+                if isinstance(sub, (ast.For, ast.AsyncFor)):
+                    iters.append(sub.iter)
+                elif isinstance(
+                    sub,
+                    (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+                ):
+                    iters.extend(g.iter for g in sub.generators)
+                for it in iters:
+                    reason, needs_emitting = _unordered_reason(it, set_names)
+                    if not reason or (needs_emitting and not emitting):
+                        continue
+                    where = (
+                        "on an emitting path" if emitting else "in protocol code"
+                    )
+                    out.append(
+                        self.violation(
+                            ctx,
+                            it,
+                            f"iteration over {reason} {where} — "
+                            "wrap in sorted(...)",
+                        )
+                    )
+        return out

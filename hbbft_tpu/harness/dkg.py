@@ -77,6 +77,7 @@ from ..crypto.poly import (
     Commitment,
     lagrange_coefficients_at_zero,
 )
+from ..obs import recorder as _obs
 
 R = F.R
 
@@ -265,43 +266,48 @@ class VectorizedDkg:
         n, t = self.n, self.t
         tp1 = t + 1
         faults = FaultLog()
-        if coeffs is None:
-            coeffs = self._dealer_coeffs(self.rng)
-
-        # power matrices POW[r][j] = (r+1)^j (bytes, reused everywhere)
-        pow_rows = self._pow_matrix()
-        POW = _fr_bytes([v for row in pow_rows for v in row])  # [n, t+1]
-        POWT = _fr_bytes(
-            [pow_rows[r][j] for j in range(tp1) for r in range(n)]
-        )  # [t+1, n]
-
-        # flat coefficient buffers per dealer
-        C = [
-            _fr_bytes([c for row in mat for c in row]) for mat in coeffs
-        ]  # each [t+1, t+1]
-
-        # ack senders: every node in verify mode or with adversaries
-        # present (the reference has every node ack every part); the
-        # lowest 2t+1 under clean elision (completeness threshold;
-        # elided values are never read — module doc)
         adversarial = bool(wrong_row or wrong_value)
-        if verify_honest or adversarial:
-            n_ackers = n
-            n_valued = n
-        else:
-            n_ackers = min(n, 2 * t + 1)
-            n_valued = min(n, t + 1)
+        with _obs.span("dkg.dealing", n=n, threshold=t, engine="host"):
+            if coeffs is None:
+                coeffs = self._dealer_coeffs(self.rng)
 
-        # per-dealer grids (native Fr matmuls)
-        ROWS: List[np.ndarray] = []  # [n or ackers, t+1] row coefficients
-        VAL: List[np.ndarray] = []  # [n_valued, n] value grids
-        n_rowed = n if verify_honest else n_ackers
-        for d in range(n):
-            rows_d = NT.fr_matmul(POW[: n_rowed * tp1 * 32], C[d], n_rowed, tp1, tp1)
-            ROWS.append(rows_d)
-            VAL.append(
-                NT.fr_matmul(rows_d[: n_valued * tp1 * 32], POWT, n_valued, tp1, n)
-            )
+            # power matrices POW[r][j] = (r+1)^j (bytes, reused everywhere)
+            pow_rows = self._pow_matrix()
+            POW = _fr_bytes([v for row in pow_rows for v in row])  # [n, t+1]
+            POWT = _fr_bytes(
+                [pow_rows[r][j] for j in range(tp1) for r in range(n)]
+            )  # [t+1, n]
+
+            # flat coefficient buffers per dealer
+            C = [
+                _fr_bytes([c for row in mat for c in row]) for mat in coeffs
+            ]  # each [t+1, t+1]
+
+            # ack senders: every node in verify mode or with adversaries
+            # present (the reference has every node ack every part); the
+            # lowest 2t+1 under clean elision (completeness threshold;
+            # elided values are never read — module doc)
+            if verify_honest or adversarial:
+                n_ackers = n
+                n_valued = n
+            else:
+                n_ackers = min(n, 2 * t + 1)
+                n_valued = min(n, t + 1)
+
+            # per-dealer grids (native Fr matmuls)
+            ROWS: List[np.ndarray] = []  # [n or ackers, t+1] rows
+            VAL: List[np.ndarray] = []  # [n_valued, n] value grids
+            n_rowed = n if verify_honest else n_ackers
+            for d in range(n):
+                rows_d = NT.fr_matmul(
+                    POW[: n_rowed * tp1 * 32], C[d], n_rowed, tp1, tp1
+                )
+                ROWS.append(rows_d)
+                VAL.append(
+                    NT.fr_matmul(
+                        rows_d[: n_valued * tp1 * 32], POWT, n_valued, tp1, n
+                    )
+                )
 
         # commitments: needed for verification (and for any dealer with
         # adversarial cells, to run the exact per-item checks)
@@ -314,9 +320,10 @@ class VectorizedDkg:
         )
         commit_wires: Dict[int, np.ndarray] = {}
         if need_commit:
-            g2w = NT.g2_wire(G2_GEN)
-            for d in sorted(need_commit):
-                commit_wires[d] = NT.g2_mul_many_raw(g2w, C[d])
+            with _obs.span("dkg.commitments", dealers=len(need_commit)):
+                g2w = NT.g2_wire(G2_GEN)
+                for d in sorted(need_commit):
+                    commit_wires[d] = NT.g2_mul_many_raw(g2w, C[d])
 
         # adversarial deltas: indexes of corrupted cells
         bad_rows: Set[Tuple[int, int]] = set()  # (dealer, receiver)
@@ -355,40 +362,52 @@ class VectorizedDkg:
 
         row_checks = value_checks = msm_points = 0
         if verify_honest:
-            ok, msm_points = self._fused_check(
-                ROWS, VAL, commit_wires, n_ackers
-            )
-            row_checks = n * n
-            value_checks = n * n_ackers * n
-            if not ok:
-                self._fallback_attribution(
-                    ROWS, VAL, commit_wires, faults
+            with _obs.span("dkg.verify", mode="fused", n=n):
+                ok, msm_points = self._fused_check(
+                    ROWS, VAL, commit_wires, n_ackers
                 )
+                row_checks = n * n
+                value_checks = n * n_ackers * n
+                if not ok:
+                    self._fallback_attribution(
+                        ROWS, VAL, commit_wires, faults
+                    )
         else:
             # adversarial cells are verified exactly, per item, against
             # the flagged dealer's real commitment — the same checks the
             # sequential machine runs (attribution identical); honest
             # cells verify by construction (module doc) and are elided
-            flagged_dealers: Set[int] = set()
-            flagged_senders: Set[Tuple[int, int]] = set()
-            for d, r in sorted(bad_rows):
-                row_checks += 1
-                if not self._check_row_item(
-                    commit_wires[d], _fr_ints(ROWS[d][r * tp1 * 32 : (r + 1) * tp1 * 32]), r
-                ):
-                    if d not in flagged_dealers:
-                        flagged_dealers.add(d)
-                        faults.add(self.node_ids[d], FaultKind.INVALID_PART)
-            for d, s, r in sorted(bad_vals):
-                value_checks += 1
-                off = (s * n + r) * 32
-                val = int.from_bytes(
-                    VAL[d][off : off + 32].tobytes(), "big"
-                )
-                if not self._check_value_item(commit_wires[d], val, r, s):
-                    if (d, s) not in flagged_senders:
-                        flagged_senders.add((d, s))
-                        faults.add(self.node_ids[s], FaultKind.INVALID_ACK)
+            with _obs.span(
+                "dkg.verify",
+                mode="exact",
+                cells=len(bad_rows) + len(bad_vals),
+            ):
+                flagged_dealers: Set[int] = set()
+                flagged_senders: Set[Tuple[int, int]] = set()
+                for d, r in sorted(bad_rows):
+                    row_checks += 1
+                    if not self._check_row_item(
+                        commit_wires[d],
+                        _fr_ints(ROWS[d][r * tp1 * 32 : (r + 1) * tp1 * 32]),
+                        r,
+                    ):
+                        if d not in flagged_dealers:
+                            flagged_dealers.add(d)
+                            faults.add(
+                                self.node_ids[d], FaultKind.INVALID_PART
+                            )
+                for d, s, r in sorted(bad_vals):
+                    value_checks += 1
+                    off = (s * n + r) * 32
+                    val = int.from_bytes(
+                        VAL[d][off : off + 32].tobytes(), "big"
+                    )
+                    if not self._check_value_item(commit_wires[d], val, r, s):
+                        if (d, s) not in flagged_senders:
+                            flagged_senders.add((d, s))
+                            faults.add(
+                                self.node_ids[s], FaultKind.INVALID_ACK
+                            )
 
         # ack bookkeeping: receiver with a bad row refuses to ack
         acks: Dict[int, Set[int]] = {d: set() for d in range(n)}
@@ -406,51 +425,53 @@ class VectorizedDkg:
         # generation (sync_key_gen.rs:396-409 semantics):
         # pk commitment = Σ_d row-0 commitment; share_r = Σ_d
         # interpolate₀(lowest t+1 VALID values for r)
-        pk_coeffs_scalars = [
-            sum(coeffs[d][0][k] for d in complete) % R for k in range(tp1)
-        ]
-        pk_commit = Commitment([G2_GEN * s for s in pk_coeffs_scalars])
-        master_g1 = G1_GEN * (sum(coeffs[d][0][0] for d in complete) % R)
+        with _obs.span("dkg.generation", complete=len(complete)):
+            pk_coeffs_scalars = [
+                sum(coeffs[d][0][k] for d in complete) % R for k in range(tp1)
+            ]
+            pk_commit = Commitment([G2_GEN * s for s in pk_coeffs_scalars])
+            master_g1 = G1_GEN * (sum(coeffs[d][0][0] for d in complete) % R)
 
-        lam = lagrange_coefficients_at_zero(list(range(1, tp1 + 1)))
-        lam_buf = _fr_bytes(lam)
-        shares: Dict[Any, Any] = {}
-        share_acc = [0] * n
-        for d in complete:
-            # the deterministic subset: lowest t+1 ack senders whose
-            # value passed (sync_key_gen.rs:403); with no adversarial
-            # cells that is senders 0..t and one Fr matmul covers all
-            # receivers at once
-            d_bad = {(s, r) for dd, s, r in bad_vals if dd == d}
-            if not d_bad:
-                contrib = _fr_ints(
-                    NT.fr_matmul(lam_buf, VAL[d][: tp1 * n * 32], 1, tp1, n)
-                )
-                for r in range(n):
-                    share_acc[r] = (share_acc[r] + contrib[r]) % R
-            else:
-                vals_d = _fr_ints(VAL[d])  # [n_valued, n] flattened
-                for r in range(n):
-                    pts = []
-                    for s in sorted(acks[d]):
-                        if (s, r) in d_bad:
-                            continue
-                        if s >= n_valued:
-                            break
-                        pts.append((s + 1, vals_d[s * self.n + r]))
-                        if len(pts) == tp1:
-                            break
-                    if len(pts) <= t:
-                        raise RuntimeError(
-                            "not enough valid values to reconstruct a share"
-                        )
-                    from ..crypto.poly import interpolate_at_zero
+            lam = lagrange_coefficients_at_zero(list(range(1, tp1 + 1)))
+            lam_buf = _fr_bytes(lam)
+            shares: Dict[Any, Any] = {}
+            share_acc = [0] * n
+            for d in complete:
+                # the deterministic subset: lowest t+1 ack senders whose
+                # value passed (sync_key_gen.rs:403); with no adversarial
+                # cells that is senders 0..t and one Fr matmul covers all
+                # receivers at once
+                d_bad = {(s, r) for dd, s, r in bad_vals if dd == d}
+                if not d_bad:
+                    contrib = _fr_ints(
+                        NT.fr_matmul(lam_buf, VAL[d][: tp1 * n * 32], 1, tp1, n)
+                    )
+                    for r in range(n):
+                        share_acc[r] = (share_acc[r] + contrib[r]) % R
+                else:
+                    vals_d = _fr_ints(VAL[d])  # [n_valued, n] flattened
+                    for r in range(n):
+                        pts = []
+                        for s in sorted(acks[d]):
+                            if (s, r) in d_bad:
+                                continue
+                            if s >= n_valued:
+                                break
+                            pts.append((s + 1, vals_d[s * self.n + r]))
+                            if len(pts) == tp1:
+                                break
+                        if len(pts) <= t:
+                            raise RuntimeError(
+                                "not enough valid values to reconstruct "
+                                "a share"
+                            )
+                        from ..crypto.poly import interpolate_at_zero
 
-                    share_acc[r] = (
-                        share_acc[r] + interpolate_at_zero(pts)
-                    ) % R
-        for r, nid in enumerate(self.node_ids):
-            shares[nid] = T.SecretKeyShare(share_acc[r])
+                        share_acc[r] = (
+                            share_acc[r] + interpolate_at_zero(pts)
+                        ) % R
+            for r, nid in enumerate(self.node_ids):
+                shares[nid] = T.SecretKeyShare(share_acc[r])
 
         pk_set = T.PublicKeySet(pk_commit, master_g1)
         return DkgResult(
@@ -541,46 +562,49 @@ class VectorizedDkg:
         share_acc = jnp.zeros((n, FJ.FR_LIMBS), jnp.uint8)
         row0_acc = jnp.zeros((tp1, FJ.FR_LIMBS), jnp.uint8)
         digest = jnp.zeros((), jnp.int32)
-        if coeffs is None:
-            run_step = jax.jit(step_sampled)
-            # chain 8×32 bits of caller entropy into the threefry key
-            # (a bare PRNGKey(getrandbits(63)) capped the whole era's
-            # key material at 63 bits of seed entropy — ADVICE r4 #1).
-            # The key STATE is still 64 bits, an inherent threefry
-            # limit: sampled device dealing is for benchmarks and
-            # co-simulation; a production deployment supplies host-
-            # drawn ``coeffs`` (SyncKeyGen's path) for full-entropy
-            # key material.
-            key = jax.random.PRNGKey(self.rng.getrandbits(32))
-            for _ in range(7):
-                key = jax.random.fold_in(key, self.rng.getrandbits(32))
-            keys = jax.random.split(key, n)
-            for d in range(n):
-                share_acc, row0_acc, digest = run_step(
-                    keys[d], share_acc, row0_acc, digest
-                )
-        else:
-            run_step = jax.jit(grids)
-            for d in range(n):
-                c_limbs = jnp.asarray(
-                    FJ.fr_to_limbs(
-                        [c for row in coeffs[d] for c in row]
-                    ).reshape(tp1, tp1, FJ.FR_LIMBS)
-                )
-                share_acc, row0_acc, digest = run_step(
-                    c_limbs, share_acc, row0_acc, digest
-                )
+        with _obs.span("dkg.dealing", n=n, threshold=t, engine="device"):
+            if coeffs is None:
+                run_step = jax.jit(step_sampled)
+                # chain 8×32 bits of caller entropy into the threefry key
+                # (a bare PRNGKey(getrandbits(63)) capped the whole era's
+                # key material at 63 bits of seed entropy — ADVICE r4 #1).
+                # The key STATE is still 64 bits, an inherent threefry
+                # limit: sampled device dealing is for benchmarks and
+                # co-simulation; a production deployment supplies host-
+                # drawn ``coeffs`` (SyncKeyGen's path) for full-entropy
+                # key material.
+                key = jax.random.PRNGKey(self.rng.getrandbits(32))
+                for _ in range(7):
+                    key = jax.random.fold_in(key, self.rng.getrandbits(32))
+                keys = jax.random.split(key, n)
+                for d in range(n):
+                    share_acc, row0_acc, digest = run_step(
+                        keys[d], share_acc, row0_acc, digest
+                    )
+            else:
+                run_step = jax.jit(grids)
+                for d in range(n):
+                    c_limbs = jnp.asarray(
+                        FJ.fr_to_limbs(
+                            [c for row in coeffs[d] for c in row]
+                        ).reshape(tp1, tp1, FJ.FR_LIMBS)
+                    )
+                    share_acc, row0_acc, digest = run_step(
+                        c_limbs, share_acc, row0_acc, digest
+                    )
 
-        int(digest)  # sync: the full data plane has been computed
-        share_vals = FJ.limbs_to_fr(np.asarray(share_acc))
-        pk_coeffs_scalars = FJ.limbs_to_fr(np.asarray(row0_acc))
+            int(digest)  # sync: the full data plane has been computed
 
-        pk_commit = Commitment([G2_GEN * s for s in pk_coeffs_scalars])
-        master_g1 = G1_GEN * pk_coeffs_scalars[0]
-        shares = {
-            nid: T.SecretKeyShare(share_vals[r])
-            for r, nid in enumerate(self.node_ids)
-        }
+        with _obs.span("dkg.generation", complete=n, engine="device"):
+            share_vals = FJ.limbs_to_fr(np.asarray(share_acc))
+            pk_coeffs_scalars = FJ.limbs_to_fr(np.asarray(row0_acc))
+
+            pk_commit = Commitment([G2_GEN * s for s in pk_coeffs_scalars])
+            master_g1 = G1_GEN * pk_coeffs_scalars[0]
+            shares = {
+                nid: T.SecretKeyShare(share_vals[r])
+                for r, nid in enumerate(self.node_ids)
+            }
         return DkgResult(
             T.PublicKeySet(pk_commit, master_g1),
             shares,

@@ -10,6 +10,9 @@ Design constraints (ISSUE 1 tentpole):
   the untraced build.
 - **Stable event schema.**  Every event is one JSON object per line
   with at least ``{"ev": <type>, "t": <seconds since trace start>}``.
+  The authoritative per-type field table lives in
+  :mod:`hbbft_tpu.obs.schema` and is enforced over every call site by
+  the ``obs-schema`` badgerlint rule (``python -m hbbft_tpu.analysis``).
   Event types in use across the stack (consumed by
   :mod:`hbbft_tpu.obs.report`):
 
@@ -27,11 +30,15 @@ Design constraints (ISSUE 1 tentpole):
   ``epoch_phases``    vectorized epoch driver wall-clock breakdown:
                       ``epoch, phases{...}, shares, coin_flips, faults``
   ``flush``           one crypto batch flush: ``queued, shipped, real,
-                      inline, cached, occupancy, dur, groups, phases``
+                      inline`` (+ ``occupancy, dur, groups,
+                      fallback_groups, phases`` on non-cached rounds)
   ``device_op``       one MSM routing decision: ``op, k, engine``
   ``fault``           one attributed Byzantine fault: ``fault`` (the
                       stable compact form ``<node!r>:<KIND>``), ``node,
                       kind``
+  ``wire_send``       one frame written to a TCP peer link: ``peer,
+                      size`` (+ ``kind``: ``all``/``node``)
+  ``wire_recv``       one frame read off a TCP peer link: ``peer, size``
   ``counter``         final counter values (emitted on close)
   ``hist``            histogram summaries (emitted on close)
   ``trace_end``       total event count + duration
@@ -52,7 +59,7 @@ import threading
 import time as _time
 from typing import Any, Callable, Dict, IO, List, Optional
 
-SCHEMA_VERSION = 1
+from .schema import SCHEMA_VERSION
 
 # THE hot-path gate: instrumented modules do
 #     rec = _obs.ACTIVE

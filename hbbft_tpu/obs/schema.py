@@ -1,0 +1,69 @@
+"""The stable JSONL event schema — one authoritative field table.
+
+Schema v1 (PR 1) with the additive v1 extensions from the static
+-analysis PR (``wire_send`` / ``wire_recv`` for the real TCP mesh).
+Consumed by :mod:`hbbft_tpu.obs.report` (field access), by
+:mod:`hbbft_tpu.analysis.rules.obs_schema` (call-site lint), and by
+tests.
+
+Every event row carries ``ev`` (the type) and ``t`` (seconds since
+trace start) — those are added by :meth:`Recorder.event` itself and
+are not listed per type.  ``required`` fields must appear at every
+emit site; ``optional`` fields may.  Event types marked ``open``
+accept arbitrary extra attributes (spans carry caller attrs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSpec:
+    required: FrozenSet[str]
+    optional: FrozenSet[str] = frozenset()
+    open: bool = False  # arbitrary extra fields allowed
+
+    @property
+    def allowed(self) -> FrozenSet[str]:
+        return self.required | self.optional
+
+
+def _spec(required, optional=(), open=False) -> EventSpec:
+    return EventSpec(frozenset(required), frozenset(optional), open)
+
+
+EVENTS: Dict[str, EventSpec] = {
+    # lifecycle (emitted by the Recorder itself)
+    "trace_start": _spec({"schema", "wall_unix"}),
+    "trace_end": _spec({"events", "dur"}),
+    "counter": _spec({"name", "value"}),
+    "hist": _spec({"name", "count", "min", "p50", "p90", "max", "sum"}),
+    # spans carry caller attributes — open by design
+    "span": _spec({"name", "dur", "depth"}, open=True),
+    # simulator message plane
+    "msg_send": _spec({"src", "size", "vt", "kind"}),
+    "msg_deliver": _spec({"src", "dst", "size", "vt", "kind"}),
+    "msg_handle": _spec({"node", "vt", "wall", "size"}),
+    # epoch rows
+    "epoch_start": _spec({"epoch", "vt"}),
+    "epoch_decide": _spec({"epoch", "node", "vt"}),
+    "epoch": _spec(
+        {"epoch", "min_time", "max_time", "txs", "msgs_per_node", "bytes_per_node"}
+    ),
+    "epoch_phases": _spec({"epoch", "phases", "shares", "coin_flips", "faults"}),
+    # crypto batching / device routing
+    "flush": _spec(
+        {"queued", "shipped", "real", "inline"},
+        {"occupancy", "dur", "groups", "fallback_groups", "phases"},
+    ),
+    "device_op": _spec({"op", "k", "engine"}),
+    # fault attribution
+    "fault": _spec({"fault", "node", "kind"}),
+    # real TCP mesh wire plane (additive, this PR)
+    "wire_send": _spec({"peer", "size"}, {"kind"}),
+    "wire_recv": _spec({"peer", "size"}),
+}

@@ -199,7 +199,10 @@ class DynamicHoneyBadger(DistAlgorithm):
     ):
         self.netinfo = netinfo
         self.max_future_epochs = max_future_epochs
-        self.rng = rng if rng is not None else random.Random()
+        # deterministic per-node default (badgerlint: determinism)
+        self.rng = (
+            rng if rng is not None else netinfo.default_rng("dynamic_honey_badger")
+        )
         self.start_epoch = start_epoch
         self.vote_counter = VoteCounter(netinfo, start_epoch)
         self.key_gen_msg_buffer: List[SignedKeyGenMsg] = []
@@ -505,6 +508,9 @@ class DynamicHoneyBadgerBuilder:
         from ..crypto import mock as M
         from ..crypto import threshold as T
 
+        # fresh OS-entropy keys are REQUIRED here: this generates the
+        # network's first secret key set, so a derivable seed would let
+        # anyone reconstruct it  # lint: ok(determinism)
         rng = self._rng if self._rng is not None else random.Random()
         if mock:
             sk_set = M.MockSecretKeySet.random(0, rng)

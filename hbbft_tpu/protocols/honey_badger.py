@@ -94,7 +94,10 @@ class HoneyBadger(DistAlgorithm):
         self.decrypted_contributions: Dict[Any, bytes] = {}
         # epoch -> proposer -> ciphertext
         self.ciphertexts: Dict[int, Dict[Any, Any]] = {}
-        self.rng = rng if rng is not None else random.Random()
+        # deterministic per-node default (badgerlint: determinism) —
+        # replayable and co-simulation-stable; the seed folds in our
+        # secret key so the ciphertext randomness stays unpredictable
+        self.rng = rng if rng is not None else netinfo.default_rng("honey_badger")
 
     # -- DistAlgorithm -----------------------------------------------------
 
@@ -281,7 +284,7 @@ class HoneyBadger(DistAlgorithm):
         self, proposer_id, incorrect, epoch
     ) -> None:
         shares = self.received_shares.get(epoch, {}).get(proposer_id, {})
-        for sender_id in incorrect:
+        for sender_id in sorted(incorrect, key=repr):
             shares.pop(sender_id, None)
 
     def _try_output_batches(self) -> Step:

@@ -32,7 +32,14 @@ class QueueingHoneyBadger(DistAlgorithm):
         self.dyn_hb = dyn_hb
         self.batch_size = batch_size
         self.queue = TransactionQueue(txs)
-        self.rng = rng if rng is not None else random.Random()
+        # deterministic per-node default (badgerlint: determinism);
+        # proposal sampling stays unpredictable to peers via the
+        # secret-key-folded seed, and identical across re-runs
+        self.rng = (
+            rng
+            if rng is not None
+            else dyn_hb.netinfo.default_rng("queueing_honey_badger")
+        )
 
     @classmethod
     def builder(cls, dyn_hb: DynamicHoneyBadger) -> "QueueingHoneyBadgerBuilder":

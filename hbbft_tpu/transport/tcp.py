@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core.network_info import NetworkInfo
 from ..core.serialize import SerializationError, dumps, loads
 from ..core.step import Step
+from ..obs import recorder as _obs
 
 _LEN_BYTES = 4
 _MAX_FRAME = 64 * 1024 * 1024
@@ -56,11 +57,17 @@ def generate_keys_for(addresses: List[str], our_addr: str) -> NetworkInfo:
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    message, _ = await _read_frame_sized(reader)
+    return message
+
+
+async def _read_frame_sized(reader: asyncio.StreamReader) -> Any:
+    """→ (message, frame length in payload bytes)."""
     header = await reader.readexactly(_LEN_BYTES)
     length = int.from_bytes(header, "big")
     if length > _MAX_FRAME:
         raise ConnectionError(f"oversized frame: {length} bytes")
-    return loads(await reader.readexactly(length))
+    return loads(await reader.readexactly(length)), length
 
 
 def _frame(message: Any) -> bytes:
@@ -223,12 +230,17 @@ class TcpNode:
     async def _recv_loop(self, peer: str, reader: asyncio.StreamReader) -> None:
         while True:
             try:
-                message = await _read_frame(reader)
+                message, size = await _read_frame_sized(reader)
             except (asyncio.IncompleteReadError, ConnectionError, OSError):
                 return  # peer closed; the protocol tolerates f silent nodes
             except SerializationError:
                 continue  # malformed frame: drop it, the length-prefixed
                 # stream stays aligned on the next frame
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.event("wire_recv", peer=peer, size=size)
+                rec.count("wire.recv_frames")
+                rec.count("wire.recv_bytes", size)
             await self._inbox.put((peer, message))
 
     # -- the protocol pump --------------------------------------------------
@@ -236,6 +248,7 @@ class TcpNode:
     async def _route(self, step: Step) -> None:
         self.outputs.extend(step.output)
         self.faults.extend(step.fault_log)
+        rec = _obs.ACTIVE
         touched = []
         for tm in step.messages:
             if tm.target.is_all:
@@ -243,11 +256,21 @@ class TcpNode:
             else:
                 targets = [tm.target.node] if tm.target.node != self.our_addr else []
             frame = _frame(tm.message)
+            kind = "all" if tm.target.is_all else "node"
             for peer in targets:
                 w = self._writers.get(peer)
                 if w is not None:
                     w.write(frame)
                     touched.append(w)
+                    if rec is not None:
+                        rec.event(
+                            "wire_send",
+                            peer=peer,
+                            size=len(frame) - _LEN_BYTES,
+                            kind=kind,
+                        )
+                        rec.count("wire.sent_frames")
+                        rec.count("wire.sent_bytes", len(frame) - _LEN_BYTES)
         for w in touched:
             try:
                 await w.drain()

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# badgerlint wrapper: lint the package (or the given paths), forwarding
+# all flags to the CLI.  Examples:
+#   scripts/lint.sh
+#   scripts/lint.sh --json
+#   scripts/lint.sh --select determinism,layering hbbft_tpu/protocols
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m hbbft_tpu.analysis "$@"

@@ -1,0 +1,38 @@
+"""Tier-1 gate: the checked-in tree passes badgerlint.
+
+This is the test the CI actually leans on — every invariant the rule
+suite encodes (protocol determinism, ordered emission, jit sync
+discipline, limb dtype bounds, layer map, event schema) holds over
+``hbbft_tpu/`` itself, modulo the reviewed baseline.  A PR that
+introduces a violation fails here with the exact ``path:line:
+[rule] message`` rendering in the assertion.
+"""
+
+import os
+
+from hbbft_tpu.analysis import Baseline, all_rules, lint_paths
+from hbbft_tpu.analysis.cli import DEFAULT_BASELINE
+
+PACKAGE_DIR = os.path.dirname(DEFAULT_BASELINE).rsplit(os.sep, 1)[0]
+
+
+def test_package_tree_lints_clean():
+    violations, errors = lint_paths([PACKAGE_DIR], all_rules())
+    assert errors == [], "\n".join(errors)
+    baseline = Baseline.load(DEFAULT_BASELINE)
+    new, _baselined = baseline.split(violations)
+    assert new == [], "\n".join(v.render() for v in new)
+
+
+def test_baseline_entries_still_fire():
+    """Every baseline entry must still match a live violation —
+    otherwise the fix landed and the entry is stale cover for the next
+    regression."""
+    violations, _ = lint_paths([PACKAGE_DIR], all_rules())
+    live = {v.key() for v in violations}
+    stale = [
+        e
+        for e in Baseline.load(DEFAULT_BASELINE).entries
+        if (e["rule"], e["path"], e["message"]) not in live
+    ]
+    assert stale == [], f"stale baseline entries: {stale}"

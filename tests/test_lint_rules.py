@@ -1,0 +1,452 @@
+"""Per-rule fixture tests for badgerlint (``hbbft_tpu/analysis/``).
+
+Each rule is demonstrated by a minimal source fixture that trips it
+under a pretend package-relative path, plus a near-identical clean
+variant that does not — so a rule that silently stops firing (or
+starts over-firing) fails here, not in a production trace.  The
+suppression comment, the baseline round-trip, and the CLI surface are
+exercised the same way.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from hbbft_tpu.analysis import (
+    Baseline,
+    Violation,
+    all_rules,
+    lint_source,
+)
+from hbbft_tpu.analysis.cli import main as cli_main
+
+RULES = all_rules()
+
+
+def _lint(source, relpath, select=None):
+    rules = RULES
+    if select is not None:
+        rules = [r for r in RULES if r.name == select]
+        assert rules, f"no such rule: {select}"
+    return lint_source(textwrap.dedent(source), relpath, rules)
+
+
+def _names(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_flags_unseeded_rng_and_clocks():
+    src = """
+        import random, time, os, uuid
+
+        class Algo:
+            def __init__(self):
+                self.rng = random.Random()
+
+            def handle_message(self, sender, msg):
+                now = time.time()
+                tag = uuid.uuid4()
+                noise = os.urandom(8)
+                key = id(msg)
+                return now, tag, noise, key
+    """
+    vs = _lint(src, "protocols/fixture.py", select="determinism")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 5
+    assert "unseeded random.Random()" in msgs
+    assert "time.time" in msgs
+    assert "uuid.uuid4" in msgs
+    assert "os.urandom" in msgs
+    assert "id() is address-derived" in msgs
+
+
+def test_determinism_allows_seeded_and_injected_rng():
+    src = """
+        import random
+
+        class Algo:
+            def __init__(self, netinfo, rng=None):
+                self.rng = rng or netinfo.default_rng("algo")
+                self.aux = random.Random(42)
+    """
+    assert _lint(src, "protocols/fixture.py", select="determinism") == []
+
+
+def test_determinism_flags_global_random_helpers():
+    src = """
+        import random
+
+        def pick(xs):
+            return random.choice(xs)
+    """
+    vs = _lint(src, "core/fixture.py", select="determinism")
+    assert len(vs) == 1
+    assert "ambient-seeded global RNG" in vs[0].message
+
+
+def test_determinism_scope_excludes_harness():
+    src = "import time\nx = time.time()\n"
+    assert _lint(src, "harness/fixture.py", select="determinism") == []
+
+
+# ---------------------------------------------------------------------------
+# ordered-iter
+# ---------------------------------------------------------------------------
+
+
+def test_ordered_iter_flags_bare_set_iteration():
+    src = """
+        class Algo:
+            def __init__(self):
+                self.pending = set()
+
+            def flush(self, step):
+                for nid in self.pending:
+                    step.send_to(nid, "x")
+    """
+    vs = _lint(src, "protocols/fixture.py", select="ordered-iter")
+    assert len(vs) == 1
+    assert "set-typed 'self.pending'" in vs[0].message
+    assert "emitting path" in vs[0].message
+
+
+def test_ordered_iter_sorted_wrapper_is_clean():
+    src = """
+        class Algo:
+            def __init__(self):
+                self.pending = set()
+
+            def flush(self, step):
+                for nid in sorted(self.pending):
+                    step.send_to(nid, "x")
+    """
+    assert _lint(src, "protocols/fixture.py", select="ordered-iter") == []
+
+
+def test_ordered_iter_dict_keys_only_on_emitting_paths():
+    src = """
+        def tally(counts):
+            return [counts[k] for k in counts.keys()]
+
+        def emit(counts, step):
+            for k in counts.keys():
+                step.send_all(k)
+    """
+    vs = _lint(src, "protocols/fixture.py", select="ordered-iter")
+    assert len(vs) == 1
+    assert "dict.keys()" in vs[0].message
+    assert vs[0].line > 4  # the emitting function, not the tally
+
+
+def test_ordered_iter_scope_excludes_ops():
+    src = "def f(s: set):\n    return [x for x in s]\n"
+    assert _lint(src, "ops/fixture.py", select="ordered-iter") == []
+
+
+# ---------------------------------------------------------------------------
+# device-sync
+# ---------------------------------------------------------------------------
+
+
+def test_device_sync_flags_sync_inside_decorated_jit():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            n = int(x)
+            h = np.asarray(x)
+            return x.sum().item() + n + h
+    """
+    vs = _lint(src, "ops/fixture.py", select="device-sync")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 3
+    assert ".item() forces a device sync" in msgs
+    assert "np.asarray materializes" in msgs
+    assert "int() on a (possibly traced) value" in msgs
+
+
+def test_device_sync_finds_jit_wrap_sites():
+    src = """
+        import jax
+
+        def kernel(x):
+            return float(x)
+
+        kernel_j = jax.jit(kernel)
+    """
+    vs = _lint(src, "harness/fixture.py", select="device-sync")
+    assert len(vs) == 1
+    assert "float()" in vs[0].message
+
+
+def test_device_sync_allows_shape_arithmetic_and_plain_functions():
+    src = """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            n = int(x.shape[0])
+            m = float(len(x.shape))
+            return x * n * m
+
+        def host_helper(x):
+            return int(x)  # not a jit region
+    """
+    assert _lint(src, "ops/fixture.py", select="device-sync") == []
+
+
+# ---------------------------------------------------------------------------
+# dtype-width
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_width_requires_preferred_element_type():
+    src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def mul(a, b):
+            good = lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            bad = lax.dot_general(a, b, (((1,), (0,)), ((), ())))
+            worse = jnp.einsum("ij,jk->ik", a, b)
+            return good, bad, worse
+    """
+    vs = _lint(src, "ops/limbs.py", select="dtype-width")
+    assert len(vs) == 2
+    assert all("preferred_element_type" in v.message for v in vs)
+
+
+def test_dtype_width_flags_narrow_product_and_overflowing_constant():
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(a, b):
+            wraps = a.astype(jnp.uint8) * b.astype(jnp.uint8)
+            mask = np.int8(300)
+            ok = jnp.array(255, dtype=jnp.uint8)
+            neg = jnp.array(-128, dtype=jnp.int8)
+            return wraps, mask, ok, neg
+    """
+    vs = _lint(src, "ops/fr_jax.py", select="dtype-width")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 2
+    assert "uint8×uint8 narrow casts" in msgs
+    assert "constant 300 does not fit declared dtype int8" in msgs
+
+
+def test_dtype_width_scope_is_limb_modules_only():
+    src = "import jax.numpy as jnp\nx = jnp.einsum('ij,jk->ik', 1, 2)\n"
+    assert _lint(src, "harness/fixture.py", select="dtype-width") == []
+
+
+# ---------------------------------------------------------------------------
+# layering
+# ---------------------------------------------------------------------------
+
+
+def test_layering_flags_upward_imports():
+    src = """
+        from ..harness import batching
+        from hbbft_tpu.transport import tcp
+    """
+    vs = _lint(src, "ops/fixture.py", select="layering")
+    assert len(vs) == 2
+    assert "must not import layer 'harness'" in vs[0].message
+    assert "must not import layer 'transport'" in vs[1].message
+
+
+def test_layering_resolves_relative_imports():
+    src = """
+        from ..core.step import Step
+        from ..crypto import threshold
+        from . import agreement
+    """
+    # legal from protocols/ (core + crypto + self are all allowed)
+    assert _lint(src, "protocols/fixture.py", select="layering") == []
+    # the SAME source under obs/ trips twice: obs imports nothing
+    vs = _lint(src, "obs/fixture.py", select="layering")
+    assert len(vs) == 2
+    assert all("must not import layer" in v.message for v in vs)
+
+
+def test_layering_root_package_from_import_uses_alias_names():
+    src = "from .. import harness\n"
+    vs = _lint(src, "protocols/fixture.py", select="layering")
+    assert len(vs) == 1
+    assert "'harness'" in vs[0].message
+
+
+def test_layering_external_imports_unconstrained():
+    src = "import numpy\nfrom typing import Any\n"
+    assert _lint(src, "obs/fixture.py", select="layering") == []
+
+
+# ---------------------------------------------------------------------------
+# obs-schema
+# ---------------------------------------------------------------------------
+
+
+def test_obs_schema_flags_unknown_event_and_fields():
+    src = """
+        def f(rec):
+            rec.event("no_such_event", x=1)
+            rec.event("epoch_start", epoch=1, vt=0.5, bogus=2)
+            rec.event("epoch_start", epoch=1)  # vt missing
+    """
+    vs = _lint(src, "harness/fixture.py", select="obs-schema")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 3
+    assert "unknown event type 'no_such_event'" in msgs
+    assert "field 'bogus' is not in the schema" in msgs
+    assert "missing required field(s) vt" in msgs
+
+
+def test_obs_schema_accepts_valid_and_open_events():
+    src = """
+        def f(rec, extra):
+            rec.event("epoch_start", epoch=1, vt=0.5)
+            rec.event("span", name="x", dur=0.1, depth=0, anything="goes")
+            rec.event("flush", queued=1, shipped=1, real=1, inline=0, dur=0.2)
+            rec.event("epoch", **extra)  # splat: named subset only
+    """
+    assert _lint(src, "harness/fixture.py", select="obs-schema") == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_comment_silences_one_rule():
+    flagged = "import time\nx = time.time()\n"
+    same_line = "import time\nx = time.time()  # lint: ok(determinism)\n"
+    line_above = (
+        "import time\n# lint: ok(determinism)\nx = time.time()\n"
+    )
+    wildcard = "import time\nx = time.time()  # lint: ok(*)\n"
+    other_rule = "import time\nx = time.time()  # lint: ok(layering)\n"
+    rel = "protocols/fixture.py"
+    assert len(_lint(flagged, rel, select="determinism")) == 1
+    assert _lint(same_line, rel, select="determinism") == []
+    assert _lint(line_above, rel, select="determinism") == []
+    assert _lint(wildcard, rel, select="determinism") == []
+    assert len(_lint(other_rule, rel, select="determinism")) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    v1 = Violation("determinism", "protocols/a.py", 3, 0, "msg one")
+    v2 = Violation("layering", "ops/b.py", 9, 4, "msg two")
+    bl = Baseline.from_violations([v1, v2], "legacy, tracked in ROADMAP")
+    path = tmp_path / "baseline.json"
+    bl.save(str(path))
+    loaded = Baseline.load(str(path))
+    assert loaded.covers(v1) and loaded.covers(v2)
+    # line/col excluded from identity: a moved violation stays covered
+    moved = Violation("determinism", "protocols/a.py", 77, 8, "msg one")
+    assert loaded.covers(moved)
+    new, old = loaded.split([moved, Violation("x", "y.py", 1, 0, "fresh")])
+    assert [v.message for v in new] == ["fresh"]
+    assert [v.message for v in old] == ["msg one"]
+
+
+def test_baseline_rejects_missing_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "entries": [
+                    {"rule": "r", "path": "p.py", "message": "m", "justification": ""}
+                ],
+            }
+        )
+    )
+    with pytest.raises(ValueError, match="justification"):
+        Baseline.load(str(path))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _write_pkg_file(tmp_path, rel, source):
+    """Materialize a fixture under a fake hbbft_tpu/ package root so
+    the CLI's path → package-relative mapping applies the scoped rules."""
+    f = tmp_path / "hbbft_tpu" / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    dirty = _write_pkg_file(
+        tmp_path, "protocols/fixture.py", "import time\nx = time.time()\n"
+    )
+    rc = cli_main(["--json", "--no-baseline", str(dirty)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["ok"] is False
+    assert out["counts"] == {"determinism": 1}
+    assert out["violations"][0]["path"] == "protocols/fixture.py"
+
+    clean = _write_pkg_file(tmp_path, "protocols/clean.py", "x = 1\n")
+    assert cli_main(["--json", "--no-baseline", str(clean)]) == 0
+    assert json.loads(capsys.readouterr().out)["ok"] is True
+
+
+def test_cli_baseline_write_then_pass(tmp_path, capsys):
+    dirty = _write_pkg_file(
+        tmp_path, "protocols/fixture.py", "import time\nx = time.time()\n"
+    )
+    bl = tmp_path / "baseline.json"
+    assert (
+        cli_main(
+            [
+                "--write-baseline",
+                "known legacy clock read",
+                "--baseline",
+                str(bl),
+                str(dirty),
+            ]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    # with the baseline: clean exit; without: violation again
+    assert cli_main(["--baseline", str(bl), str(dirty)]) == 0
+    assert "1 baselined" in capsys.readouterr().out
+    assert cli_main(["--no-baseline", str(dirty)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_select_unknown_rule_is_usage_error(tmp_path, capsys):
+    f = _write_pkg_file(tmp_path, "core/x.py", "x = 1\n")
+    assert cli_main(["--select", "nope", str(f)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_module_entry_point():
+    """``python -m hbbft_tpu.analysis --list-rules`` works end to end."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "hbbft_tpu.analysis", "--list-rules"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0
+    for rule in RULES:
+        assert rule.name in proc.stdout
