@@ -4,7 +4,9 @@
 
     python -m hbbft_tpu.analysis [paths...]          # human output
     python -m hbbft_tpu.analysis --json [paths...]   # CI / pre-commit
+    python -m hbbft_tpu.analysis --format sarif      # PR annotations
     python -m hbbft_tpu.analysis --write-baseline    # re-baseline (reviewed!)
+    python -m hbbft_tpu.analysis --write-wire-manifest  # pin @wire registry
 
 Exit codes: 0 clean (baselined violations allowed), 1 new violations
 or parse errors, 2 usage error.
@@ -20,6 +22,7 @@ from typing import List, Optional
 
 from .core import Baseline, Violation, lint_paths
 from .rules import all_rules
+from .rules.wire_stability import DEFAULT_MANIFEST, build_manifest, write_manifest
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
@@ -39,6 +42,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "paths", nargs="*", help="files/dirs to lint (default: the package)"
     )
     parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument(
+        "--format",
+        choices=("human", "json", "sarif"),
+        default=None,
+        help="output format (--json is shorthand for --format json)",
+    )
+    parser.add_argument(
+        "--manifest",
+        default=DEFAULT_MANIFEST,
+        help="wire manifest file (default: the checked-in one)",
+    )
+    parser.add_argument(
+        "--write-wire-manifest",
+        action="store_true",
+        help="regenerate the @wire golden manifest from the scanned "
+        "paths and exit 0",
+    )
     parser.add_argument(
         "--baseline",
         default=DEFAULT_BASELINE,
@@ -63,12 +83,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--list-rules", action="store_true", help="list rules and exit"
     )
     args = parser.parse_args(argv)
+    fmt = args.format or ("json" if args.json else "human")
 
     rules = all_rules()
     if args.list_rules:
         for r in rules:
             print(f"{r.name:14s} {r.description}")
         return 0
+    for r in rules:
+        if r.name == "wire-stability":
+            r.manifest_path = args.manifest
     if args.select:
         wanted = {s.strip() for s in args.select.split(",")}
         unknown = wanted - {r.name for r in rules}
@@ -82,6 +106,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not os.path.exists(p):
             print(f"no such path: {p}", file=sys.stderr)
             return 2
+
+    if args.write_wire_manifest:
+        manifest = build_manifest(paths)
+        write_manifest(manifest, args.manifest)
+        print(
+            f"wrote {len(manifest['types'])} wire type(s) and "
+            f"{len(manifest['primitive_tags'])} primitive tag(s) to "
+            f"{args.manifest}"
+        )
+        return 0
 
     violations, errors = lint_paths(paths, rules)
 
@@ -99,7 +133,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline = Baseline.load(args.baseline)
     new, baselined = baseline.split(violations)
 
-    if args.json:
+    if fmt == "json":
         print(
             json.dumps(
                 {
@@ -113,6 +147,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 indent=2,
             )
         )
+    elif fmt == "sarif":
+        print(json.dumps(_sarif(new, errors, rules), indent=2))
     else:
         for v in new:
             print(v.render())
@@ -135,3 +171,65 @@ def _counts(violations: List[Violation]) -> dict:
     for v in violations:
         counts[v.rule] = counts.get(v.rule, 0) + 1
     return counts
+
+
+def _sarif(new: List[Violation], errors: List[str], rules) -> dict:
+    """SARIF 2.1.0 — the minimal subset GitHub code scanning renders
+    as inline PR annotations."""
+    results = [
+        {
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": v.path},
+                        "region": {
+                            "startLine": max(v.line, 1),
+                            "startColumn": v.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for v in new
+    ]
+    for e in errors:
+        path, _, msg = e.partition(": ")
+        results.append(
+            {
+                "ruleId": "parse-error",
+                "level": "error",
+                "message": {"text": msg or e},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": path},
+                            "region": {"startLine": 1, "startColumn": 1},
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "badgerlint",
+                        "rules": [
+                            {
+                                "id": r.name,
+                                "shortDescription": {"text": r.description},
+                            }
+                            for r in rules
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }

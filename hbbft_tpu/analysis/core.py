@@ -93,7 +93,17 @@ class FileContext:
 class Rule:
     """Base class: subclasses set ``name``, ``description``, ``scope``
     (package-relative path prefixes; empty tuple = every file) and
-    implement :meth:`check`."""
+    implement :meth:`check`.
+
+    Whole-project rules additionally override :meth:`begin_run` /
+    :meth:`finish_run`: ``begin_run`` resets any cross-file state
+    before a lint run, ``check`` accumulates per-file facts, and
+    ``finish_run`` yields the violations only visible once every file
+    has been seen (e.g. a wire type present in the golden manifest but
+    found in no scanned module).  ``finish_run`` violations have no
+    enclosing source line, so ``# lint: ok`` cannot silence them — the
+    baseline is the only escape hatch.
+    """
 
     name: str = ""
     description: str = ""
@@ -104,6 +114,13 @@ class Rule:
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:  # pragma: no cover
         raise NotImplementedError
+
+    def begin_run(self) -> None:
+        """Reset cross-file state (start of a lint run)."""
+
+    def finish_run(self) -> Iterable[Violation]:
+        """Project-level violations, after every file was checked."""
+        return ()
 
     def violation(
         self, ctx: FileContext, node: ast.AST, message: str
@@ -198,14 +215,9 @@ class Baseline:
 # ---------------------------------------------------------------------------
 
 
-def lint_source(
-    source: str,
-    relpath: str,
-    rules: Sequence[Rule],
-) -> List[Violation]:
-    """Lint one in-memory source blob under a pretend package-relative
-    path (the fixture-test entry point)."""
-    ctx = FileContext(relpath, source)
+def _check_ctx(ctx: FileContext, rules: Sequence[Rule]) -> List[Violation]:
+    """Per-file portion of a run: every applicable rule over one file,
+    suppression comments honored.  Callers own begin/finish_run."""
     out: List[Violation] = []
     for rule in rules:
         if not rule.applies(ctx):
@@ -213,6 +225,23 @@ def lint_source(
         for v in rule.check(ctx):
             if not ctx.suppressed(v.rule, v.line):
                 out.append(v)
+    return out
+
+
+def lint_source(
+    source: str,
+    relpath: str,
+    rules: Sequence[Rule],
+) -> List[Violation]:
+    """Lint one in-memory source blob under a pretend package-relative
+    path (the fixture-test entry point).  This is a complete run: the
+    whole-project hooks fire around the single file."""
+    ctx = FileContext(relpath, source)
+    for rule in rules:
+        rule.begin_run()
+    out = _check_ctx(ctx, rules)
+    for rule in rules:
+        out.extend(rule.finish_run())
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out
 
@@ -259,12 +288,25 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[Tuple[str, str]]:
 def lint_paths(
     paths: Sequence[str], rules: Sequence[Rule]
 ) -> Tuple[List[Violation], List[str]]:
-    """Lint every file under ``paths`` → (violations, parse_errors)."""
+    """Lint every file under ``paths`` → (violations, parse_errors).
+
+    One whole-project run: ``begin_run`` fires once up front, every
+    file goes through ``check``, and ``finish_run`` fires once at the
+    end so cross-file rules see the full tree before reporting."""
     violations: List[Violation] = []
     errors: List[str] = []
+    for rule in rules:
+        rule.begin_run()
     for abspath, relpath in iter_python_files(paths):
         try:
-            violations.extend(lint_file(abspath, relpath, rules))
+            with tokenize.open(abspath) as fh:
+                source = fh.read()
+            ctx = FileContext(relpath, source)
         except SyntaxError as exc:
             errors.append(f"{relpath}: syntax error: {exc}")
+            continue
+        violations.extend(_check_ctx(ctx, rules))
+    for rule in rules:
+        violations.extend(rule.finish_run())
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations, errors

@@ -14,6 +14,14 @@
 ``layering``              the SURVEY layer map's import direction
 ``obs-schema``            every ``recorder.event(...)`` call site matches
                           the stable JSONL schema (``obs/schema.py``)
+``step-purity``           DistAlgorithm ``handle_*`` dataflow: effects
+                          (outputs, messages, faults) flow only through
+                          the returned ``Step``
+``wire-stability``        the ``@wire`` registry matches the golden
+                          ``wire_manifest.json`` — tags and field orders
+                          are append-only
+``pallas-shape``          ``pl.pallas_call`` BlockSpecs tile the padded
+                          array shapes; index maps stay in bounds
 ========================  ==================================================
 """
 
@@ -28,6 +36,9 @@ from .dtype_width import DtypeWidthRule
 from .layering import LayeringRule
 from .obs_schema import ObsSchemaRule
 from .ordering import OrderedIterRule
+from .pallas_shape import PallasShapeRule
+from .step_purity import StepPurityRule
+from .wire_stability import WireStabilityRule
 
 
 def all_rules() -> List[Rule]:
@@ -39,4 +50,7 @@ def all_rules() -> List[Rule]:
         DtypeWidthRule(),
         LayeringRule(),
         ObsSchemaRule(),
+        StepPurityRule(),
+        WireStabilityRule(),
+        PallasShapeRule(),
     ]

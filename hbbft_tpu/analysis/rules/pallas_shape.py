@@ -1,0 +1,474 @@
+"""``pallas-shape`` — symbolic shape/grid checking of pallas_call sites.
+
+A mis-sized ``BlockSpec`` in ``ops/pallas_ec.py`` does not fail at the
+call site: Mosaic compiles the kernel minutes later (or loads a stale
+cached executable) and either pads silently — corrupting limb math —
+or dies deep inside the compiler with no source location.  This rule
+evaluates every ``pl.pallas_call`` site symbolically at lint time:
+
+- ``grid=`` and ``out_shape=`` must be present;
+- every ``BlockSpec`` index map takes exactly one argument per grid
+  axis and (when the block rank is known) returns one index per block
+  axis;
+- where block and array shapes evaluate to concrete ints, the block
+  must divide the array dim, a grid-mapped axis must tile it exactly
+  (``grid × block == dim`` — the power-of-two padding helpers produce
+  exactly-covering padded shapes), and a constant index must keep the
+  block in bounds.
+
+The evaluator is deliberately partial: int/tuple literals, tuple
+concat/repeat arithmetic, ``len``/``tuple``/slicing, ``jnp.zeros``-
+style constructors, ``jax.ShapeDtypeStruct``, ``x.shape`` of a known
+array, and locally-defined ``spec(...)`` helper functions returning
+``BlockSpec`` (including index maps chosen by an ``if``-expression on
+a known flag).  Anything it cannot evaluate is skipped, never guessed
+— the real kernels' runtime-shaped calls pass the structural checks
+while fully-concrete fixtures (and regressions that hard-code a bad
+block) are decidable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name
+
+
+class _Unknown:
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "<?>"
+
+
+UNKNOWN = _Unknown()
+
+
+class _GridVar:
+    """The index-map parameter for one grid axis."""
+
+    def __init__(self, axis: int):
+        self.axis = axis
+
+
+_ARRAY_CTORS = {"zeros", "ones", "empty", "full", "arange"}
+
+
+def _is_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+class _Env:
+    """Name → symbolic value; array shapes under ``name + '.shape'``."""
+
+    def __init__(self, parent: Optional["_Env"] = None):
+        self.vars: Dict[str, object] = {}
+        self.parent = parent
+
+    def get(self, name: str):
+        env: Optional[_Env] = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        return UNKNOWN
+
+    def set(self, name: str, value) -> None:
+        self.vars[name] = value
+
+
+def _eval(node: ast.AST, env: _Env):
+    """Partial evaluation → int | tuple | _GridVar | UNKNOWN."""
+    if isinstance(node, ast.Constant):
+        if _is_int(node.value) or isinstance(node.value, bool):
+            return node.value
+        return UNKNOWN
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.Tuple):
+        return tuple(_eval(e, env) for e in node.elts)
+    if isinstance(node, ast.Attribute):
+        if node.attr == "shape" and isinstance(node.value, ast.Name):
+            return env.get(node.value.id + ".shape")
+        return UNKNOWN
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _eval(node.operand, env)
+        return -v if _is_int(v) else UNKNOWN
+    if isinstance(node, ast.BinOp):
+        left, right = _eval(node.left, env), _eval(node.right, env)
+        if isinstance(node.op, ast.Add):
+            if isinstance(left, tuple) and isinstance(right, tuple):
+                return left + right
+            if _is_int(left) and _is_int(right):
+                return left + right
+        elif isinstance(node.op, ast.Mult):
+            if isinstance(left, tuple) and _is_int(right):
+                return left * right
+            if _is_int(left) and isinstance(right, tuple):
+                return right * left
+            if _is_int(left) and _is_int(right):
+                return left * right
+        elif _is_int(left) and _is_int(right):
+            try:
+                if isinstance(node.op, ast.Sub):
+                    return left - right
+                if isinstance(node.op, ast.FloorDiv):
+                    return left // right
+                if isinstance(node.op, ast.Mod):
+                    return left % right
+                if isinstance(node.op, ast.Pow):
+                    return left**right
+                if isinstance(node.op, ast.LShift):
+                    return left << right
+            except (ZeroDivisionError, ValueError):
+                return UNKNOWN
+        return UNKNOWN
+    if isinstance(node, ast.Subscript):
+        base = _eval(node.value, env)
+        if not isinstance(base, tuple):
+            return UNKNOWN
+        sl = node.slice
+        if isinstance(sl, ast.Slice):
+            lo = _eval(sl.lower, env) if sl.lower else 0
+            hi = _eval(sl.upper, env) if sl.upper else len(base)
+            if _is_int(lo) and _is_int(hi) and sl.step is None:
+                return base[lo:hi]
+            return UNKNOWN
+        idx = _eval(sl, env)
+        if _is_int(idx) and -len(base) <= idx < len(base):
+            return base[idx]
+        return UNKNOWN
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf == "tuple" and len(node.args) == 1:
+            v = _eval(node.args[0], env)
+            return v if isinstance(v, tuple) else UNKNOWN
+        if leaf == "len" and len(node.args) == 1:
+            v = _eval(node.args[0], env)
+            return len(v) if isinstance(v, tuple) else UNKNOWN
+        if leaf in _ARRAY_CTORS and node.args:
+            shape = _eval(node.args[0], env)
+            if _is_int(shape):
+                return (shape,)
+            return shape if isinstance(shape, tuple) else UNKNOWN
+        if leaf == "ShapeDtypeStruct" and node.args:
+            v = _eval(node.args[0], env)
+            return v if isinstance(v, tuple) else UNKNOWN
+        return UNKNOWN
+    if isinstance(node, ast.IfExp):
+        cond = _eval(node.test, env)
+        if cond is True or (_is_int(cond) and cond):
+            return _eval(node.body, env)
+        if cond is False or cond == 0:
+            return _eval(node.orelse, env)
+        return UNKNOWN
+    return UNKNOWN
+
+
+def _build_env(fn: ast.AST, env: _Env) -> None:
+    """Fold simple assignments (in line order) into ``env``; array
+    constructor results record their shape under ``name.shape``."""
+    assigns = [
+        n
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Assign) and len(n.targets) == 1
+    ]
+    for a in sorted(assigns, key=lambda n: n.lineno):
+        t = a.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        value = a.value
+        if isinstance(value, (ast.Lambda, ast.IfExp)) and _contains_lambda(value):
+            env.set(t.id + ".lambda", value)
+            continue
+        v = _eval(value, env)
+        if isinstance(value, ast.Call):
+            leaf = (dotted_name(value.func) or "").rsplit(".", 1)[-1]
+            if leaf in _ARRAY_CTORS and isinstance(v, tuple):
+                env.set(t.id + ".shape", v)
+                continue
+        env.set(t.id, v)
+        # booleans for IfExp index-map selection
+        if isinstance(value, ast.Constant) and isinstance(value.value, bool):
+            env.set(t.id, value.value)
+
+
+def _contains_lambda(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Lambda) for n in ast.walk(node))
+
+
+def _resolve_lambdas(node: ast.AST, env: _Env) -> List[ast.Lambda]:
+    """The candidate index-map lambdas an expression can denote: a
+    lambda literal, a name bound to one, or an if-expression over
+    lambdas (both branches when the flag is unknown)."""
+    if isinstance(node, ast.Lambda):
+        return [node]
+    if isinstance(node, ast.Name):
+        bound = env.get(node.id + ".lambda")
+        if isinstance(bound, ast.AST):
+            return _resolve_lambdas(bound, env)
+        return []
+    if isinstance(node, ast.IfExp):
+        cond = _eval(node.test, env)
+        if cond is True or (_is_int(cond) and cond):
+            return _resolve_lambdas(node.body, env)
+        if cond is False or cond == 0:
+            return _resolve_lambdas(node.orelse, env)
+        return _resolve_lambdas(node.body, env) + _resolve_lambdas(
+            node.orelse, env
+        )
+    return []
+
+
+class PallasShapeRule(Rule):
+    name = "pallas-shape"
+    description = (
+        "pl.pallas_call BlockSpecs: index-map arity matches the grid, "
+        "blocks divide (and grid-mapped axes exactly tile) the padded "
+        "array shapes"
+    )
+    scope = ("ops/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        module_env = _Env()
+        _build_env(ctx.tree, module_env)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = [
+                n
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Call)
+                and (dotted_name(n.func) or "").rsplit(".", 1)[-1]
+                == "pallas_call"
+            ]
+            if not calls:
+                continue
+            env = _Env(module_env)
+            _build_env(fn, env)
+            helpers = {
+                s.name: s for s in ast.walk(fn) if isinstance(s, ast.FunctionDef)
+            }
+            for call in calls:
+                yield from self._check_site(ctx, fn, call, env, helpers)
+
+    # -- one pallas_call ---------------------------------------------------
+
+    def _check_site(
+        self,
+        ctx: FileContext,
+        fn: ast.AST,
+        call: ast.Call,
+        env: _Env,
+        helpers: Dict[str, ast.FunctionDef],
+    ) -> Iterable[Violation]:
+        kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+        if "grid" not in kwargs:
+            yield self.violation(
+                ctx, call, "pallas_call without grid= — block tiling is implicit"
+            )
+            return
+        if "out_shape" not in kwargs:
+            yield self.violation(
+                ctx, call, "pallas_call without out_shape= — output block unchecked"
+            )
+            return
+        grid = _eval(kwargs["grid"], env)
+        if _is_int(grid):
+            grid = (grid,)
+        grid_rank = len(grid) if isinstance(grid, tuple) else None
+
+        # arrays fed to the compiled kernel: pallas_call(...)(a, b, c)
+        arg_shapes = self._runtime_arg_shapes(ctx, call, env)
+        out_shape = _eval(kwargs["out_shape"], env)
+        if not isinstance(out_shape, tuple):
+            out_shape = UNKNOWN
+
+        specs: List[Tuple[ast.AST, object, list, object]] = []
+        in_specs = kwargs.get("in_specs")
+        if isinstance(in_specs, (ast.List, ast.Tuple)):
+            for i, expr in enumerate(in_specs.elts):
+                resolved = self._resolve_spec(expr, env, helpers)
+                if resolved is not None:
+                    shape = (
+                        arg_shapes[i]
+                        if arg_shapes is not None and i < len(arg_shapes)
+                        else UNKNOWN
+                    )
+                    specs.append((expr, resolved[0], resolved[1], shape))
+        out_spec = kwargs.get("out_specs")
+        if out_spec is not None:
+            resolved = self._resolve_spec(out_spec, env, helpers)
+            if resolved is not None:
+                specs.append((out_spec, resolved[0], resolved[1], out_shape))
+
+        for node, block, index_maps, shape in specs:
+            yield from self._check_spec(
+                ctx, node, block, index_maps, shape, grid, grid_rank, env
+            )
+
+    def _runtime_arg_shapes(self, ctx: FileContext, call: ast.Call, env: _Env):
+        """Shapes of the arrays the wrapped kernel is applied to, when
+        the pallas_call expression is immediately called."""
+        for parent in ast.walk(ctx.tree):
+            if isinstance(parent, ast.Call) and parent.func is call:
+                shapes = []
+                for a in parent.args:
+                    if isinstance(a, ast.Name):
+                        shapes.append(env.get(a.id + ".shape"))
+                    else:
+                        shapes.append(UNKNOWN)
+                return shapes
+        return None
+
+    def _resolve_spec(
+        self, expr: ast.AST, env: _Env, helpers: Dict[str, ast.FunctionDef]
+    ):
+        """→ (block_value, [index-map lambdas]) or None if opaque."""
+        if not isinstance(expr, ast.Call):
+            return None
+        name = dotted_name(expr.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf == "BlockSpec":
+            block = _eval(expr.args[0], env) if expr.args else UNKNOWN
+            maps = (
+                [(lam, env) for lam in _resolve_lambdas(expr.args[1], env)]
+                if len(expr.args) > 1
+                else []
+            )
+            return block, maps
+        helper = helpers.get(name)
+        if helper is None:
+            return None
+        # bind the helper's parameters to call-site values
+        henv = _Env(env)
+        params = [a.arg for a in helper.args.args]
+        defaults = helper.args.defaults
+        for p, d in zip(params[len(params) - len(defaults) :], defaults):
+            v = _eval(d, henv)
+            henv.set(p, d.value if isinstance(d, ast.Constant) else v)
+        for p, a in zip(params, expr.args):
+            if isinstance(a, ast.Constant):
+                henv.set(p, a.value)
+            else:
+                henv.set(p, _eval(a, env))
+        for kw in expr.keywords:
+            if kw.arg in params:
+                if isinstance(kw.value, ast.Constant):
+                    henv.set(kw.arg, kw.value.value)
+                else:
+                    henv.set(kw.arg, _eval(kw.value, env))
+        _build_env(helper, henv)
+        for sub in ast.walk(helper):
+            if isinstance(sub, ast.Return) and isinstance(sub.value, ast.Call):
+                rleaf = (dotted_name(sub.value.func) or "").rsplit(".", 1)[-1]
+                if rleaf == "BlockSpec" and sub.value.args:
+                    block = _eval(sub.value.args[0], henv)
+                    maps = (
+                        [
+                            (lam, henv)
+                            for lam in _resolve_lambdas(sub.value.args[1], henv)
+                        ]
+                        if len(sub.value.args) > 1
+                        else []
+                    )
+                    return block, maps
+        return None
+
+    def _check_spec(
+        self,
+        ctx: FileContext,
+        node: ast.AST,
+        block,
+        index_maps: List[Tuple[ast.Lambda, _Env]],
+        shape,
+        grid,
+        grid_rank: Optional[int],
+        env: _Env,
+    ) -> Iterable[Violation]:
+        block_rank = len(block) if isinstance(block, tuple) else None
+
+        for lam, lam_env in index_maps:
+            arity = len(lam.args.args)
+            if grid_rank is not None and arity != grid_rank:
+                yield self.violation(
+                    ctx,
+                    node,
+                    f"index_map takes {arity} arg(s) but the grid has "
+                    f"rank {grid_rank}",
+                )
+                continue
+            lenv = _Env(lam_env)
+            for axis, a in enumerate(lam.args.args):
+                lenv.set(a.arg, _GridVar(axis))
+            idx = _eval(lam.body, lenv)
+            if not isinstance(idx, tuple):
+                continue
+            if block_rank is not None and len(idx) != block_rank:
+                yield self.violation(
+                    ctx,
+                    node,
+                    f"index_map returns {len(idx)} index/indices for a "
+                    f"rank-{block_rank} block",
+                )
+                continue
+            yield from self._check_coverage(
+                ctx, node, idx, block, shape, grid
+            )
+
+        if not index_maps:
+            # no index map to locate axes; still check divisibility
+            yield from self._check_coverage(ctx, node, None, block, shape, grid)
+
+    def _check_coverage(
+        self, ctx: FileContext, node: ast.AST, idx, block, shape, grid
+    ) -> Iterable[Violation]:
+        if not isinstance(block, tuple) or not isinstance(shape, tuple):
+            return
+        if len(block) != len(shape):
+            yield self.violation(
+                ctx,
+                node,
+                f"block rank {len(block)} != array rank {len(shape)}",
+            )
+            return
+        for axis in range(len(block)):
+            b, s = block[axis], shape[axis]
+            if not _is_int(b) or not _is_int(s):
+                continue
+            if b <= 0 or s <= 0:
+                continue
+            if s % b != 0:
+                yield self.violation(
+                    ctx,
+                    node,
+                    f"block dim {b} does not divide array dim {s} "
+                    f"(axis {axis}) — Mosaic pads the remainder tile "
+                    "silently",
+                )
+                continue
+            entry = idx[axis] if isinstance(idx, tuple) and axis < len(idx) else None
+            if isinstance(entry, _GridVar):
+                g = (
+                    grid[entry.axis]
+                    if isinstance(grid, tuple) and entry.axis < len(grid)
+                    else UNKNOWN
+                )
+                if _is_int(g) and g * b != s:
+                    yield self.violation(
+                        ctx,
+                        node,
+                        f"grid axis {entry.axis} × block ({g}×{b}="
+                        f"{g * b}) does not tile array dim {s} "
+                        f"(axis {axis}) — pad to a power-of-two bucket "
+                        "first",
+                    )
+            elif _is_int(entry) and entry != 0:
+                if (entry + 1) * b > s:
+                    yield self.violation(
+                        ctx,
+                        node,
+                        f"constant index {entry} puts the block out of "
+                        f"bounds on axis {axis} (block {b}, dim {s})",
+                    )

@@ -1,0 +1,383 @@
+"""``step-purity`` — handler effects flow only through the returned Step.
+
+The deterministic core contract (``core/step.py``): a
+``DistAlgorithm.handle_*`` method may mutate its *own* state (``self``)
+and must report every observable effect — outputs, outgoing messages,
+fault attributions — in the :class:`Step` it returns.  The caller
+delivers messages; the handler never touches a transport, never writes
+caller-visible state, and never mutates its arguments (incoming
+messages are shared between the router and other recipients in the
+simulated network — an in-place edit corrupts peers).
+
+This is a dataflow pass, scoped to classes whose AST bases name
+``DistAlgorithm``: ``SyncKeyGen`` and other helper classes with
+out-parameter conventions are deliberately out of scope.  Inside each
+``handle_*`` method it flags:
+
+- mutation of a parameter (attribute/subscript stores, ``del``,
+  augmented assigns, or known mutator-method calls rooted at a
+  parameter or a local aliasing one);
+- writes to module-level state (``global``/``nonlocal`` declarations,
+  stores rooted at a module-level binding);
+- direct transport / IO calls (names imported from
+  ``hbbft_tpu.transport``, ``socket`` methods, ``print``/``open``);
+- returns that are not step-shaped: every explicit ``return`` must
+  produce a Step (constructor/classmethod, a Step-classified local, a
+  ``self._helper(...)`` result, or a combinator chain on one) and a
+  bare ``return``/``return None`` drops the implicit empty Step.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name
+
+# In-place mutators on containers and Steps.  Calling one of these on
+# an argument-derived value leaks effects outside the returned Step.
+_MUTATORS = frozenset(
+    {
+        "append",
+        "extend",
+        "add",
+        "update",
+        "insert",
+        "setdefault",
+        "pop",
+        "popitem",
+        "remove",
+        "discard",
+        "clear",
+        "sort",
+        "reverse",
+        "merge",
+        # Step combinators — fine on a fresh Step, not on a caller's.
+        "extend_with",
+        "add_fault",
+        "send_all",
+        "send_to",
+    }
+)
+
+_TRANSPORT_CALLS = frozenset(
+    {"send", "sendall", "sendto", "recv", "recvfrom", "connect", "bind", "listen", "accept"}
+)
+
+
+def _base_names(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for b in cls.bases:
+        name = dotted_name(b)
+        if name:
+            out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The leftmost Name of an Attribute/Subscript/Name chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _module_level_names(tree: ast.Module) -> Set[str]:
+    """Names bound at module top level (mutable-state write targets)."""
+    names: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _transport_imports(tree: ast.Module) -> Set[str]:
+    """Local names bound by importing from the transport layer (or the
+    socket module itself)."""
+    names: Set[str] = set()
+    for stmt in ast.walk(tree):
+        if isinstance(stmt, ast.ImportFrom):
+            mod = stmt.module or ""
+            if "transport" in mod.split(".") or mod == "socket":
+                for a in stmt.names:
+                    names.add(a.asname or a.name)
+        elif isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                if a.name == "socket" or "transport" in a.name.split("."):
+                    names.add((a.asname or a.name).split(".")[0])
+    return names
+
+
+class StepPurityRule(Rule):
+    name = "step-purity"
+    description = (
+        "DistAlgorithm handle_* effects flow only through the returned "
+        "Step: no argument mutation, module-state writes, transport "
+        "calls, or non-Step returns"
+    )
+    scope = ("protocols/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        module_names = _module_level_names(ctx.tree)
+        transport_names = _transport_imports(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if "DistAlgorithm" not in _base_names(node):
+                continue
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name.startswith(
+                    "handle_"
+                ):
+                    yield from self._check_handler(
+                        ctx, item, module_names, transport_names
+                    )
+
+    # -- one handler -------------------------------------------------------
+
+    def _check_handler(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef,
+        module_names: Set[str],
+        transport_names: Set[str],
+    ) -> Iterable[Violation]:
+        params = {a.arg for a in fn.args.args if a.arg != "self"}
+        params.update(a.arg for a in fn.args.kwonlyargs)
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+
+        tainted, step_like = self._classify_locals(fn, params)
+
+        def is_tainted(root: Optional[str]) -> bool:
+            return root is not None and (root in params or root in tainted)
+
+        for sub in ast.walk(fn):
+            # (a) global / nonlocal escape hatches
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(sub, ast.Global) else "nonlocal"
+                yield self.violation(
+                    ctx,
+                    sub,
+                    f"{fn.name} declares '{kw} {', '.join(sub.names)}' — "
+                    "handler effects must flow through the returned Step",
+                )
+                continue
+
+            # (b) stores through attributes/subscripts of non-self roots
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                )
+                for t in targets:
+                    yield from self._check_store(
+                        ctx, fn, t, is_tainted, module_names
+                    )
+            elif isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    yield from self._check_store(
+                        ctx, fn, t, is_tainted, module_names, verb="deletes"
+                    )
+
+            # (c) mutator-method calls on tainted roots; transport calls
+            elif isinstance(sub, ast.Call):
+                yield from self._check_call(
+                    ctx, fn, sub, is_tainted, transport_names
+                )
+
+        # (d) every explicit return is step-shaped
+        for ret in ast.walk(fn):
+            if isinstance(ret, ast.Return) and self._in_function(fn, ret):
+                yield from self._check_return(ctx, fn, ret, step_like)
+
+    @staticmethod
+    def _in_function(fn: ast.FunctionDef, node: ast.AST) -> bool:
+        """Exclude returns belonging to nested defs/lambdas."""
+        for sub in ast.walk(fn):
+            if sub is fn:
+                continue
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                for inner in ast.walk(sub):
+                    if inner is node:
+                        return False
+        return True
+
+    def _classify_locals(
+        self, fn: ast.FunctionDef, params: Set[str]
+    ) -> "tuple[Set[str], Set[str]]":
+        """→ (tainted locals aliasing a parameter, Step-classified
+        locals).  Flow-insensitive single pass in line order: a name
+        assigned from a bare param chain is tainted; one assigned from
+        a Step constructor, a ``self`` method call, or a call on an
+        existing Step local is step-like.  Call results are fresh —
+        ``list(msg.votes)`` copies."""
+        tainted: Set[str] = set()
+        step_like: Set[str] = set()
+        assigns = sorted(
+            (n for n in ast.walk(fn) if isinstance(n, (ast.Assign, ast.AnnAssign))),
+            key=lambda n: n.lineno,
+        )
+        for a in assigns:
+            value = a.value
+            if value is None:  # bare annotation
+                continue
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            # tuple targets: taint conservatively from a param chain RHS
+            for t in targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(
+                        e.id for e in t.elts if isinstance(e, ast.Name)
+                    )
+            if not names:
+                continue
+            if self._is_step_expr(value, step_like):
+                step_like.update(names)
+            elif not isinstance(value, ast.Call):
+                root = _root_name(value)
+                if root is not None and (root in params or root in tainted):
+                    tainted.update(names)
+                elif root in step_like:
+                    step_like.update(names)
+        return tainted, step_like
+
+    @staticmethod
+    def _is_step_expr(value: ast.AST, step_like: Set[str]) -> bool:
+        """Step constructor / classmethod, ``self._helper(...)``, or a
+        combinator call on a step-like value."""
+        if not isinstance(value, ast.Call):
+            return False
+        name = dotted_name(value.func)
+        if name is None:
+            return False
+        head = name.split(".", 1)[0]
+        return (
+            head == "Step"
+            or head == "self"
+            or head in step_like
+        )
+
+    def _check_store(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef,
+        target: ast.AST,
+        is_tainted,
+        module_names: Set[str],
+        verb: str = "writes",
+    ) -> Iterable[Violation]:
+        if not isinstance(target, (ast.Attribute, ast.Subscript)):
+            return
+        root = _root_name(target)
+        if root is None or root == "self":
+            return
+        if is_tainted(root):
+            yield self.violation(
+                ctx,
+                target,
+                f"{fn.name} {verb} through argument-derived '{root}' — "
+                "incoming messages are shared; report effects via the "
+                "returned Step",
+            )
+        elif root in module_names:
+            yield self.violation(
+                ctx,
+                target,
+                f"{fn.name} {verb} module-level state '{root}' — "
+                "caller-visible state outside self breaks replay "
+                "determinism",
+            )
+
+    def _check_call(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef,
+        call: ast.Call,
+        is_tainted,
+        transport_names: Set[str],
+    ) -> Iterable[Violation]:
+        name = dotted_name(call.func)
+        if name is None:
+            return
+        parts = name.split(".")
+        root, leaf = parts[0], parts[-1]
+        if root in transport_names or (
+            len(parts) > 1 and root == "socket"
+        ):
+            yield self.violation(
+                ctx,
+                call,
+                f"{fn.name} calls transport API '{name}' — handlers "
+                "emit messages via the returned Step; the caller "
+                "delivers them",
+            )
+            return
+        if len(parts) == 1 and leaf in ("print", "open"):
+            yield self.violation(
+                ctx,
+                call,
+                f"{fn.name} calls {leaf}() — side-channel IO inside a "
+                "deterministic handler",
+            )
+            return
+        if len(parts) > 1 and leaf in _TRANSPORT_CALLS and root != "self":
+            yield self.violation(
+                ctx,
+                call,
+                f"{fn.name} calls socket-style '{name}' — handlers "
+                "never touch a transport; the caller delivers Step "
+                "messages",
+            )
+            return
+        if len(parts) > 1 and leaf in _MUTATORS and is_tainted(root):
+            yield self.violation(
+                ctx,
+                call,
+                f"{fn.name} mutates argument-derived '{root}' via "
+                f".{leaf}() — incoming messages are shared; report "
+                "effects via the returned Step",
+            )
+
+    def _check_return(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef,
+        ret: ast.Return,
+        step_like: Set[str],
+    ) -> Iterable[Violation]:
+        value = ret.value
+        if value is None or (
+            isinstance(value, ast.Constant) and value.value is None
+        ):
+            yield self.violation(
+                ctx,
+                ret,
+                f"{fn.name} returns None — return an (empty) Step so "
+                "the caller can deliver messages and faults",
+            )
+            return
+        if isinstance(value, ast.Name) and value.id in step_like:
+            return
+        if self._is_step_expr(value, step_like):
+            return
+        if isinstance(value, ast.IfExp):
+            yield from self._check_return(
+                ctx, fn, ast.Return(value=value.body, lineno=ret.lineno, col_offset=ret.col_offset), step_like
+            )
+            yield from self._check_return(
+                ctx, fn, ast.Return(value=value.orelse, lineno=ret.lineno, col_offset=ret.col_offset), step_like
+            )
+            return
+        yield self.violation(
+            ctx,
+            ret,
+            f"{fn.name} returns a non-Step value — handler results "
+            "flow through Step.output, not the return slot",
+        )

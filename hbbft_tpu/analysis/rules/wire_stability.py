@@ -1,0 +1,362 @@
+"""``wire-stability`` — the ``@wire`` registry evolves append-only.
+
+Votes and DKG messages are *signed over their serialization*
+(``core/serialize.py``): a renamed wire tag, a removed type, or a
+reordered field list silently breaks decode (and signature checks) of
+every byte already on the wire between versions.  This rule pins the
+registry to a checked-in golden manifest,
+``hbbft_tpu/analysis/wire_manifest.json``, regenerated explicitly via
+``python -m hbbft_tpu.analysis --write-wire-manifest`` so every schema
+change shows up as a reviewable manifest diff.
+
+Statically (no imports — a broken tree still lints) it extracts, per
+file, every ``@wire("Name")`` class with its field order: dataclass
+annotation order, or the ``return (self.a, self.b)`` tuple of a local
+``_wire_fields``.  Classes whose fields aren't statically derivable
+(e.g. ``G1``/``G2`` delegating to a base class) are pinned by name
+only; the runtime round-trip test covers their bytes.  It checks:
+
+- every wire class appears in the manifest (new types ⇒ regenerate);
+- field lists match the manifest exactly — renames/removals/reorders
+  get a *breaking* diagnostic, pure appends a *regenerate* one;
+- the primitive ``_TAG_*`` byte table in ``core/serialize.py`` is
+  append-only: a removed or renumbered tag byte is flagged, as is a
+  duplicate byte value;
+- (``finish_run``) a manifest type whose recorded module was scanned
+  but which no scanned file still declares ⇒ removed/renamed.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import FileContext, Rule, Violation, iter_python_files
+from ._ast_util import dotted_name
+
+MANIFEST_NAME = "wire_manifest.json"
+DEFAULT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), MANIFEST_NAME
+)
+SERIALIZE_MODULE = "core/serialize.py"
+
+
+# ---------------------------------------------------------------------------
+# Static extraction (shared by the rule and --write-wire-manifest)
+# ---------------------------------------------------------------------------
+
+
+def _wire_name(cls: ast.ClassDef) -> Optional[str]:
+    """The ``"Name"`` of a ``@wire("Name")`` decorator, if present."""
+    for deco in cls.decorator_list:
+        if (
+            isinstance(deco, ast.Call)
+            and (dotted_name(deco.func) or "").rsplit(".", 1)[-1] == "wire"
+            and deco.args
+            and isinstance(deco.args[0], ast.Constant)
+            and isinstance(deco.args[0].value, str)
+        ):
+            return deco.args[0].value
+    return None
+
+
+def _is_dataclass(cls: ast.ClassDef) -> bool:
+    for deco in cls.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if (dotted_name(target) or "").rsplit(".", 1)[-1] == "dataclass":
+            return True
+    return False
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> List[str]:
+    """Annotated names in body order — dataclasses serialize in exactly
+    this order (``serialize.py`` iterates ``dataclasses.fields``)."""
+    out = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            ann = dotted_name(stmt.annotation) or ""
+            if ann.rsplit(".", 1)[-1] == "ClassVar":
+                continue
+            out.append(stmt.target.id)
+    return out
+
+
+def _custom_fields(cls: ast.ClassDef) -> Optional[List[str]]:
+    """If the class body defines ``_wire_fields`` returning a plain
+    tuple of ``self.x`` attributes, those attribute names in order;
+    None when the field list isn't statically derivable."""
+    for stmt in cls.body:
+        if isinstance(stmt, ast.FunctionDef) and stmt.name == "_wire_fields":
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Return) and isinstance(
+                    sub.value, ast.Tuple
+                ):
+                    names = []
+                    for e in sub.value.elts:
+                        if (
+                            isinstance(e, ast.Attribute)
+                            and isinstance(e.value, ast.Name)
+                            and e.value.id == "self"
+                        ):
+                            names.append(e.attr)
+                        else:
+                            return None
+                    return names
+            return None
+    return None
+
+
+def extract_wire_classes(tree: ast.Module) -> List[Dict[str, object]]:
+    """Every ``@wire`` class in one module: ``{name, kind, fields,
+    lineno}`` with ``fields`` None when not statically derivable."""
+    out: List[Dict[str, object]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        name = _wire_name(node)
+        if name is None:
+            continue
+        if _is_dataclass(node):
+            entry = {"kind": "dataclass", "fields": _dataclass_fields(node)}
+        else:
+            entry = {"kind": "custom", "fields": _custom_fields(node)}
+        entry.update(name=name, lineno=node.lineno)
+        out.append(entry)
+    return out
+
+
+def extract_tag_table(tree: ast.Module) -> Dict[str, int]:
+    """Module-level ``_TAG_* = b"\\x.."`` assignments → byte values."""
+    tags: Dict[str, int] = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        t = stmt.targets[0]
+        if (
+            isinstance(t, ast.Name)
+            and t.id.startswith("_TAG_")
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, bytes)
+            and len(stmt.value.value) == 1
+        ):
+            tags[t.id] = stmt.value.value[0]
+    return tags
+
+
+def build_manifest(paths: Sequence[str]) -> Dict[str, object]:
+    """Scan ``paths`` and build the golden manifest dict."""
+    types: Dict[str, Dict[str, object]] = {}
+    primitive_tags: Dict[str, int] = {}
+    for abspath, relpath in iter_python_files(paths):
+        with open(abspath, "r") as fh:
+            try:
+                tree = ast.parse(fh.read())
+            except SyntaxError:
+                continue
+        if relpath == SERIALIZE_MODULE:
+            primitive_tags = extract_tag_table(tree)
+        for entry in extract_wire_classes(tree):
+            types[str(entry["name"])] = {
+                "module": relpath,
+                "kind": entry["kind"],
+                "fields": entry["fields"],
+            }
+    return {
+        "version": 1,
+        "serialize_module": SERIALIZE_MODULE,
+        "primitive_tags": dict(sorted(primitive_tags.items(), key=lambda kv: kv[1])),
+        "types": {k: types[k] for k in sorted(types)},
+    }
+
+
+def write_manifest(manifest: Dict[str, object], path: str) -> None:
+    with open(path, "w") as fh:
+        json.dump(manifest, fh, indent=2)
+        fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# The rule
+# ---------------------------------------------------------------------------
+
+
+class WireStabilityRule(Rule):
+    name = "wire-stability"
+    description = (
+        "@wire registry matches the golden wire_manifest.json: tags and "
+        "field orders are append-only (regenerate with "
+        "--write-wire-manifest)"
+    )
+    # every package layer (wire types live in crypto/, protocols/,
+    # core/, harness/ today) — but NOT tests/examples linted from the
+    # repo root, whose throwaway @wire fixtures are manifest-exempt
+    scope = (
+        "core/",
+        "crypto/",
+        "protocols/",
+        "harness/",
+        "ops/",
+        "transport/",
+        "obs/",
+        "analysis/",
+        "parallel/",
+        "native/",
+    )
+
+    def __init__(self, manifest: Optional[Dict[str, object]] = None):
+        self.manifest = manifest
+        self.manifest_path = DEFAULT_MANIFEST
+        self._seen: Set[str] = set()
+        self._scanned_modules: Set[str] = set()
+
+    def _load(self) -> Optional[Dict[str, object]]:
+        if self.manifest is None:
+            if not os.path.exists(self.manifest_path):
+                return None
+            with open(self.manifest_path, "r") as fh:
+                self.manifest = json.load(fh)
+        return self.manifest
+
+    def begin_run(self) -> None:
+        self._seen = set()
+        self._scanned_modules = set()
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        manifest = self._load()
+        if manifest is None:
+            return
+        self._scanned_modules.add(ctx.relpath)
+        types: Dict[str, Dict[str, object]] = manifest.get("types", {})  # type: ignore[assignment]
+
+        if ctx.relpath == manifest.get("serialize_module", SERIALIZE_MODULE):
+            yield from self._check_tags(ctx, manifest)
+
+        for entry in extract_wire_classes(ctx.tree):
+            name = str(entry["name"])
+            node = _Anchor(int(entry["lineno"]))
+            if name in self._seen:
+                yield self.violation(
+                    ctx,
+                    node,
+                    f"wire tag {name!r} declared more than once in the "
+                    "scanned tree — decode is ambiguous",
+                )
+                continue
+            self._seen.add(name)
+            pinned = types.get(name)
+            if pinned is None:
+                yield self.violation(
+                    ctx,
+                    node,
+                    f"wire type {name!r} is not in {MANIFEST_NAME} — "
+                    "regenerate with --write-wire-manifest",
+                )
+                continue
+            yield from self._check_fields(ctx, node, name, pinned, entry)
+
+    def _check_tags(
+        self, ctx: FileContext, manifest: Dict[str, object]
+    ) -> Iterable[Violation]:
+        pinned: Dict[str, int] = manifest.get("primitive_tags", {})  # type: ignore[assignment]
+        live = extract_tag_table(ctx.tree)
+        anchor = _Anchor(1)
+        for tag_name, byte in sorted(pinned.items(), key=lambda kv: kv[1]):
+            if tag_name not in live:
+                yield self.violation(
+                    ctx,
+                    anchor,
+                    f"primitive tag {tag_name} (byte 0x{byte:02x}) removed"
+                    " — the tag table is append-only",
+                )
+            elif live[tag_name] != byte:
+                yield self.violation(
+                    ctx,
+                    anchor,
+                    f"primitive tag {tag_name} renumbered "
+                    f"0x{byte:02x} → 0x{live[tag_name]:02x} — existing "
+                    "wires decode through the old byte",
+                )
+        by_byte: Dict[int, str] = {}
+        for tag_name in sorted(live):
+            byte = live[tag_name]
+            if byte in by_byte:
+                yield self.violation(
+                    ctx,
+                    anchor,
+                    f"primitive tags {by_byte[byte]} and {tag_name} share "
+                    f"byte 0x{byte:02x}",
+                )
+            else:
+                by_byte[byte] = tag_name
+
+    def _check_fields(
+        self,
+        ctx: FileContext,
+        node: "_Anchor",
+        name: str,
+        pinned: Dict[str, object],
+        entry: Dict[str, object],
+    ) -> Iterable[Violation]:
+        want = pinned.get("fields")
+        have = entry["fields"]
+        if want is None:
+            return  # pinned by name only (custom class, opaque fields)
+        if have is None:
+            yield self.violation(
+                ctx,
+                node,
+                f"wire type {name!r}: field list no longer statically "
+                f"derivable (manifest pins {want!r})",
+            )
+            return
+        assert isinstance(want, list)
+        have = list(have)  # type: ignore[arg-type]
+        if have == want:
+            return
+        if have[: len(want)] == want:
+            appended = ", ".join(have[len(want) :])
+            yield self.violation(
+                ctx,
+                node,
+                f"wire type {name!r} appended field(s) {appended} — "
+                "regenerate the manifest with --write-wire-manifest",
+            )
+        else:
+            yield self.violation(
+                ctx,
+                node,
+                f"wire type {name!r} field order changed incompatibly: "
+                f"manifest {want!r} vs source {have!r} — renames/"
+                "removals/reorders break decode of signed bytes",
+            )
+
+    def finish_run(self) -> Iterable[Violation]:
+        manifest = self._load()
+        if manifest is None:
+            return
+        types: Dict[str, Dict[str, object]] = manifest.get("types", {})  # type: ignore[assignment]
+        for name in sorted(types):
+            pinned = types[name]
+            module = str(pinned.get("module", ""))
+            if module in self._scanned_modules and name not in self._seen:
+                yield Violation(
+                    rule=self.name,
+                    path=module,
+                    line=1,
+                    col=0,
+                    message=(
+                        f"wire type {name!r} removed or renamed (was in "
+                        f"{module}) — decode of existing bytes will fail; "
+                        "the registry is append-only"
+                    ),
+                )
+
+
+class _Anchor:
+    """A minimal lineno/col carrier for Rule.violation()."""
+
+    def __init__(self, lineno: int, col_offset: int = 0):
+        self.lineno = lineno
+        self.col_offset = col_offset

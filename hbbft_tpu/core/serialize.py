@@ -74,8 +74,16 @@ def wire(name: str):
             def from_fields(*vals):
                 return cls._from_wire(*vals)
 
-        if name in _BY_NAME:
-            raise ValueError(f"wire tag {name!r} already registered")
+        if name in _BY_NAME and _BY_NAME[name][0] is not cls:
+            raise SerializationError(
+                f"wire tag {name!r} already registered to "
+                f"{_BY_NAME[name][0].__name__}"
+            )
+        if cls in _BY_CLASS and _BY_CLASS[cls][0] != name:
+            raise SerializationError(
+                f"{cls.__name__} already registered as wire tag "
+                f"{_BY_CLASS[cls][0]!r}"
+            )
         _BY_CLASS[cls] = (name, to_fields, from_fields)
         _BY_NAME[name] = (cls, from_fields)
         return cls

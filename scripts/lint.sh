@@ -4,6 +4,44 @@
 #   scripts/lint.sh
 #   scripts/lint.sh --json
 #   scripts/lint.sh --select determinism,layering hbbft_tpu/protocols
-set -euo pipefail
+#   scripts/lint.sh --changed            # only files in git diff (pre-commit)
+#   LINT_LOG=/tmp/lint.log scripts/lint.sh
+set -uo pipefail
 cd "$(dirname "$0")/.."
-exec python -m hbbft_tpu.analysis "$@"
+
+changed=0
+args=()
+for a in "$@"; do
+  if [ "$a" = "--changed" ]; then
+    changed=1
+  else
+    args+=("$a")
+  fi
+done
+
+targets=()
+if [ "$changed" = 1 ]; then
+  # staged + unstaged python files still on disk
+  while IFS= read -r f; do
+    [ -f "$f" ] && targets+=("$f")
+  done < <(
+    { git diff --name-only HEAD -- '*.py'
+      git diff --cached --name-only -- '*.py'; } | sort -u
+  )
+  if [ "${#targets[@]}" -eq 0 ]; then
+    echo "lint: no changed python files"
+    exit 0
+  fi
+fi
+
+# Under pipefail, ${PIPESTATUS[0]} is the lint's own exit code even
+# when the output is piped through tee — the old `exec` form lost it
+# as soon as a log pipe was added.
+if [ -n "${LINT_LOG:-}" ]; then
+  python -m hbbft_tpu.analysis "${args[@]+"${args[@]}"}" \
+    "${targets[@]+"${targets[@]}"}" 2>&1 | tee "$LINT_LOG"
+  exit "${PIPESTATUS[0]}"
+fi
+python -m hbbft_tpu.analysis "${args[@]+"${args[@]}"}" \
+  "${targets[@]+"${targets[@]}"}"
+exit $?

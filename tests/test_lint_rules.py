@@ -327,6 +327,345 @@ def test_obs_schema_accepts_valid_and_open_events():
 
 
 # ---------------------------------------------------------------------------
+# step-purity
+# ---------------------------------------------------------------------------
+
+
+def test_step_purity_flags_impure_handler_effects():
+    src = """
+        CACHE = {}
+
+        class Algo(DistAlgorithm):
+            def handle_message(self, sender_id, msg):
+                msg.seen = True
+                msg.votes.append(sender_id)
+                CACHE[sender_id] = msg
+                print("got", msg)
+                return None
+    """
+    vs = _lint(src, "protocols/fixture.py", select="step-purity")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 5
+    assert "writes through argument-derived 'msg'" in msgs
+    assert "mutates argument-derived 'msg' via .append()" in msgs
+    assert "writes module-level state 'CACHE'" in msgs
+    assert "calls print()" in msgs
+    assert "returns None" in msgs
+
+
+def test_step_purity_flags_transport_calls_and_aliased_mutation():
+    src = """
+        import socket
+        from ..transport.tcp import send_frame
+
+        class Algo(DistAlgorithm):
+            def handle_message(self, sender_id, msg):
+                votes = msg.votes
+                votes.append(sender_id)
+                send_frame(sender_id, msg)
+                sock = socket.socket()
+                sock.sendall(b"x")
+                return Step()
+    """
+    vs = _lint(src, "protocols/fixture.py", select="step-purity")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 4
+    assert "mutates argument-derived 'votes'" in msgs
+    assert "transport API 'send_frame'" in msgs
+    assert "transport API 'socket.socket'" in msgs
+    assert "socket-style 'sock.sendall'" in msgs
+
+
+def test_step_purity_clean_handler_and_combinators():
+    src = """
+        class Algo(DistAlgorithm):
+            def handle_message(self, sender_id, msg):
+                if msg.bad:
+                    return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
+                self.received[sender_id] = msg
+                votes = list(msg.votes)
+                step: Step = Step()
+                step.send_all(msg)
+                step.extend(self._flush(votes))
+                return step
+
+            def handle_input(self, value):
+                return self._propose(value)
+
+            def _flush(self, votes):
+                return Step()
+    """
+    assert _lint(src, "protocols/fixture.py", select="step-purity") == []
+
+
+def test_step_purity_scope_is_dist_algorithms_only():
+    """SyncKeyGen-style helpers keep their out-parameter convention."""
+    src = """
+        class SyncKeyGen:
+            def handle_part(self, sender_id, part, faults):
+                faults.append(sender_id)
+                return None
+    """
+    assert _lint(src, "protocols/fixture.py", select="step-purity") == []
+    # and the same class IS flagged once it claims to be a DistAlgorithm
+    src2 = src.replace("class SyncKeyGen:", "class SyncKeyGen(DistAlgorithm):")
+    assert len(_lint(src2, "protocols/fixture.py", select="step-purity")) == 2
+
+
+def test_step_purity_suppression_and_baseline():
+    src = """
+        class Algo(DistAlgorithm):
+            def handle_message(self, sender_id, msg):
+                msg.seen = True  # lint: ok(step-purity)
+                return Step()
+    """
+    assert _lint(src, "protocols/fixture.py", select="step-purity") == []
+    flagged = _lint(
+        src.replace("  # lint: ok(step-purity)", ""),
+        "protocols/fixture.py",
+        select="step-purity",
+    )
+    assert len(flagged) == 1
+    bl = Baseline.from_violations(flagged, "legacy handler, tracked")
+    assert bl.split(flagged) == ([], flagged)
+
+
+# ---------------------------------------------------------------------------
+# wire-stability
+# ---------------------------------------------------------------------------
+
+
+WIRE_SRC = """
+    import dataclasses
+    from ..core.serialize import wire
+
+    @wire("Vote")
+    @dataclasses.dataclass(frozen=True)
+    class Vote:
+        change: object
+        era: int
+        num: int
+"""
+
+
+def _wire_manifest(fields=("change", "era", "num"), types=None):
+    all_types = {
+        "Vote": {
+            "module": "protocols/fixture.py",
+            "kind": "dataclass",
+            "fields": list(fields),
+        }
+    }
+    if types is not None:
+        all_types = types
+    return {
+        "version": 1,
+        "serialize_module": "core/serialize.py",
+        "primitive_tags": {"_TAG_NONE": 0, "_TAG_STR": 6},
+        "types": all_types,
+    }
+
+
+def _wire_lint(src, relpath, manifest):
+    from hbbft_tpu.analysis.rules.wire_stability import WireStabilityRule
+
+    return lint_source(
+        textwrap.dedent(src), relpath, [WireStabilityRule(manifest=manifest)]
+    )
+
+
+def test_wire_stability_matching_manifest_is_clean():
+    assert _wire_lint(WIRE_SRC, "protocols/fixture.py", _wire_manifest()) == []
+
+
+def test_wire_stability_flags_reorder_and_append():
+    reordered = _wire_lint(
+        WIRE_SRC, "protocols/fixture.py", _wire_manifest(("era", "change", "num"))
+    )
+    assert len(reordered) == 1
+    assert "field order changed incompatibly" in reordered[0].message
+
+    appended = _wire_lint(
+        WIRE_SRC, "protocols/fixture.py", _wire_manifest(("change", "era"))
+    )
+    assert len(appended) == 1
+    assert "appended field(s) num" in appended[0].message
+    assert "--write-wire-manifest" in appended[0].message
+
+
+def test_wire_stability_flags_type_deleted_from_manifest():
+    """Deleting a tag from the manifest (or adding a type without
+    regenerating) fails the lint."""
+    vs = _wire_lint(WIRE_SRC, "protocols/fixture.py", _wire_manifest(types={}))
+    assert len(vs) == 1
+    assert "not in wire_manifest.json" in vs[0].message
+
+
+def test_wire_stability_flags_removed_type_via_finish_run():
+    manifest = _wire_manifest(
+        types={
+            "Gone": {
+                "module": "protocols/fixture.py",
+                "kind": "dataclass",
+                "fields": ["x"],
+            }
+        }
+    )
+    vs = _wire_lint("x = 1\n", "protocols/fixture.py", manifest)
+    assert len(vs) == 1
+    assert "'Gone' removed or renamed" in vs[0].message
+    # a module the run never scanned stays un-flagged
+    assert _wire_lint("x = 1\n", "protocols/other.py", manifest) == []
+
+
+def test_wire_stability_primitive_tag_table_append_only():
+    src = """
+        _TAG_NONE = b"\\x01"
+        _TAG_LIST = b"\\x07"
+    """
+    vs = _wire_lint(src, "core/serialize.py", _wire_manifest(types={}))
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 2
+    assert "renumbered 0x00" in msgs  # _TAG_NONE moved
+    assert "_TAG_STR (byte 0x06) removed" in msgs
+    clean = """
+        _TAG_NONE = b"\\x00"
+        _TAG_STR = b"\\x06"
+        _TAG_NEW = b"\\x0b"
+    """
+    assert _wire_lint(clean, "core/serialize.py", _wire_manifest(types={})) == []
+
+
+def test_wire_stability_checked_in_manifest_matches_tree():
+    """The default rule instance (checked-in manifest) over the real
+    package: regeneration drift fails here before CI's tree gate."""
+    import os
+
+    from hbbft_tpu.analysis.cli import DEFAULT_BASELINE
+    from hbbft_tpu.analysis.rules.wire_stability import (
+        DEFAULT_MANIFEST,
+        build_manifest,
+    )
+
+    assert os.path.exists(DEFAULT_MANIFEST)
+    pkg = os.path.dirname(DEFAULT_BASELINE).rsplit(os.sep, 1)[0]
+    built = build_manifest([pkg])
+    with open(DEFAULT_MANIFEST) as fh:
+        assert json.load(fh) == built
+
+
+# ---------------------------------------------------------------------------
+# pallas-shape
+# ---------------------------------------------------------------------------
+
+
+def _pallas_src(block="(2, 128)", grid="(4,)", out="(8, 128)"):
+    return f"""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(kernel, x):
+            block = {block}
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct({out}, jnp.int32),
+                grid={grid},
+                in_specs=[pl.BlockSpec(block, lambda g: (g, 0))],
+                out_specs=pl.BlockSpec(block, lambda g: (g, 0)),
+            )(x)
+    """
+
+
+def test_pallas_shape_exact_tiling_is_clean():
+    assert _lint(_pallas_src(), "ops/fixture.py", select="pallas-shape") == []
+
+
+def test_pallas_shape_flags_shrunk_block_and_non_divisor():
+    # grid 4 × block 1 covers only 4 of the 8 output rows
+    vs = _lint(
+        _pallas_src(block="(1, 128)"), "ops/fixture.py", select="pallas-shape"
+    )
+    assert len(vs) == 1
+    assert "4×1=4) does not tile array dim 8" in vs[0].message
+
+    # block 3 does not divide dim 8 at all
+    vs = _lint(
+        _pallas_src(block="(3, 128)", grid="(2,)"),
+        "ops/fixture.py",
+        select="pallas-shape",
+    )
+    assert len(vs) == 1
+    assert "block dim 3 does not divide array dim 8" in vs[0].message
+
+
+def test_pallas_shape_flags_arity_and_missing_grid():
+    src = _pallas_src().replace("lambda g:", "lambda g, h:")
+    vs = _lint(src, "ops/fixture.py", select="pallas-shape")
+    assert len(vs) == 2  # both specs
+    assert all("takes 2 arg(s) but the grid has rank 1" in v.message for v in vs)
+
+    src = """
+        from jax.experimental import pallas as pl
+
+        def run(kernel, x):
+            return pl.pallas_call(kernel, out_shape=None)(x)
+    """
+    vs = _lint(src, "ops/fixture.py", select="pallas-shape")
+    assert len(vs) == 1
+    assert "without grid=" in vs[0].message
+
+
+def test_pallas_shape_resolves_spec_helper_functions():
+    """The ``spec()`` closure idiom from ops/pallas_ec.py, fully
+    concrete: the tiled index map evaluates through the helper."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(kernel, x):
+            G = 2
+            T = 128
+            block = (1, T)
+
+            def spec(blk, tiled=True):
+                index_map = (
+                    (lambda g: (g,) + (0,) * (len(blk) - 1))
+                    if tiled
+                    else (lambda g: (0,) * len(blk))
+                )
+                return pl.BlockSpec(blk, index_map)
+
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((4, T), jnp.int32),
+                grid=(G,),
+                in_specs=[spec(block)],
+                out_specs=spec(block),
+            )(x)
+    """
+    vs = _lint(src, "ops/fixture.py", select="pallas-shape")
+    assert len(vs) == 1  # out_spec: 2×1 covers 2 of 4 rows
+    assert "2×1=2) does not tile array dim 4" in vs[0].message
+    assert _lint(src.replace("G = 2", "G = 4"), "ops/fixture.py",
+                 select="pallas-shape") == []
+
+
+def test_pallas_shape_scope_and_suppression():
+    bad = _pallas_src(block="(1, 128)")
+    assert _lint(bad, "protocols/fixture.py", select="pallas-shape") == []
+    suppressed = bad.replace(
+        "out_specs=pl.BlockSpec(block, lambda g: (g, 0)),",
+        "out_specs=pl.BlockSpec(block, lambda g: (g, 0)),  # lint: ok(pallas-shape)",
+    )
+    # suppression anchors on the pallas_call line; the violation node
+    # is the out_specs expression — comment goes on its line
+    vs = _lint(suppressed, "ops/fixture.py", select="pallas-shape")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
 
@@ -438,6 +777,90 @@ def test_cli_select_unknown_rule_is_usage_error(tmp_path, capsys):
     f = _write_pkg_file(tmp_path, "core/x.py", "x = 1\n")
     assert cli_main(["--select", "nope", str(f)]) == 2
     capsys.readouterr()
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    dirty = _write_pkg_file(
+        tmp_path, "protocols/fixture.py", "import time\nx = time.time()\n"
+    )
+    rc = cli_main(["--format", "sarif", "--no-baseline", str(dirty)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["version"] == "2.1.0"
+    run = out["runs"][0]
+    assert run["tool"]["driver"]["name"] == "badgerlint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {
+        r.name for r in RULES
+    }
+    (result,) = run["results"]
+    assert result["ruleId"] == "determinism"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "protocols/fixture.py"
+    assert loc["region"]["startLine"] == 2
+
+    clean = _write_pkg_file(tmp_path, "protocols/clean.py", "x = 1\n")
+    assert cli_main(["--format", "sarif", "--no-baseline", str(clean)]) == 0
+    assert json.loads(capsys.readouterr().out)["runs"][0]["results"] == []
+
+
+def test_cli_write_wire_manifest_and_stability_gate(tmp_path, capsys):
+    src = """
+        import dataclasses
+        from ..core.serialize import wire
+
+        @wire("Thing")
+        @dataclasses.dataclass(frozen=True)
+        class Thing:
+            a: int
+            b: bytes
+    """
+    f = _write_pkg_file(tmp_path, "protocols/things.py", src)
+    manifest = tmp_path / "wire_manifest.json"
+    assert (
+        cli_main(
+            ["--write-wire-manifest", "--manifest", str(manifest), str(f)]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    data = json.loads(manifest.read_text())
+    assert data["types"]["Thing"] == {
+        "module": "protocols/things.py",
+        "kind": "dataclass",
+        "fields": ["a", "b"],
+    }
+
+    # in sync → clean; reorder the dataclass fields → lint fails
+    assert (
+        cli_main(
+            ["--no-baseline", "--manifest", str(manifest),
+             "--select", "wire-stability", str(f)]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    _write_pkg_file(
+        tmp_path,
+        "protocols/things.py",
+        """
+        import dataclasses
+        from ..core.serialize import wire
+
+        @wire("Thing")
+        @dataclasses.dataclass(frozen=True)
+        class Thing:
+            b: bytes
+            a: int
+        """,
+    )
+    assert (
+        cli_main(
+            ["--no-baseline", "--manifest", str(manifest),
+             "--select", "wire-stability", str(f)]
+        )
+        == 1
+    )
+    assert "field order changed incompatibly" in capsys.readouterr().out
 
 
 def test_cli_module_entry_point():

@@ -1,0 +1,225 @@
+"""The wire manifest vs the live registry, and byte-identical
+round-trips for every pinned type.
+
+Three layers of defense for the serialization contract (signed
+payloads decode across versions — ``core/serialize.py``):
+
+1. the static lint (``wire-stability``) pins the source to
+   ``hbbft_tpu/analysis/wire_manifest.json``;
+2. this module cross-checks the *live* registry — every manifest type
+   imports, registers under the pinned tag, and (for dataclasses)
+   exposes exactly the pinned field order at runtime;
+3. a curated instance of every manifest type round-trips through
+   ``dumps``/``loads`` byte-identically, so the codec itself can't
+   drift under a type either.
+
+The sample factory is asserted complete against the manifest: adding a
+``@wire`` type without a sample here fails, which is the point — new
+wire formats ship with a pinned byte-level example.
+"""
+
+import dataclasses
+import importlib
+import json
+
+import pytest
+
+from hbbft_tpu.analysis.rules.wire_stability import DEFAULT_MANIFEST
+from hbbft_tpu.core.serialize import (
+    SerializationError,
+    _BY_NAME,
+    dumps,
+    loads,
+    wire,
+)
+
+
+def _manifest():
+    with open(DEFAULT_MANIFEST) as fh:
+        return json.load(fh)
+
+
+def _import_manifest_modules(manifest):
+    for info in manifest["types"].values():
+        importlib.import_module(
+            "hbbft_tpu." + info["module"][: -len(".py")].replace("/", ".")
+        )
+
+
+# ---------------------------------------------------------------------------
+# manifest ↔ live registry
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_matches_live_registry():
+    manifest = _manifest()
+    _import_manifest_modules(manifest)
+    for name, info in manifest["types"].items():
+        assert name in _BY_NAME, f"manifest type {name!r} not registered"
+        cls = _BY_NAME[name][0]
+        if info["kind"] == "dataclass":
+            assert dataclasses.is_dataclass(cls)
+            live = [f.name for f in dataclasses.fields(cls)]
+            assert live == info["fields"], (
+                f"{name}: live field order {live} != manifest "
+                f"{info['fields']}"
+            )
+
+
+def test_manifest_pins_primitive_tag_bytes():
+    from hbbft_tpu.core import serialize
+
+    manifest = _manifest()
+    assert manifest["primitive_tags"], "no primitive tags pinned"
+    for tag_name, byte in manifest["primitive_tags"].items():
+        live = getattr(serialize, tag_name)
+        assert live == bytes([byte]), f"{tag_name}: 0x{byte:02x} != {live!r}"
+
+
+# ---------------------------------------------------------------------------
+# byte-identical round-trips
+# ---------------------------------------------------------------------------
+
+
+def _samples():
+    """One representative instance per wire tag.  Nested fields use
+    real wire objects where the shape matters and small scalars where
+    the codec treats them opaquely."""
+    manifest = _manifest()
+    _import_manifest_modules(manifest)
+    from hbbft_tpu.crypto.curve import G1_GEN, G2_GEN
+    from hbbft_tpu.crypto.merkle import MerkleProof
+    from hbbft_tpu.crypto.poly import BivarCommitment, BivarPoly, Commitment, Poly
+
+    cls = {name: _BY_NAME[name][0] for name in manifest["types"]}
+
+    poly = Poly([3, 1, 4, 1, 5])
+    commitment = Commitment([G2_GEN, G2_GEN.double()])
+    proof = MerkleProof(b"leaf", 1, (b"\x11" * 32, b"\x22" * 32), b"\x33" * 32)
+    vote = cls["Vote"](cls["ChangeAdd"]("node-9", b"pk"), 2, 7)
+    signed_vote = cls["SignedVote"](vote, "node-3", cls["MockSig"](b"\xaa" * 32))
+    dkg_ack = cls["DkgAck"](1, {0: b"row0", 1: b"row1"})
+    dkg_part = cls["DkgPart"](commitment, [b"r0", b"r1"], G1_GEN)
+
+    samples = {
+        # crypto/threshold.py (real BLS objects are curve points)
+        "Sig": cls["Sig"](G1_GEN),
+        "SigShare": cls["SigShare"](G1_GEN.double()),
+        "DecShare": cls["DecShare"](G1_GEN),
+        "Ciphertext": cls["Ciphertext"](G1_GEN, b"\x05" * 16, G2_GEN, G1_GEN),
+        "PublicKey": cls["PublicKey"](G1_GEN, G2_GEN),
+        "SecretKey": cls["SecretKey"](12345),
+        "SecretKeyShare": cls["SecretKeyShare"](67890),
+        "PublicKeyShare": cls["PublicKeyShare"](G2_GEN),
+        "PublicKeySet": cls["PublicKeySet"](commitment, G1_GEN),
+        "SecretKeySet": cls["SecretKeySet"](poly),
+        # crypto/mock.py
+        "MockSig": cls["MockSig"](b"\x01" * 32),
+        "MockSigShare": cls["MockSigShare"](b"\x02" * 32, b"\x03" * 32),
+        "MockDecShare": cls["MockDecShare"](b"\x04" * 32, b"\x05" * 32),
+        "MockCiphertext": cls["MockCiphertext"](
+            b"\x06" * 32, b"\x07" * 16, b"payload", b"\x08" * 32
+        ),
+        "MockPublicKey": cls["MockPublicKey"](b"\x09" * 32),
+        "MockSecretKey": cls["MockSecretKey"](b"\x0a" * 32),
+        "MockSecretKeyShare": cls["MockSecretKeyShare"](b"\x0b" * 32, 4),
+        "MockPublicKeyShare": cls["MockPublicKeyShare"](b"\x0c" * 32, 4),
+        "MockPublicKeySet": cls["MockPublicKeySet"](b"\x0d" * 32, 2),
+        # crypto/poly.py + merkle + curve
+        "Poly": poly,
+        "Commitment": commitment,
+        "BivarPoly": BivarPoly([[1, 2], [3, 4]]),
+        "BivarCommitment": BivarCommitment([[G2_GEN], [G2_GEN.double()]]),
+        "MerkleProof": proof,
+        "G1": G1_GEN,
+        "G2": G2_GEN,
+        # protocols
+        "BoolSet": cls["BoolSet"](2),
+        "SbvBVal": cls["SbvBVal"](True),
+        "SbvAux": cls["SbvAux"](False),
+        "AbaSbv": cls["AbaSbv"](cls["SbvBVal"](True)),
+        "AbaConf": cls["AbaConf"](cls["BoolSet"](3)),
+        "AbaTerm": cls["AbaTerm"](True),
+        "AbaCoin": cls["AbaCoin"](cls["CoinMsg"](cls["MockSigShare"](b"t", b"c"))),
+        "AbaMsg": cls["AbaMsg"](5, cls["AbaTerm"](False)),
+        "CoinMsg": cls["CoinMsg"](cls["MockSigShare"](b"t", b"c")),
+        "BcValue": cls["BcValue"](proof),
+        "BcEcho": cls["BcEcho"](proof),
+        "BcReady": cls["BcReady"](b"\x33" * 32),
+        "CsBc": cls["CsBc"]("node-1", cls["BcReady"](b"\x33" * 32)),
+        "CsAba": cls["CsAba"]("node-1", cls["AbaMsg"](0, cls["AbaTerm"](True))),
+        "HbBatch": cls["HbBatch"](3, {"node-1": b"contrib"}),
+        "HbCs": cls["HbCs"](cls["CsBc"]("node-1", cls["BcReady"](b"\x33" * 32))),
+        "HbDec": cls["HbDec"]("node-2", cls["MockDecShare"](b"t", b"k")),
+        "HbMsg": cls["HbMsg"](3, cls["HbDec"]("n", cls["MockDecShare"](b"t", b"k"))),
+        "Vote": vote,
+        "SignedVote": signed_vote,
+        "ChangeAdd": cls["ChangeAdd"]("node-9", b"pk"),
+        "ChangeRemove": cls["ChangeRemove"]("node-9"),
+        "CsNone": cls["CsNone"](),
+        "CsInProgress": cls["CsInProgress"](cls["ChangeRemove"]("node-9")),
+        "CsComplete": cls["CsComplete"](cls["ChangeAdd"]("node-9", b"pk")),
+        "DkgPart": dkg_part,
+        "DkgAck": dkg_ack,
+        "KgPart": cls["KgPart"](dkg_part),
+        "KgAck": cls["KgAck"](dkg_ack),
+        "SignedKgMsg": cls["SignedKgMsg"](
+            1, "node-0", cls["KgAck"](dkg_ack), cls["MockSig"](b"\xbb" * 32)
+        ),
+        "InternalContrib": cls["InternalContrib"](
+            b"user-payload", (cls["SignedKgMsg"](1, "n", cls["KgAck"](dkg_ack), None),),
+            (signed_vote,),
+        ),
+        "DhbHb": cls["DhbHb"](0, cls["HbBatch"](0, {})),
+        "DhbKeyGen": cls["DhbKeyGen"](1, cls["KgPart"](dkg_part), cls["MockSig"](b"s")),
+        "DhbVote": cls["DhbVote"](signed_vote),
+        "JoinPlan": cls["JoinPlan"](
+            9, cls["CsNone"](), cls["MockPublicKeySet"](b"\x0d" * 32, 2),
+            {"node-0": cls["MockPublicKey"](b"\x09" * 32)},
+        ),
+        # harness
+        "DynContrib": cls["DynContrib"](b"user", (signed_vote,)),
+    }
+    return manifest, samples
+
+
+def test_every_manifest_type_round_trips_byte_identically():
+    manifest, samples = _samples()
+    missing = sorted(set(manifest["types"]) - set(samples))
+    assert missing == [], f"no round-trip sample for: {missing}"
+    extra = sorted(set(samples) - set(manifest["types"]))
+    assert extra == [], f"samples without manifest entry: {extra}"
+    for name, obj in sorted(samples.items()):
+        blob = dumps(obj)
+        back = loads(blob)
+        assert type(back) is type(obj), name
+        assert dumps(back) == blob, f"{name}: re-encode changed bytes"
+
+
+# ---------------------------------------------------------------------------
+# wire() duplicate-registration guard
+# ---------------------------------------------------------------------------
+
+
+def test_wire_rejects_duplicate_tag_name():
+    @wire("_TestDupA")
+    @dataclasses.dataclass(frozen=True)
+    class A:
+        x: int
+
+    with pytest.raises(SerializationError, match="already registered"):
+
+        @wire("_TestDupA")
+        @dataclasses.dataclass(frozen=True)
+        class B:
+            y: int
+
+
+def test_wire_rejects_reregistering_a_class():
+    @dataclasses.dataclass(frozen=True)
+    class C:
+        x: int
+
+    wire("_TestDupC")(C)
+    with pytest.raises(SerializationError, match="already registered as"):
+        wire("_TestDupC2")(C)
