@@ -16,6 +16,16 @@ protocol layer thinks it does.  The rule finds jit regions two ways:
 ``int()``/``float()`` on shape arithmetic (an argument mentioning
 ``.shape``, ``len()``, ``.ndim``) and on literal constants is allowed
 — those are static under tracing.
+
+``ops/staging`` additionally gets a MODULE-WIDE pass: that module is
+the flush pipeline's overlap window (its whole point is to run
+marshalling + non-blocking ``device_put`` dispatch while the caller's
+host work proceeds), so a ``.block_until_ready()`` / ``np.asarray`` /
+``jax.device_get`` anywhere in it — jit or not — stalls exactly the
+overlap it exists to provide.  The one materializing fetch of the
+flush engine lives in ``packed_msm``'s waiter thread, outside the
+window.  ``int()``/``float()`` stay legal there (host marshalling is
+concrete numpy, not traced values).
 """
 
 from __future__ import annotations
@@ -84,6 +94,8 @@ class DeviceSyncRule(Rule):
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
         out: List[Violation] = []
+        if ctx.relpath.startswith("ops/staging"):
+            out.extend(self._check_overlap_module(ctx))
         wrapped = _jit_wrapped_names(ctx.tree)
         for fn in ast.walk(ctx.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -91,6 +103,51 @@ class DeviceSyncRule(Rule):
             if not (_decorated_jit(fn) or fn.name in wrapped):
                 continue
             out.extend(self._check_jit_body(ctx, fn))
+        return out
+
+    def _check_overlap_module(self, ctx: FileContext) -> List[Violation]:
+        """``ops/staging`` is an overlap window, not a jit body: every
+        call there runs between dispatch and the finalizer's fetch, so
+        ANY blocking/materializing call — jit or not — stalls the
+        pipeline the module exists to provide.  ``int()``/``float()``
+        are NOT flagged (staging handles concrete numpy, where they
+        are ordinary host arithmetic, not concretization hazards)."""
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "item",
+                "block_until_ready",
+            ):
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f".{node.func.attr}() blocks the staging overlap "
+                        "window — this module is non-blocking by design",
+                    )
+                )
+            elif name in ("jax.device_get", "device_get"):
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        "jax.device_get blocks the staging overlap window "
+                        "— this module is non-blocking by design",
+                    )
+                )
+            elif name in _NUMPY_SYNC:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f"{name} materializes a device value in the staging "
+                        "overlap window — the flush engine's one blocking "
+                        "fetch lives in packed_msm's waiter thread, not here",
+                    )
+                )
         return out
 
     def _check_jit_body(self, ctx: FileContext, fn: ast.AST) -> List[Violation]:

@@ -26,6 +26,30 @@ from .rs import make_codec
 from . import threshold as T
 
 
+class EagerFinalizer:
+    """Finalizer-protocol wrapper over an already-computed result.
+
+    Every ``*_async`` backend method returns an object satisfying the
+    finalizer protocol: calling it yields the result; ``ready()`` /
+    ``poll()`` report — without blocking — whether calling it would
+    block.  Host backends compute eagerly, so their finalizers are
+    born ready; the device finalizer with a real drain to probe is
+    ``ops.packed_msm.ProductFinalizer``."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self, result):
+        self._result = result
+
+    def __call__(self):
+        return self._result
+
+    def ready(self) -> bool:
+        return True
+
+    poll = ready
+
+
 class CpuBackend:
     """Pure host-side ops backend (the correctness oracle)."""
 
@@ -59,9 +83,11 @@ class CpuBackend:
         Device backends overlap the MSM with host work between the call
         and the finalize (``ops/packed_msm.py``); the host backend
         computes eagerly — same results, same ordering guarantees.
+        Finalizers additionally expose ``ready()``/``poll()`` (see
+        :class:`EagerFinalizer`).
         """
         result = self.g1_msm(points, scalars)
-        return lambda: result
+        return EagerFinalizer(result)
 
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         return g2_multi_exp(points, scalars)
@@ -127,7 +153,7 @@ class CpuBackend:
                 flat.append((s_coeffs[idx] * t) % F.R)
                 idx += 1
         result = self.g1_msm(points, flat)
-        return lambda: result
+        return EagerFinalizer(result)
 
     # -- share verification ------------------------------------------------
     # Every protocol-level share check routes through these two methods

@@ -110,6 +110,33 @@ def _jacobian_ops(zero, one, add, sub, neg, mul, sq, scalar, inv, eq):
         zinv2 = sq(zinv)
         return (mul(p[0], zinv2), mul(mul(p[1], zinv), zinv2))
 
+    def batch_to_affine(pts):
+        """Affine forms of many points with ONE field inversion
+        (Montgomery's trick): prefix products of the Z coordinates,
+        a single ``inv`` of the running product, then a back-sweep
+        peeling off each 1/Zᵢ with two muls.  Every field op is
+        canonical (reduced representatives), so each recovered
+        inverse equals ``inv(Zᵢ)`` exactly and the output is
+        bit-identical to per-point :func:`to_affine`."""
+        idx = [i for i, p in enumerate(pts) if not eq(p[2], zero)]
+        out = [None] * len(pts)
+        if not idx:
+            return out
+        zs = [pts[i][2] for i in idx]
+        prefix = []
+        acc = None
+        for z in zs:
+            acc = z if acc is None else mul(acc, z)
+            prefix.append(acc)
+        acc = inv(prefix[-1])
+        for j in range(len(idx) - 1, -1, -1):
+            zinv = mul(acc, prefix[j - 1]) if j else acc
+            acc = mul(acc, zs[j])
+            p = pts[idx[j]]
+            zinv2 = sq(zinv)
+            out[idx[j]] = (mul(p[0], zinv2), mul(mul(p[1], zinv), zinv2))
+        return out
+
     def from_affine(a):
         if a is None:
             return INF
@@ -134,6 +161,7 @@ def _jacobian_ops(zero, one, add, sub, neg, mul, sq, scalar, inv, eq):
         "neg": pneg,
         "mul": mul_scalar,
         "to_affine": to_affine,
+        "batch_to_affine": batch_to_affine,
         "from_affine": from_affine,
         "eq": point_eq,
     }
@@ -251,6 +279,38 @@ class _Point:
             self._cbytes = cached
         return cached
 
+    @classmethod
+    def batch_affine(cls, points):
+        """Affine forms of many points sharing ONE field inversion
+        (Montgomery batch inversion) — bit-identical to per-point
+        :meth:`affine`."""
+        return cls.ops["batch_to_affine"]([p.jac for p in points])
+
+    @classmethod
+    def batch_serialize(cls, points):
+        """Fill the ``_cbytes`` (compressed) and ``_wire`` (native
+        uncompressed) memos of every point in one batch-inversion
+        pass.  Points already carrying both memos are skipped; the
+        rest amortize a single inversion across the whole flush, so
+        later ``to_bytes``/``native.*_wire`` calls are dict lookups."""
+        todo = [
+            p
+            for p in points
+            if getattr(p, "_cbytes", None) is None
+            or getattr(p, "_wire", None) is None
+        ]
+        if not todo:
+            return
+        affs = cls.batch_affine(todo)
+        for p, aff in zip(todo, affs):
+            try:
+                if getattr(p, "_cbytes", None) is None:
+                    p._cbytes = cls._encode_affine(aff)
+                if getattr(p, "_wire", None) is None:
+                    p._wire = cls._wire_affine(aff)
+            except AttributeError:  # slot-restricted stand-ins
+                pass
+
     def __eq__(self, other) -> bool:
         return isinstance(other, type(self)) and self.ops["eq"](self.jac, other.jac)
 
@@ -307,7 +367,10 @@ class G1(_Point):
     _native_mul_raw = _native_mul
 
     def _encode(self) -> bytes:
-        aff = self.affine()
+        return self._encode_affine(self.affine())
+
+    @staticmethod
+    def _encode_affine(aff) -> bytes:
         if aff is None:
             return bytes([0xC0]) + bytes(47)
         x, y = aff
@@ -316,6 +379,13 @@ class G1(_Point):
         if _is_lex_largest_fq(y):
             buf[0] |= 0x20
         return bytes(buf)
+
+    @staticmethod
+    def _wire_affine(aff) -> bytes:
+        # native/__init__.py g1_wire: 96-byte x||y, all-zero = infinity
+        if aff is None:
+            return bytes(96)
+        return aff[0].to_bytes(48, "big") + aff[1].to_bytes(48, "big")
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "G1":
@@ -365,7 +435,10 @@ class G2(_Point):
     _native_mul_raw = _native_mul
 
     def _encode(self) -> bytes:
-        aff = self.affine()
+        return self._encode_affine(self.affine())
+
+    @staticmethod
+    def _encode_affine(aff) -> bytes:
         if aff is None:
             return bytes([0xC0]) + bytes(95)
         (x0, x1), y = aff
@@ -374,6 +447,19 @@ class G2(_Point):
         if _is_lex_largest_fq2(y):
             buf[0] |= 0x20
         return bytes(buf)
+
+    @staticmethod
+    def _wire_affine(aff) -> bytes:
+        # native/__init__.py g2_wire: 192-byte x.c0||x.c1||y.c0||y.c1
+        if aff is None:
+            return bytes(192)
+        (x0, x1), (y0, y1) = aff
+        return (
+            x0.to_bytes(48, "big")
+            + x1.to_bytes(48, "big")
+            + y0.to_bytes(48, "big")
+            + y1.to_bytes(48, "big")
+        )
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "G2":

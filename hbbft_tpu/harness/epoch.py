@@ -1528,6 +1528,31 @@ class VectorizedHoneyBadgerSim:
                 delivered[pid] = value
         return delivered
 
+    def _stage_epoch(
+        self,
+        contributions: Dict[Any, Any],
+        dead: Set[Any],
+        corrupt_shards: Dict[Any, Dict[Any, bytes]],
+        late: Set[Any],
+        faults: FaultLog,
+        diag: Dict[str, bool],
+    ) -> Tuple[Dict[Any, bytes], Dict[Any, bytes]]:
+        """Propose THEN broadcast one epoch, as a single unit of
+        pipeline-worker work.  Running the proposer encryption on the
+        worker (rather than the calling thread, as the pre-PR-4 driver
+        did) lets epoch e+1's threshold encryptions overlap epoch e's
+        agreement + decryption flush too — and stays deterministic
+        because the single FIFO worker executes stage tasks in
+        submission (= epoch) order, so ``_propose_phase``'s rng draws
+        happen in exactly the sequential loop's sequence.  Nothing on
+        the calling thread touches ``self.rng`` (``_finish_epoch`` is
+        rng-free), so there is no interleaving to race."""
+        payloads = self._propose_phase(contributions, dead)
+        delivered = self._broadcast_phase(
+            payloads, dead, corrupt_shards, late, faults, diag
+        )
+        return payloads, delivered
+
     # -- pipelined multi-epoch driver ---------------------------------------
 
     def run_epochs(
@@ -1543,13 +1568,17 @@ class VectorizedHoneyBadgerSim:
         CommonSubset instances running while the current epoch
         decrypts.
 
-        Schedule: epoch e+1's proposer encryption runs on the calling
-        thread (deterministic rng order — exactly the sequential
-        sequence, see ``_propose_phase``), then its broadcast matmuls
-        run on a worker thread while THIS thread completes epoch e's
+        Schedule: epoch e+1's proposer encryption AND broadcast
+        matmuls run as one staged task on a worker thread
+        (:meth:`_stage_epoch` — the single FIFO worker preserves the
+        sequential rng order) while THIS thread completes epoch e's
         agreement + decryption flush (whose device transfers/MSMs
         release the GIL, so the overlap is real on a single core).
-        Outcomes are bit-identical to the sequential loop (asserted in
+        The flush's finalizer exposes ``ready()``/``poll()``
+        (``crypto/backend.py``), so while the device drains, the only
+        host work left in flight is the worker's — the pipeline never
+        stalls both threads on the same wait.  Outcomes are
+        bit-identical to the sequential loop (asserted in
         ``tests/test_epoch_vec.py``).
 
         ``epoch_kwargs`` are forwarded to every epoch (adversarial
@@ -1575,10 +1604,9 @@ class VectorizedHoneyBadgerSim:
         with ThreadPoolExecutor(max_workers=1) as ex:
             faults_next = FaultLog()
             diag_next: Dict[str, bool] = {}
-            payloads_next = self._propose_phase(seq[0], dead)
             fut = ex.submit(
-                self._broadcast_phase,
-                payloads_next,
+                self._stage_epoch,
+                seq[0],
                 dead,
                 corrupt_shards,
                 late,
@@ -1586,15 +1614,17 @@ class VectorizedHoneyBadgerSim:
                 diag_next,
             )
             for e in range(len(seq)):
-                delivered, faults, diag = fut.result(), faults_next, diag_next
-                payloads = payloads_next
+                (payloads, delivered), faults, diag = (
+                    fut.result(),
+                    faults_next,
+                    diag_next,
+                )
                 if e + 1 < len(seq):
                     faults_next = FaultLog()
                     diag_next = {}
-                    payloads_next = self._propose_phase(seq[e + 1], dead)
                     fut = ex.submit(
-                        self._broadcast_phase,
-                        payloads_next,
+                        self._stage_epoch,
+                        seq[e + 1],
                         dead,
                         corrupt_shards,
                         late,

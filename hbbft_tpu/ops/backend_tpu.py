@@ -25,6 +25,7 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..crypto import backend as _backend
 from ..crypto.backend import CpuBackend
 from ..crypto.curve import G1, G2, G1_GEN, G2_GEN
 from ..obs import recorder as _obs
@@ -73,6 +74,15 @@ class TpuBackend(CpuBackend):
             env = os.environ.get("HBBFT_TPU_" + attr)
             if env is not None:
                 setattr(self, attr, int(env))
+        # warm start: begin deserializing the last run's flush-shape
+        # executables (disk → memory, no compiling) while the caller
+        # runs DKG/setup — the first flush then skips the per-
+        # executable load wall that dominated the r05 cold flush
+        try:
+            if jax.default_backend() == "tpu":
+                packed_msm.start_background_prewarm()
+        except Exception:
+            pass  # prewarm is an optimization; never block construction
 
     # -- hashing / merkle -------------------------------------------------
     # Like the MSMs, routed by measured capability: the native C++ host
@@ -243,12 +253,17 @@ class TpuBackend(CpuBackend):
 
             return packed_msm.g1_msm_packed_async(points, scalars)
         result = ec_jax.g1_msm(points, scalars)
-        return lambda: result
+        return _backend.EagerFinalizer(result)
 
     def g1_msm_async(self, points, scalars):
         """Async G1 MSM: device-routed batches overlap the tunnel
         transfer + kernel with the caller's host work (the fused
-        flush's G2 MSMs and transcript pairings — VERDICT r3 item 1)."""
+        flush's G2 MSMs and transcript pairings — VERDICT r3 item 1).
+
+        A mesh-configured backend has no async seam (shard_map blocks
+        until the partial sums cross ICI) — it degrades to the sync
+        :meth:`g1_msm`, whose own ``device_op`` event (``engine=
+        "mesh"``) keeps the trace honest about the degradation."""
         points, scalars = list(points), list(scalars)
         if (
             self.mesh is None
@@ -257,9 +272,20 @@ class TpuBackend(CpuBackend):
         ):
             fin = self._device_g1_msm(points, scalars)
             if fin is not None:
+                # the sync path stamps every route it takes; the async
+                # fast path was the ONE silent branch — device MSMs in
+                # flight were invisible in traces (ISSUE 4 satellite)
+                rec = _obs.ACTIVE
+                if rec is not None:
+                    rec.event(
+                        "device_op",
+                        op="g1_msm",
+                        k=len(points),
+                        engine="device_async",
+                    )
                 return fin
         result = self.g1_msm(points, scalars)
-        return lambda: result
+        return _backend.EagerFinalizer(result)
 
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         points, scalars = list(points), list(scalars)

@@ -233,51 +233,16 @@ def g2_kernel() -> PointKernel:
 # ---------------------------------------------------------------------------
 
 
-def _batch_inv(vals, p: int):
-    """Montgomery batch inversion: one modular inverse + 3(k−1) mulmods
-    for k nonzero values."""
-    k = len(vals)
-    prefix = [0] * k
-    acc = 1
-    for i, v in enumerate(vals):
-        acc = acc * v % p
-        prefix[i] = acc
-    inv_all = pow(acc, -1, p)
-    out = [0] * k
-    for i in range(k - 1, -1, -1):
-        out[i] = (inv_all * (prefix[i - 1] if i else 1)) % p
-        inv_all = inv_all * vals[i] % p
-    return out
-
-
 def g1_batch_affine(points: Sequence[Any]) -> List[Any]:
     """Host G1 points → ``[(x, y) | None]`` (None = infinity), with ONE
-    Montgomery batch inversion shared across every Jacobian (Z ∉ {0, 1})
-    point — the one home for the normalization both limb and packed-wire
-    marshalling need (``g1_to_limbs``, ``packed_msm.g1_wires_batch``).
-    Affine-constructed points (Z = 1, the common case for deserialized
-    and native-built shares) skip inversion entirely."""
-    from ..crypto import fields as F
+    Montgomery batch inversion shared across every non-infinity point.
+    Delegates to the shared normalization home in :mod:`crypto.curve`
+    (``G1.batch_affine`` / ``_jacobian_ops``'s ``batch_to_affine``) so
+    limb marshalling, packed-wire marshalling, and the serialization
+    memos all flow from the same batch."""
+    from ..crypto.curve import G1
 
-    p = F.P
-    n = len(points)
-    out: List[Any] = [None] * n
-    inv_idx, inv_z = [], []
-    for i, pt in enumerate(points):
-        X, Y, Z = pt.jac
-        if Z == 0:
-            continue
-        if Z == 1:
-            out[i] = (X % p, Y % p)
-        else:
-            inv_idx.append(i)
-            inv_z.append(Z % p)
-    if inv_idx:
-        for i, zinv in zip(inv_idx, _batch_inv(inv_z, p)):
-            X, Y, _ = points[i].jac
-            zinv2 = zinv * zinv % p
-            out[i] = (X * zinv2 % p, Y * zinv * zinv2 % p)
-    return out
+    return G1.batch_affine(points)
 
 
 def g1_to_limbs(points: Sequence[Any]) -> np.ndarray:
@@ -306,11 +271,13 @@ def g1_to_limbs(points: Sequence[Any]) -> np.ndarray:
 
 
 def g2_to_limbs(points: Sequence[Any]) -> np.ndarray:
-    """Host G2 points → [k, 3, 2, L] projective limbs."""
+    """Host G2 points → [k, 3, 2, L] projective limbs (one shared
+    Fq2 batch inversion, not one ``fq2_inv`` per point)."""
+    from ..crypto.curve import G2
+
     f = LB.fq()
     out = np.zeros((len(points), 3, 2, f.L), dtype=np.int32)
-    for i, pt in enumerate(points):
-        aff = pt.affine()
+    for i, aff in enumerate(G2.batch_affine(points)):
         if aff is None:
             out[i, 1, 0] = f.to_limbs(1)
         else:

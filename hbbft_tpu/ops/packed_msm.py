@@ -45,6 +45,7 @@ import numpy as np
 
 from . import limbs as LB
 from . import pallas_ec
+from . import staging
 
 # Scalars ship as ceil(width/8) big-endian bytes; ec_jax._width's
 # buckets (128/160/192/255 bits) keep the set of compiled kernel
@@ -108,8 +109,12 @@ def _flat_ready(kp: int, nb: int, g2: bool = False) -> bool:
     return all(pallas_ec.exec_available(n, p) for n, p in checks)
 
 
-def _product_ready(kd: int, n_groups: int, compressed: bool) -> bool:
-    """All executables of ONE factored-product device chunk are warm.
+def _product_exec_keys(kd: int, n_groups: int, compressed: bool):
+    """The ``(name, key_parts)`` of every executable ONE
+    factored-product device chunk needs — the ONE home shared by the
+    warm-routing guard (:func:`_product_ready`) and the warm-start
+    prewarmer (:func:`prewarm_shapes`), so what the prewarmer loads can
+    never drift from what routing requires.
 
     ``kd`` is the chunk's true point count (``n_groups`` × group size);
     the transfer/unpack/kernel run on the bucket-padded ``kp`` rows and
@@ -134,12 +139,19 @@ def _product_ready(kd: int, n_groups: int, compressed: bool) -> bool:
             "unpack_g1_v1",
             (((kp, 96), "uint8"), ((kp, nb), "uint8")),
         )
-    checks = [
+    return [
         unpack,
         ("win_g1", ((G, 3, L, T), (G, nb * 2, T))),
         ("gtree_g1_%d" % n_groups, (((kd, 3, L), "int32"),)),
     ]
-    return all(pallas_ec.exec_available(n, p) for n, p in checks)
+
+
+def _product_ready(kd: int, n_groups: int, compressed: bool) -> bool:
+    """All executables of ONE factored-product device chunk are warm."""
+    return all(
+        pallas_ec.exec_available(n, p)
+        for n, p in _product_exec_keys(kd, n_groups, compressed)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -153,10 +165,13 @@ def g1_wires_batch(points: Sequence[Any]) -> np.ndarray:
     Points deserialized from the network or built by the native ops
     carry a memoized ``_wire`` (``native.g1_wire``) and cost one dict
     lookup each.  The rest are normalized together through
-    ``ec_jax.g1_batch_affine`` (one shared Montgomery batch inversion,
-    not a Python ``pow`` per point).
+    ``crypto.curve.G1.batch_affine`` (one shared Montgomery batch
+    inversion, not a Python ``pow`` per point); both the wire and the
+    compressed ``to_bytes`` memos are filled from that single
+    normalized batch (``G1.batch_serialize``), so later cache keying
+    never re-inverts the same points.
     """
-    from . import ec_jax
+    from ..crypto.curve import G1
 
     n = len(points)
     out = np.empty((n, 96), dtype=np.uint8)
@@ -168,18 +183,16 @@ def g1_wires_batch(points: Sequence[Any]) -> np.ndarray:
         else:
             slow.append(i)
     if slow:
-        affs = ec_jax.g1_batch_affine([points[i] for i in slow])
-        for i, aff in zip(slow, affs):
-            if aff is None:
-                out[i] = 0  # native.g1_wire's infinity encoding
-            else:
-                out[i] = np.frombuffer(
-                    aff[0].to_bytes(48, "big") + aff[1].to_bytes(48, "big"),
-                    dtype=np.uint8,
-                )
-            # memoize for the next flush over the same objects
+        slow_pts = [points[i] for i in slow]
+        affs = G1.batch_affine(slow_pts)
+        for i, pt, aff in zip(slow, slow_pts, affs):
+            w = G1._wire_affine(aff)  # infinity = native's all-zero row
+            out[i] = np.frombuffer(w, dtype=np.uint8)
+            # memoize both encodings for the next flush / cache keying
             try:
-                points[i]._wire = out[i].tobytes()
+                pt._wire = w
+                if getattr(pt, "_cbytes", None) is None:
+                    pt._cbytes = G1._encode_affine(aff)
             except AttributeError:
                 pass
     return out
@@ -941,10 +954,143 @@ def _split_plan(k: int, n_groups: int) -> List[int]:
     return plan
 
 
+# ---------------------------------------------------------------------------
+# Persistent warm-start: flush-shape memory + background prewarm
+# ---------------------------------------------------------------------------
+# The controller persists the learned split (device_fraction.json) and
+# the executables persist as .palexe files — but a fresh process still
+# paid the deserialize + device-load wall for EVERY executable inside
+# its first flush (the r05 32.8 s cold flush vs the 1.42 s warm
+# median).  So also persist the SET of flush shapes that actually
+# shipped a device plan, and let the backend prewarm their executables
+# on a background thread during DKG/setup: the first flush then starts
+# at the converged split AND with warm executables.
+
+_WARM_SEEN: set = set()  # shapes recorded this process (dedupe disk writes)
+_PREWARM: Optional[Any] = None  # the background prewarm thread, once kicked
+
+
+def _warm_shapes_path() -> str:
+    return os.path.join(pallas_ec._exec_cache_dir(), "warm_shapes.json")
+
+
+def _load_warm_shapes() -> dict:
+    """``{"n:n_groups": {"compressed": bool}}`` — per-entry tolerant,
+    like ``_rho_state`` (one malformed entry must not drop the rest)."""
+    import json
+
+    out: dict = {}
+    try:
+        with open(_warm_shapes_path()) as fh:
+            raw = json.load(fh)
+    except Exception:
+        return out
+    for k, v in raw.items() if isinstance(raw, dict) else ():
+        try:
+            n, g = (int(x) for x in str(k).split(":"))
+            if n > 0 and g > 0:
+                out["%d:%d" % (n, g)] = {
+                    "compressed": bool(v.get("compressed"))
+                    if isinstance(v, dict)
+                    else False
+                }
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def record_warm_shape(n: int, n_groups: int, compressed: bool) -> None:
+    """Remember that shape ``(n, n_groups)`` shipped a device plan, so
+    the NEXT process can prewarm its executables before its first
+    flush.  Read-merge-replace keeps other processes' entries; a
+    compressed sighting is sticky (both transfer modes get prewarmed
+    once a shape has probed compression).  Best-effort throughout —
+    losing the hint only costs one cold-start first flush."""
+    import json
+
+    seen_key = ("%d:%d" % (n, n_groups), bool(compressed))
+    if seen_key in _WARM_SEEN:
+        return
+    _WARM_SEEN.add(seen_key)
+    try:
+        shapes = _load_warm_shapes()
+        ent = shapes.setdefault(seen_key[0], {"compressed": False})
+        ent["compressed"] = bool(ent.get("compressed")) or bool(compressed)
+        path = _warm_shapes_path()
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as fh:
+            json.dump(shapes, fh)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def prewarm_shapes() -> int:
+    """Bring every recorded shape's executables disk → memory, WITHOUT
+    compiling (``pallas_ec.preload_exec``).  Each shape's chunk plan is
+    recomputed at the PERSISTED split (``device_fraction.json``) via
+    the same ``_split_plan`` routing uses, and the chunk → executable
+    mapping is the shared ``_product_exec_keys`` — so what the
+    prewarmer loads is exactly what the first flush will route, by
+    construction.  The uncompressed executables are always included
+    (the controller's periodic mode probe can flip a shape's transfer
+    mode at any flush).  Returns how many executables are warm in
+    memory afterwards; a missing ``.palexe`` simply stays cold and
+    routing falls back exactly as before."""
+    warm = 0
+    for skey, ent in sorted(_load_warm_shapes().items()):
+        try:
+            n, n_groups = (int(x) for x in skey.split(":"))
+        except ValueError:
+            continue
+        plan = _split_plan(n * n_groups, n_groups)
+        modes = {False, bool(ent.get("compressed"))}
+        for g in plan:
+            for compressed in sorted(modes):
+                for name, parts in _product_exec_keys(
+                    g * n, g, compressed
+                ):
+                    if pallas_ec.preload_exec(name, parts):
+                        warm += 1
+    return warm
+
+
+def start_background_prewarm() -> Optional[Any]:
+    """Kick ONE daemon thread per process deserializing the recorded
+    shapes' executables while DKG/setup runs on the main thread (the
+    natural dead time before the first flush).  Idempotent; returns
+    the thread (or the one already started).  Safe to race with the
+    first flush: ``preload_exec`` and ``cached_compiled`` both write
+    ``_EXEC_MEM`` atomically and a duplicate load is only wasted
+    work, never a wrong result."""
+    global _PREWARM
+    if _PREWARM is not None:
+        return _PREWARM
+    import threading
+
+    th = threading.Thread(
+        target=prewarm_shapes, name="hbbft-prewarm", daemon=True
+    )
+    _PREWARM = th
+    th.start()
+    return th
+
+
 class ShippedPoints:
-    """Points already marshalled and (asynchronously) in flight to the
+    """Points being marshalled and (asynchronously) shipped to the
     device — ``backend.g1_ship``'s handle.  Keeps the host list so any
     fallback path can still reach the original objects.
+
+    The plan/transfer-mode/warm-executable ROUTING decisions are made
+    synchronously (cheap, and callers key the host-vs-device decision
+    off ``self.plan``); the marshalling itself — batch-affine wire
+    encoding plus per-chunk pad/compress/``device_put`` — runs as a
+    staged task on the flush pipeline's FIFO worker, overlapping the
+    caller's transcript/serialization work instead of walling the
+    flush (the r05 7.5 s ``ship`` wall).  ``g1_msm_product_async``
+    resolves the task inside its own staged launch (FIFO ⇒ the ship
+    task has completed by then); marshalling errors re-raise at the
+    finalizer, exactly where the sequential path surfaced them.
 
     In compressed mode only the x coordinates cross the tunnel, plus
     two packed bit-rows (y parity, infinity flag); y is recovered on
@@ -958,7 +1104,9 @@ class ShippedPoints:
     ):
         self.points = points
         self.compressed = False
-        self.chunks: List[tuple] = []  # (g, kd, dev, dev_meta)
+        self.plan: List[int] = []
+        self.task: Optional[staging.StageTask] = None  # → [(g, kd, dev, dev_meta)]
+        self.lease = staging.buffers().lease()
         self.g_dev = 0
         self.k_dev = 0
         k = len(points)
@@ -979,45 +1127,73 @@ class ShippedPoints:
             _product_ready(g * n, g, self.compressed) for g in plan
         ):
             return  # cold shapes — the flush will run host-side
-        # only the device prefix is marshalled: the host tail goes
-        # through native Pippenger's own (memoized) wire encoding, so
-        # serializing it here would be pure wasted flush-path time
-        k_dev = sum(plan) * n
-        wires = g1_wires_batch(points[:k_dev])
-        lo = 0
-        for g in plan:
-            kd = g * n
-            dev, dev_meta = _put_chunk(
-                wires[lo : lo + kd], kd, _bucket_rows(kd), self.compressed
-            )
-            self.chunks.append((g, kd, dev, dev_meta))
-            lo += kd
+        self.plan = plan
         self.g_dev = sum(plan)
-        self.k_dev = lo
+        self.k_dev = self.g_dev * n
+        k_dev, compressed, lease = self.k_dev, self.compressed, self.lease
+
+        def _marshal():
+            # only the device prefix is marshalled: the host tail goes
+            # through native Pippenger's own (memoized) wire encoding,
+            # so serializing it here would be pure wasted flush time
+            wires = g1_wires_batch(points[:k_dev])
+            chunks = []
+            lo = 0
+            for g in plan:
+                kd = g * n
+                dev, dev_meta = _put_chunk(
+                    wires[lo : lo + kd], kd, _bucket_rows(kd),
+                    compressed, lease,
+                )
+                chunks.append((g, kd, dev, dev_meta))
+                lo += kd
+            return chunks
+
+        self.task = staging.stager().submit(_marshal)
 
 
-def _put_chunk(wires: np.ndarray, kd: int, kp: int, compressed: bool):
+def _put_chunk(
+    wires: np.ndarray,
+    kd: int,
+    kp: int,
+    compressed: bool,
+    lease: Optional[staging.Lease] = None,
+):
     """Pad one device chunk's wires to the ``kp`` bucket and start its
     transfer — (dev, dev_meta); the ONE home for the pad/compress/ship
     step shared by the eager (``ShippedPoints``) and lazy
-    (``g1_msm_product_async`` fallback) marshalling paths."""
+    (``g1_msm_product_async`` fallback) marshalling paths.  With a
+    ``lease`` the pad buffer comes preallocated from the staging pool
+    (retired by the finalizer once the device results materialize —
+    i.e. once the transfer provably completed)."""
     if compressed:
-        x, meta = compress_rows(wires, kp)
+        x, meta = compress_rows(wires, kp, lease)
         return jax.device_put(x), jax.device_put(meta)
     if kp != kd:
-        wires = np.concatenate(
-            [wires, np.zeros((kp - kd, 96), dtype=np.uint8)]
-        )
+        if lease is not None:
+            buf = lease.get((kp, 96))
+            buf[:kd] = wires
+            wires = buf
+        else:
+            wires = np.concatenate(
+                [wires, np.zeros((kp - kd, 96), dtype=np.uint8)]
+            )
     return jax.device_put(wires), None
 
 
-def compress_rows(wires: np.ndarray, kp: int) -> tuple:
+def compress_rows(
+    wires: np.ndarray, kp: int, lease: Optional[staging.Lease] = None
+) -> tuple:
     """[k, 96] wires → ([kp, 48] x bytes, [2, kp/8] packed meta bits).
     Padding rows (k..kp) are flagged infinity.  Meta row 0 is y parity
     (last wire byte & 1), row 1 the infinity/padding flag (all-zero
     wire — ``native.g1_wire``'s encoding)."""
     k = wires.shape[0]
-    x = np.zeros((kp, 48), dtype=np.uint8)
+    x = (
+        lease.get((kp, 48))
+        if lease is not None
+        else np.zeros((kp, 48), dtype=np.uint8)
+    )
     x[:k] = wires[:, :48]
     parity = np.zeros(kp, dtype=np.uint8)
     parity[:k] = wires[:, 95] & 1
@@ -1031,6 +1207,40 @@ def ship_points(
     points: Sequence[Any], group_sizes: Optional[Sequence[int]] = None
 ) -> ShippedPoints:
     return ShippedPoints(list(points), group_sizes)
+
+
+class ProductFinalizer:
+    """Callable finalizer handle with a non-blocking readiness probe.
+
+    ``fin()`` blocks exactly like the plain closure it replaces (host
+    Pippenger tail, then the device drain); ``fin.ready()`` /
+    ``fin.poll()`` report — without blocking — whether the device
+    results have already materialized, so a driver can interleave
+    other work (serializing the next round's obligations, the epoch
+    pipeline's staging) until the drain completes instead of sitting
+    inside ``agg_share_fin()``.  Idempotent: the first call runs the
+    finalizer, later calls return the memoized result."""
+
+    __slots__ = ("_fn", "_probe", "_done", "_result")
+
+    def __init__(self, fn: Callable[[], Any], probe: Optional[Callable[[], bool]] = None):
+        self._fn = fn
+        self._probe = probe
+        self._done = False
+        self._result: Any = None
+
+    def __call__(self):
+        if not self._done:
+            self._result = self._fn()
+            self._done = True
+        return self._result
+
+    def ready(self) -> bool:
+        if self._done:
+            return True
+        return bool(self._probe()) if self._probe is not None else True
+
+    poll = ready
 
 
 def _group_tree(prods: jnp.ndarray, n_groups: int) -> jnp.ndarray:
@@ -1112,9 +1322,13 @@ def g1_msm_product_async(
         interpret = jax.default_backend() != "tpu"
 
     if shipped is not None:
-        chunks = shipped.chunks
+        # routing off the synchronously-computed plan: the staged
+        # marshal may still be in flight, and must not be waited on
+        # here — the launch below resolves it on the FIFO worker
+        plan = shipped.plan
         compressed = shipped.compressed
-        if not chunks:
+        ship_task = shipped.task
+        if not plan:
             return None
     else:
         plan = _split_plan(k, n_groups)
@@ -1131,44 +1345,73 @@ def g1_msm_product_async(
             )
         ):
             return None
-        chunks = [(g, g * n, None, None) for g in plan]
+        ship_task = None
 
     nb = _S_BITS // 8
-    k_dev = sum(kd for _, kd, _, _ in chunks)
-    sc = scalar_bytes_batch(s_coeffs[:k_dev], nb)
-    gsums = []
-    g_dev = 0
-    lo = 0
-    for g, kd, dev, dev_meta in chunks:
-        kp = _bucket_rows(kd)
-        sc_chunk = sc[lo : lo + kd]
-        if kp != kd:
-            sc_chunk = np.concatenate(
-                [sc_chunk, np.zeros((kp - kd, nb), dtype=np.uint8)]
-            )
-        dev_sc = jax.device_put(sc_chunk)
-        if dev is None:  # lazy marshalling (no ShippedPoints handle)
-            dev, dev_meta = _put_chunk(
-                g1_wires_batch(pts_list[lo : lo + kd]), kd, kp, compressed
-            )
-        # _put_chunk returns meta iff compressed, on both paths
-        if dev_meta is not None:
-            pts_t, dig_t = _unpack_compressed_device(dev, dev_meta, dev_sc)
-        else:
-            pts_t, dig_t = _unpack_device(dev, dev_sc)
-        out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
-        prods = pallas_ec._untile(out_t, kd, kp)  # slice the padding
-        gsums.append(_group_tree_device(prods, g))
-        g_dev += g
-        lo += kd
-
+    k_dev = sum(plan) * n
+    # snapshots against caller mutation: the marshalling below runs on
+    # the staging worker after this call returns
+    s_head = list(s_coeffs[:k_dev])
+    s_tail = list(s_coeffs[k_dev:])
     t_list = list(t_coeffs)
     host_pts = pts_list[k_dev:]
-    s_tail = list(s_coeffs[k_dev:])  # snapshot against caller mutation
+    g_dev = sum(plan)
+    lease = staging.buffers().lease()
+
+    if not interpret:
+        # this shape shipped a real device plan: remember it so the
+        # next process can prewarm its executables during setup
+        record_warm_shape(n, n_groups, compressed)
+
     import threading
     import time
 
-    t_launch = time.perf_counter()
+    t_call = time.perf_counter()
+
+    def _launch():
+        # Staged dispatch: scalar marshalling, pad-to-bucket, and the
+        # non-blocking device_puts all run on the pipeline's FIFO
+        # worker, overlapping the caller's G2 MSMs/transcript work —
+        # the r05 12.7 s ``launch`` wall.  FIFO ⇒ a ShippedPoints
+        # marshal submitted earlier has completed; ``result()``
+        # re-raises its errors here, which the waiter carries to the
+        # finalizer (same surfacing point as the sequential path).
+        chunks = (
+            ship_task.result()
+            if ship_task is not None
+            else [(g, g * n, None, None) for g in plan]
+        )
+        sc = scalar_bytes_batch(s_head, nb)
+        gsums = []
+        lo = 0
+        for g, kd, dev, dev_meta in chunks:
+            kp = _bucket_rows(kd)
+            sc_chunk = sc[lo : lo + kd]
+            if kp != kd:
+                buf = lease.get((kp, nb))
+                buf[:kd] = sc_chunk
+                sc_chunk = buf
+            dev_sc = jax.device_put(sc_chunk)
+            if dev is None:  # lazy marshalling (no ShippedPoints handle)
+                dev, dev_meta = _put_chunk(
+                    g1_wires_batch(pts_list[lo : lo + kd]),
+                    kd, kp, compressed, lease,
+                )
+            # _put_chunk returns meta iff compressed, on both paths
+            if dev_meta is not None:
+                pts_t, dig_t = _unpack_compressed_device(dev, dev_meta, dev_sc)
+            else:
+                pts_t, dig_t = _unpack_device(dev, dev_sc)
+            out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
+            prods = pallas_ec._untile(out_t, kd, kp)  # slice the padding
+            gsums.append(_group_tree_device(prods, g))
+            lo += kd
+        # dispatch-end stamp: t_dev below keeps the same semantics as
+        # the sequential path (dispatch done → group sums materialize)
+        return gsums, time.perf_counter()
+
+    launch_task = staging.stager().submit(_launch)
+
     # Waiter thread: stamp the wall at which the device group sums
     # actually materialize on host.  The fetched arrays are tiny
     # ([G, 3, L] int32 per chunk) and the main thread spends the same
@@ -1178,10 +1421,12 @@ def g1_msm_product_async(
     # ``block_until_ready`` is a no-op and only a materializing fetch
     # observes completion, so the stamp lives on its own thread instead
     # of gating the finalizer.
-    waiter: dict = {"arrs": None, "t": None, "err": None}
+    waiter: dict = {"arrs": None, "t": None, "t_disp": None, "err": None}
 
     def _wait():
         try:
+            gsums, t_disp = launch_task.result()
+            waiter["t_disp"] = t_disp
             waiter["arrs"] = [np.asarray(gs) for gs in gsums]
         except BaseException as e:  # re-raised on the finalizer below
             waiter["err"] = e
@@ -1196,7 +1441,7 @@ def g1_msm_product_async(
         # The flat coefficient products are built HERE, not at launch —
         # launch-time work delays the caller's G2 MSMs/pairings, the
         # exact overlap the async contract exists to provide.
-        t_caller = time.perf_counter() - t_launch
+        t_caller = time.perf_counter() - t_call
         t0 = time.perf_counter()
         host_sum = None
         if host_pts:
@@ -1207,13 +1452,21 @@ def g1_msm_product_async(
             host_sum = CpuBackend().g1_msm(host_pts, host_flat)
         t_host = time.perf_counter() - t0
         th.join()
+        # the device results materialized (or failed): every staged
+        # transfer has been consumed, so the pad buffers can go back
+        # to the pool for the next flush
+        lease.retire()
+        if shipped is not None:
+            shipped.lease.retire()
         if waiter["err"] is not None:
             # surface the device failure to the flush caller with its
             # real traceback; no rate sample is recorded from a
             # failed fetch (it would poison the persisted estimate)
             raise waiter["err"]
         arrs = waiter["arrs"]
-        t_dev = (waiter["t"] or time.perf_counter()) - t_launch
+        t_dev = (waiter["t"] or time.perf_counter()) - (
+            waiter["t_disp"] or t_call
+        )
         if not interpret and _env_fraction() is None:
             _adapt(
                 n,
@@ -1233,4 +1486,7 @@ def g1_msm_product_async(
         dev_sum = CpuBackend().g1_msm(group_pts, t_list[:g_dev])
         return dev_sum + host_sum if host_sum is not None else dev_sum
 
-    return finalize
+    # ready() = the device drain is over; the epoch driver uses it to
+    # keep serializing the next round's obligations until the drain
+    # completes instead of blocking inside the finalizer
+    return ProductFinalizer(finalize, probe=lambda: not th.is_alive())

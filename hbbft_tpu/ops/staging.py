@@ -1,0 +1,218 @@
+"""Host→device staging pipeline for the flush engine.
+
+The r05 bench showed the flush wall is host-side plumbing: ``launch``
+(synchronous scalar marshalling + per-chunk ``device_put``) and
+``ship`` strictly precede the host G2 MSMs and transcript work they
+could overlap.  This module provides the overlap machinery:
+
+- :class:`StageTask` — a one-shot unit of marshalling/dispatch work
+  with a completion event; ``result()`` re-raises worker exceptions in
+  the caller so fault attribution is unchanged.
+- :class:`Stager` — a single daemon worker draining a FIFO queue.
+  One worker, strict FIFO: tasks submitted in dependency order (ship
+  before launch) need no locks, and the device stream sees the same
+  dispatch order as the sequential path — bit-identity is structural,
+  not probabilistic.
+- :class:`BufferPool` / :class:`Lease` — preallocated host arrays for
+  the packed wire/scalar marshalling with leased lifetimes: a flush
+  leases buffers for its chunks and retires them only after the
+  device results materialize (all input transfers provably complete),
+  so a buffer being DMA'd by ``jax.device_put`` is never the one
+  being overwritten for the next chunk.  Steady state is double
+  buffering — one generation in flight, one being filled — without
+  ever guessing at transfer completion.
+
+Everything in this module is non-blocking by design: no
+``.block_until_ready()``, no ``np.asarray`` materialization, no
+``jax.device_get`` — the badgerlint ``device-sync`` rule enforces
+this module-wide (the whole file is an overlap window, not just jit
+bodies).  The one place the flush *does* block — the waiter thread's
+``np.asarray`` fetch — lives in ``packed_msm``, outside the window.
+
+``HBBFT_TPU_STAGING=0`` disables the pipeline: ``submit`` runs the
+work inline on the caller thread, which is exactly the sequential
+path the determinism tests diff against.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def enabled() -> bool:
+    """Staged transfers are on unless ``HBBFT_TPU_STAGING=0``."""
+    return os.environ.get("HBBFT_TPU_STAGING", "1") != "0"
+
+
+class StageTask:
+    """One unit of staged work: runs ``fn`` on the stager worker (or
+    inline when staging is off), captures the result or exception,
+    and lets callers block on completion exactly once — at the point
+    the sequential code would have paid the cost anyway."""
+
+    __slots__ = ("_fn", "_done", "_result", "_err")
+
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+        self._done = threading.Event()
+        self._result: Any = None
+        self._err: Optional[BaseException] = None
+
+    def _run(self) -> None:
+        try:
+            self._result = self._fn()
+        except BaseException as exc:  # re-raised at result()
+            self._err = exc
+        finally:
+            self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def failed(self) -> bool:
+        return self._done.is_set() and self._err is not None
+
+    def result(self) -> Any:
+        """Wait for completion; re-raise the worker's exception here so
+        the caller's fallback cascade (and FaultLog attribution) sees
+        the same error it would have seen running sequentially."""
+        self._done.wait()
+        if self._err is not None:
+            raise self._err
+        return self._result
+
+
+class Stager:
+    """A single FIFO worker thread for marshalling + dispatch tasks.
+
+    Single worker on purpose: FIFO order means a task may assume every
+    earlier-submitted task has completed (ship → launch → next ship),
+    and device_puts reach the runtime in submission order — the same
+    order the sequential path issues them."""
+
+    def __init__(self):
+        self._q: "queue.SimpleQueue[Optional[StageTask]]" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="hbbft-stager", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is None:
+                return
+            task._run()
+
+    def submit(self, fn: Callable[[], Any]) -> StageTask:
+        """Enqueue ``fn`` on the worker (staging on) or run it inline
+        (staging off).  Either way the returned task is the caller's
+        only handle — completion, result, and errors flow through it."""
+        task = StageTask(fn)
+        if not enabled():
+            task._run()
+            return task
+        self._ensure_thread()
+        self._q.put(task)
+        return task
+
+
+_STAGER: Optional[Stager] = None
+_STAGER_LOCK = threading.Lock()
+
+
+def stager() -> Stager:
+    """The process-wide staging worker (lazily created)."""
+    global _STAGER
+    if _STAGER is None:
+        with _STAGER_LOCK:
+            if _STAGER is None:
+                _STAGER = Stager()
+    return _STAGER
+
+
+class Lease:
+    """A flush's claim on staging buffers.
+
+    ``get`` hands out a zeroed buffer from the pool's free list (or
+    grows the pool to peak demand — after warm-up every flush reuses
+    preallocated memory); ``retire`` returns every held buffer to the
+    free list.  Retire ONLY once the transfers that read the buffers
+    are provably complete — in the flush engine that point is the
+    waiter thread's materializing fetch of the device results, which
+    cannot happen before the device consumed its inputs."""
+
+    __slots__ = ("_pool", "_held")
+
+    def __init__(self, pool: "BufferPool"):
+        self._pool = pool
+        self._held: List[Tuple[Tuple[Tuple[int, ...], str], np.ndarray]] = []
+
+    def get(self, shape: Tuple[int, ...], dtype: Any = np.uint8) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        buf = self._pool._take(key)
+        if buf is None:
+            buf = np.zeros(shape, dtype=dtype)
+        else:
+            buf.fill(0)
+        self._held.append((key, buf))
+        return buf
+
+    def retire(self) -> None:
+        held, self._held = self._held, []
+        self._pool._give(held)
+
+
+class BufferPool:
+    """Preallocated host staging arrays keyed by ``(shape, dtype)``.
+
+    ``jax.device_put`` on a numpy array may DMA asynchronously from
+    the caller's buffer (PJRT's immutable-until-transfer-completes
+    semantics); overwriting it for the next chunk while the previous
+    transfer drains would corrupt the wire.  Leased lifetimes make the
+    reuse provably safe with no completion guessing: a buffer goes
+    back on the free list only when its flush retires, which the
+    flush engine does after materializing the device results.  In the
+    one-deep flush pipeline at most two generations are alive, so the
+    pool settles at classic double buffering."""
+
+    def __init__(self):
+        self._free: Dict[Tuple[Tuple[int, ...], str], List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    def lease(self) -> Lease:
+        return Lease(self)
+
+    def _take(self, key) -> Optional[np.ndarray]:
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                return free.pop()
+        return None
+
+    def _give(self, held) -> None:
+        with self._lock:
+            for key, buf in held:
+                self._free.setdefault(key, []).append(buf)
+
+
+_BUFFERS = BufferPool()
+
+
+def buffers() -> BufferPool:
+    """The process-wide staging-buffer pool."""
+    return _BUFFERS

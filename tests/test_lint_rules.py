@@ -205,6 +205,42 @@ def test_device_sync_allows_shape_arithmetic_and_plain_functions():
     assert _lint(src, "ops/fixture.py", select="device-sync") == []
 
 
+def test_device_sync_staging_module_checked_outside_jit():
+    # ops/staging is the flush pipeline's overlap window: blocking /
+    # materializing calls are flagged MODULE-WIDE, not just in @jit
+    src = """
+        import jax
+        import numpy as np
+
+        def marshal(task, dev):
+            dev.block_until_ready()
+            host = np.asarray(dev)
+            got = jax.device_get(dev)
+            return host, got
+    """
+    vs = _lint(src, "ops/staging.py", select="device-sync")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 3
+    assert ".block_until_ready() blocks the staging overlap window" in msgs
+    assert "np.asarray materializes a device value" in msgs
+    assert "jax.device_get blocks the staging overlap window" in msgs
+    # the same source outside ops/staging has no jit region: clean
+    assert _lint(src, "ops/fixture.py", select="device-sync") == []
+
+
+def test_device_sync_staging_module_allows_host_arithmetic():
+    # int()/float() on concrete numpy are ordinary host arithmetic in
+    # the staging module — no concretization hazard without tracing
+    src = """
+        import numpy as np
+
+        def lease_shape(shape, k):
+            rows = int(np.prod(shape))
+            return rows, float(k)
+    """
+    assert _lint(src, "ops/staging.py", select="device-sync") == []
+
+
 # ---------------------------------------------------------------------------
 # dtype-width
 # ---------------------------------------------------------------------------
