@@ -7,6 +7,8 @@
     python -m hbbft_tpu.analysis --format sarif      # PR annotations
     python -m hbbft_tpu.analysis --write-baseline    # re-baseline (reviewed!)
     python -m hbbft_tpu.analysis --write-wire-manifest  # pin @wire registry
+    python -m hbbft_tpu.analysis --racecheck tests/test_racecheck.py
+                                  # runtime lockset checker over pytest
 
 Exit codes: 0 clean (baselined violations allowed), 1 new violations
 or parse errors, 2 usage error.
@@ -82,8 +84,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    parser.add_argument(
+        "--racecheck",
+        metavar="TEST_EXPR",
+        help="run `pytest --racecheck TEST_EXPR` in a subprocess under "
+        "the Eraser-style runtime lockset checker "
+        "(hbbft_tpu.analysis.racecheck) and render its candidate races "
+        "like lint violations",
+    )
     args = parser.parse_args(argv)
     fmt = args.format or ("json" if args.json else "human")
+
+    if args.racecheck is not None:
+        return _run_racecheck(args.racecheck, fmt)
 
     rules = all_rules()
     if args.list_rules:
@@ -164,6 +177,68 @@ def main(argv: Optional[List[str]] = None) -> int:
             suffix = f" ({len(baselined)} baselined)" if baselined else ""
             print(f"clean{suffix}")
     return 1 if (new or errors) else 0
+
+
+def _run_racecheck(test_expr: str, fmt: str) -> int:
+    """Drive ``pytest --racecheck`` in a subprocess (the shims must be
+    installed in the process that runs the tests, and the caller's JAX
+    state must stay untouched), collect the JSONL report and render the
+    candidate races with the usual formatters."""
+    import shlex
+    import subprocess
+    import tempfile
+
+    from . import racecheck as _rc
+
+    repo_root = os.path.dirname(os.path.dirname(_HERE))
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "racecheck.jsonl")
+        env = dict(os.environ)
+        env[_rc.OUT_ENV] = out
+        cmd = [
+            sys.executable,
+            "-m",
+            "pytest",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            "--racecheck",
+            *shlex.split(test_expr),
+        ]
+        proc = subprocess.run(cmd, env=env, cwd=repo_root)
+        reports = _rc.load_reports(out)
+
+    violations = [r.as_violation() for r in reports]
+    if fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "violations": [v.as_dict() for v in violations],
+                    "pytest_exit": proc.returncode,
+                    "ok": not violations and proc.returncode == 0,
+                },
+                indent=2,
+            )
+        )
+    elif fmt == "sarif":
+
+        class _RcRule:
+            name = "racecheck"
+            description = (
+                "runtime lockset checker: every shared-modified variable "
+                "keeps a non-empty candidate lockset"
+            )
+
+        print(json.dumps(_sarif(violations, [], [_RcRule()]), indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+        if violations:
+            print(f"\n{len(violations)} candidate race(s)")
+        else:
+            print("racecheck clean")
+    return 1 if (violations or proc.returncode) else 0
 
 
 def _counts(violations: List[Violation]) -> dict:

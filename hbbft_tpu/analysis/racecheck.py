@@ -1,0 +1,629 @@
+"""Eraser-style runtime lockset checker for the staged flush pipeline.
+
+The static passes (``thread-shared-state``, ``lock-order``,
+``atomic-cache``) see the module-level picture but are blind to
+aliasing through locals, dynamic dispatch and instance attributes.
+This module covers the other half at runtime, with the classic Eraser
+algorithm [Savage et al., SOSP '97] adapted to Python's builtins:
+
+- **Tracked locks.**  :class:`TrackedLock` wraps a real
+  ``threading.Lock``/``RLock`` and maintains a per-thread *held set*
+  (CPython's ``_thread.lock`` is a C type whose methods cannot be
+  patched, so the checker rebinds the module globals that *hold* the
+  locks rather than patching lock methods).
+- **Tracked containers.**  :class:`TrackedDict` / :class:`TrackedSet`
+  / :class:`TrackedList` subclass the builtins and record
+  ``(thread, lockset, is_write)`` per access before delegating.
+- **Lockset refinement.**  Each tracked variable moves through
+  Virgin → Exclusive(first thread) → Shared → Shared-Modified.  Its
+  candidate lockset ``C(v)`` starts as the held set at the first
+  cross-thread access and is intersected with the held set on every
+  later one; an empty ``C(v)`` on a Shared-Modified variable is a
+  candidate race, reported once per (variable, site) as a structured
+  :class:`~hbbft_tpu.analysis.core.Violation` (rule ``racecheck``) so
+  the human/JSON/SARIF renderers work unchanged.
+
+Two front doors:
+
+- ``pytest --racecheck`` (``tests/conftest.py``): every test runs
+  between :func:`enable` / :func:`disable`; candidate races accumulate
+  into ``$HBBFT_TPU_RACECHECK_OUT`` (JSONL, one report per line) and
+  fail the run in the conftest hook.
+- ``python -m hbbft_tpu.analysis --racecheck <test-expr>``: runs the
+  pytest expression in a subprocess with the env wiring above and
+  renders the collected reports like any other lint violation.
+
+What :func:`enable` shims — exactly the shared-state surface the
+static inventory mapped (plus the live instances statics cannot see):
+the ``staging`` / ``pallas_ec`` / ``packed_msm`` / ``rs`` /
+``gf256_jax`` / ``recorder`` module locks, the ``_EXEC_MEM`` /
+``_WARM_SEEN`` / ``_RHO_STATE`` caches, ``staging._BUFFERS``'s pool
+dict+lock, a live ``staging._STAGER`` and ``recorder.ACTIVE``.  After
+:func:`disable` the plain builtins are rebound (``dict(tracked)``), so
+warm caches survive the instrumented window byte-for-byte.
+
+Known gaps, by design: a module global rebound *after* enable (e.g.
+``_RHO_STATE`` rebuilt from ``None``) replaces the tracked container —
+the window closes until the next :func:`enable`; ``json``'s C encoder
+may iterate dict subclasses without calling the overridden methods
+(missed read records, never a crash).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from .core import Violation
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_PKG_ROOT = os.path.join(_REPO_ROOT, "hbbft_tpu")
+_SELF = os.path.abspath(__file__)
+
+OUT_ENV = "HBBFT_TPU_RACECHECK_OUT"
+
+
+def _site() -> Tuple[str, int]:
+    """(path, line) of the instrumented access — the innermost frame
+    that is neither this module nor the interpreter's threading
+    machinery.  Paths render package-relative (``ops/packed_msm.py``)
+    to match the static rules' violations."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) != _SELF and "threading" not in os.path.basename(fn):
+            path = os.path.abspath(fn)
+            if path.startswith(_PKG_ROOT + os.sep):
+                return os.path.relpath(path, _PKG_ROOT), f.f_lineno
+            if path.startswith(_REPO_ROOT + os.sep):
+                return os.path.relpath(path, _REPO_ROOT), f.f_lineno
+            return os.path.basename(path), f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+@dataclass
+class RaceReport:
+    """One candidate race: a Shared-Modified variable whose candidate
+    lockset refined to empty."""
+
+    var: str
+    path: str
+    line: int
+    thread: str
+    write: bool
+    first_thread: str
+    threads: Tuple[str, ...]
+
+    def message(self) -> str:
+        kind = "write" if self.write else "read"
+        return (
+            f"candidate race on '{self.var}': un-locked {kind} on thread "
+            f"'{self.thread}' after accesses from "
+            f"{{{', '.join(repr(t) for t in self.threads)}}} share no "
+            "common lock — hold one lock across every access"
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "var": self.var,
+            "path": self.path,
+            "line": self.line,
+            "thread": self.thread,
+            "write": self.write,
+            "first_thread": self.first_thread,
+            "threads": list(self.threads),
+            "message": self.message(),
+        }
+
+    def as_violation(self) -> Violation:
+        return Violation(
+            rule="racecheck",
+            path=self.path,
+            line=self.line,
+            col=0,
+            message=self.message(),
+        )
+
+
+# Eraser states
+_VIRGIN = 0
+_EXCLUSIVE = 1
+_SHARED = 2
+_SHARED_MOD = 3
+
+
+@dataclass
+class _VarState:
+    state: int = _VIRGIN
+    first_thread: str = ""
+    threads: set = field(default_factory=set)
+    lockset: Optional[FrozenSet[str]] = None  # C(v); None until refined
+
+
+class TrackedLock:
+    """Wraps a real ``threading.Lock``/``RLock``; bookkeeps the calling
+    thread's held set (reentrant depth counted, so an RLock acquired
+    twice leaves the set only on the final release).  The checker never
+    changes blocking semantics — every acquire/release delegates."""
+
+    def __init__(self, raw, name: str, checker: "RaceChecker"):
+        self._raw = raw
+        self._name = name
+        self._chk = checker
+
+    def acquire(self, *a, **kw):
+        got = self._raw.acquire(*a, **kw)
+        if got:
+            self._chk._push_lock(self._name)
+        return got
+
+    def release(self):
+        self._chk._pop_lock(self._name)
+        return self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __repr__(self):
+        return f"TrackedLock({self._name!r}, {self._raw!r})"
+
+
+class TrackedDict(dict):
+    """A dict that records each access against the checker before
+    delegating.  Mutators record writes; lookups record reads."""
+
+    __slots__ = ("_chk", "_name")
+
+    def __init__(self, chk: "RaceChecker", name: str, *a, **kw):
+        self._chk = chk
+        self._name = name
+        super().__init__(*a, **kw)
+
+    def _rec(self, write: bool) -> None:
+        self._chk._record(self._name, write)
+
+    def __getitem__(self, k):
+        self._rec(False)
+        return super().__getitem__(k)
+
+    def __contains__(self, k):
+        self._rec(False)
+        return super().__contains__(k)
+
+    def get(self, k, default=None):
+        self._rec(False)
+        return super().get(k, default)
+
+    def __iter__(self):
+        self._rec(False)
+        return super().__iter__()
+
+    def items(self):
+        self._rec(False)
+        return super().items()
+
+    def values(self):
+        self._rec(False)
+        return super().values()
+
+    def keys(self):
+        self._rec(False)
+        return super().keys()
+
+    def __setitem__(self, k, v):
+        self._rec(True)
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._rec(True)
+        super().__delitem__(k)
+
+    def setdefault(self, k, default=None):
+        self._rec(True)
+        return super().setdefault(k, default)
+
+    def pop(self, *a):
+        self._rec(True)
+        return super().pop(*a)
+
+    def popitem(self):
+        self._rec(True)
+        return super().popitem()
+
+    def update(self, *a, **kw):
+        self._rec(True)
+        super().update(*a, **kw)
+
+    def clear(self):
+        self._rec(True)
+        super().clear()
+
+
+class TrackedSet(set):
+    __slots__ = ("_chk", "_name")
+
+    def __init__(self, chk: "RaceChecker", name: str, *a):
+        self._chk = chk
+        self._name = name
+        super().__init__(*a)
+
+    def _rec(self, write: bool) -> None:
+        self._chk._record(self._name, write)
+
+    def __contains__(self, v):
+        self._rec(False)
+        return super().__contains__(v)
+
+    def __iter__(self):
+        self._rec(False)
+        return super().__iter__()
+
+    def add(self, v):
+        self._rec(True)
+        super().add(v)
+
+    def discard(self, v):
+        self._rec(True)
+        super().discard(v)
+
+    def remove(self, v):
+        self._rec(True)
+        super().remove(v)
+
+    def update(self, *a):
+        self._rec(True)
+        super().update(*a)
+
+    def clear(self):
+        self._rec(True)
+        super().clear()
+
+
+class TrackedList(list):
+    __slots__ = ("_chk", "_name")
+
+    def __init__(self, chk: "RaceChecker", name: str, *a):
+        self._chk = chk
+        self._name = name
+        super().__init__(*a)
+
+    def _rec(self, write: bool) -> None:
+        self._chk._record(self._name, write)
+
+    def __getitem__(self, i):
+        self._rec(False)
+        return super().__getitem__(i)
+
+    def __iter__(self):
+        self._rec(False)
+        return super().__iter__()
+
+    def __setitem__(self, i, v):
+        self._rec(True)
+        super().__setitem__(i, v)
+
+    def append(self, v):
+        self._rec(True)
+        super().append(v)
+
+    def extend(self, it):
+        self._rec(True)
+        super().extend(it)
+
+    def insert(self, i, v):
+        self._rec(True)
+        super().insert(i, v)
+
+    def pop(self, *a):
+        self._rec(True)
+        return super().pop(*a)
+
+    def remove(self, v):
+        self._rec(True)
+        super().remove(v)
+
+    def clear(self):
+        self._rec(True)
+        super().clear()
+
+
+class RaceChecker:
+    """The lockset state machine + the shim installer.
+
+    Usable standalone in tests (``chk = RaceChecker();
+    d = chk.track_dict({}, "mine")``) or process-wide via the
+    module-level :func:`enable` / :func:`disable` pair."""
+
+    def __init__(self) -> None:
+        # the checker's OWN synchronization is a raw RLock created
+        # before any shimming — it must never appear in held sets
+        self._mu = threading.RLock()
+        self._tls = threading.local()
+        self._vars: Dict[str, _VarState] = {}
+        self.reports: List[RaceReport] = []
+        self._seen: set = set()  # (var, path, line) dedupe
+        self.active = True
+        self._shims: List[Tuple[Any, str, Any]] = []  # (obj, attr, original)
+
+    # -- held-set bookkeeping (thread-local, no lock needed) ----------------
+
+    def _held_map(self) -> Dict[str, int]:
+        m = getattr(self._tls, "held", None)
+        if m is None:
+            m = {}
+            self._tls.held = m
+        return m
+
+    def _push_lock(self, name: str) -> None:
+        m = self._held_map()
+        m[name] = m.get(name, 0) + 1
+
+    def _pop_lock(self, name: str) -> None:
+        m = self._held_map()
+        n = m.get(name, 0) - 1
+        if n <= 0:
+            m.pop(name, None)
+        else:
+            m[name] = n
+
+    def held(self) -> FrozenSet[str]:
+        return frozenset(self._held_map())
+
+    # -- the Eraser state machine -------------------------------------------
+
+    def _record(self, var: str, write: bool) -> None:
+        if not self.active:
+            return
+        tname = threading.current_thread().name
+        held = self.held()
+        with self._mu:
+            st = self._vars.get(var)
+            if st is None:
+                st = self._vars[var] = _VarState()
+            st.threads.add(tname)
+            if st.state == _VIRGIN:
+                st.state = _EXCLUSIVE
+                st.first_thread = tname
+                return
+            if st.state == _EXCLUSIVE:
+                if tname == st.first_thread:
+                    return
+                # first cross-thread access: start lockset refinement
+                st.lockset = held
+                st.state = _SHARED_MOD if write else _SHARED
+            else:
+                st.lockset = (
+                    held if st.lockset is None else st.lockset & held
+                )
+                if write:
+                    st.state = _SHARED_MOD
+            if st.state == _SHARED_MOD and not st.lockset:
+                path, line = _site()
+                key = (var, path, line)
+                if key in self._seen:
+                    return
+                self._seen.add(key)
+                self.reports.append(
+                    RaceReport(
+                        var=var,
+                        path=path,
+                        line=line,
+                        thread=tname,
+                        write=write,
+                        first_thread=st.first_thread,
+                        threads=tuple(sorted(st.threads)),
+                    )
+                )
+
+    # -- ad-hoc tracking (fixtures, instance attributes) --------------------
+
+    def track_lock(self, lock, name: str) -> TrackedLock:
+        if isinstance(lock, TrackedLock):
+            return lock
+        return TrackedLock(lock, name, self)
+
+    def track_dict(self, d: dict, name: str) -> TrackedDict:
+        if isinstance(d, TrackedDict):
+            return d
+        return TrackedDict(self, name, d)
+
+    def track_set(self, s: set, name: str) -> TrackedSet:
+        if isinstance(s, TrackedSet):
+            return s
+        return TrackedSet(self, name, s)
+
+    def track_list(self, lst: list, name: str) -> TrackedList:
+        if isinstance(lst, TrackedList):
+            return lst
+        return TrackedList(self, name, lst)
+
+    # -- shim installation ---------------------------------------------------
+
+    def _shim(self, obj: Any, attr: str, wrapped: Any) -> None:
+        self._shims.append((obj, attr, getattr(obj, attr)))
+        setattr(obj, attr, wrapped)
+
+    def install(self) -> None:
+        """Shim the package's shared-state surface (see module doc).
+        Imports lazily so the checker works in a process that never
+        touched the ops layer."""
+        from ..crypto import rs
+        from ..obs import recorder
+        from ..ops import gf256_jax, packed_msm, pallas_ec, staging
+
+        lock_sites = [
+            (staging, "_STAGER_LOCK", "ops/staging._STAGER_LOCK"),
+            (pallas_ec, "_EXEC_LOCK", "ops/pallas_ec._EXEC_LOCK"),
+            (pallas_ec, "_FIELD_LOCK", "ops/pallas_ec._FIELD_LOCK"),
+            (packed_msm, "_STATE_LOCK", "ops/packed_msm._STATE_LOCK"),
+            (rs, "_TABLE16_LOCK", "crypto/rs._TABLE16_LOCK"),
+            (gf256_jax, "_BITS16_LOCK", "ops/gf256_jax._BITS16_LOCK"),
+            (recorder, "_SWITCH_LOCK", "obs/recorder._SWITCH_LOCK"),
+        ]
+        for mod, attr, name in lock_sites:
+            self._shim(mod, attr, self.track_lock(getattr(mod, attr), name))
+
+        self._shim(
+            pallas_ec,
+            "_EXEC_MEM",
+            self.track_dict(pallas_ec._EXEC_MEM, "ops/pallas_ec._EXEC_MEM"),
+        )
+        self._shim(
+            packed_msm,
+            "_WARM_SEEN",
+            self.track_set(packed_msm._WARM_SEEN, "ops/packed_msm._WARM_SEEN"),
+        )
+        if isinstance(packed_msm._RHO_STATE, dict):
+            self._shim(
+                packed_msm,
+                "_RHO_STATE",
+                self.track_dict(
+                    packed_msm._RHO_STATE, "ops/packed_msm._RHO_STATE"
+                ),
+            )
+
+        # live instances the static passes cannot see
+        pool = staging._BUFFERS
+        self._shim(
+            pool, "_lock",
+            self.track_lock(pool._lock, "ops/staging.BufferPool._lock"),
+        )
+        self._shim(
+            pool, "_free",
+            self.track_dict(pool._free, "ops/staging.BufferPool._free"),
+        )
+        stager = staging._STAGER
+        if stager is not None:
+            self._shim(
+                stager, "_lock",
+                self.track_lock(stager._lock, "ops/staging.Stager._lock"),
+            )
+        rec = recorder.ACTIVE
+        if rec is not None:
+            self._shim(
+                rec, "_lock",
+                self.track_lock(rec._lock, "obs/recorder.Recorder._lock"),
+            )
+            self._shim(
+                rec, "events",
+                self.track_list(rec.events, "obs/recorder.Recorder.events"),
+            )
+            self._shim(
+                rec, "counters",
+                self.track_dict(rec.counters, "obs/recorder.Recorder.counters"),
+            )
+            self._shim(
+                rec, "_hists",
+                self.track_dict(rec._hists, "obs/recorder.Recorder._hists"),
+            )
+
+    def uninstall(self) -> None:
+        """Undo every shim, newest first.  Tracked containers rebind as
+        plain builtins built from their CURRENT contents (an executable
+        loaded during the instrumented window stays cached); tracked
+        locks rebind to the original lock object they delegated to, so
+        no held state is lost."""
+        self.active = False
+        for obj, attr, original in reversed(self._shims):
+            current = getattr(obj, attr)
+            if isinstance(current, TrackedDict):
+                setattr(obj, attr, dict(current))
+            elif isinstance(current, TrackedSet):
+                setattr(obj, attr, set(current))
+            elif isinstance(current, TrackedList):
+                setattr(obj, attr, list(current))
+            elif isinstance(current, TrackedLock):
+                setattr(obj, attr, current._raw)
+            else:
+                # product code rebound the global mid-window (documented
+                # gap: e.g. _RHO_STATE reset by a test) — leave its value
+                pass
+        self._shims.clear()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide switchboard (refcounted: nested enables share one checker)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[RaceChecker] = None
+_DEPTH = 0
+_SWITCH = threading.Lock()
+
+
+def active() -> Optional[RaceChecker]:
+    return _ACTIVE
+
+
+def enable() -> RaceChecker:
+    """Install the process-wide checker (idempotent/refcounted)."""
+    global _ACTIVE, _DEPTH
+    with _SWITCH:
+        if _ACTIVE is None:
+            chk = RaceChecker()
+            chk.install()
+            _ACTIVE = chk
+            _DEPTH = 0
+        _DEPTH += 1
+        return _ACTIVE
+
+
+def disable() -> List[RaceReport]:
+    """Drop one enable; on the last one, uninstall every shim, append
+    the collected reports to ``$HBBFT_TPU_RACECHECK_OUT`` (JSONL) when
+    set, and return them."""
+    global _ACTIVE, _DEPTH
+    with _SWITCH:
+        if _ACTIVE is None:
+            return []
+        _DEPTH -= 1
+        if _DEPTH > 0:
+            return list(_ACTIVE.reports)
+        chk = _ACTIVE
+        _ACTIVE = None
+    chk.uninstall()
+    out = os.environ.get(OUT_ENV)
+    if out and chk.reports:
+        with open(out, "a") as fh:
+            for r in chk.reports:
+                fh.write(json.dumps(r.as_dict(), sort_keys=True) + "\n")
+    return list(chk.reports)
+
+
+def load_reports(path: str) -> List[RaceReport]:
+    """Parse a ``$HBBFT_TPU_RACECHECK_OUT`` JSONL file back into
+    reports (the CLI renders them as violations)."""
+    reports: List[RaceReport] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                reports.append(
+                    RaceReport(
+                        var=d["var"],
+                        path=d["path"],
+                        line=int(d["line"]),
+                        thread=d["thread"],
+                        write=bool(d["write"]),
+                        first_thread=d.get("first_thread", ""),
+                        threads=tuple(d.get("threads", ())),
+                    )
+                )
+    except FileNotFoundError:
+        pass
+    return reports

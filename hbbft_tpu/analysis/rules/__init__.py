@@ -22,6 +22,13 @@
                           are append-only
 ``pallas-shape``          ``pl.pallas_call`` BlockSpecs tile the padded
                           array shapes; index maps stay in bounds
+``thread-shared-state``   module globals shared between thread targets
+                          and the main path are written under a lock;
+                          spawned threads carry stable ``hbbft-*`` names
+``lock-order``            the static lock-acquisition graph is acyclic;
+                          no re-acquisition of a held non-reentrant lock
+``atomic-cache``          no unguarded check-then-act cache idioms in
+                          modules the thread inventory marks concurrent
 ========================  ==================================================
 """
 
@@ -30,14 +37,17 @@ from __future__ import annotations
 from typing import List
 
 from ..core import Rule
+from .atomic_cache import AtomicCacheRule
 from .determinism import DeterminismRule
 from .device_sync import DeviceSyncRule
 from .dtype_width import DtypeWidthRule
 from .layering import LayeringRule
+from .lock_order import LockOrderRule
 from .obs_schema import ObsSchemaRule
 from .ordering import OrderedIterRule
 from .pallas_shape import PallasShapeRule
 from .step_purity import StepPurityRule
+from .thread_shared_state import ThreadSharedStateRule
 from .wire_stability import WireStabilityRule
 
 
@@ -53,4 +63,7 @@ def all_rules() -> List[Rule]:
         StepPurityRule(),
         WireStabilityRule(),
         PallasShapeRule(),
+        ThreadSharedStateRule(),
+        LockOrderRule(),
+        AtomicCacheRule(),
     ]

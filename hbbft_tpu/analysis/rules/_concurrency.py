@@ -1,0 +1,976 @@
+"""Shared static thread inventory for the concurrency rules.
+
+The three thread-safety passes (``thread-shared-state``,
+``lock-order``, ``atomic-cache``) all need the same whole-project
+facts, extracted once per file during ``check`` and joined in
+``finish_run``:
+
+- **Spawn sites** — every ``threading.Thread(target=...)``,
+  ``ThreadPoolExecutor(...)`` and ``<anything>.submit(fn, ...)``
+  (which covers both executors and the staging FIFO worker's
+  ``Stager.submit``), with the target reference recorded for
+  call-graph seeding and the ``name=`` / ``thread_name_prefix=``
+  keyword recorded for the readable-racecheck-report check.
+- **A call graph** good enough for reachability: bare names and
+  ``self.method`` resolve within the module (methods and nested
+  closures are indexed by bare name — over-approximate on purpose),
+  ``alias.f`` resolves through intra-package import aliases.  BFS
+  from the spawn targets yields the set of *thread-reachable*
+  functions.
+- **Module-level mutable state** — bindings whose initializer is a
+  container literal/constructor, plus any name some function rebinds
+  through a ``global`` declaration (lazy singletons like
+  ``_RHO_STATE`` / ``_STAGER``).
+- **Global accesses** with their lock context: reads/writes of those
+  globals from function bodies, each tagged with whether it happened
+  inside a ``with <something ending in "lock">:`` block.  Writes
+  cover rebinds, subscript stores/deletes and mutator method calls
+  (``.add`` / ``.append`` / ``.setdefault`` / ...).
+- **Lock facts** — which locks each function acquires, the direct
+  nested-``with`` edges, which calls happen while holding a lock,
+  and each lock's constructor kind (``Lock`` vs ``RLock``) where the
+  assignment is visible.
+- **Check-then-act candidates** — ``if key not in cache:``,
+  ``cache.get(k) is None``, ``if G is None:`` lazy init and
+  early-return membership guards whose *act* (the store/mutate) is
+  not under a lock.  ``atomic-cache`` reports them only for modules
+  the inventory marks concurrent.
+
+Known blind spots, on purpose (this is a project lint, not a
+verifier): aliasing through locals (``state = _rho_state();
+state[k] = ...`` is invisible), dynamic dispatch
+(``self.nodes[i].algo.handle_message`` does not extend the call
+graph), and instance-attribute state (covered at runtime by
+``analysis/racecheck.py`` instead).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileContext
+from ._ast_util import dotted_name
+
+# Container constructors whose module-level result is shared mutable
+# state worth tracking.
+_MUTABLE_CTORS = {
+    "dict",
+    "list",
+    "set",
+    "bytearray",
+    "collections.defaultdict",
+    "defaultdict",
+    "collections.OrderedDict",
+    "OrderedDict",
+    "collections.deque",
+    "deque",
+    "collections.Counter",
+    "Counter",
+}
+
+# In-place mutator methods on the tracked containers.
+_MUTATORS = {
+    "add",
+    "append",
+    "appendleft",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "popleft",
+    "remove",
+    "setdefault",
+    "update",
+}
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_EXECUTOR_CTORS = {
+    "ThreadPoolExecutor",
+    "futures.ThreadPoolExecutor",
+    "concurrent.futures.ThreadPoolExecutor",
+}
+
+THREAD_NAME_PREFIX = "hbbft-"
+
+
+def module_key(relpath: str) -> str:
+    """``ops/packed_msm.py`` → ``ops/packed_msm`` (stable across the
+    scan root, like every badgerlint path)."""
+    key = relpath[:-3] if relpath.endswith(".py") else relpath
+    return key
+
+
+class GlobalAccess:
+    """One read/write of a module-level mutable global from a function
+    body.  ``owner`` is None for this module's own globals, else the
+    candidate owner module key (``alias.NAME`` accesses — confirmed
+    against the owner's global table at finish time)."""
+
+    __slots__ = ("owner", "name", "line", "col", "write", "locked", "suppressed")
+
+    def __init__(self, owner, name, line, col, write, locked, suppressed):
+        self.owner = owner
+        self.name = name
+        self.line = line
+        self.col = col
+        self.write = write
+        self.locked = locked
+        self.suppressed = suppressed
+
+
+class SpawnSite:
+    """One thread/executor creation or ``.submit`` call."""
+
+    __slots__ = ("kind", "target", "line", "col", "name_ok", "name_missing")
+
+    def __init__(self, kind, target, line, col, name_ok, name_missing):
+        self.kind = kind  # "thread" | "executor" | "submit"
+        self.target = target  # a ref (see _call_ref) or None
+        self.line = line
+        self.col = col
+        self.name_ok = name_ok
+        self.name_missing = name_missing
+
+
+class CheckThenAct:
+    """One unguarded check-then-act candidate (reported by
+    ``atomic-cache`` iff the module turns out concurrent)."""
+
+    __slots__ = ("owner", "name", "line", "col", "suppressed", "what")
+
+    def __init__(self, owner, name, line, col, suppressed, what):
+        self.owner = owner
+        self.name = name
+        self.line = line
+        self.col = col
+        self.suppressed = suppressed
+        self.what = what
+
+
+class FuncInfo:
+    """Per-function facts."""
+
+    __slots__ = (
+        "qualname",
+        "bare",
+        "class_name",
+        "line",
+        "calls",
+        "acquires",
+        "edges",
+        "accesses",
+    )
+
+    def __init__(self, qualname, bare, class_name, line):
+        self.qualname = qualname
+        self.bare = bare
+        self.class_name = class_name
+        self.line = line
+        # (ref, held_locks_tuple, line)
+        self.calls: List[Tuple[tuple, Tuple[str, ...], int]] = []
+        # (lock_id, line, col, suppressed)
+        self.acquires: List[Tuple[str, int, int, bool]] = []
+        # (outer_id, inner_id, line, col, suppressed)
+        self.edges: List[Tuple[str, str, int, int, bool]] = []
+        self.accesses: List[GlobalAccess] = []
+
+
+class ModuleInfo:
+    """Per-file facts, joined across the project in ``finish_run``."""
+
+    def __init__(self, key: str, relpath: str):
+        self.key = key
+        self.relpath = relpath
+        self.functions: List[FuncInfo] = []
+        self.by_bare: Dict[str, List[FuncInfo]] = {}
+        self.spawns: List[SpawnSite] = []
+        self.mutable_globals: Dict[str, int] = {}
+        self.module_names: Set[str] = set()
+        # alias → list of (kind, ...) candidates; kind "mod" → module
+        # key, kind "name" → (module key, original name)
+        self.aliases: Dict[str, List[tuple]] = {}
+        self.lock_kinds: Dict[str, str] = {}
+        self.cta: List[CheckThenAct] = []
+
+    def add_function(self, fi: FuncInfo) -> None:
+        self.functions.append(fi)
+        self.by_bare.setdefault(fi.bare, []).append(fi)
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
+
+
+def _mutable_value(value: Optional[ast.AST]) -> bool:
+    if value is None:
+        return False
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        return dotted_name(value.func) in _MUTABLE_CTORS
+    return False
+
+
+def _lock_ctor_kind(value: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        dn = dotted_name(value.func)
+        if dn in ("threading.Lock", "Lock"):
+            return "Lock"
+        if dn in ("threading.RLock", "RLock"):
+            return "RLock"
+    return None
+
+
+def _package_of(key: str) -> str:
+    return key.rsplit("/", 1)[0] if "/" in key else ""
+
+
+def _join_mod(*parts: str) -> str:
+    return "/".join(p for p in parts if p)
+
+
+def _collect_locals(fn: ast.AST) -> Tuple[Set[str], Set[str], Set[str]]:
+    """→ (locals, global_decls, nested_def_names) for one function,
+    without descending into nested function/class bodies."""
+    locs: Set[str] = set()
+    globs: Set[str] = set()
+    nested: Set[str] = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs)
+        + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        locs.add(a.arg)
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.add(child.name)
+                continue
+            if isinstance(child, (ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Global):
+                globs.update(child.names)
+            elif isinstance(child, ast.Name) and isinstance(
+                child.ctx, (ast.Store, ast.Del)
+            ):
+                locs.add(child.id)
+            elif isinstance(child, ast.ExceptHandler) and child.name:
+                locs.add(child.name)
+            walk(child)
+
+    walk(fn)
+    locs -= globs
+    locs -= nested
+    return locs, globs, nested
+
+
+class _Extractor:
+    """One pass over a parsed file → :class:`ModuleInfo`.
+
+    ``rule_name`` is the calling rule's name: suppression flags are
+    per-rule, so each rule extracts with its own name (the walks are
+    cheap next to parse)."""
+
+    def __init__(self, ctx: FileContext, rule_name: str):
+        self.ctx = ctx
+        self.rule = rule_name
+        self.mi = ModuleInfo(module_key(ctx.relpath), ctx.relpath)
+
+    # -- module level -------------------------------------------------------
+
+    def run(self) -> ModuleInfo:
+        tree = self.ctx.tree
+        self._collect_module_bindings(tree)
+        self._collect_imports(tree)
+        # names some function rebinds via `global` are shared mutable
+        # state even when bound to None at module level (lazy
+        # singletons)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Global):
+                for n in node.names:
+                    self.mi.mutable_globals.setdefault(
+                        n, getattr(node, "lineno", 0)
+                    )
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._extract_function(stmt, prefix="", class_name=None)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._extract_function(
+                            sub, prefix=stmt.name + ".", class_name=stmt.name
+                        )
+        # top-level statements can spawn too (scripts, fixtures); treat
+        # all module names as locals so import-time bindings are not
+        # mistaken for unguarded writes
+        mod_fi = FuncInfo("<module>", "<module>", None, 1)
+        self.mi.add_function(mod_fi)
+        top = ast.Module(
+            body=[
+                s
+                for s in tree.body
+                if not isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+            ],
+            type_ignores=[],
+        )
+        self._walk_body(
+            top, mod_fi, set(self.mi.module_names), set(), set(), "<module>", None
+        )
+        return self.mi
+
+    def _collect_module_bindings(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            targets: List[ast.AST] = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign):
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                self.mi.module_names.add(stmt.name)
+                continue
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                self.mi.module_names.add(t.id)
+                if _mutable_value(value):
+                    self.mi.mutable_globals.setdefault(t.id, stmt.lineno)
+                kind = _lock_ctor_kind(value)
+                if kind:
+                    self.mi.lock_kinds[f"{self.mi.key}:{t.id}"] = kind
+        # `self._lock = threading.Lock()` in methods → per-class kind
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        kind = _lock_ctor_kind(sub.value)
+                        if kind:
+                            self.mi.lock_kinds[
+                                f"{self.mi.key}:{node.name}.{t.attr}"
+                            ] = kind
+
+    def _collect_imports(self, tree: ast.Module) -> None:
+        pkg = _package_of(self.mi.key)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.name
+                    parts = name.split(".")
+                    if parts[0] == "hbbft_tpu" and alias.asname:
+                        self.mi.aliases.setdefault(alias.asname, []).append(
+                            ("mod", _join_mod(*parts[1:]))
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base_parts = self.mi.key.split("/")[:-1]
+                    up = node.level - 1
+                    base_parts = base_parts[: len(base_parts) - up] if up else base_parts
+                    base = "/".join(base_parts)
+                else:
+                    mod = node.module or ""
+                    parts = mod.split(".")
+                    if parts[0] != "hbbft_tpu":
+                        continue  # external import: out of scope
+                    base = _join_mod(*parts[1:])
+                    mod = ""
+                sub = (node.module or "").replace(".", "/") if node.level else ""
+                target = _join_mod(base, sub) if node.level else base
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    cands = self.mi.aliases.setdefault(bound, [])
+                    if node.level and not node.module:
+                        # `from . import staging` — submodule for sure
+                        cands.append(("mod", _join_mod(target, alias.name)))
+                    else:
+                        # `from .obs import recorder` could bind a
+                        # submodule OR a name; record both, resolution
+                        # picks whichever module key was scanned
+                        cands.append(("mod", _join_mod(target, alias.name)))
+                        cands.append(("name", target, alias.name))
+
+    # -- function level -----------------------------------------------------
+
+    def _extract_function(self, fn, prefix: str, class_name: Optional[str]):
+        fi = FuncInfo(prefix + fn.name, fn.name, class_name, fn.lineno)
+        self.mi.add_function(fi)
+        locs, globs, nested = _collect_locals(fn)
+        self._walk_body(fn, fi, locs, globs, nested, prefix + fn.name, class_name)
+        self._scan_check_then_act(fn, fi, locs, globs)
+
+    def _lock_id(self, expr: ast.AST, fi: FuncInfo, locs: Set[str]) -> Optional[str]:
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        if "lock" not in parts[-1].lower():
+            return None
+        mod = self.mi.key
+        if parts[0] == "self" and len(parts) == 2:
+            cls = fi.class_name or "self"
+            return f"{mod}:{cls}.{parts[1]}"
+        if len(parts) == 1:
+            if parts[0] in locs:
+                return f"{mod}:?{fi.qualname}.{parts[0]}"
+            return f"{mod}:{parts[0]}"
+        if len(parts) == 2 and parts[0] in self.mi.aliases:
+            for cand in self.mi.aliases[parts[0]]:
+                if cand[0] == "mod":
+                    return f"{cand[1]}:{parts[1]}"
+        return f"{mod}:?{dn}"
+
+    def _call_ref(self, func_expr: ast.AST, locs: Set[str], nested: Set[str]):
+        """A resolvable reference to the called/spawned function, or
+        None.  Forms: ("local", bare) — same module (methods, nested
+        closures, top-level defs); ("ext", [(mod, name), ...]) —
+        through an import alias."""
+        dn = dotted_name(func_expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        if len(parts) == 1:
+            n = parts[0]
+            if n in nested or n in self.mi.module_names:
+                return ("local", n)
+            if n in self.mi.aliases:
+                ext = [
+                    (c[1], c[2]) for c in self.mi.aliases[n] if c[0] == "name"
+                ]
+                if ext:
+                    return ("ext", ext)
+            if n in locs:
+                return None
+            return ("local", n)
+        if parts[0] == "self" and len(parts) == 2:
+            return ("local", parts[1])
+        if len(parts) == 2 and parts[0] in self.mi.aliases:
+            ext = [
+                (c[1], parts[1])
+                for c in self.mi.aliases[parts[0]]
+                if c[0] == "mod"
+            ]
+            if ext:
+                return ("ext", ext)
+        return None
+
+    def _global_target(
+        self, expr: ast.AST, locs: Set[str], globs: Set[str]
+    ) -> Optional[Tuple[Optional[str], str]]:
+        """(owner_key_or_None, name) when ``expr`` is a tracked global
+        (bare name) or an ``alias.NAME`` candidate."""
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in locs:
+                return None
+            if n in globs or n in self.mi.mutable_globals:
+                return (None, n)
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            alias = expr.value.id
+            if alias in locs or alias == "self":
+                return None
+            for cand in self.mi.aliases.get(alias, ()):
+                if cand[0] == "mod":
+                    return (cand[1], expr.attr)
+        return None
+
+    def _record_access(self, fi, owner, name, node, write, held):
+        fi.accesses.append(
+            GlobalAccess(
+                owner,
+                name,
+                node.lineno,
+                node.col_offset,
+                write,
+                bool(held),
+                self.ctx.suppressed(self.rule, node.lineno),
+            )
+        )
+
+    def _spawn_name_ok(self, call: ast.Call, kw: str) -> Tuple[bool, bool]:
+        """→ (name_ok, name_missing) for a Thread/executor ctor."""
+        for k in call.keywords:
+            if k.arg != kw:
+                continue
+            v = k.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value.startswith(THREAD_NAME_PREFIX), False)
+            if isinstance(v, ast.JoinedStr) and v.values:
+                first = v.values[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ):
+                    return (first.value.startswith(THREAD_NAME_PREFIX), False)
+            return (True, False)  # dynamic name: give it the benefit
+        return (False, True)
+
+    def _walk_body(self, fn, fi, locs, globs, nested, qual, class_name):
+        mi = self.mi
+
+        def handle_call(node: ast.Call, held):
+            dn = dotted_name(node.func)
+            # spawn sites
+            if dn in _THREAD_CTORS:
+                target = None
+                for k in node.keywords:
+                    if k.arg == "target":
+                        target = self._call_ref(k.value, locs, nested)
+                ok, missing = self._spawn_name_ok(node, "name")
+                mi.spawns.append(
+                    SpawnSite(
+                        "thread", target, node.lineno, node.col_offset, ok, missing
+                    )
+                )
+            elif dn in _EXECUTOR_CTORS:
+                ok, missing = self._spawn_name_ok(node, "thread_name_prefix")
+                mi.spawns.append(
+                    SpawnSite(
+                        "executor", None, node.lineno, node.col_offset, ok, missing
+                    )
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+                and node.args
+            ):
+                target = self._call_ref(node.args[0], locs, nested)
+                if target is not None:
+                    mi.spawns.append(
+                        SpawnSite(
+                            "submit",
+                            target,
+                            node.lineno,
+                            node.col_offset,
+                            True,
+                            False,
+                        )
+                    )
+            # mutator method on a tracked global → write
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+                tgt = self._global_target(node.func.value, locs, globs)
+                if tgt is not None:
+                    self._record_access(
+                        fi, tgt[0], tgt[1], node, True, held
+                    )
+            # call-graph edge
+            ref = self._call_ref(node.func, locs, nested)
+            if ref is not None:
+                fi.calls.append((ref, tuple(held), node.lineno))
+
+        def handle_store(target: ast.AST, node_for_pos: ast.AST, held):
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for el in target.elts:
+                    handle_store(el, node_for_pos, held)
+                return
+            if isinstance(target, ast.Name):
+                if target.id in globs:
+                    self._record_access(
+                        fi, None, target.id, node_for_pos, True, held
+                    )
+                return
+            if isinstance(target, ast.Subscript):
+                tgt = self._global_target(target.value, locs, globs)
+                if tgt is not None:
+                    self._record_access(
+                        fi, tgt[0], tgt[1], node_for_pos, True, held
+                    )
+                return
+            if isinstance(target, ast.Attribute):
+                tgt = self._global_target(target, locs, globs)
+                if tgt is not None:
+                    self._record_access(
+                        fi, tgt[0], tgt[1], node_for_pos, True, held
+                    )
+
+        def walk(node, held):
+            for child in ast.iter_child_nodes(node):
+                walk_node(child, held)
+
+        def walk_node(child, held):
+            # every node — whether a direct function-body statement, a
+            # with-body statement or a grandchild — routes through here,
+            # so a ``with <lock>:`` keeps its lock context at ANY depth
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested closure: fresh lock context (its body runs
+                # at call time, possibly on another thread)
+                sub_fi = FuncInfo(
+                    qual + ".<locals>." + child.name,
+                    child.name,
+                    class_name,
+                    child.lineno,
+                )
+                mi.add_function(sub_fi)
+                s_locs, s_globs, s_nested = _collect_locals(child)
+                # enclosing-scope names stay visible to the closure
+                s_locs |= locs | nested
+                self._walk_body(
+                    child, sub_fi, s_locs, s_globs, s_nested,
+                    sub_fi.qualname, class_name,
+                )
+                self._scan_check_then_act(child, sub_fi, s_locs, s_globs)
+                return
+            if isinstance(child, ast.ClassDef):
+                return
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                new_ids = []
+                for item in child.items:
+                    lid = self._lock_id(item.context_expr, fi, locs)
+                    if lid is not None:
+                        sup = self.ctx.suppressed(self.rule, child.lineno)
+                        fi.acquires.append(
+                            (lid, child.lineno, child.col_offset, sup)
+                        )
+                        for outer in held:
+                            fi.edges.append(
+                                (
+                                    outer,
+                                    lid,
+                                    child.lineno,
+                                    child.col_offset,
+                                    sup,
+                                )
+                            )
+                        new_ids.append(lid)
+                    walk_node(item.context_expr, held)
+                for stmt in child.body:
+                    walk_node(stmt, held + new_ids)
+                return
+            if isinstance(child, ast.Call):
+                handle_call(child, held)
+            elif isinstance(child, ast.Assign):
+                for t in child.targets:
+                    handle_store(t, child, held)
+            elif isinstance(child, ast.AugAssign):
+                handle_store(child.target, child, held)
+            elif isinstance(child, ast.AnnAssign):
+                handle_store(child.target, child, held)
+            elif isinstance(child, ast.Delete):
+                for t in child.targets:
+                    handle_store(t, child, held)
+            elif isinstance(child, ast.Name) and isinstance(child.ctx, ast.Load):
+                if child.id not in locs and (
+                    child.id in globs or child.id in mi.mutable_globals
+                ):
+                    self._record_access(fi, None, child.id, child, False, held)
+            elif isinstance(child, ast.Attribute) and isinstance(
+                child.ctx, ast.Load
+            ):
+                tgt = self._global_target(child, locs, globs)
+                if tgt is not None and tgt[0] is not None:
+                    self._record_access(
+                        fi, tgt[0], tgt[1], child, False, held
+                    )
+                    return  # don't re-walk the alias Name below
+            walk(child, held)
+
+        for stmt in fn.body:
+            walk_node(stmt, [])
+
+    # -- check-then-act patterns --------------------------------------------
+
+    def _scan_check_then_act(self, fn, fi, locs, globs):
+        """Linear scan of one function for the four unguarded
+        check-then-act shapes (module docstring).  Acts found under a
+        ``with``-lock are fine — that is the double-checked idiom
+        (``staging.stager``)."""
+        mi = self.mi
+
+        def tgt_of(expr):
+            return self._global_target(expr, locs, globs)
+
+        def is_act(stmt, tgt):
+            """Does this simple statement store to / mutate ``tgt``?"""
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Subscript) and tgt_of(t.value) == tgt:
+                        return True
+                    if (
+                        isinstance(t, ast.Name)
+                        and tgt == (None, t.id)
+                        and t.id in globs
+                    ):
+                        return True
+            if isinstance(stmt, ast.AugAssign):
+                t = stmt.target
+                if isinstance(t, ast.Subscript) and tgt_of(t.value) == tgt:
+                    return True
+                if isinstance(t, ast.Name) and tgt == (None, t.id) and t.id in globs:
+                    return True
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _MUTATORS
+                    and tgt_of(call.func.value) == tgt
+                ):
+                    return True
+            return False
+
+        def find_act(stmts, tgt, under_lock):
+            """First unguarded store/mutator on ``tgt`` in a statement
+            list, descending through control flow while tracking lock
+            contexts (a store inside ``with <lock>:`` is the
+            double-checked idiom — not an act)."""
+            for stmt in stmts:
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    has_lock = any(
+                        self._lock_id(i.context_expr, fi, locs) is not None
+                        for i in stmt.items
+                    )
+                    hit = find_act(stmt.body, tgt, under_lock or has_lock)
+                    if hit is not None:
+                        return hit
+                    continue
+                if isinstance(stmt, (ast.If, ast.For, ast.While)):
+                    hit = find_act(stmt.body, tgt, under_lock) or find_act(
+                        stmt.orelse, tgt, under_lock
+                    )
+                    if hit is not None:
+                        return hit
+                    continue
+                if isinstance(stmt, ast.Try):
+                    for block in (
+                        [stmt.body, stmt.orelse, stmt.finalbody]
+                        + [h.body for h in stmt.handlers]
+                    ):
+                        hit = find_act(block, tgt, under_lock)
+                        if hit is not None:
+                            return hit
+                    continue
+                if not under_lock and is_act(stmt, tgt):
+                    return stmt
+            return None
+
+        def add(tgt, node, what):
+            owner = tgt[0] if tgt[0] is not None else mi.key
+            mi.cta.append(
+                CheckThenAct(
+                    owner,
+                    tgt[1],
+                    node.lineno,
+                    node.col_offset,
+                    self.ctx.suppressed(self.rule, node.lineno),
+                    what,
+                )
+            )
+
+        def body_returns(stmts) -> bool:
+            return any(isinstance(s, ast.Return) for s in stmts)
+
+        def scan_block(stmts, held):
+            get_vars: Dict[str, Tuple[Optional[str], str]] = {}
+            pending: List[Tuple[Tuple[Optional[str], str], str]] = []
+            for i, stmt in enumerate(stmts):
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    has_lock = any(
+                        self._lock_id(it.context_expr, fi, locs) is not None
+                        for it in stmt.items
+                    )
+                    scan_block(stmt.body, held or has_lock)
+                    continue
+                # v = C.get(k) bookkeeping (pattern B)
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Attribute)
+                    and stmt.value.func.attr == "get"
+                ):
+                    tgt = tgt_of(stmt.value.func.value)
+                    if tgt is not None:
+                        get_vars[stmt.targets[0].id] = tgt
+                if isinstance(stmt, ast.If) and not held:
+                    test = stmt.test
+                    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+                        op = test.ops[0]
+                        left, right = test.left, test.comparators[0]
+                        # A: `if k not in C:` with an unguarded act inside
+                        if isinstance(op, ast.NotIn):
+                            tgt = tgt_of(right)
+                            if tgt is not None:
+                                act = find_act(stmt.body, tgt, held)
+                                if act is not None:
+                                    add(tgt, act, "membership test + store")
+                        # D: `if k in C: return` + later unguarded act
+                        elif isinstance(op, ast.In) and body_returns(stmt.body):
+                            tgt = tgt_of(right)
+                            if tgt is not None:
+                                act = find_act(stmts[i + 1 :], tgt, held)
+                                if act is not None:
+                                    add(tgt, act, "membership guard + store")
+                        # C: `if G is None:` lazy init, unguarded rebind
+                        elif isinstance(op, ast.Is) and isinstance(
+                            right, ast.Constant
+                        ) and right.value is None:
+                            tgt = tgt_of(left)
+                            if tgt is None and isinstance(left, ast.Name):
+                                v = get_vars.get(left.id)
+                                if v is not None:
+                                    # B: `v = C.get(k)` / `if v is None:`
+                                    act = find_act(stmt.body, v, held)
+                                    if act is None:
+                                        act = find_act(stmts[i + 1 :], v, held)
+                                    if act is not None:
+                                        add(v, act, "get-then-store")
+                            elif tgt is not None and tgt[0] is None:
+                                act = find_act(stmt.body, tgt, held)
+                                if act is not None:
+                                    add(tgt, act, "lazy init")
+                        # C': `if G is not None: return` + later rebind
+                        elif isinstance(op, ast.IsNot) and isinstance(
+                            right, ast.Constant
+                        ) and right.value is None and body_returns(stmt.body):
+                            tgt = tgt_of(left)
+                            if tgt is not None and tgt[0] is None:
+                                act = find_act(stmts[i + 1 :], tgt, held)
+                                if act is not None:
+                                    add(tgt, act, "lazy init")
+                    scan_block(stmt.body, held)
+                    scan_block(stmt.orelse, held)
+                elif isinstance(stmt, ast.If):
+                    scan_block(stmt.body, held)
+                    scan_block(stmt.orelse, held)
+                elif isinstance(stmt, (ast.For, ast.While)):
+                    scan_block(stmt.body, held)
+                elif isinstance(stmt, ast.Try):
+                    scan_block(stmt.body, held)
+                    for h in stmt.handlers:
+                        scan_block(h.body, held)
+                    scan_block(stmt.finalbody, held)
+
+        scan_block(fn.body, False)
+        # dedupe by line (one act can match two patterns)
+        seen: Set[Tuple[int, int]] = set()
+        uniq = []
+        for c in mi.cta:
+            k = (c.line, c.col)
+            if k not in seen:
+                seen.add(k)
+                uniq.append(c)
+        mi.cta[:] = uniq
+
+
+def extract(ctx: FileContext, rule_name: str) -> ModuleInfo:
+    return _Extractor(ctx, rule_name).run()
+
+
+# ---------------------------------------------------------------------------
+# Whole-project join
+# ---------------------------------------------------------------------------
+
+
+class Inventory:
+    """Cross-file aggregation: call-graph reachability from spawn
+    targets, shared-global classification, concurrent-module set."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+
+    def add(self, mi: ModuleInfo) -> None:
+        self.modules[mi.key] = mi
+
+    def reset(self) -> None:
+        self.modules.clear()
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve(self, mod_key: str, ref) -> List[Tuple[str, FuncInfo]]:
+        out: List[Tuple[str, FuncInfo]] = []
+        if ref is None:
+            return out
+        if ref[0] == "local":
+            mi = self.modules.get(mod_key)
+            if mi:
+                out.extend((mod_key, f) for f in mi.by_bare.get(ref[1], ()))
+        elif ref[0] == "ext":
+            for key, name in ref[1]:
+                mi = self.modules.get(key)
+                if mi:
+                    out.extend((key, f) for f in mi.by_bare.get(name, ()))
+        return out
+
+    def thread_reachable(self) -> Set[Tuple[str, str]]:
+        """(module key, qualname) of every function reachable from a
+        spawn target."""
+        seen: Set[Tuple[str, str]] = set()
+        frontier: List[Tuple[str, FuncInfo]] = []
+        for key in sorted(self.modules):
+            mi = self.modules[key]
+            for spawn in mi.spawns:
+                for hit in self.resolve(key, spawn.target):
+                    if (hit[0], hit[1].qualname) not in seen:
+                        seen.add((hit[0], hit[1].qualname))
+                        frontier.append(hit)
+        while frontier:
+            key, fi = frontier.pop()
+            for ref, _held, _line in fi.calls:
+                for hit in self.resolve(key, ref):
+                    ident = (hit[0], hit[1].qualname)
+                    if ident not in seen:
+                        seen.add(ident)
+                        frontier.append(hit)
+        return seen
+
+    def main_reachable(
+        self, thread_set: Set[Tuple[str, str]]
+    ) -> Set[Tuple[str, str]]:
+        """(module key, qualname) of every function the main path can
+        run: everything not exclusively behind a spawn target.  Seeds
+        are the functions outside ``thread_set``; BFS over the same
+        call graph then re-adds dual-use helpers (``_rho_state`` is
+        thread-reachable via the prewarm daemon AND called from the
+        finalizer's controller — its accesses count for both sides)."""
+        seen: Set[Tuple[str, str]] = set()
+        frontier: List[Tuple[str, FuncInfo]] = []
+        for key in sorted(self.modules):
+            mi = self.modules[key]
+            for fi in mi.functions:
+                ident = (key, fi.qualname)
+                if ident not in thread_set:
+                    seen.add(ident)
+                    frontier.append((key, fi))
+        while frontier:
+            key, fi = frontier.pop()
+            for ref, _held, _line in fi.calls:
+                for hit in self.resolve(key, ref):
+                    ident = (hit[0], hit[1].qualname)
+                    if ident not in seen:
+                        seen.add(ident)
+                        frontier.append(hit)
+        return seen
+
+    def confirmed_owner(self, mod_key: str, acc: GlobalAccess) -> Optional[str]:
+        """The owner module key of an access, or None when the name is
+        not a tracked mutable global there (alias.CONSTANT reads)."""
+        owner = acc.owner if acc.owner is not None else mod_key
+        mi = self.modules.get(owner)
+        if mi is None or acc.name not in mi.mutable_globals:
+            return None
+        return owner
+
+    def concurrent_modules(self) -> Set[str]:
+        """Modules that spawn threads or contain thread-reachable
+        code."""
+        reach = self.thread_reachable()
+        out = {key for key, _ in reach}
+        for key, mi in self.modules.items():
+            if mi.spawns:
+                out.add(key)
+        return out

@@ -1,0 +1,81 @@
+"""Rule ``atomic-cache`` — no unguarded check-then-act on shared
+caches in concurrent modules.
+
+``if key not in cache: cache[key] = build()`` is fine single-threaded
+and a classic lost-update/duplicate-work race the moment a second
+thread runs the same module — which PR 4's prewarm daemon, staging
+worker and epoch executor now do.  Four shapes are flagged, all only
+when the *act* (the store/mutate) is NOT under a ``with <lock>:``
+block and only in modules the thread inventory marks concurrent
+(modules that spawn threads or contain thread-reachable code — a
+single-threaded module's caches are none of this rule's business):
+
+- ``if k not in C: C[k] = ...``           (membership test + store)
+- ``if k in C: return ...`` … ``C[k] = ...`` / ``C.add(...)``
+- ``v = C.get(k)`` … ``if v is None: ... C[k] = ...``
+- ``if G is None: ... G = ...`` and the inverted
+  ``if G is not None: return ...`` … ``G = ...``  (lazy singletons)
+
+The double-checked idiom stays legal: an act inside ``with LOCK:`` is
+never a candidate, so ``staging.stager()``'s outer ``is None`` probe
+with the store under ``_STAGER_LOCK`` passes as-is.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from ..core import FileContext, Rule, Violation
+from ._concurrency import Inventory, extract
+
+
+class AtomicCacheRule(Rule):
+    name = "atomic-cache"
+    description = (
+        "check-then-act cache idioms in concurrent modules must hold "
+        "one lock across the test and the update"
+    )
+    scope = ()
+
+    def begin_run(self) -> None:
+        self._inv = Inventory()
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        self._inv.add(extract(ctx, self.name))
+        return ()
+
+    def finish_run(self) -> Iterable[Violation]:
+        inv = self._inv
+        concurrent = inv.concurrent_modules()
+        out: List[Violation] = []
+        for key in sorted(inv.modules):
+            if key not in concurrent:
+                continue
+            mi = inv.modules[key]
+            seen: set = set()
+            for c in mi.cta:
+                if c.suppressed:
+                    continue
+                # confirm the target really is a tracked global of its
+                # owner (drops alias.CONSTANT false candidates)
+                owner = inv.modules.get(c.owner)
+                if owner is None or c.name not in owner.mutable_globals:
+                    continue
+                k: Tuple[int, int] = (c.line, c.col)
+                if k in seen:
+                    continue
+                seen.add(k)
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=mi.relpath,
+                        line=c.line,
+                        col=c.col,
+                        message=(
+                            f"check-then-act on '{c.owner}.{c.name}' "
+                            f"({c.what}) in a concurrent module — hold one "
+                            "lock across the test and the update"
+                        ),
+                    )
+                )
+        return out

@@ -1,0 +1,211 @@
+"""Rule ``lock-order`` — the static lock-acquisition graph is acyclic.
+
+Every ``with <lock>:`` site contributes a node (locks are normalized
+to ``module:Class.attr`` for ``self._lock`` attributes and
+``module:NAME`` for module-level locks like ``_STAGER_LOCK``); nested
+``with`` blocks and calls made *while holding* a lock into functions
+that acquire another one contribute edges.  Two violations:
+
+- **Cycles.**  If thread A acquires L1→L2 while thread B acquires
+  L2→L1, the staged flush pipeline deadlocks the first time the
+  prewarm daemon and a flush collide.  Every edge inside a strongly
+  connected component is flagged at its acquisition site, with the
+  component spelled out; when the edges come from both a
+  thread-reachable function and the main path, the message says so —
+  that is exactly the daemon-vs-main inconsistency that stays latent
+  in tests (the daemon usually wins the race) and fires in
+  production.
+- **Self-deadlock.**  Re-acquiring a lock already held is flagged
+  when the lock's constructor is visibly ``threading.Lock()`` (a
+  plain Lock is not reentrant — the ``with`` blocks forever).
+  ``RLock()`` and locks of unknown kind are left alone.
+
+Interprocedural edges go through the same call graph as
+``thread-shared-state``: acquire sets propagate over resolvable calls
+to a fixpoint, so ``with A: helper()`` where ``helper`` takes ``B``
+yields A→B.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import FileContext, Rule, Violation
+from ._concurrency import Inventory, extract
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = (
+        "no cycles in the static lock-acquisition graph; no "
+        "re-acquisition of a non-reentrant lock already held"
+    )
+    scope = ()
+
+    def begin_run(self) -> None:
+        self._inv = Inventory()
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        self._inv.add(extract(ctx, self.name))
+        return ()
+
+    def finish_run(self) -> Iterable[Violation]:
+        inv = self._inv
+        reach = inv.thread_reachable()
+        lock_kinds: Dict[str, str] = {}
+        for mi in inv.modules.values():
+            lock_kinds.update(mi.lock_kinds)
+
+        # transitive acquire sets per function, to a fixpoint
+        acquires: Dict[Tuple[str, str], Set[str]] = {}
+        funcs = [
+            (mi, fi) for mi in inv.modules.values() for fi in mi.functions
+        ]
+        for mi, fi in funcs:
+            acquires[(mi.key, fi.qualname)] = {a[0] for a in fi.acquires}
+        changed = True
+        while changed:
+            changed = False
+            for mi, fi in funcs:
+                mine = acquires[(mi.key, fi.qualname)]
+                for ref, _held, _line in fi.calls:
+                    for key, callee in inv.resolve(mi.key, ref):
+                        extra = acquires[(key, callee.qualname)] - mine
+                        if extra:
+                            mine |= extra
+                            changed = True
+
+        # edges: (outer, inner) → first (relpath, line, col, suppressed,
+        # thread_side) site, deterministic
+        edges: Dict[Tuple[str, str], Tuple[str, int, int, bool, bool]] = {}
+
+        def add_edge(outer, inner, mi, fi, line, col, sup):
+            k = (outer, inner)
+            site = (mi.relpath, line, col, sup, (mi.key, fi.qualname) in reach)
+            if k not in edges or site[:2] < edges[k][:2]:
+                edges[k] = site
+
+        for mi in inv.modules.values():
+            for fi in mi.functions:
+                for outer, inner, line, col, sup in fi.edges:
+                    add_edge(outer, inner, mi, fi, line, col, sup)
+                for ref, held, line in fi.calls:
+                    if not held:
+                        continue
+                    for key, callee in inv.resolve(mi.key, ref):
+                        for inner in acquires[(key, callee.qualname)]:
+                            for outer in held:
+                                add_edge(outer, inner, mi, fi, line, 0, False)
+
+        out: List[Violation] = []
+
+        # self-deadlock: non-reentrant lock re-acquired while held
+        for (outer, inner), (path, line, col, sup, _th) in sorted(edges.items()):
+            if outer == inner and lock_kinds.get(outer) == "Lock" and not sup:
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=path,
+                        line=line,
+                        col=col,
+                        message=(
+                            f"non-reentrant lock '{outer}' acquired while "
+                            "already held — threading.Lock deadlocks here; "
+                            "use RLock or restructure"
+                        ),
+                    )
+                )
+
+        # cycles: Tarjan SCCs over distinct-lock edges
+        graph: Dict[str, Set[str]] = {}
+        for (outer, inner) in edges:
+            if outer != inner:
+                graph.setdefault(outer, set()).add(inner)
+                graph.setdefault(inner, set())
+        sccs = _tarjan(graph)
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            cyc = " -> ".join(sorted(scc))
+            in_scc = [
+                (k, v)
+                for k, v in sorted(edges.items())
+                if k[0] in scc and k[1] in scc and k[0] != k[1]
+            ]
+            mixed = (
+                any(site[4] for _, site in in_scc)
+                and not all(site[4] for _, site in in_scc)
+            )
+            note = (
+                " (one side runs on a thread target — the daemon and the "
+                "main path disagree on the order)"
+                if mixed
+                else ""
+            )
+            for (outer, inner), (path, line, col, sup, _th) in in_scc:
+                if sup:
+                    continue
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=path,
+                        line=line,
+                        col=col,
+                        message=(
+                            f"acquiring '{inner}' while holding '{outer}' "
+                            f"completes a lock-order cycle [{cyc}]{note} — "
+                            "pick one canonical order"
+                        ),
+                    )
+                )
+        return out
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Strongly connected components, iterative (lint runs inside
+    pytest's recursion budget)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs

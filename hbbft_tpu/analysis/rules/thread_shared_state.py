@@ -1,0 +1,132 @@
+"""Rule ``thread-shared-state`` — unguarded writes to state shared
+with a thread target.
+
+PR 4 made the flush pipeline genuinely multithreaded: the staging FIFO
+worker, the prewarm daemon, the MSM waiter threads and the epoch
+driver's executor all run package code concurrently with the main
+path.  This pass inventories every spawn site
+(``threading.Thread(target=...)``, ``ThreadPoolExecutor``,
+``<anything>.submit(fn)``), walks the static call graph from the
+targets, and marks a module-level mutable global *shared* when both a
+thread-reachable function and main-path code touch it.  Every write to
+a shared global that is not inside a ``with <lock>:`` block is flagged
+— under the free-running GIL a lost update or a dict mutated mid-
+iteration silently corrupts the byte-identity guarantees the whole
+port rests on.
+
+Two per-file checks ride along so runtime racecheck reports stay
+readable: a ``threading.Thread`` without a stable ``name="hbbft-*"``
+and a ``ThreadPoolExecutor`` without ``thread_name_prefix="hbbft-*"``
+are flagged at the spawn site (candidate-race reports name the
+threads involved; ``Thread-3`` identifies nothing).
+
+Known blind spots (see ``_concurrency``): aliasing through locals,
+dynamic dispatch, instance attributes — the runtime lockset checker
+(``analysis/racecheck.py``) covers those.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..core import FileContext, Rule, Violation
+from ._concurrency import Inventory, extract
+
+
+class ThreadSharedStateRule(Rule):
+    name = "thread-shared-state"
+    description = (
+        "module globals reachable from both a thread target and the "
+        "main path must only be written under a lock; spawned threads "
+        "carry stable hbbft-* names"
+    )
+    scope = ()  # whole tree: spawn sites and shared state cross layers
+
+    def begin_run(self) -> None:
+        self._inv = Inventory()
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        mi = extract(ctx, self.name)
+        self._inv.add(mi)
+        out: List[Violation] = []
+        for spawn in mi.spawns:
+            if spawn.kind == "thread" and (spawn.name_missing or not spawn.name_ok):
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=ctx.relpath,
+                        line=spawn.line,
+                        col=spawn.col,
+                        message=(
+                            "threading.Thread without a stable "
+                            'name="hbbft-*" — racecheck reports identify '
+                            "threads by name"
+                        ),
+                    )
+                )
+            elif spawn.kind == "executor" and (
+                spawn.name_missing or not spawn.name_ok
+            ):
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=ctx.relpath,
+                        line=spawn.line,
+                        col=spawn.col,
+                        message=(
+                            "ThreadPoolExecutor without "
+                            'thread_name_prefix="hbbft-*" — racecheck '
+                            "reports identify threads by name"
+                        ),
+                    )
+                )
+        return out
+
+    def finish_run(self) -> Iterable[Violation]:
+        inv = self._inv
+        reach = inv.thread_reachable()
+        main = inv.main_reachable(reach)
+        # bucket confirmed accesses per global
+        buckets = {}
+        for key in sorted(inv.modules):
+            mi = inv.modules[key]
+            for fi in mi.functions:
+                for acc in fi.accesses:
+                    owner = inv.confirmed_owner(key, acc)
+                    if owner is None:
+                        continue
+                    buckets.setdefault((owner, acc.name), []).append(
+                        (mi, fi, acc)
+                    )
+        out: List[Violation] = []
+        for (owner, name) in sorted(buckets):
+            accs = buckets[(owner, name)]
+            thread_side = sorted(
+                fi.qualname
+                for mi, fi, _ in accs
+                if (mi.key, fi.qualname) in reach
+            )
+            main_side = [
+                True
+                for mi, fi, _ in accs
+                if (mi.key, fi.qualname) in main
+            ]
+            if not thread_side or not main_side:
+                continue
+            for mi, fi, acc in accs:
+                if acc.write and not acc.locked and not acc.suppressed:
+                    out.append(
+                        Violation(
+                            rule=self.name,
+                            path=mi.relpath,
+                            line=acc.line,
+                            col=acc.col,
+                            message=(
+                                f"unguarded write to '{owner}.{name}', "
+                                "which is shared with the thread-target "
+                                f"path ('{thread_side[0]}') — wrap the "
+                                "access in the module's lock"
+                            ),
+                        )
+                    )
+        return out

@@ -84,7 +84,9 @@ def wire(name: str):
                 f"{cls.__name__} already registered as wire tag "
                 f"{_BY_CLASS[cls][0]!r}"
             )
-        _BY_CLASS[cls] = (name, to_fields, from_fields)
+        # registration runs at import time, before any thread spawns —
+        # by the time _encode/_decode race, the registry is read-only
+        _BY_CLASS[cls] = (name, to_fields, from_fields)  # lint: ok(thread-shared-state)
         _BY_NAME[name] = (cls, from_fields)
         return cls
 

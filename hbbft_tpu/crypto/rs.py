@@ -21,6 +21,7 @@ reference's ``Coding::Trivial`` fallback (``broadcast.rs:596-658``).
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -222,23 +223,31 @@ class ReedSolomon:
 
 _EXP16: Optional[np.ndarray] = None
 _LOG16: Optional[np.ndarray] = None
+# the epoch driver's stage worker runs RS encodes concurrently with
+# the main thread's decodes — the lazy build must not be torn
+_TABLE16_LOCK = threading.Lock()
 
 
 def _build_tables16() -> None:
     global _EXP16, _LOG16
     if _EXP16 is not None:
         return
-    exp = np.zeros(2 * 65535, dtype=np.uint16)
-    log = np.zeros(65536, dtype=np.int32)
-    x = 1
-    for i in range(65535):
-        exp[i] = x
-        log[x] = i
-        x <<= 1
-        if x & 0x10000:
-            x ^= 0x1100B
-    exp[65535:] = exp[:65535]
-    _EXP16, _LOG16 = exp, log
+    with _TABLE16_LOCK:
+        if _EXP16 is not None:
+            return
+        exp = np.zeros(2 * 65535, dtype=np.uint16)
+        log = np.zeros(65536, dtype=np.int32)
+        x = 1
+        for i in range(65535):
+            exp[i] = x
+            log[x] = i
+            x <<= 1
+            if x & 0x10000:
+                x ^= 0x1100B
+        exp[65535:] = exp[:65535]
+        # publish LOG16 first: readers gate on _EXP16 being non-None
+        _LOG16 = log
+        _EXP16 = exp
 
 
 def gf16_mul(a: int, b: int) -> int:

@@ -1601,7 +1601,9 @@ class VectorizedHoneyBadgerSim:
                 f"{len(dead)} dead nodes exceeds the f={self.num_faulty} bound"
             )
         results: List[EpochResult] = []
-        with ThreadPoolExecutor(max_workers=1) as ex:
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="hbbft-epoch-stage"
+        ) as ex:
             faults_next = FaultLog()
             diag_next: Dict[str, bool] = {}
             fut = ex.submit(

@@ -225,6 +225,15 @@ class Recorder:
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
 
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """A consistent copy of the events recorded so far.  In-process
+        readers (tests, bench, report) iterate THIS, not ``events``,
+        while waiter/stager threads may still be appending — list
+        append is atomic under the GIL but iterating a list being
+        appended to is not a stable view."""
+        with self._lock:
+            return list(self.events)
+
     def _enter_span(self) -> int:
         d = getattr(self._tls, "depth", 0)
         self._tls.depth = d + 1
@@ -237,14 +246,24 @@ class Recorder:
 
     def close(self) -> None:
         """Emit counter + histogram summaries and ``trace_end``, then
-        close the sink.  Idempotent."""
-        if self._closed:
-            return
-        self._closed = True
-        for name in sorted(self.counters):
-            self.event("counter", name=name, value=self.counters[name])
-        for name in sorted(self._hists):
-            vals = sorted(self._hists[name])
+        close the sink.  Idempotent — the closed-check and the flag
+        flip happen under ``_lock`` so two racing closers (e.g. a
+        waiter thread finishing while ``disable()`` runs) emit the
+        summaries exactly once.  The summaries themselves are emitted
+        AFTER releasing the lock: ``event()`` re-takes the
+        non-reentrant ``_lock``, so emitting while holding it would
+        self-deadlock (the ``lock-order`` badgerlint rule catches
+        exactly this shape)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            counters = dict(self.counters)
+            hists = {k: list(v) for k, v in self._hists.items()}
+        for name in sorted(counters):
+            self.event("counter", name=name, value=counters[name])
+        for name in sorted(hists):
+            vals = sorted(hists[name])
             self.event(
                 "hist",
                 name=name,
@@ -255,15 +274,26 @@ class Recorder:
                 max=round(vals[-1], 9),
                 sum=round(sum(vals), 9),
             )
-        self.event("trace_end", events=len(self.events) + 1, dur=round(self.now(), 9))
-        if self._sink is not None:
-            self._sink.close()
-            self._sink = None
+        with self._lock:
+            n_events = len(self.events) + 1
+        self.event("trace_end", events=n_events, dur=round(self.now(), 9))
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
 
 
 # ---------------------------------------------------------------------------
 # Module-level switchboard
 # ---------------------------------------------------------------------------
+
+# Guards the ACTIVE swap in enable()/disable() — hot-path READERS stay
+# lock-free (one global load + is-None branch; a stale read during a
+# swap only routes one event to the outgoing recorder, which is still
+# open until close() runs).  close() is called under this lock from
+# enable(), giving the one-directional _SWITCH_LOCK → Recorder._lock
+# edge; nothing acquires them in the other order.
+_SWITCH_LOCK = threading.Lock()
 
 
 def active() -> Optional[Recorder]:
@@ -280,17 +310,19 @@ def enable(
     """Install a recorder as the process-wide trace sink.  A previously
     installed recorder is closed first."""
     global ACTIVE
-    if ACTIVE is not None:
-        ACTIVE.close()
-    ACTIVE = Recorder(path, jax_annotations=jax_annotations, clock=clock)
-    return ACTIVE
+    with _SWITCH_LOCK:
+        if ACTIVE is not None:
+            ACTIVE.close()
+        ACTIVE = Recorder(path, jax_annotations=jax_annotations, clock=clock)
+        return ACTIVE
 
 
 def disable() -> Optional[Recorder]:
     """Uninstall and close the active recorder; returns it (its
     in-memory ``events`` stay readable after close)."""
     global ACTIVE
-    rec, ACTIVE = ACTIVE, None
+    with _SWITCH_LOCK:
+        rec, ACTIVE = ACTIVE, None
     if rec is not None:
         rec.close()
     return rec

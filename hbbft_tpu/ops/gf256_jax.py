@@ -22,6 +22,7 @@ batched dimension is long and contiguous.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -164,6 +165,7 @@ class ReedSolomonDevice:
 # GF(2^16) matmul lowers to a (16m×16k) binary matmul + parity.
 
 _BITS16: Optional[np.ndarray] = None  # [65536, 16, 16] uint8
+_BITS16_LOCK = threading.Lock()  # stage worker + main thread both decode
 
 
 def _bits16_table() -> np.ndarray:
@@ -171,15 +173,19 @@ def _bits16_table() -> np.ndarray:
     (built vectorised from the host log/antilog tables, ~16 MB)."""
     global _BITS16
     if _BITS16 is None:
-        _host_rs._build_tables16()
-        exp, log = _host_rs._EXP16, _host_rs._LOG16
-        cs = np.arange(65536, dtype=np.int64)
-        table = np.zeros((65536, 16, 16), dtype=np.uint8)
-        for bit in range(16):
-            prod = np.where(cs == 0, 0, exp[log[cs] + int(log[1 << bit])])
-            for r in range(16):
-                table[:, r, bit] = (prod >> r) & 1
-        _BITS16 = table
+        with _BITS16_LOCK:
+            if _BITS16 is None:
+                _host_rs._build_tables16()
+                exp, log = _host_rs._EXP16, _host_rs._LOG16
+                cs = np.arange(65536, dtype=np.int64)
+                table = np.zeros((65536, 16, 16), dtype=np.uint8)
+                for bit in range(16):
+                    prod = np.where(
+                        cs == 0, 0, exp[log[cs] + int(log[1 << bit])]
+                    )
+                    for r in range(16):
+                        table[:, r, bit] = (prod >> r) & 1
+                _BITS16 = table
     return _BITS16
 
 

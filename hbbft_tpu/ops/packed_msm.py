@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
@@ -669,6 +670,15 @@ def _env_fraction() -> Optional[float]:
 _RHO_DEFAULT = 0.5
 _RHO_STATE: Optional[dict] = None
 
+# One lock for ALL controller/warm-shape state in this module
+# (_RHO_STATE and its nested per-shape dicts, _WARM_SEEN, _PREWARM,
+# the warm_shapes.json read-merge-replace): the finalizer's waiter
+# thread, the prewarm daemon and the main flush path all touch it.
+# RLock because the guarded helpers nest (_adapt → _shape_state →
+# _rho_state → _save_rho).  Never held across a pallas_ec call that
+# takes _EXEC_LOCK — the two stay unordered.
+_STATE_LOCK = threading.RLock()
+
 # flushes between forced host-rate refreshes once the solved split
 # covers every group (an all-device plan has no host tail to measure,
 # and a stale ``h`` could otherwise freeze the split at full-device
@@ -685,32 +695,37 @@ def _rho_path() -> str:
 def _rho_state() -> dict:
     global _RHO_STATE
     if _RHO_STATE is None:
-        import json
+        with _STATE_LOCK:
+            if _RHO_STATE is None:
+                import json
 
-        state: dict = {}
-        try:
-            with open(_rho_path()) as fh:
-                raw = json.load(fh)
-        except Exception:
-            raw = {}
-        for k, v in raw.items() if isinstance(raw, dict) else ():
-            try:  # per-entry: one malformed entry must not drop the rest
-                if isinstance(v, dict):
-                    if 0.0 < float(v.get("rho", -1)) <= 1.0:
-                        state[str(k)] = {
-                            "rho": float(v["rho"]),
-                            "d": float(v["d"]) if v.get("d") else None,
-                            "h": float(v["h"]) if v.get("h") else None,
-                            "hage": int(v.get("hage", 0)),
-                            "dc": float(v["dc"]) if v.get("dc") else None,
-                            "cage": int(v.get("cage", 0)),
-                            "dage": int(v.get("dage", 0)),
-                        }
-                elif 0.0 < float(v) < 1.0:  # legacy bare-rho entries
-                    state[str(k)] = {"rho": float(v), "d": None, "h": None}
-            except (TypeError, ValueError):
-                continue
-        _RHO_STATE = state
+                state: dict = {}
+                try:
+                    with open(_rho_path()) as fh:
+                        raw = json.load(fh)
+                except Exception:
+                    raw = {}
+                for k, v in raw.items() if isinstance(raw, dict) else ():
+                    try:  # per-entry: one malformed entry must not drop
+                        # the rest
+                        if isinstance(v, dict):
+                            if 0.0 < float(v.get("rho", -1)) <= 1.0:
+                                state[str(k)] = {
+                                    "rho": float(v["rho"]),
+                                    "d": float(v["d"]) if v.get("d") else None,
+                                    "h": float(v["h"]) if v.get("h") else None,
+                                    "hage": int(v.get("hage", 0)),
+                                    "dc": float(v["dc"]) if v.get("dc") else None,
+                                    "cage": int(v.get("cage", 0)),
+                                    "dage": int(v.get("dage", 0)),
+                                }
+                        elif 0.0 < float(v) < 1.0:  # legacy bare-rho entries
+                            state[str(k)] = {
+                                "rho": float(v), "d": None, "h": None
+                            }
+                    except (TypeError, ValueError):
+                        continue
+                _RHO_STATE = state
     return _RHO_STATE
 
 
@@ -720,8 +735,10 @@ def _save_rho() -> None:
     try:
         path = _rho_path()
         tmp = path + ".tmp.%d" % os.getpid()
+        with _STATE_LOCK:  # snapshot while no flush/waiter mutates it
+            payload = json.dumps(_rho_state())
         with open(tmp, "w") as fh:
-            json.dump(_rho_state(), fh)
+            fh.write(payload)
         os.replace(tmp, path)
     except Exception:
         pass  # best-effort: losing the hint only costs re-convergence
@@ -733,23 +750,25 @@ def learned_fraction(n: int, n_groups: int) -> float:
     env = _env_fraction()
     if env is not None:
         return env
-    v = _rho_state().get("%d:%d" % (n, n_groups))
-    if v is None:
-        return _RHO_DEFAULT
-    if isinstance(v, dict):
-        return v.get("rho", _RHO_DEFAULT)
-    return float(v)
+    with _STATE_LOCK:
+        v = _rho_state().get("%d:%d" % (n, n_groups))
+        if v is None:
+            return _RHO_DEFAULT
+        if isinstance(v, dict):
+            return v.get("rho", _RHO_DEFAULT)
+        return float(v)
 
 
 def _shape_state(n: int, n_groups: int) -> dict:
     key = "%d:%d" % (n, n_groups)
-    state = _rho_state()
-    st = state.get(key)
-    if not isinstance(st, dict):
-        st = {"rho": st if isinstance(st, float) else _RHO_DEFAULT,
-              "d": None, "h": None, "hage": 0, "dc": None, "cage": 0}
-        state[key] = st
-    return st
+    with _STATE_LOCK:
+        state = _rho_state()
+        st = state.get(key)
+        if not isinstance(st, dict):
+            st = {"rho": st if isinstance(st, float) else _RHO_DEFAULT,
+                  "d": None, "h": None, "hage": 0, "dc": None, "cage": 0}
+            state[key] = st
+        return st
 
 
 def _solve_rho(st: dict, K: float, t_caller: float) -> None:
@@ -793,36 +812,37 @@ def _adapt(
     noise; a slew-rate clip bounds one pathological flush's damage to
     3×; the solved split converges in a couple of flushes and
     re-converges when the load regime shifts."""
-    st = _shape_state(n, n_groups)
-    if k_host > 0:
-        h_obs = k_host / max(t_host, 1e-6)
-        if st["h"] is None:
-            st["h"] = h_obs
+    with _STATE_LOCK:  # one balance step is atomic vs waiter/prewarm
+        st = _shape_state(n, n_groups)
+        if k_host > 0:
+            h_obs = k_host / max(t_host, 1e-6)
+            if st["h"] is None:
+                st["h"] = h_obs
+            else:
+                h_obs = min(max(h_obs, st["h"] / 3.0), st["h"] * 3.0)
+                st["h"] = 0.5 * st["h"] + 0.5 * h_obs
+            st["hage"] = 0
         else:
-            h_obs = min(max(h_obs, st["h"] / 3.0), st["h"] * 3.0)
-            st["h"] = 0.5 * st["h"] + 0.5 * h_obs
-        st["hage"] = 0
-    else:
-        # all-device plan: the host rate went unmeasured — count the
-        # staleness so _split_plan can reserve a probe chunk
-        st["hage"] = st.get("hage", 0) + 1
-    if k_dev > 0:
-        # the compressed and uncompressed transfers keep SEPARATE
-        # device-rate EMAs ("dc" / "d"); the shipping mode is whichever
-        # measures faster, re-probed every _COMPRESS_PROBE_IV flushes
-        slot = "dc" if compressed else "d"
-        d_obs = k_dev / max(t_dev, 1e-6)
-        if st.get(slot) is None:
-            st[slot] = d_obs
-        else:
-            d_obs = min(max(d_obs, st[slot] / 3.0), st[slot] * 3.0)
-            st[slot] = 0.5 * st[slot] + 0.5 * d_obs
-        # mode-staleness counters, symmetric: each mode's counter
-        # resets on its own sample and grows on the other's
-        st["cage"] = 0 if compressed else st.get("cage", 0) + 1
-        st["dage"] = st.get("dage", 0) + 1 if compressed else 0
-    _solve_rho(st, float(k_dev + k_host), t_caller)
-    _save_rho()
+            # all-device plan: the host rate went unmeasured — count the
+            # staleness so _split_plan can reserve a probe chunk
+            st["hage"] = st.get("hage", 0) + 1
+        if k_dev > 0:
+            # the compressed and uncompressed transfers keep SEPARATE
+            # device-rate EMAs ("dc" / "d"); the shipping mode is whichever
+            # measures faster, re-probed every _COMPRESS_PROBE_IV flushes
+            slot = "dc" if compressed else "d"
+            d_obs = k_dev / max(t_dev, 1e-6)
+            if st.get(slot) is None:
+                st[slot] = d_obs
+            else:
+                d_obs = min(max(d_obs, st[slot] / 3.0), st[slot] * 3.0)
+                st[slot] = 0.5 * st[slot] + 0.5 * d_obs
+            # mode-staleness counters, symmetric: each mode's counter
+            # resets on its own sample and grows on the other's
+            st["cage"] = 0 if compressed else st.get("cage", 0) + 1
+            st["dage"] = st.get("dage", 0) + 1 if compressed else 0
+        _solve_rho(st, float(k_dev + k_host), t_caller)
+        _save_rho()
 
 
 def seed_rates(
@@ -843,17 +863,18 @@ def seed_rates(
     they are LOWER BOUNDS on the engine-only rates the controller's
     EMAs track — a seed therefore only ever RAISES an estimate, never
     overwrites a converged (higher) one."""
-    st = _shape_state(n, n_groups)
-    if d:
-        st["d"] = max(st.get("d") or 0.0, float(d))
-    if h:
-        st["h"] = max(st.get("h") or 0.0, float(h))
-        st["hage"] = 0
-    # t_caller unknown here: solve the pure rate balance (the caller
-    # term only nudges the split further device-ward; the first real
-    # flush re-solves with it measured)
-    _solve_rho(st, 1.0, 0.0)
-    _save_rho()
+    with _STATE_LOCK:
+        st = _shape_state(n, n_groups)
+        if d:
+            st["d"] = max(st.get("d") or 0.0, float(d))
+        if h:
+            st["h"] = max(st.get("h") or 0.0, float(h))
+            st["hage"] = 0
+        # t_caller unknown here: solve the pure rate balance (the caller
+        # term only nudges the split further device-ward; the first real
+        # flush re-solves with it measured)
+        _solve_rho(st, 1.0, 0.0)
+        _save_rho()
 
 
 # Largest device share of one product flush: the per-group tree is a
@@ -920,8 +941,9 @@ def _split_plan(k: int, n_groups: int) -> List[int]:
                 # or one quantum spanning all groups) can neither be
                 # balanced nor host-probed: stay host-side
                 return []
-            st = _rho_state().get("%d:%d" % (n, n_groups))
-            hage = st.get("hage", 0) if isinstance(st, dict) else 0
+            with _STATE_LOCK:
+                st = _rho_state().get("%d:%d" % (n, n_groups))
+                hage = st.get("hage", 0) if isinstance(st, dict) else 0
             if hage >= _HOST_PROBE_IV:
                 m -= 1
     else:
@@ -1005,24 +1027,28 @@ def record_warm_shape(n: int, n_groups: int, compressed: bool) -> None:
     flush.  Read-merge-replace keeps other processes' entries; a
     compressed sighting is sticky (both transfer modes get prewarmed
     once a shape has probed compression).  Best-effort throughout —
-    losing the hint only costs one cold-start first flush."""
+    losing the hint only costs one cold-start first flush.  The whole
+    dedupe + read-merge-replace runs under ``_STATE_LOCK`` so two
+    concurrent flushes can't interleave their merges and drop each
+    other's entries."""
     import json
 
     seen_key = ("%d:%d" % (n, n_groups), bool(compressed))
-    if seen_key in _WARM_SEEN:
-        return
-    _WARM_SEEN.add(seen_key)
-    try:
-        shapes = _load_warm_shapes()
-        ent = shapes.setdefault(seen_key[0], {"compressed": False})
-        ent["compressed"] = bool(ent.get("compressed")) or bool(compressed)
-        path = _warm_shapes_path()
-        tmp = path + ".tmp.%d" % os.getpid()
-        with open(tmp, "w") as fh:
-            json.dump(shapes, fh)
-        os.replace(tmp, path)
-    except Exception:
-        pass
+    with _STATE_LOCK:
+        if seen_key in _WARM_SEEN:
+            return
+        _WARM_SEEN.add(seen_key)
+        try:
+            shapes = _load_warm_shapes()
+            ent = shapes.setdefault(seen_key[0], {"compressed": False})
+            ent["compressed"] = bool(ent.get("compressed")) or bool(compressed)
+            path = _warm_shapes_path()
+            tmp = path + ".tmp.%d" % os.getpid()
+            with open(tmp, "w") as fh:
+                json.dump(shapes, fh)
+            os.replace(tmp, path)
+        except Exception:
+            pass
 
 
 def prewarm_shapes() -> int:
@@ -1061,17 +1087,18 @@ def start_background_prewarm() -> Optional[Any]:
     natural dead time before the first flush).  Idempotent; returns
     the thread (or the one already started).  Safe to race with the
     first flush: ``preload_exec`` and ``cached_compiled`` both write
-    ``_EXEC_MEM`` atomically and a duplicate load is only wasted
-    work, never a wrong result."""
+    ``_EXEC_MEM`` under ``pallas_ec._EXEC_LOCK`` and a duplicate load
+    is only wasted work, never a wrong result."""
     global _PREWARM
     if _PREWARM is not None:
         return _PREWARM
-    import threading
-
-    th = threading.Thread(
-        target=prewarm_shapes, name="hbbft-prewarm", daemon=True
-    )
-    _PREWARM = th
+    with _STATE_LOCK:
+        if _PREWARM is not None:
+            return _PREWARM
+        th = threading.Thread(
+            target=prewarm_shapes, name="hbbft-prewarm", daemon=True
+        )
+        _PREWARM = th
     th.start()
     return th
 
@@ -1363,7 +1390,6 @@ def g1_msm_product_async(
         # next process can prewarm its executables during setup
         record_warm_shape(n, n_groups, compressed)
 
-    import threading
     import time
 
     t_call = time.perf_counter()
@@ -1432,7 +1458,7 @@ def g1_msm_product_async(
             waiter["err"] = e
         waiter["t"] = time.perf_counter()
 
-    th = threading.Thread(target=_wait, daemon=True)
+    th = threading.Thread(target=_wait, name="hbbft-msm-wait", daemon=True)
     th.start()
 
     def finalize():

@@ -22,6 +22,7 @@ tree reduction stays in XLA; the scalar-mul scan is ~99% of the work).
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -33,12 +34,15 @@ from . import limbs as LB
 TILE = 128  # points per grid program (the lane width)
 
 _f = None
+_FIELD_LOCK = threading.Lock()
 
 
 def _field():
     global _f
     if _f is None:
-        _f = LB.fq()
+        with _FIELD_LOCK:
+            if _f is None:
+                _f = LB.fq()
     return _f
 
 
@@ -369,6 +373,13 @@ def _run_tiles(kernel, pts_t: jnp.ndarray, aux_t: jnp.ndarray, interpret: bool):
 # the key space tiny.
 
 _EXEC_MEM: dict = {}
+# One lock across test-and-update on _EXEC_MEM: the prewarm daemon
+# (packed_msm.start_background_prewarm → preload_exec) populates the
+# cache concurrently with flush-path lookups.  RLock so a cache miss
+# that recurses through routing helpers can't self-deadlock.  Compiles
+# run UNDER the lock on purpose — a duplicate Mosaic compile costs
+# minutes, so the second thread should block and find the entry.
+_EXEC_LOCK = threading.RLock()
 
 
 def _exec_cache_dir() -> "str":
@@ -420,32 +431,34 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
     def exec_path() -> str:
         return os.path.join(_exec_cache_dir(), _exec_fname(key))
 
-    loaded = _EXEC_MEM.get(key)
-    if loaded is None:
-        path = exec_path()
-        if os.path.exists(path):
-            try:
-                from jax.experimental.serialize_executable import (
-                    deserialize_and_load,
-                )
-
-                with open(path, "rb") as fh:
-                    payload, in_tree, out_tree = pickle.load(fh)
-                loaded = deserialize_and_load(payload, in_tree, out_tree)
-            except Exception:
-                loaded = None
+    with _EXEC_LOCK:
+        loaded = _EXEC_MEM.get(key)
         if loaded is None:
-            loaded = jax.jit(fn).lower(*args).compile()
-            _save_exec(loaded, path)
-        _EXEC_MEM[key] = loaded
+            path = exec_path()
+            if os.path.exists(path):
+                try:
+                    from jax.experimental.serialize_executable import (
+                        deserialize_and_load,
+                    )
+
+                    with open(path, "rb") as fh:
+                        payload, in_tree, out_tree = pickle.load(fh)
+                    loaded = deserialize_and_load(payload, in_tree, out_tree)
+                except Exception:
+                    loaded = None
+            if loaded is None:
+                loaded = jax.jit(fn).lower(*args).compile()
+                _save_exec(loaded, path)
+            _EXEC_MEM[key] = loaded
     try:
-        return loaded(*args)
+        return loaded(*args)  # execute OUTSIDE the lock — runs overlap
     except TypeError:
         # a stale on-disk executable whose signature no longer matches
         # (e.g. serialized before the np-constant fix, when closed-over
         # jnp arrays were hidden const-inputs): recompile and replace
         compiled = jax.jit(fn).lower(*args).compile()
-        _EXEC_MEM[key] = compiled
+        with _EXEC_LOCK:
+            _EXEC_MEM[key] = compiled
         _save_exec(compiled, exec_path())
         return compiled(*args)
 
@@ -479,8 +492,9 @@ def exec_available(name: str, key_parts) -> bool:
     import os
 
     key = _exec_key(name, key_parts)
-    if key in _EXEC_MEM:
-        return True
+    with _EXEC_LOCK:
+        if key in _EXEC_MEM:
+            return True
     return os.path.exists(
         os.path.join(_exec_cache_dir(), _exec_fname(key))
     )
@@ -493,15 +507,17 @@ def preload_exec(name: str, key_parts) -> bool:
     device-load wall on FIRST use of each executable, which lands in
     the middle of the first flush; the background prewarmer calls this
     during DKG/setup so the first flush starts warm.  Returns True when
-    the executable is in memory afterwards.  Safe to race with
-    ``cached_compiled``: dict stores are atomic and a duplicate load
-    only wastes the loser's work."""
+    the executable is in memory afterwards.  Races ``cached_compiled``
+    by design: the deserialize runs outside ``_EXEC_LOCK`` (it is pure
+    file I/O) and the store is a locked ``setdefault`` so whichever
+    side loads first wins and the loser's work is dropped."""
     import os
     import pickle
 
     key = _exec_key(name, key_parts)
-    if key in _EXEC_MEM:
-        return True
+    with _EXEC_LOCK:
+        if key in _EXEC_MEM:
+            return True
     path = os.path.join(_exec_cache_dir(), _exec_fname(key))
     if not os.path.exists(path):
         return False
@@ -512,7 +528,9 @@ def preload_exec(name: str, key_parts) -> bool:
 
         with open(path, "rb") as fh:
             payload, in_tree, out_tree = pickle.load(fh)
-        _EXEC_MEM[key] = deserialize_and_load(payload, in_tree, out_tree)
+        loaded = deserialize_and_load(payload, in_tree, out_tree)
+        with _EXEC_LOCK:
+            _EXEC_MEM.setdefault(key, loaded)
         return True
     except Exception:
         return False  # corrupt/stale file: first use recompiles

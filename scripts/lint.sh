@@ -4,6 +4,8 @@
 #   scripts/lint.sh
 #   scripts/lint.sh --json
 #   scripts/lint.sh --select determinism,layering hbbft_tpu/protocols
+#   scripts/lint.sh --select thread-shared-state,lock-order,atomic-cache
+#   scripts/lint.sh --racecheck tests/test_racecheck.py   # runtime lockset checker
 #   scripts/lint.sh --changed            # only files in git diff (pre-commit)
 #   LINT_LOG=/tmp/lint.log scripts/lint.sh
 set -uo pipefail

@@ -43,6 +43,41 @@ import random  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--racecheck",
+        action="store_true",
+        default=False,
+        help="run every test under the Eraser-style lockset checker "
+        "(hbbft_tpu.analysis.racecheck); candidate races fail the test "
+        "and append to $HBBFT_TPU_RACECHECK_OUT when set",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _racecheck_guard(request):
+    """With ``--racecheck``, bracket every test with the runtime
+    lockset checker.  Reports surface twice: as a test failure here and
+    as JSONL in ``$HBBFT_TPU_RACECHECK_OUT`` for the
+    ``python -m hbbft_tpu.analysis --racecheck`` driver."""
+    if not request.config.getoption("--racecheck"):
+        yield
+        return
+    from hbbft_tpu.analysis import racecheck
+
+    racecheck.enable()
+    yield
+    reports = racecheck.disable()
+    if reports:
+        pytest.fail(
+            "racecheck: "
+            + "; ".join(
+                f"{r.path}:{r.line}: {r.message()}" for r in reports
+            ),
+            pytrace=False,
+        )
+
+
 @pytest.fixture
 def rng():
     return random.Random(0x4242)

@@ -702,6 +702,275 @@ def test_pallas_shape_scope_and_suppression():
 
 
 # ---------------------------------------------------------------------------
+# thread-shared-state
+# ---------------------------------------------------------------------------
+
+_SHARED_STATE_SRC = """
+    import threading
+
+    CACHE = {{}}
+    _LOCK = threading.Lock()
+
+    def _worker():
+        {worker_write}
+
+    def start():
+        t = threading.Thread(target=_worker, name="hbbft-w", daemon=True)
+        t.start()
+        return t
+
+    def lookup(key):
+        {main_write}
+        return CACHE.get(key)
+"""
+
+
+def test_thread_shared_state_flags_unguarded_writes():
+    src = _SHARED_STATE_SRC.format(
+        worker_write='CACHE["w"] = 1',
+        main_write='CACHE[key] = 2',
+    )
+    vs = _lint(src, "ops/fixture.py", select="thread-shared-state")
+    assert len(vs) == 2  # both the worker's and the main path's write
+    assert all("unguarded write to 'ops/fixture.CACHE'" in v.message for v in vs)
+    assert all("_worker" in v.message for v in vs)  # names the thread side
+
+
+def test_thread_shared_state_locked_writes_are_clean():
+    src = _SHARED_STATE_SRC.format(
+        worker_write='with _LOCK:\n            CACHE["w"] = 1',
+        main_write="with _LOCK:\n            CACHE[key] = 2",
+    )
+    assert _lint(src, "ops/fixture.py", select="thread-shared-state") == []
+
+
+def test_thread_shared_state_no_spawn_no_sharing():
+    # same writes, but nothing ever runs on a thread — not shared
+    src = """
+        CACHE = {}
+
+        def put(k):
+            CACHE[k] = 1
+    """
+    assert _lint(src, "ops/fixture.py", select="thread-shared-state") == []
+
+
+def test_thread_shared_state_suppression_survives_finish_run():
+    # cross-file rules report at finish_run, after the per-file
+    # suppression filter has run — the flag must be honored anyway
+    src = _SHARED_STATE_SRC.format(
+        worker_write='CACHE["w"] = 1  # lint: ok(thread-shared-state)',
+        main_write='CACHE[key] = 2  # lint: ok(thread-shared-state)',
+    )
+    assert _lint(src, "ops/fixture.py", select="thread-shared-state") == []
+
+
+def test_thread_shared_state_flags_anonymous_threads():
+    src = """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def _work():
+            return 1
+
+        def bad():
+            threading.Thread(target=_work, daemon=True).start()
+            threading.Thread(target=_work, name="waiter").start()
+            with ThreadPoolExecutor(max_workers=1) as ex:
+                ex.submit(_work)
+
+        def good():
+            threading.Thread(target=_work, name="hbbft-x").start()
+            with ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="hbbft-y"
+            ) as ex:
+                ex.submit(_work)
+    """
+    vs = _lint(src, "harness/fixture.py", select="thread-shared-state")
+    assert len(vs) == 3
+    msgs = "\n".join(v.message for v in vs)
+    assert msgs.count("threading.Thread without a stable") == 2
+    assert msgs.count("ThreadPoolExecutor without") == 1
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_flags_cycle_with_thread_note():
+    src = """
+        import threading
+
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def daemon_path():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+
+        def start():
+            threading.Thread(
+                target=daemon_path, name="hbbft-d", daemon=True
+            ).start()
+
+        def main_path():
+            with B_LOCK:
+                with A_LOCK:
+                    pass
+    """
+    vs = _lint(src, "ops/fixture.py", select="lock-order")
+    assert len(vs) == 2  # one per edge of the 2-cycle
+    msgs = "\n".join(v.message for v in vs)
+    assert "completes a lock-order cycle" in msgs
+    assert "daemon and the main path disagree" in msgs
+
+
+def test_lock_order_consistent_order_is_clean():
+    src = """
+        import threading
+
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def one():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+
+        def two():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+    """
+    assert _lint(src, "ops/fixture.py", select="lock-order") == []
+
+
+def test_lock_order_interprocedural_edge():
+    # with A: helper() where helper takes B, plus a direct B→A nesting
+    src = """
+        import threading
+
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def helper():
+            with B_LOCK:
+                pass
+
+        def one():
+            with A_LOCK:
+                helper()
+
+        def two():
+            with B_LOCK:
+                with A_LOCK:
+                    pass
+    """
+    vs = _lint(src, "ops/fixture.py", select="lock-order")
+    assert vs, "call-through acquisition must close the cycle"
+    assert any("cycle" in v.message for v in vs)
+
+
+def test_lock_order_self_deadlock_on_plain_lock_only():
+    plain = """
+        import threading
+
+        MY_LOCK = threading.Lock()
+
+        def reenter():
+            with MY_LOCK:
+                with MY_LOCK:
+                    pass
+    """
+    vs = _lint(plain, "ops/fixture.py", select="lock-order")
+    assert len(vs) == 1
+    assert "non-reentrant lock" in vs[0].message
+
+    reentrant = plain.replace("threading.Lock()", "threading.RLock()")
+    assert _lint(reentrant, "ops/fixture.py", select="lock-order") == []
+
+
+# ---------------------------------------------------------------------------
+# atomic-cache
+# ---------------------------------------------------------------------------
+
+_ATOMIC_SRC = """
+    import threading
+
+    CACHE = {{}}
+    _STATE = None
+    _LOCK = threading.Lock()
+
+    def _bg():
+        return 1
+
+    def start():
+        threading.Thread(target=_bg, name="hbbft-bg", daemon=True).start()
+
+    {body}
+"""
+
+
+def test_atomic_cache_flags_membership_guard():
+    src = _ATOMIC_SRC.format(
+        body="""
+    def get(k):
+        if k not in CACHE:
+            CACHE[k] = object()
+        return CACHE[k]
+    """
+    )
+    vs = _lint(src, "ops/fixture.py", select="atomic-cache")
+    assert len(vs) == 1
+    assert "check-then-act on 'ops/fixture.CACHE'" in vs[0].message
+
+
+def test_atomic_cache_flags_lazy_init():
+    src = _ATOMIC_SRC.format(
+        body="""
+    def state():
+        global _STATE
+        if _STATE is None:
+            _STATE = {}
+        return _STATE
+    """
+    )
+    vs = _lint(src, "ops/fixture.py", select="atomic-cache")
+    assert len(vs) == 1
+    assert "lazy init" in vs[0].message
+
+
+def test_atomic_cache_double_checked_locking_is_legal():
+    src = _ATOMIC_SRC.format(
+        body="""
+    def state():
+        global _STATE
+        if _STATE is None:
+            with _LOCK:
+                if _STATE is None:
+                    _STATE = {}
+        return _STATE
+    """
+    )
+    assert _lint(src, "ops/fixture.py", select="atomic-cache") == []
+
+
+def test_atomic_cache_ignores_single_threaded_modules():
+    # identical idiom, but the module never spawns a thread
+    src = """
+        CACHE = {}
+
+        def get(k):
+            if k not in CACHE:
+                CACHE[k] = object()
+            return CACHE[k]
+    """
+    assert _lint(src, "ops/fixture.py", select="atomic-cache") == []
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
 

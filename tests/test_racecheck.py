@@ -1,0 +1,338 @@
+"""The runtime lockset checker (``hbbft_tpu/analysis/racecheck.py``).
+
+Three layers, mirroring the ISSUE 5 acceptance criteria:
+
+- a deliberate-race fixture is flagged by BOTH the static
+  ``thread-shared-state`` pass and the runtime Eraser checker, and the
+  locked variant is clean under both;
+- the enable/disable shims install over the real shared-state surface
+  (``pallas_ec._EXEC_MEM``, ``packed_msm._WARM_SEEN``, the module
+  locks) and restore plain builtins afterwards with contents intact;
+- a stress run drives the staging worker, the background prewarmer and
+  an epoch-style executor concurrently with the main path under the
+  checker: zero candidate races, and the persisted flush caches
+  (``warm_shapes.json`` / ``device_fraction.json``) are byte-identical
+  with staging on and off.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import threading
+import textwrap
+
+import pytest
+
+from hbbft_tpu.analysis import all_rules, lint_source
+from hbbft_tpu.analysis import racecheck
+from hbbft_tpu.analysis.racecheck import RaceChecker
+from hbbft_tpu.crypto import rs
+from hbbft_tpu.ops import packed_msm, pallas_ec, staging
+
+# ---------------------------------------------------------------------------
+# The deliberate-race fixture: one source, caught twice
+# ---------------------------------------------------------------------------
+
+DELIBERATE_RACE_SRC = textwrap.dedent(
+    """
+    import threading
+
+    CACHE = {}
+
+    def _worker():
+        CACHE["w"] = 1
+
+    def start():
+        t = threading.Thread(target=_worker, name="hbbft-racer", daemon=True)
+        t.start()
+        return t
+
+    def main_write(key):
+        CACHE[key] = 2
+"""
+)
+
+
+def test_deliberate_race_flagged_by_static_pass():
+    rules = [r for r in all_rules() if r.name == "thread-shared-state"]
+    vs = lint_source(DELIBERATE_RACE_SRC, "ops/fixture.py", rules)
+    assert len(vs) == 2
+    assert all("unguarded write to 'ops/fixture.CACHE'" in v.message for v in vs)
+
+
+def test_deliberate_race_flagged_by_runtime_checker():
+    # the same shape, executed: two threads write a dict, no lock
+    chk = RaceChecker()
+    cache = chk.track_dict({}, "ops/fixture.CACHE")
+
+    def worker():
+        cache["w"] = 1
+
+    t = threading.Thread(target=worker, name="hbbft-racer")
+    t.start()
+    t.join()
+    cache["m"] = 2  # main thread, no common lock → candidate race
+
+    assert len(chk.reports) == 1
+    r = chk.reports[0]
+    assert r.var == "ops/fixture.CACHE"
+    assert r.write
+    assert "hbbft-racer" in r.threads and "MainThread" in r.threads
+    assert "candidate race" in r.message()
+    # reuses the structured Violation machinery (human/JSON/SARIF)
+    v = r.as_violation()
+    assert v.rule == "racecheck"
+    assert v.render()  # renders like any lint violation
+    assert json.loads(json.dumps(r.as_dict()))["var"] == "ops/fixture.CACHE"
+
+
+def test_locked_variant_is_clean_at_runtime():
+    chk = RaceChecker()
+    lock = chk.track_lock(threading.Lock(), "ops/fixture._LOCK")
+    cache = chk.track_dict({}, "ops/fixture.CACHE")
+
+    def worker():
+        for i in range(50):
+            with lock:
+                cache[("w", i)] = i
+
+    t = threading.Thread(target=worker, name="hbbft-racer")
+    t.start()
+    for i in range(50):
+        with lock:
+            cache[("m", i)] = i
+    t.join()
+    assert chk.reports == []
+
+
+def test_lockset_refinement_empties_across_different_locks():
+    # classic Eraser: each access IS locked, but never by a COMMON lock
+    chk = RaceChecker()
+    a = chk.track_lock(threading.Lock(), "fixture.A_LOCK")
+    b = chk.track_lock(threading.Lock(), "fixture.B_LOCK")
+    d = chk.track_dict({}, "fixture.STATE")
+
+    d["x"] = 0  # main: Virgin → Exclusive
+
+    def worker():
+        with a:
+            d["x"] = 1  # cross-thread: C(v) = {A}
+
+    t = threading.Thread(target=worker, name="hbbft-a-side")
+    t.start()
+    t.join()
+    with b:
+        d["x"] = 2  # C(v) = {A} ∩ {B} = ∅ → report
+    assert len(chk.reports) == 1
+    assert "share no common lock" in chk.reports[0].message()
+
+
+def test_tracked_rlock_reentrancy_keeps_held_set():
+    chk = RaceChecker()
+    rl = chk.track_lock(threading.RLock(), "fixture.RLOCK")
+    d = chk.track_dict({}, "fixture.STATE")
+
+    def worker():
+        with rl:
+            with rl:  # reentrant acquire
+                d["x"] = 1
+            d["y"] = 2  # still held after inner release
+
+    t = threading.Thread(target=worker, name="hbbft-r")
+    t.start()
+    t.join()
+    with rl:
+        d["x"] = 3
+    assert chk.reports == []
+
+
+# ---------------------------------------------------------------------------
+# enable()/disable(): the process-wide shims
+# ---------------------------------------------------------------------------
+
+
+def test_enable_shims_known_globals_and_disable_restores(request):
+    if request.config.getoption("--racecheck"):
+        pytest.skip("manages the global checker itself")
+    mem_before = pallas_ec._EXEC_MEM
+    racecheck.enable()
+    try:
+        assert isinstance(pallas_ec._EXEC_MEM, racecheck.TrackedDict)
+        assert isinstance(pallas_ec._EXEC_LOCK, racecheck.TrackedLock)
+        assert isinstance(packed_msm._WARM_SEEN, racecheck.TrackedSet)
+        assert isinstance(packed_msm._STATE_LOCK, racecheck.TrackedLock)
+        assert isinstance(staging._STAGER_LOCK, racecheck.TrackedLock)
+        assert isinstance(staging._BUFFERS._free, racecheck.TrackedDict)
+        # nested enable shares the active checker (refcounted)
+        assert racecheck.enable() is racecheck.active()
+        racecheck.disable()
+        pallas_ec._EXEC_MEM["__racecheck_test__"] = "kept"
+    finally:
+        reports = racecheck.disable()
+    assert racecheck.active() is None
+    assert type(pallas_ec._EXEC_MEM) is dict
+    assert type(packed_msm._WARM_SEEN) is set
+    # contents loaded during the instrumented window survive
+    assert pallas_ec._EXEC_MEM.pop("__racecheck_test__") == "kept"
+    assert mem_before is not pallas_ec._EXEC_MEM or not mem_before
+    assert isinstance(reports, list)
+
+
+def test_reports_append_to_out_file(tmp_path, monkeypatch, request):
+    if request.config.getoption("--racecheck"):
+        pytest.skip("manages the global checker itself")
+    out = tmp_path / "races.jsonl"
+    monkeypatch.setenv(racecheck.OUT_ENV, str(out))
+    monkeypatch.setattr(pallas_ec, "_EXEC_MEM", {})
+    racecheck.enable()
+    try:
+        mem = pallas_ec._EXEC_MEM
+
+        def worker():
+            mem["w"] = 1  # no lock, worker thread
+
+        t = threading.Thread(target=worker, name="hbbft-racer")
+        t.start()
+        t.join()
+        mem["m"] = 2  # no lock, main thread → candidate race
+    finally:
+        reports = racecheck.disable()
+    assert len(reports) == 1
+    assert reports[0].var == "ops/pallas_ec._EXEC_MEM"
+    loaded = racecheck.load_reports(str(out))
+    assert len(loaded) == 1
+    assert loaded[0].var == "ops/pallas_ec._EXEC_MEM"
+    assert loaded[0].message() == reports[0].message()
+
+
+# ---------------------------------------------------------------------------
+# The stress test: stager + prewarm + epoch-style overlap, zero races,
+# byte-identical flush caches with staging on and off
+# ---------------------------------------------------------------------------
+
+_SHAPES = [(64, 4, False), (64, 4, True), (128, 8, False), (974, 16, False)]
+
+
+def _drive_flush_state(cache_dir, staged, monkeypatch):
+    """Replay the flush pipeline's persistent-state traffic —
+    ``record_warm_shape`` + ``seed_rates`` for each shape — through the
+    staging worker (staged) or inline (sequential), and return the
+    bytes of the two persisted caches."""
+    monkeypatch.setenv("HBBFT_TPU_EXEC_CACHE", str(cache_dir))
+    monkeypatch.setenv("HBBFT_TPU_STAGING", "1" if staged else "0")
+    # reset IN PLACE so the racecheck shim installed over _WARM_SEEN
+    # keeps tracking it (rebinding the global would escape the shim)
+    with packed_msm._STATE_LOCK:
+        packed_msm._WARM_SEEN.clear()
+    monkeypatch.setattr(packed_msm, "_RHO_STATE", None)
+    st = staging.stager()
+    tasks = []
+    for n, g, comp in _SHAPES:
+        tasks.append(
+            st.submit(functools.partial(packed_msm.record_warm_shape, n, g, comp))
+        )
+        tasks.append(
+            st.submit(
+                functools.partial(packed_msm.seed_rates, n, g, 1e6, 5e5)
+            )
+        )
+    for t in tasks:
+        t.result()
+    warm = (cache_dir / "warm_shapes.json").read_bytes()
+    rho = (cache_dir / "device_fraction.json").read_bytes()
+    return warm, rho
+
+
+def test_stress_concurrent_pipeline_zero_races_and_byte_identity(
+    tmp_path, monkeypatch
+):
+    seq_dir = tmp_path / "seq"
+    staged_dir = tmp_path / "staged"
+    seq_dir.mkdir()
+    staged_dir.mkdir()
+    # fresh state BEFORE enable(): the shims install over these exact
+    # objects, so the stress traffic below runs fully tracked
+    monkeypatch.setattr(packed_msm, "_PREWARM", None)
+    monkeypatch.setattr(packed_msm, "_WARM_SEEN", set())
+    monkeypatch.setattr(packed_msm, "_RHO_STATE", None)
+
+    racecheck.enable()
+    try:
+        # sequential leg first: staging off, everything inline
+        warm_seq, rho_seq = _drive_flush_state(seq_dir, False, monkeypatch)
+
+        # staged leg: the stager worker replays the same traffic while
+        # the prewarm daemon, an epoch-style stage executor and the
+        # main path all hammer the same module state
+        stop = threading.Event()
+
+        def prewarm_leg():
+            while not stop.is_set():
+                packed_msm.prewarm_shapes()
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        def epoch_unit(i):
+            # what the epoch stage worker actually exercises: RS table
+            # math + the controller's read path
+            packed_msm.learned_fraction(64, 4)
+            rs.gf16_mul(3, i % 65535 + 1)
+            pallas_ec.exec_available("fixture", ((i % 7, 2),))
+            return i
+
+        aux = threading.Thread(
+            target=prewarm_leg, name="hbbft-test-prewarm", daemon=True
+        )
+        aux.start()
+        packed_msm.start_background_prewarm()
+        with ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="hbbft-epoch-stage"
+        ) as ex:
+            futs = [ex.submit(epoch_unit, i) for i in range(64)]
+            warm_staged, rho_staged = _drive_flush_state(
+                staged_dir, True, monkeypatch
+            )
+            # main path reads race the legs above
+            for i in range(64):
+                packed_msm.learned_fraction(64, 4)
+                packed_msm.record_warm_shape(64, 4, False)
+            assert [f.result() for f in futs] == list(range(64))
+        stop.set()
+        aux.join(timeout=10)
+    finally:
+        reports = racecheck.disable()
+
+    assert reports == [], "\n".join(r.message() for r in reports)
+    assert warm_staged == warm_seq
+    assert rho_staged == rho_seq
+    # sanity: the caches really did record the driven shapes
+    recorded = json.loads(warm_seq)
+    assert set(recorded) == {"%d:%d" % (n, g) for n, g, _ in _SHAPES}
+    assert recorded["64:4"]["compressed"] is True  # sticky sighting
+
+
+# ---------------------------------------------------------------------------
+# The CLI driver: python -m hbbft_tpu.analysis --racecheck <test-expr>
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_racecheck_driver_runs_clean():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "hbbft_tpu.analysis",
+            "--racecheck",
+            "tests/test_racecheck.py::test_locked_variant_is_clean_at_runtime",
+        ],
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "racecheck clean" in proc.stdout
