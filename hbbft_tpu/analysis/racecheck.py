@@ -38,7 +38,10 @@ static inventory mapped (plus the live instances statics cannot see):
 the ``staging`` / ``pallas_ec`` / ``packed_msm`` / ``rs`` /
 ``gf256_jax`` / ``recorder`` module locks, the ``_EXEC_MEM`` /
 ``_WARM_SEEN`` / ``_RHO_STATE`` caches, ``staging._BUFFERS``'s pool
-dict+lock, a live ``staging._STAGER`` and ``recorder.ACTIVE``.  After
+dict+lock, a live ``staging._STAGER`` and ``recorder.ACTIVE``, and —
+via the ``transport/tcp._TRACK_NODE`` constructor hook — the
+per-connection state (``_writers``/``outputs``/``faults``) of every
+``TcpNode`` built inside the instrumented window.  After
 :func:`disable` the plain builtins are rebound (``dict(tracked)``), so
 warm caches survive the instrumented window byte-for-byte.
 
@@ -463,6 +466,7 @@ class RaceChecker:
         from ..crypto import rs
         from ..obs import recorder
         from ..ops import gf256_jax, packed_msm, pallas_ec, staging
+        from ..transport import tcp as _tcp
 
         lock_sites = [
             (staging, "_STAGER_LOCK", "ops/staging._STAGER_LOCK"),
@@ -511,6 +515,24 @@ class RaceChecker:
                 stager, "_lock",
                 self.track_lock(stager._lock, "ops/staging.Stager._lock"),
             )
+        # per-connection transport state: every TcpNode constructed while
+        # the checker is installed gets its connection-facing containers
+        # tracked (the recv loops / accept callbacks touch them from
+        # whatever thread runs the event loop; fuzz/scenario harnesses
+        # drive multiple loops from worker threads)
+        def _track_tcp_node(node, _chk=self):
+            node._writers = _chk.track_dict(
+                node._writers, "transport/tcp.TcpNode._writers"
+            )
+            node.outputs = _chk.track_list(
+                node.outputs, "transport/tcp.TcpNode.outputs"
+            )
+            node.faults = _chk.track_list(
+                node.faults, "transport/tcp.TcpNode.faults"
+            )
+
+        self._shim(_tcp, "_TRACK_NODE", _track_tcp_node)
+
         rec = recorder.ACTIVE
         if rec is not None:
             self._shim(
@@ -547,6 +569,11 @@ class RaceChecker:
                 setattr(obj, attr, list(current))
             elif isinstance(current, TrackedLock):
                 setattr(obj, attr, current._raw)
+            elif attr == "_TRACK_NODE":
+                # the tcp constructor hook is a plain callable we set —
+                # restore the original (None) so nodes built after
+                # disable() are untracked
+                setattr(obj, attr, original)
             else:
                 # product code rebound the global mid-window (documented
                 # gap: e.g. _RHO_STATE reset by a test) — leave its value

@@ -69,6 +69,23 @@ _MUTABLE_CTORS = {
     "Counter",
 }
 
+# Thread-safe handoff channels: every queue.* constructor locks
+# internally, so producer/consumer traffic through a module-level queue
+# needs no caller lock.  A global is exempted only when every visible
+# rebind of it assigns one of these (or the ``None`` placeholder of the
+# lazy-singleton idiom) — one rebind to a plain container and the name
+# is tracked as usual.
+_SAFE_HANDOFF_CTORS = {
+    "queue.Queue",
+    "Queue",
+    "queue.SimpleQueue",
+    "SimpleQueue",
+    "queue.LifoQueue",
+    "LifoQueue",
+    "queue.PriorityQueue",
+    "PriorityQueue",
+}
+
 # In-place mutator methods on the tracked containers.
 _MUTATORS = {
     "add",
@@ -188,6 +205,9 @@ class ModuleInfo:
         self.by_bare: Dict[str, List[FuncInfo]] = {}
         self.spawns: List[SpawnSite] = []
         self.mutable_globals: Dict[str, int] = {}
+        # names pruned from mutable_globals because every rebind is a
+        # queue-module handoff channel (internally locked)
+        self.safe_globals: Set[str] = set()
         self.module_names: Set[str] = set()
         # alias → list of (kind, ...) candidates; kind "mod" → module
         # key, kind "name" → (module key, original name)
@@ -212,6 +232,12 @@ def _mutable_value(value: Optional[ast.AST]) -> bool:
         return True
     if isinstance(value, ast.Call):
         return dotted_name(value.func) in _MUTABLE_CTORS
+    return False
+
+
+def _safe_handoff_value(value: Optional[ast.AST]) -> bool:
+    if isinstance(value, ast.Call):
+        return dotted_name(value.func) in _SAFE_HANDOFF_CTORS
     return False
 
 
@@ -299,6 +325,15 @@ class _Extractor:
                     self.mi.mutable_globals.setdefault(
                         n, getattr(node, "lineno", 0)
                     )
+        # queue.Queue handoff exemption: a global whose every visible
+        # rebind (module level or through a `global` declaration) is a
+        # queue-module channel or the None lazy-init placeholder locks
+        # internally — drop it from the tracked set so thread-shared-
+        # state and atomic-cache accept unguarded put/get traffic.
+        for n in self._classify_handoff(tree):
+            if n in self.mi.mutable_globals:
+                self.mi.safe_globals.add(n)
+                del self.mi.mutable_globals[n]
         for stmt in tree.body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._extract_function(stmt, prefix="", class_name=None)
@@ -327,6 +362,31 @@ class _Extractor:
             top, mod_fi, set(self.mi.module_names), set(), set(), "<module>", None
         )
         return self.mi
+
+    def _classify_handoff(self, tree: ast.Module) -> Set[str]:
+        """Names whose every visible ``Name = <value>`` binding anywhere
+        in the file is a :data:`_SAFE_HANDOFF_CTORS` call or ``None``.
+        Same-named locals in unrelated functions can only *demote* a
+        name (conservative: the lint keeps flagging)."""
+        safe: Set[str] = set()
+        unsafe: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if _safe_handoff_value(value):
+                    safe.add(t.id)
+                elif not (
+                    isinstance(value, ast.Constant) and value.value is None
+                ):
+                    unsafe.add(t.id)
+        return safe - unsafe
 
     def _collect_module_bindings(self, tree: ast.Module) -> None:
         for stmt in tree.body:

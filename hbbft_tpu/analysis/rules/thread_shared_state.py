@@ -20,6 +20,14 @@ and a ``ThreadPoolExecutor`` without ``thread_name_prefix="hbbft-*"``
 are flagged at the spawn site (candidate-race reports name the
 threads involved; ``Thread-3`` identifies nothing).
 
+A module-level ``queue.Queue`` (or ``SimpleQueue`` / ``LifoQueue`` /
+``PriorityQueue``) is recognized as a thread-safe handoff channel —
+queues lock internally, so unguarded producer/consumer traffic through
+one is the *intended* cross-thread idiom, not a race.  The exemption
+holds only while every visible rebind of the name stays a queue
+constructor (or the lazy-init ``None`` placeholder); one rebind to a
+plain container and the name is tracked like any other global.
+
 Known blind spots (see ``_concurrency``): aliasing through locals,
 dynamic dispatch, instance attributes — the runtime lockset checker
 (``analysis/racecheck.py``) covers those.

@@ -40,6 +40,14 @@ class SerializationError(Exception):
     pass
 
 
+# Maximum nesting depth accepted by the decoder.  Honest payloads are a
+# handful of levels deep; a crafted frame of nested list headers would
+# otherwise recurse until the interpreter dies (RecursionError escapes
+# the transport's SerializationError drop path and kills the receive
+# loop).
+_MAX_DECODE_DEPTH = 64
+
+
 # registry: class -> (name, to_fields, from_fields)
 _BY_CLASS: Dict[type, Tuple[str, Callable[[Any], tuple], Callable[..., Any]]] = {}
 _BY_NAME: Dict[str, Tuple[type, Callable[..., Any]]] = {}
@@ -161,7 +169,9 @@ def dumps(obj: Any) -> bytes:
     return b"".join(out)
 
 
-def _decode(buf: bytes, pos: int) -> Tuple[Any, int]:
+def _decode(buf: bytes, pos: int, depth: int = 0) -> Tuple[Any, int]:
+    if depth > _MAX_DECODE_DEPTH:
+        raise SerializationError("nesting too deep")
     tag = buf[pos : pos + 1]
     pos += 1
     if tag == _TAG_NONE:
@@ -184,15 +194,15 @@ def _decode(buf: bytes, pos: int) -> Tuple[Any, int]:
         n, pos = _dec_len(buf, pos)
         items = []
         for _ in range(n):
-            item, pos = _decode(buf, pos)
+            item, pos = _decode(buf, pos, depth + 1)
             items.append(item)
         return (items if tag == _TAG_LIST else tuple(items)), pos
     if tag == _TAG_DICT:
         n, pos = _dec_len(buf, pos)
         d = {}
         for _ in range(n):
-            k, pos = _decode(buf, pos)
-            v, pos = _decode(buf, pos)
+            k, pos = _decode(buf, pos, depth + 1)
+            v, pos = _decode(buf, pos, depth + 1)
             d[k] = v
         return d, pos
     if tag == _TAG_OBJ:
@@ -206,14 +216,28 @@ def _decode(buf: bytes, pos: int) -> Tuple[Any, int]:
         _, from_fields = reg
         fields = []
         for _ in range(nf):
-            f, pos = _decode(buf, pos)
+            f, pos = _decode(buf, pos, depth + 1)
             fields.append(f)
         return from_fields(*fields), pos
     raise SerializationError(f"bad tag byte {tag!r} at {pos - 1}")
 
 
 def loads(buf: bytes) -> Any:
-    obj, pos = _decode(buf, 0)
+    """Decode canonical bytes.  Raises :class:`SerializationError` on ANY
+    malformed input — truncation (``IndexError``/``struct.error``), bad
+    UTF-8/ASCII, a wrong-arity ``_TAG_OBJ`` frame (``TypeError`` from the
+    constructor), a constructor rejecting a field value, or excessive
+    nesting.  Transports rely on this: :mod:`..transport.tcp` drops
+    frames only on ``SerializationError``; any other exception type
+    escaping here would kill the receive loop."""
+    try:
+        obj, pos = _decode(buf, 0)
+    except SerializationError:
+        raise
+    except Exception as exc:
+        raise SerializationError(
+            f"malformed input ({type(exc).__name__}: {exc})"
+        ) from exc
     if pos != len(buf):
         raise SerializationError(f"trailing bytes after position {pos}")
     return obj

@@ -53,6 +53,17 @@ class MerkleProof:
     def validate(self, n_leaves: int) -> bool:
         """Recompute the root from value+lemma (reference
         ``validate_proof``, ``broadcast.rs:555-575``)."""
+        # a deserialized proof can carry arbitrary field types; a
+        # non-int index / non-bytes value / non-sequence lemma must
+        # fail validation, not raise
+        if (
+            not isinstance(self.index, int)
+            or isinstance(self.index, bool)
+            or not isinstance(self.value, bytes)
+            or not isinstance(self.lemma, (tuple, list))
+            or not isinstance(self.root_hash, bytes)
+        ):
+            return False
         if not 0 <= self.index < n_leaves:
             return False
         if len(self.lemma) != _tree_depth(n_leaves):

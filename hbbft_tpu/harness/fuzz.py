@@ -1,0 +1,416 @@
+"""Wire-format fuzzer seeded from ``analysis/wire_manifest.json``.
+
+The manifest pins every ``@wire`` type (name, module, field list); the
+fuzzer uses it as a *generator seed*: it synthesizes canonical-codec
+frames for each registered type with randomized — deliberately
+type-confused — field values, then mutates the raw bytes (truncation,
+bit flips, bad tags, inflated length prefixes, unknown type names,
+wrong-arity objects, pathological nesting).
+
+Three attack surfaces, one invariant each:
+
+- :func:`fuzz_codec` — ``core.serialize.loads`` must either decode or
+  raise ``SerializationError``; any other exception type is a crash
+  (the transport only drops ``SerializationError`` frames).
+- :func:`fuzz_frames` — ``transport.tcp``'s length-prefixed receive
+  loop must deliver exactly the well-formed frames, drop the malformed
+  ones, terminate on truncation/oversize, and never hang.
+- :func:`fuzz_handlers` — every ``handle_*`` surface fed a
+  malformed-but-deserializable message from a known sender must return
+  a ``Step`` (possibly carrying ``Fault``\\ s), never raise.
+
+All randomness flows from one seeded ``random.Random`` — a failing
+seed reproduces exactly.  The manifest is loaded from its JSON file by
+path (the harness layer must not import ``analysis``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import importlib
+import json
+import os
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core import serialize as _ser
+from ..core.network_info import NetworkInfo
+from ..core.serialize import SerializationError, dumps, loads
+from ..core.step import Step
+
+_MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "analysis",
+    "wire_manifest.json",
+)
+
+#: Hard per-surface wall-clock bound — a fuzz run exceeding it counts
+#: as a hang, which is itself a finding.
+FRAME_TIMEOUT_S = 30.0
+
+
+def load_manifest(path: Optional[str] = None) -> Dict[str, Any]:
+    with open(path or _MANIFEST_PATH) as fh:
+        return json.load(fh)
+
+
+def register_manifest_types(manifest: Dict[str, Any]) -> None:
+    """Import every module the manifest names so all ``@wire`` classes
+    are registered with the codec before frames are generated."""
+    seen = set()
+    for info in manifest["types"].values():
+        mod = info["module"]
+        if mod in seen:
+            continue
+        seen.add(mod)
+        dotted = "hbbft_tpu." + mod[: -len(".py")].replace("/", ".")
+        importlib.import_module(dotted)
+
+
+@dataclasses.dataclass
+class FuzzReport:
+    """Outcome of one fuzz surface.  ``failures`` must stay empty: each
+    entry is a reproducible crash (exception type escaping the clean
+    ``SerializationError``/``Fault`` path)."""
+
+    surface: str
+    cases: int = 0
+    decoded: int = 0  # inputs the codec accepted
+    rejected: int = 0  # clean SerializationError rejections
+    delivered: int = 0  # frames surfaced by the transport loop
+    faults: int = 0  # Faults attributed by handlers
+    failures: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# -- frame synthesis --------------------------------------------------------
+
+
+def _random_primitive(rng: random.Random) -> Any:
+    k = rng.randrange(9)
+    if k == 0:
+        return None
+    if k == 1:
+        return bool(rng.randrange(2))
+    if k == 2:
+        return rng.randrange(-(2**70), 2**70)
+    if k == 3:
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+    if k == 4:
+        return "".join(chr(rng.randrange(32, 0x2FF)) for _ in range(rng.randrange(0, 12)))
+    if k == 5:
+        return rng.randrange(2**256).to_bytes(32, "big")
+    if k == 6:
+        return rng.randrange(8)
+    if k == 7:
+        return -rng.randrange(1, 8)
+    return ""
+
+
+def _random_value(rng: random.Random, manifest: Dict[str, Any], depth: int = 0) -> bytes:
+    """Encoded bytes of a random value — primitives, containers, or a
+    (possibly type-confused) manifest object."""
+    k = rng.randrange(10)
+    if depth < 3 and k == 0:
+        items = [_random_value(rng, manifest, depth + 1) for _ in range(rng.randrange(0, 4))]
+        tag = _ser._TAG_LIST if rng.randrange(2) else _ser._TAG_TUPLE
+        return tag + _ser._enc_len(len(items)) + b"".join(items)
+    if depth < 3 and k == 1:
+        n = rng.randrange(0, 3)
+        parts = []
+        for _ in range(n):
+            parts.append(dumps(_random_primitive(rng)))
+            parts.append(_random_value(rng, manifest, depth + 1))
+        return _ser._TAG_DICT + _ser._enc_len(n) + b"".join(parts)
+    if depth < 3 and k in (2, 3):
+        return _random_obj_frame(rng, manifest, depth + 1)
+    return dumps(_random_primitive(rng))
+
+
+def _random_obj_frame(
+    rng: random.Random,
+    manifest: Dict[str, Any],
+    depth: int = 0,
+    name: Optional[str] = None,
+    arity: Optional[int] = None,
+) -> bytes:
+    """A raw ``_TAG_OBJ`` frame for a manifest type, with randomized
+    field values (and, when ``arity`` is given, a confused field count)."""
+    names = sorted(manifest["types"])
+    name = name if name is not None else rng.choice(names)
+    # custom-codec types (G1/G2) carry ``fields: null`` in the manifest
+    flds = manifest["types"].get(name, {}).get("fields") or ()
+    nf = arity if arity is not None else len(flds)
+    nb = name.encode("ascii", "replace")
+    fields = b"".join(_random_value(rng, manifest, depth + 1) for _ in range(nf))
+    return _ser._TAG_OBJ + _ser._enc_len(len(nb)) + nb + _ser._enc_len(nf) + fields
+
+
+def _mutate(rng: random.Random, buf: bytes) -> bytes:
+    """One random byte-level mutation."""
+    k = rng.randrange(6)
+    if not buf:
+        return bytes([rng.randrange(256)])
+    if k == 0:  # truncate
+        return buf[: rng.randrange(len(buf))]
+    if k == 1:  # bit flip
+        i = rng.randrange(len(buf))
+        return buf[:i] + bytes([buf[i] ^ (1 << rng.randrange(8))]) + buf[i + 1 :]
+    if k == 2:  # overwrite a byte
+        i = rng.randrange(len(buf))
+        return buf[:i] + bytes([rng.randrange(256)]) + buf[i + 1 :]
+    if k == 3:  # splice garbage
+        i = rng.randrange(len(buf) + 1)
+        junk = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 6)))
+        return buf[:i] + junk + buf[i:]
+    if k == 4:  # inflate a length prefix
+        return buf[:1] + b"\xff" + (rng.randrange(2**63)).to_bytes(8, "big") + buf[1:]
+    # duplicate a slice (misaligns downstream tags)
+    i = rng.randrange(len(buf))
+    return buf + buf[i:]
+
+
+def _adversarial_frames(rng: random.Random, manifest: Dict[str, Any]) -> List[bytes]:
+    """Hand-crafted pathological frames every run must survive."""
+    deep = b"\x07\x01" * 500 + b"\x00"  # 500-deep nested single-item lists
+    huge_list = _ser._TAG_LIST + b"\xff" + (2**62).to_bytes(8, "big")
+    unknown = _ser._TAG_OBJ + _ser._enc_len(7) + b"NoSuchT" + _ser._enc_len(0)
+    names = sorted(manifest["types"])
+    wrong_arity = [
+        _random_obj_frame(rng, manifest, name=n, arity=rng.randrange(0, 6))
+        for n in rng.sample(names, min(8, len(names)))
+    ]
+    return [
+        b"",
+        b"\x0b",  # tag one past the last valid
+        b"\xff" * 16,
+        deep,
+        huge_list,
+        unknown,
+        _ser._TAG_STR + _ser._enc_len(4) + b"\xff\xfe\x80\x81",  # bad UTF-8
+        _ser._TAG_OBJ + _ser._enc_len(2) + b"\xc3\x28" + _ser._enc_len(0),  # bad ASCII name
+    ] + wrong_arity
+
+
+# -- surface 1: the codec ---------------------------------------------------
+
+
+def fuzz_codec(
+    seed: int, cases: int, manifest: Optional[Dict[str, Any]] = None
+) -> FuzzReport:
+    """Throw synthesized + mutated frames at ``loads``."""
+    rng = random.Random(seed)
+    manifest = manifest or load_manifest()
+    register_manifest_types(manifest)
+    report = FuzzReport(surface="codec")
+    corpus = list(_adversarial_frames(rng, manifest))
+    while len(corpus) < cases:
+        base = _random_obj_frame(rng, manifest)
+        corpus.append(base)
+        for _ in range(rng.randrange(1, 4)):
+            base = _mutate(rng, base)
+            corpus.append(base)
+    for buf in corpus[:max(cases, len(corpus))]:
+        report.cases += 1
+        try:
+            loads(buf)
+            report.decoded += 1
+        except SerializationError:
+            report.rejected += 1
+        except Exception as exc:  # crash: anything but SerializationError
+            report.failures.append(
+                f"loads({buf[:40].hex()}…len={len(buf)}) raised "
+                f"{type(exc).__name__}: {exc}"
+            )
+    return report
+
+
+# -- surface 2: the TCP framing layer ---------------------------------------
+
+
+def fuzz_frames(
+    seed: int, cases: int, manifest: Optional[Dict[str, Any]] = None
+) -> FuzzReport:
+    """Feed crafted length-prefixed streams through ``TcpNode._recv_loop``
+    (a fed ``StreamReader`` — no real sockets) and check: well-formed
+    frames are delivered, malformed ones dropped with stream realignment,
+    truncation/oversize terminate the loop, and nothing hangs."""
+    from ..transport import tcp as _tcp
+
+    rng = random.Random(seed)
+    manifest = manifest or load_manifest()
+    register_manifest_types(manifest)
+    report = FuzzReport(surface="frames")
+
+    node = _tcp.TcpNode("127.0.0.1:1", ["127.0.0.1:1", "127.0.0.1:2"], lambda ni: None)
+
+    def frame_of(payload: bytes) -> bytes:
+        return len(payload).to_bytes(_tcp._LEN_BYTES, "big") + payload
+
+    async def run_stream(stream: bytes, expect_delivered: int) -> None:
+        reader = asyncio.StreamReader()
+        reader.feed_data(stream)
+        reader.feed_eof()
+        await asyncio.wait_for(
+            node._recv_loop("fuzz-peer", reader), FRAME_TIMEOUT_S
+        )
+        got = 0
+        while not node._inbox.empty():
+            node._inbox.get_nowait()
+            got += 1
+        report.delivered += got
+        if got != expect_delivered:
+            report.failures.append(
+                f"stream {stream[:32].hex()}…len={len(stream)}: delivered "
+                f"{got}, expected {expect_delivered}"
+            )
+
+    async def run_all() -> None:
+        for _ in range(cases):
+            report.cases += 1
+            stream = b""
+            expect = 0
+            terminated = False
+            for _ in range(rng.randrange(1, 6)):
+                if terminated:
+                    break
+                k = rng.randrange(6)
+                if k in (0, 1):  # valid frame
+                    stream += frame_of(dumps(_random_primitive(rng)))
+                    expect += 1
+                elif k == 2:  # well-formed frame, malformed payload: dropped
+                    payload = _mutate(rng, _random_obj_frame(rng, manifest))
+                    try:
+                        loads(payload)
+                        expect += 1  # mutation happened to stay valid
+                    except SerializationError:
+                        pass
+                    stream += frame_of(payload)
+                elif k == 3:  # truncated frame: loop must terminate cleanly
+                    payload = dumps(_random_primitive(rng))
+                    cut = frame_of(payload)[: _tcp._LEN_BYTES + rng.randrange(len(payload))]
+                    stream += cut
+                    terminated = True
+                elif k == 4:  # oversize length prefix: ConnectionError path
+                    stream += (_tcp._MAX_FRAME + 1 + rng.randrange(2**20)).to_bytes(
+                        _tcp._LEN_BYTES, "big"
+                    )
+                    terminated = True
+                else:  # truncated header
+                    stream += bytes(rng.randrange(256) for _ in range(rng.randrange(1, 4)))
+                    terminated = True
+            try:
+                await run_stream(stream, expect)
+            except asyncio.TimeoutError:
+                report.failures.append(
+                    f"recv loop hung on stream {stream[:32].hex()}…len={len(stream)}"
+                )
+            except Exception as exc:
+                report.failures.append(
+                    f"recv loop crashed on stream {stream[:32].hex()}…"
+                    f"len={len(stream)}: {type(exc).__name__}: {exc}"
+                )
+
+    asyncio.run(run_all())
+    return report
+
+
+# -- surface 3: the handle_* surface ----------------------------------------
+
+
+def _build_targets(rng: random.Random) -> Tuple[Any, List[Tuple[str, Any]]]:
+    """Fresh protocol instances over one 4-node mock network.  Returns
+    ``(sender_id, [(label, algo), ...])``."""
+    from ..protocols.agreement import Agreement
+    from ..protocols.broadcast import Broadcast
+    from ..protocols.common_coin import CommonCoin
+    from ..protocols.common_subset import CommonSubset
+    from ..protocols.dynamic_honey_badger import DynamicHoneyBadgerBuilder
+    from ..protocols.honey_badger import HoneyBadger
+
+    ids = list(range(4))
+    netinfos = NetworkInfo.generate_map(ids, rng, mock=True)
+    ni = netinfos[0]
+    sender = 1
+    targets = [
+        ("honey_badger", HoneyBadger(ni)),
+        ("common_subset", CommonSubset(ni, 0)),
+        ("agreement", Agreement(ni, 0, sender)),
+        ("broadcast", Broadcast(ni, sender)),
+        ("common_coin", CommonCoin(ni, b"fuzz nonce")),
+        ("dynamic_honey_badger", DynamicHoneyBadgerBuilder().build(ni)),
+    ]
+    return sender, targets
+
+
+def fuzz_handlers(
+    seed: int, cases: int, manifest: Optional[Dict[str, Any]] = None
+) -> FuzzReport:
+    """Feed malformed-but-deserializable objects to every protocol's
+    ``handle_message`` from a *known* sender.  The contract: a ``Step``
+    back (faults allowed), never an exception."""
+    rng = random.Random(seed)
+    manifest = manifest or load_manifest()
+    register_manifest_types(manifest)
+    report = FuzzReport(surface="handlers")
+    sender, targets = _build_targets(rng)
+    for i in range(cases):
+        if i and i % 64 == 0:
+            # handler state accretes garbage; periodically start fresh
+            sender, targets = _build_targets(rng)
+        frame = _random_obj_frame(rng, manifest)
+        for _ in range(rng.randrange(0, 2)):
+            frame = _mutate(rng, frame)
+        try:
+            message = loads(frame)
+            report.decoded += 1
+        except SerializationError:
+            report.rejected += 1
+            continue
+        except Exception as exc:
+            report.failures.append(
+                f"loads({frame[:40].hex()}…) raised {type(exc).__name__}: {exc}"
+            )
+            continue
+        report.cases += 1
+        for label, algo in targets:
+            try:
+                step = algo.handle_message(sender, message)
+            except Exception as exc:
+                report.failures.append(
+                    f"{label}.handle_message({message!r:.120}) raised "
+                    f"{type(exc).__name__}: {exc}"
+                )
+                continue
+            if not isinstance(step, Step):
+                report.failures.append(
+                    f"{label}.handle_message returned {type(step).__name__}"
+                )
+                continue
+            report.faults += len(step.fault_log)
+    return report
+
+
+# -- the full corpus --------------------------------------------------------
+
+
+def run_corpus(
+    seed: int = 0xF0227,
+    codec_cases: int = 400,
+    frame_cases: int = 60,
+    handler_cases: int = 200,
+) -> List[FuzzReport]:
+    """The pinned-seed corpus: all three surfaces, deterministic."""
+    manifest = load_manifest()
+    return [
+        fuzz_codec(seed, codec_cases, manifest),
+        fuzz_frames(seed + 1, frame_cases, manifest),
+        fuzz_handlers(seed + 2, handler_cases, manifest),
+    ]

@@ -198,6 +198,192 @@ class RandomAdversary(Adversary):
         return out
 
 
+class EquivocatingAdversary(Adversary):
+    """Corrupted nodes send *conflicting* protocol messages to two
+    disjoint halves of the honest nodes (the classic equivocation
+    attack: Broadcast ``Value``/Agreement ``BVal`` splits), then fall
+    silent.
+
+    ``make_pair(adv_id) -> (msg_a, msg_b)`` builds the two conflicting
+    messages; half A of the honest nodes (sorted order) receives
+    ``msg_a``, half B ``msg_b``.  With f < N/3 equivocators the protocol
+    guarantees all honest nodes still agree — scenario assertions
+    compare their outputs bit-for-bit against a twin run in which the
+    equivocators are simply dead.
+    """
+
+    def __init__(self, scheduler: MessageScheduler, make_pair):
+        self.scheduler = scheduler
+        self.make_pair = make_pair
+        self.class_a: List[Any] = []
+        self.class_b: List[Any] = []
+        self.adv_ids: List[Any] = []
+        self._emitted = False
+
+    def init(self, all_nodes, adv_netinfos):
+        honest = sorted(all_nodes)
+        half = (len(honest) + 1) // 2
+        self.class_a = honest[:half]
+        self.class_b = honest[half:]
+        self.adv_ids = sorted(adv_netinfos)
+
+    def pick_node(self, nodes):
+        return self.scheduler.pick_node(nodes)
+
+    def push_message(self, sender_id, tm):
+        pass
+
+    def step(self):
+        if self._emitted:
+            return []
+        self._emitted = True
+        out: List[MessageWithSender] = []
+        for adv in self.adv_ids:
+            msg_a, msg_b = self.make_pair(adv)
+            for nid in self.class_a:
+                out.append(
+                    MessageWithSender(
+                        adv, TargetedMessage(Target.to(nid), msg_a)
+                    )
+                )
+            for nid in self.class_b:
+                out.append(
+                    MessageWithSender(
+                        adv, TargetedMessage(Target.to(nid), msg_b)
+                    )
+                )
+        return out
+
+
+class BadShareAdversary(Adversary):
+    """Corrupted validators multicast forged threshold-decryption shares
+    for the first ``epochs`` HoneyBadger epochs (generalizes the
+    test-local ``FaultyShareAdversary``).  Honest nodes must verify each
+    share, attribute ``INVALID_DECRYPTION_SHARE`` faults to the senders,
+    and still commit the fault-free batch.  Mock-crypto networks only
+    (the forged share type is :class:`~..crypto.mock.MockDecryptionShare`).
+    """
+
+    def __init__(self, scheduler: MessageScheduler, rng, epochs: int = 2):
+        self.scheduler = scheduler
+        self.rng = rng
+        self.epochs = epochs
+        self.all_ids: List[Any] = []
+        self.adv_ids: List[Any] = []
+        self._emitted = False
+
+    def init(self, all_nodes, adv_netinfos):
+        self.all_ids = sorted(all_nodes) + sorted(adv_netinfos)
+        self.adv_ids = sorted(adv_netinfos)
+
+    def pick_node(self, nodes):
+        return self.scheduler.pick_node(nodes)
+
+    def push_message(self, sender_id, tm):
+        pass
+
+    def step(self):
+        if self._emitted:
+            return []
+        self._emitted = True
+        from ..crypto.mock import MockDecryptionShare
+        from ..protocols.honey_badger import (
+            HbDecryptionShare,
+            HoneyBadgerMessage,
+        )
+
+        out: List[MessageWithSender] = []
+        for epoch in range(self.epochs):
+            for adv in self.adv_ids:
+                for proposer in self.all_ids:
+                    bogus = MockDecryptionShare(
+                        self.rng.randrange(2**256).to_bytes(32, "big"),
+                        self.rng.randrange(2**256).to_bytes(32, "big"),
+                    )
+                    msg = HoneyBadgerMessage(
+                        epoch, HbDecryptionShare(proposer, bogus)
+                    )
+                    out.append(
+                        MessageWithSender(adv, TargetedMessage(Target.all(), msg))
+                    )
+        return out
+
+
+# -- delivery schedules (message_filter callables) --------------------------
+#
+# Delay, reordering and partitions are *scheduler* power, not corruption:
+# the asynchronous model lets the adversary hold any message finitely.
+# These classes plug into ``TestNetwork(message_filter=...)`` and release
+# their backlog through ``TestNetwork.release_held``.
+
+
+class PartitionSchedule:
+    """Deterministic network partition that heals.
+
+    ``groups`` are disjoint collections of node ids; while the partition
+    is active, any message crossing a group boundary is held.  The
+    observer rides with ``groups[observer_side]``.  Call
+    :meth:`heal` to dissolve the partition and flush the held backlog —
+    liveness assertions then drive the network to completion.
+    """
+
+    def __init__(self, groups, observer_side: int = 0):
+        self._side: Dict[Any, int] = {}
+        for side, group in enumerate(groups):
+            for nid in group:
+                self._side[nid] = side
+        self._side[TestNetwork.OBSERVER_ID] = observer_side
+        self.healed = False
+        self.held_count = 0
+
+    def __call__(self, sender, recipient, message) -> bool:
+        if self.healed:
+            return True
+        # ids outside every group (e.g. adversarial senders) are
+        # reachable from either side
+        a = self._side.get(sender)
+        b = self._side.get(recipient)
+        if a is None or b is None or a == b:
+            return True
+        self.held_count += 1
+        return False
+
+    def heal(self, network: "TestNetwork") -> None:
+        """Dissolve the partition and deliver everything it held."""
+        self.healed = True
+        network.release_held()
+
+
+class SeededDelaySchedule:
+    """Seeded random delay + reordering.
+
+    Each message is held with probability ``p_delay`` (all randomness
+    from one ``random.Random(seed)`` — runs are reproducible).  Calling
+    :meth:`pump` releases a random subset of the backlog, so held
+    messages re-enter delivery out of their original send order.  Drain
+    fully with ``network.release_held()`` once the scenario's delay
+    budget is spent (delays must be finite for liveness).
+    """
+
+    def __init__(self, rng, p_delay: float = 0.25, p_release: float = 0.5):
+        self.rng = rng
+        self.p_delay = p_delay
+        self.p_release = p_release
+        self.held_count = 0
+
+    def __call__(self, sender, recipient, message) -> bool:
+        if self.rng.random() < self.p_delay:
+            self.held_count += 1
+            return False
+        return True
+
+    def pump(self, network: "TestNetwork") -> None:
+        """Release a random subset of the held backlog (reordered)."""
+        network.release_held(
+            lambda s, r, m: self.rng.random() < self.p_release
+        )
+
+
 class TestNetwork:
     """A network of ``TestNode`` with adversary-controlled scheduling
     (reference ``tests/network/mod.rs:359-541``).

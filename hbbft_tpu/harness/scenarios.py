@@ -1,0 +1,540 @@
+"""Adversarial scenario matrix — one runner for every attack class.
+
+The test suite exercises each adversarial surface in isolation
+(``tests/test_epoch_vec.py``, ``tests/test_broadcast.py``, ...); this
+module packages them as a named, seeded, CLI-drivable matrix (reference
+``tests/network/mod.rs:151-173`` adversary catalogue):
+
+- **silent**: f crashed validators; the batch must carry exactly the
+  live proposers' contributions, bit-identical to the guarantee-
+  equivalent baseline (the fault-free run minus the dead proposers).
+- **bad-share**: a live validator multicasts forged threshold-decryption
+  shares; the batch must be bit-identical to the fault-free twin and
+  the forger must be the only node attributed in the ``FaultLog``.
+- **corrupt-echo**: a broadcast relay tampers its echoed shard; the
+  erasure decode recovers, the batch matches the fault-free twin, the
+  tamperer is attributed.
+- **equivocate**: f Byzantine nodes send conflicting epoch-0 ``BVal``
+  votes to two view classes under a divergent delivery schedule
+  (:class:`~hbbft_tpu.harness.epoch.DivergentEpoch0`); honest outputs
+  must be bit-identical to a twin run where the equivocators are dead.
+- **delay**: ≤ f live proposers' broadcasts are withheld past the
+  epoch; the N−f rule excludes them and the batch carries exactly the
+  timely contributions.
+- **partition-heal**: a sequential :class:`TestNetwork` broadcast under
+  a two-group partition (:class:`PartitionSchedule`) stalls, heals
+  mid-run, and must then terminate with every node delivering the
+  identical value (liveness restored by healing).
+- **churn**: DynamicHoneyBadger membership churn (Remove → Add with
+  on-chain DKG era switches) through the vectorized harness; every
+  proposed transaction commits and honest fault logs stay empty.
+- **fuzz**: the wire-format fuzzer corpus (:mod:`hbbft_tpu.harness.fuzz`)
+  over the codec, the TCP framing layer and the ``handle_*`` surface —
+  zero crashes, hangs or unlogged failures.
+
+Run ``python -m hbbft_tpu.harness.scenarios`` (``--list`` for the
+matrix, ``--only`` to select, ``--json`` for machine-readable rows).
+Exit status 0 iff every selected scenario holds.  When an
+``obs.recorder`` trace is active, one ``scenario`` event is emitted per
+row and one ``fuzz_summary`` per completed fuzz surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import random
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+from ..obs import recorder as _obs
+from . import fuzz as _fuzz
+from .dynamic import VectorizedDynamicSim
+from .epoch import DivergentEpoch0, VectorizedHoneyBadgerSim
+from .network import (
+    MessageScheduler,
+    PartitionSchedule,
+    SilentAdversary,
+    TestNetwork,
+)
+
+
+class ScenarioFailure(AssertionError):
+    """A scenario's protocol-guarantee assertion did not hold."""
+
+
+def _check(cond: bool, detail: str) -> None:
+    if not cond:
+        raise ScenarioFailure(detail)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioConfig:
+    n: int = 10
+    epochs: int = 2
+    seed: int = 0xBAD0
+    fuzz_cases: int = 200
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    ok: bool
+    n: int
+    epochs: int
+    seed: int
+    faults: int  # injected faults observed in the FaultLog(s)
+    detail: str = ""
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _contribs(n: int, tag: bytes, live=None) -> Dict[int, List[bytes]]:
+    ids = range(n) if live is None else sorted(live)
+    return {i: [b"%s-%03d" % (tag, i)] for i in ids}
+
+
+# -- vectorized-harness scenarios -------------------------------------------
+
+
+def _run_silent(cfg: ScenarioConfig) -> ScenarioResult:
+    n, f = cfg.n, (cfg.n - 1) // 3
+    _check(f >= 1, f"n={cfg.n} has f=0; need n >= 4")
+    dead = set(range(n - f, n))
+    live = sorted(set(range(n)) - dead)
+    sim = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    faults = 0
+    for e in range(cfg.epochs):
+        contribs = _contribs(n, b"si%d" % e, live)
+        res = sim.run_epoch(contribs, dead=dead)
+        # guarantee-equivalent baseline: the fault-free batch minus the
+        # dead proposers IS exactly the live contributions
+        _check(
+            set(res.accepted) == set(live),
+            f"epoch {e}: accepted {sorted(res.accepted)} != live {live}",
+        )
+        _check(
+            res.batch.contributions == contribs,
+            f"epoch {e}: batch diverges from live contributions",
+        )
+        _check(
+            res.fault_log.is_empty(),
+            f"epoch {e}: honest-only run logged faults: "
+            f"{list(res.fault_log)}",
+        )
+        faults += len(list(res.fault_log))
+    return ScenarioResult(
+        "silent", True, n, cfg.epochs, cfg.seed, faults,
+        f"{f} dead validators excluded, batches exact",
+    )
+
+
+def _run_bad_share(cfg: ScenarioConfig) -> ScenarioResult:
+    from ..crypto.mock import MockDecryptionShare
+
+    n = cfg.n
+    forger = n - 1
+    rng = random.Random(cfg.seed)
+    bogus = MockDecryptionShare(
+        rng.randrange(2**256).to_bytes(32, "big"),
+        rng.randrange(2**256).to_bytes(32, "big"),
+    )
+    sim = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    twin = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    faults = 0
+    for e in range(cfg.epochs):
+        contribs = _contribs(n, b"bs%d" % e)
+        res = sim.run_epoch(
+            contribs, forged_dec={forger: {p: bogus for p in range(n)}}
+        )
+        ref = twin.run_epoch(contribs)
+        _check(
+            res.batch.contributions == ref.batch.contributions,
+            f"epoch {e}: batch diverges from fault-free twin",
+        )
+        flagged = {fl.node_id for fl in res.fault_log}
+        _check(
+            flagged == {forger},
+            f"epoch {e}: attributed {sorted(flagged)}, expected {{{forger}}}",
+        )
+        _check(
+            ref.fault_log.is_empty(),
+            f"epoch {e}: fault-free twin logged faults",
+        )
+        faults += len(list(res.fault_log))
+    return ScenarioResult(
+        "bad-share", True, n, cfg.epochs, cfg.seed, faults,
+        f"forger {forger} attributed, batches bit-identical to twin",
+    )
+
+
+def _run_corrupt_echo(cfg: ScenarioConfig) -> ScenarioResult:
+    n = cfg.n
+    tamperer = 1 % n
+    sim = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    twin = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    faults = 0
+    for e in range(cfg.epochs):
+        contribs = _contribs(n, b"ce%d" % e)
+        res = sim.run_epoch(
+            contribs, corrupt_shards={0: {tamperer: b"\xff\x00\xff"}}
+        )
+        ref = twin.run_epoch(contribs)
+        _check(
+            res.batch.contributions == ref.batch.contributions,
+            f"epoch {e}: batch diverges from fault-free twin",
+        )
+        flagged = {fl.node_id for fl in res.fault_log}
+        _check(
+            tamperer in flagged,
+            f"epoch {e}: tamperer {tamperer} not attributed ({flagged})",
+        )
+        faults += len(list(res.fault_log))
+    return ScenarioResult(
+        "corrupt-echo", True, n, cfg.epochs, cfg.seed, faults,
+        f"echo tamperer {tamperer} attributed, decode recovered",
+    )
+
+
+def _run_equivocate(cfg: ScenarioConfig) -> ScenarioResult:
+    n, f = cfg.n, (cfg.n - 1) // 3
+    _check(f >= 1, f"n={cfg.n} has f=0; need n >= 4")
+    # the two-view-class divergent epoch-0 schedule (the delivery power
+    # of the reference adversary): equivocators split honest BVal views
+    equiv = {n - 1 - i: (True, False) for i in range(f)}
+    live = [i for i in range(n) if i not in equiv]
+    class_b = live[: f + 1]
+    class_a = frozenset(live[f + 1 :])
+    p = class_b[-1]
+    late = set(class_a) | {class_b[0]}
+    contribs = _contribs(n, b"eq", live)
+    sim = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    res = sim.run_epoch(
+        contribs,
+        late_subset={p: late},
+        divergent=DivergentEpoch0(
+            class_a=class_a, equiv=equiv, instances=frozenset({p})
+        ),
+    )
+    twin = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    ref = twin.run_epoch(contribs, dead=set(equiv), late_subset={p: late})
+    _check(
+        res.batch.contributions == ref.batch.contributions,
+        "batch diverges from the equivocators-dead twin",
+    )
+    _check(
+        set(res.accepted) == set(live),
+        f"accepted {sorted(res.accepted)} != live {live}",
+    )
+    return ScenarioResult(
+        "equivocate", True, n, 1, cfg.seed, len(list(res.fault_log)),
+        f"{f} equivocators, honest batch bit-identical to dead-twin",
+    )
+
+
+def _run_delay(cfg: ScenarioConfig) -> ScenarioResult:
+    n, f = cfg.n, (cfg.n - 1) // 3
+    _check(f >= 1, f"n={cfg.n} has f=0; need n >= 4")
+    withheld = set(range(f))  # live proposers whose RBC is delayed
+    timely = sorted(set(range(n)) - withheld)
+    sim = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    faults = 0
+    for e in range(cfg.epochs):
+        contribs = _contribs(n, b"dl%d" % e)
+        res = sim.run_epoch(contribs, late=withheld)
+        _check(
+            set(res.accepted) == set(timely),
+            f"epoch {e}: accepted {sorted(res.accepted)} != {timely}",
+        )
+        _check(
+            res.batch.contributions
+            == {i: contribs[i] for i in timely},
+            f"epoch {e}: batch diverges from timely contributions",
+        )
+        _check(
+            res.fault_log.is_empty(),
+            f"epoch {e}: delay (scheduler power) logged faults",
+        )
+        faults += len(list(res.fault_log))
+    return ScenarioResult(
+        "delay", True, n, cfg.epochs, cfg.seed, faults,
+        f"{f} delayed proposers excluded by the N-f rule, no faults",
+    )
+
+
+# -- sequential-network scenario --------------------------------------------
+
+
+def _run_partition_heal(cfg: ScenarioConfig) -> ScenarioResult:
+    from ..protocols.broadcast import Broadcast
+
+    n = max(4, min(cfg.n, 10))  # sequential network: keep it small
+    rng = random.Random(cfg.seed)
+    half = (n + 1) // 2
+    sched = PartitionSchedule([range(half), range(half, n)])
+    net = TestNetwork(
+        n,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        lambda ni: Broadcast(ni, 0),
+        rng,
+        mock_crypto=True,
+        message_filter=sched,
+    )
+    proposed = b"partition-heal-%d" % cfg.seed
+    net.input(0, proposed)
+
+    def all_done() -> bool:
+        return all(nd.terminated() for nd in net.nodes.values())
+
+    # phase 1: the partition holds — drive until the network stalls
+    steps = 0
+    while net.any_busy() and not all_done():
+        net.step()
+        steps += 1
+        _check(steps < 200_000, "partitioned network did not quiesce")
+    _check(
+        not all_done(),
+        "partition too weak: broadcast terminated before healing",
+    )
+    _check(sched.held_count > 0, "partition held no messages")
+    held = sched.held_count
+    # phase 2: heal — liveness must be restored by the released backlog
+    sched.heal(net)
+    net.step_until(all_done, max_steps=200_000)
+    for nid, nd in net.nodes.items():
+        _check(
+            nd.outputs == [proposed],
+            f"node {nid} delivered {nd.outputs!r} != proposed value",
+        )
+    _check(
+        net.observer.outputs == [proposed],
+        "observer diverged from the validators",
+    )
+    return ScenarioResult(
+        "partition-heal", True, n, 1, cfg.seed, 0,
+        f"{held} messages held across the cut; all nodes delivered "
+        "after healing",
+    )
+
+
+# -- membership churn --------------------------------------------------------
+
+
+def _run_churn(cfg: ScenarioConfig) -> ScenarioResult:
+    from ..protocols import change as C
+
+    n = cfg.n
+    _check(n >= 4, f"n={cfg.n} too small for churn (need n >= 4)")
+    sim = VectorizedDynamicSim(n, random.Random(cfg.seed), mock=True)
+    committed: set = set()
+    proposed: set = set()
+    faults = 0
+
+    def epoch(contribs, expect_change) -> None:
+        nonlocal faults
+        proposed.update(tx for txs in contribs.values() for tx in txs)
+        r = sim.run_epoch(contribs)
+        committed.update(r.batch.tx_iter())
+        _check(
+            r.fault_log.is_empty(),
+            f"honest churn epoch logged faults: {list(r.fault_log)}",
+        )
+        faults += len(list(r.fault_log))
+        if expect_change is not None:
+            _check(
+                isinstance(r.change, C.Complete)
+                and isinstance(r.change.change, expect_change),
+                f"expected Complete({expect_change.__name__}), "
+                f"got {r.change!r}",
+            )
+
+    # era 0 → 1: vote the last validator out
+    victim = n - 1
+    for v in sim.validators:
+        sim.vote_for(v, C.Remove(victim))
+    epoch({i: [b"ch-a-%03d" % i] for i in sim.validators}, C.Remove)
+    _check(victim not in sim.validators, "removed validator still active")
+    _check(sim.era == 1, f"era {sim.era} != 1 after Remove")
+    # era 1 → 2: vote it back in (its key pair is already registered)
+    pk = sim.pub_keys[victim]
+    for v in sim.validators:
+        sim.vote_for(v, C.Add(victim, pk))
+    epoch({i: [b"ch-b-%03d" % i] for i in sim.validators}, C.Add)
+    _check(victim in sim.validators, "re-added validator missing")
+    _check(sim.era == 2, f"era {sim.era} != 2 after Add")
+    # catch-up epochs in the final era (the rejoined node proposes too)
+    for e in range(max(1, cfg.epochs - 2)):
+        epoch({i: [b"ch-c%d-%03d" % (e, i)] for i in sim.validators}, None)
+    _check(
+        committed == proposed,
+        f"{len(proposed - committed)} proposed txs never committed",
+    )
+    _check(
+        sorted(sim.validators) == list(range(n)),
+        f"final validator set {sim.validators} != full set",
+    )
+    return ScenarioResult(
+        "churn", True, n, max(3, cfg.epochs), cfg.seed, faults,
+        f"Remove({victim})->Add({victim}) through 2 DKG era switches, "
+        f"{len(committed)} txs committed",
+    )
+
+
+# -- wire-format fuzzing -----------------------------------------------------
+
+
+def _run_fuzz(cfg: ScenarioConfig) -> ScenarioResult:
+    cases = cfg.fuzz_cases
+    reports = _fuzz.run_corpus(
+        seed=cfg.seed,
+        codec_cases=cases,
+        frame_cases=max(10, cases // 8),
+        handler_cases=max(20, cases // 2),
+    )
+    rec = _obs.ACTIVE
+    total_cases = 0
+    bad: List[str] = []
+    faults = 0
+    for rep in reports:
+        total_cases += rep.cases
+        faults += rep.faults
+        if rec is not None:
+            rec.event(
+                "fuzz_summary",
+                surface=rep.surface,
+                cases=rep.cases,
+                failures=len(rep.failures),
+                decoded=rep.decoded,
+                rejected=rep.rejected,
+                delivered=rep.delivered,
+                faults=rep.faults,
+            )
+        if not rep.ok:
+            bad.append(f"{rep.surface}: {rep.failures[0]}")
+    _check(not bad, "; ".join(bad))
+    return ScenarioResult(
+        "fuzz", True, cfg.n, 1, cfg.seed, faults,
+        f"{total_cases} cases over {len(reports)} surfaces, "
+        "0 crashes/hangs",
+    )
+
+
+SCENARIOS: Dict[str, Callable[[ScenarioConfig], ScenarioResult]] = {
+    "silent": _run_silent,
+    "bad-share": _run_bad_share,
+    "corrupt-echo": _run_corrupt_echo,
+    "equivocate": _run_equivocate,
+    "delay": _run_delay,
+    "partition-heal": _run_partition_heal,
+    "churn": _run_churn,
+    "fuzz": _run_fuzz,
+}
+
+
+def run_scenario(name: str, cfg: ScenarioConfig) -> ScenarioResult:
+    """Run one named scenario; assertion failures and crashes become a
+    failed :class:`ScenarioResult`, never an exception."""
+    fn = SCENARIOS[name]
+    try:
+        result = fn(cfg)
+    except ScenarioFailure as exc:
+        result = ScenarioResult(
+            name, False, cfg.n, cfg.epochs, cfg.seed, 0, str(exc)
+        )
+    except Exception as exc:  # a scenario must never take the runner down
+        result = ScenarioResult(
+            name, False, cfg.n, cfg.epochs, cfg.seed, 0,
+            f"crashed: {type(exc).__name__}: {exc}",
+        )
+    rec = _obs.ACTIVE
+    if rec is not None:
+        rec.event(
+            "scenario",
+            name=result.name,
+            ok=result.ok,
+            n=result.n,
+            faults=result.faults,
+            epochs=result.epochs,
+            detail=result.detail,
+            seed=result.seed,
+        )
+    return result
+
+
+def run_matrix(
+    cfg: ScenarioConfig, only: Optional[List[str]] = None
+) -> List[ScenarioResult]:
+    names = list(SCENARIOS) if not only else list(only)
+    unknown = [nm for nm in names if nm not in SCENARIOS]
+    if unknown:
+        raise KeyError(
+            f"unknown scenario(s) {unknown}; known: {sorted(SCENARIOS)}"
+        )
+    return [run_scenario(nm, cfg) for nm in names]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hbbft_tpu.harness.scenarios",
+        description="Adversarial scenario matrix over the co-simulation "
+        "harness: Byzantine faults, healing partitions, membership "
+        "churn, and the wire-format fuzzer.",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list scenario names and exit"
+    )
+    parser.add_argument(
+        "--only",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="run only this scenario (repeatable)",
+    )
+    parser.add_argument("--n", type=int, default=10, help="network size")
+    parser.add_argument(
+        "--epochs", type=int, default=2, help="epochs per scenario"
+    )
+    parser.add_argument("--seed", type=int, default=0xBAD0)
+    parser.add_argument(
+        "--fuzz-cases", type=int, default=200, help="codec fuzz cases"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit one JSON row per scenario"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for nm in SCENARIOS:
+            print(nm)
+        return 0
+
+    cfg = ScenarioConfig(
+        n=args.n, epochs=args.epochs, seed=args.seed,
+        fuzz_cases=args.fuzz_cases,
+    )
+    try:
+        results = run_matrix(cfg, only=args.only)
+    except KeyError as exc:
+        print(exc.args[0], file=sys.stderr)
+        return 2
+    for res in results:
+        if args.json:
+            print(json.dumps(res.as_dict(), sort_keys=True))
+        else:
+            mark = "PASS" if res.ok else "FAIL"
+            print(f"{mark}  {res.name:<15} n={res.n:<4} {res.detail}")
+    failed = [res for res in results if not res.ok]
+    if not args.json:
+        print(
+            f"{len(results) - len(failed)}/{len(results)} scenarios green"
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

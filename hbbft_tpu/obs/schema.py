@@ -63,7 +63,16 @@ EVENTS: Dict[str, EventSpec] = {
     "device_op": _spec({"op", "k", "engine"}),
     # fault attribution
     "fault": _spec({"fault", "node", "kind"}),
-    # real TCP mesh wire plane (additive, this PR)
+    # real TCP mesh wire plane (additive)
     "wire_send": _spec({"peer", "size"}, {"kind"}),
     "wire_recv": _spec({"peer", "size"}),
+    # adversarial scenario matrix (additive): one row per scenario run,
+    # and one per completed fuzz surface
+    "scenario": _spec(
+        {"name", "ok", "n", "faults"}, {"epochs", "detail", "seed"}
+    ),
+    "fuzz_summary": _spec(
+        {"surface", "cases", "failures"},
+        {"decoded", "rejected", "delivered", "faults"},
+    ),
 }

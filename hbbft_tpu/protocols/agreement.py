@@ -136,6 +136,10 @@ class Agreement(DistAlgorithm):
     def handle_message(self, sender_id, message) -> Step:
         if not isinstance(message, AgreementMessage):
             return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
+        # epoch arrives off the wire: a non-int would raise in the
+        # comparisons / queue keying below instead of being attributed
+        if not isinstance(message.epoch, int) or isinstance(message.epoch, bool):
+            return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
         if self.decision is not None or (
             message.epoch < self.epoch and message.can_expire()
         ):
@@ -163,8 +167,12 @@ class Agreement(DistAlgorithm):
             )
             return self._handle_sbvb_step(sbvb_step)
         if isinstance(content, ConfContent):
+            if not isinstance(content.values, BoolSet):
+                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
             return self._handle_conf(sender_id, content.values)
         if isinstance(content, TermContent):
+            if not isinstance(content.value, bool):
+                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
             return self._handle_term(sender_id, content.value)
         if isinstance(content, CoinContent):
             return self._handle_coin(sender_id, content.msg)

@@ -70,7 +70,13 @@ class CommonSubset(DistAlgorithm):
 
     def handle_message(self, sender_id, message) -> Step:
         if isinstance(message, CsBroadcast):
-            if message.proposer_id not in self.broadcast_instances:
+            # the wire can carry an unhashable proposer_id (e.g. a list),
+            # which would TypeError the membership test
+            try:
+                known = message.proposer_id in self.broadcast_instances
+            except TypeError:
+                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
+            if not known:
                 return Step.from_fault(
                     sender_id, FaultKind.UNEXPECTED_PROPOSER
                 )
@@ -79,7 +85,11 @@ class CommonSubset(DistAlgorithm):
                 lambda bc: bc.handle_message(sender_id, message.msg),
             )
         if isinstance(message, CsAgreement):
-            if message.proposer_id not in self.agreement_instances:
+            try:
+                known = message.proposer_id in self.agreement_instances
+            except TypeError:
+                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
+            if not known:
                 return Step.from_fault(
                     sender_id, FaultKind.UNEXPECTED_PROPOSER
                 )

@@ -104,12 +104,18 @@ class DhbSignedVote:
 
 def _message_era(message) -> Optional[int]:
     if isinstance(message, DhbHoneyBadger):
-        return message.start_epoch
-    if isinstance(message, DhbKeyGen):
-        return message.era
-    if isinstance(message, DhbSignedVote):
-        return message.signed_vote.era
-    return None
+        era = message.start_epoch
+    elif isinstance(message, DhbKeyGen):
+        era = message.era
+    elif isinstance(message, DhbSignedVote):
+        era = getattr(message.signed_vote, "era", None)
+    else:
+        return None
+    # off-wire fields can hold anything; a non-int era would raise in
+    # the caller's comparisons — treat it as no era (invalid message)
+    if not isinstance(era, int) or isinstance(era, bool):
+        return None
+    return era
 
 
 # -- batch ------------------------------------------------------------------

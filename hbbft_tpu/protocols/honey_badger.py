@@ -110,6 +110,10 @@ class HoneyBadger(DistAlgorithm):
         if not isinstance(message, HoneyBadgerMessage):
             return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
         epoch = message.epoch
+        # a deserialized message can carry anything in the epoch slot;
+        # comparing/queueing a non-int would raise instead of faulting
+        if not isinstance(epoch, int) or isinstance(epoch, bool):
+            return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
         if epoch > self.epoch + self.max_future_epochs:
             self.incoming_queue.setdefault(epoch, []).append(
                 (sender_id, message.content)
@@ -179,6 +183,14 @@ class HoneyBadger(DistAlgorithm):
     def _handle_decryption_share_message(
         self, sender_id, epoch, proposer_id, share
     ) -> Step:
+        # an unhashable proposer id (e.g. a decoded list) could never key
+        # received_shares/ciphertexts — reject before any dict lookup
+        try:
+            known = self.netinfo.is_node_validator(proposer_id)
+        except TypeError:
+            return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
+        if not known:
+            return Step.from_fault(sender_id, FaultKind.UNEXPECTED_PROPOSER)
         ciphertext = self.ciphertexts.get(epoch, {}).get(proposer_id)
         if ciphertext is not None:
             if not self._verify_decryption_share(

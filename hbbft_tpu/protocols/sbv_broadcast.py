@@ -47,9 +47,15 @@ class SbvBroadcast(DistAlgorithm):
         return self.send_bval(bool(value))
 
     def handle_message(self, sender_id, msg) -> Step:
+        # a deserialized BVal/Aux can carry a non-bool value, which would
+        # KeyError the {False, True} multimaps below
         if isinstance(msg, BVal):
+            if not isinstance(msg.value, bool):
+                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
             return self.handle_bval(sender_id, msg.value)
         if isinstance(msg, Aux):
+            if not isinstance(msg.value, bool):
+                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
             return self.handle_aux(sender_id, msg.value)
         return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
 

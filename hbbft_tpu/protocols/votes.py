@@ -38,6 +38,29 @@ class SignedVote:
         return self.vote.era
 
 
+def _well_formed(signed_vote) -> bool:
+    """Structural sanity for a vote received off the wire: the decoder
+    will happily build a ``SignedVote`` whose fields are the wrong types
+    (non-``Vote`` vote, unhashable voter/change, non-int era/num), and
+    any of those would raise out of the dict/comparison operations the
+    counters run — a remote-triggered crash instead of a ``Fault``."""
+    if not isinstance(signed_vote, SignedVote):
+        return False
+    vote = signed_vote.vote
+    if not isinstance(vote, Vote) or not isinstance(vote.change, Change):
+        return False
+    if not isinstance(vote.era, int) or isinstance(vote.era, bool):
+        return False
+    if not isinstance(vote.num, int) or isinstance(vote.num, bool):
+        return False
+    try:
+        hash(signed_vote.voter)
+        hash(vote.change)
+    except TypeError:
+        return False
+    return True
+
+
 class VoteCounter:
     def __init__(self, netinfo: NetworkInfo, era: int):
         self.netinfo = netinfo
@@ -60,7 +83,7 @@ class VoteCounter:
     def add_pending_vote(self, sender_id, signed_vote: SignedVote) -> FaultLog:
         """Buffer a vote received off-chain (reference ``:64-85``)."""
         faults = FaultLog()
-        if not isinstance(signed_vote, SignedVote):
+        if not _well_formed(signed_vote):
             faults.add(sender_id, FaultKind.INVALID_VOTE_SIGNATURE)
             return faults
         prev = self.pending.get(signed_vote.voter)
@@ -92,7 +115,7 @@ class VoteCounter:
 
     def add_committed_vote(self, proposer_id, signed_vote: SignedVote) -> FaultLog:
         faults = FaultLog()
-        if not isinstance(signed_vote, SignedVote):
+        if not _well_formed(signed_vote):
             faults.add(proposer_id, FaultKind.INVALID_VOTE_SIGNATURE)
             return faults
         prev = self.committed.get(signed_vote.voter)

@@ -37,6 +37,7 @@ import asyncio
 import random
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core.fault import Fault, FaultKind
 from ..core.network_info import NetworkInfo
 from ..core.serialize import SerializationError, dumps, loads
 from ..core.step import Step
@@ -44,6 +45,12 @@ from ..obs import recorder as _obs
 
 _LEN_BYTES = 4
 _MAX_FRAME = 64 * 1024 * 1024
+
+# Racecheck hook (analysis/racecheck.py): when the runtime lockset
+# checker is installed it replaces this with a callable that wraps each
+# new node's per-connection containers (_writers/outputs/faults) in
+# tracked views, so concurrent connection handling is race-checked.
+_TRACK_NODE: Optional[Callable[["TcpNode"], None]] = None
 
 
 def generate_keys_for(addresses: List[str], our_addr: str) -> NetworkInfo:
@@ -110,6 +117,8 @@ class TcpNode:
         self._server: Optional[asyncio.base_events.Server] = None
         self._tasks: List[asyncio.Task] = []
         self._connected = asyncio.Event()
+        if _TRACK_NODE is not None:
+            _TRACK_NODE(self)
 
     # -- connection management --------------------------------------------
 
@@ -302,7 +311,15 @@ class TcpNode:
             try:
                 step = self.algo.handle_message(sender, message)
             except Exception:
-                continue  # Byzantine garbage from a real socket: drop
+                # A deserializable-but-malformed message slipped past the
+                # handler's own guards.  Never crash the pump on remote
+                # input — but never drop it silently either: attribute
+                # it so the failure is visible in faults + obs counters.
+                self.faults.append(Fault(sender, FaultKind.INVALID_MESSAGE))
+                rec = _obs.ACTIVE
+                if rec is not None:
+                    rec.count("wire.handler_errors")
+                continue
             await self._route(step)
         return self.outputs
 

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Adversarial scenario matrix wrapper: run the full matrix (or a
+# selection), forwarding all flags to the CLI.  Examples:
+#   scripts/scenarios.sh
+#   scripts/scenarios.sh --list
+#   scripts/scenarios.sh --only fuzz --fuzz-cases 2000
+#   scripts/scenarios.sh --only churn --n 64 --json
+#   SCENARIO_LOG=/tmp/scenarios.log scripts/scenarios.sh
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+# Under pipefail, ${PIPESTATUS[0]} is the matrix's own exit code even
+# when the output is piped through tee (same idiom as lint.sh).
+if [ -n "${SCENARIO_LOG:-}" ]; then
+  python -m hbbft_tpu.harness.scenarios "${@+"$@"}" 2>&1 \
+    | tee "$SCENARIO_LOG"
+  exit "${PIPESTATUS[0]}"
+fi
+python -m hbbft_tpu.harness.scenarios "${@+"$@"}"
+exit $?

@@ -793,6 +793,55 @@ def test_thread_shared_state_flags_anonymous_threads():
     assert msgs.count("ThreadPoolExecutor without") == 1
 
 
+_QUEUE_HANDOFF_SRC = """
+    import queue
+    import threading
+
+    _Q = None
+
+    def _chan():
+        global _Q
+        if _Q is None:
+            _Q = {ctor}
+        return _Q
+
+    def _worker():
+        _chan().put(1)
+
+    def start():
+        threading.Thread(target=_worker, name="hbbft-q", daemon=True).start()
+
+    def drain():
+        return _chan().get()
+"""
+
+
+def test_thread_shared_state_queue_handoff_is_safe():
+    # a lazily-bound queue.* global is an internally-locked handoff
+    # channel: neither the shared-state pass nor atomic-cache flags it,
+    # with no suppression comment needed
+    for ctor in ("queue.SimpleQueue()", "queue.Queue(maxsize=8)"):
+        src = _QUEUE_HANDOFF_SRC.format(ctor=ctor)
+        assert _lint(src, "ops/fixture.py", select="thread-shared-state") == []
+        assert _lint(src, "ops/fixture.py", select="atomic-cache") == []
+
+
+def test_thread_shared_state_queue_exemption_is_narrow():
+    # the identical shape with a plain container still flags under both
+    # rules — the exemption keys on the constructor, not the idiom
+    src = _QUEUE_HANDOFF_SRC.format(ctor="[]")
+    vs = _lint(src, "ops/fixture.py", select="thread-shared-state")
+    assert len(vs) == 1 and "unguarded write to 'ops/fixture._Q'" in vs[0].message
+    assert _lint(src, "ops/fixture.py", select="atomic-cache") != []
+    # one rebind to a non-queue value demotes the name even when
+    # another rebind is a queue
+    mixed = _QUEUE_HANDOFF_SRC.format(ctor="queue.SimpleQueue()") + (
+        "\n    def reset():\n        global _Q\n        _Q = []\n"
+    )
+    vs = _lint(mixed, "ops/fixture.py", select="thread-shared-state")
+    assert len(vs) >= 1
+
+
 # ---------------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------------

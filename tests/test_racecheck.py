@@ -318,6 +318,38 @@ def test_stress_concurrent_pipeline_zero_races_and_byte_identity(
 # ---------------------------------------------------------------------------
 
 
+def test_tcp_node_containers_tracked_and_restored(request):
+    if request.config.getoption("--racecheck"):
+        pytest.skip("manages the global checker itself")
+    from hbbft_tpu.transport import tcp
+
+    assert tcp._TRACK_NODE is None
+    racecheck.enable()
+    try:
+        node = tcp.TcpNode(
+            "127.0.0.1:7001",
+            ["127.0.0.1:7001", "127.0.0.1:7002"],
+            lambda ni: object(),
+        )
+        # per-connection shared containers are shimmed at construction
+        assert isinstance(node._writers, racecheck.TrackedDict)
+        assert isinstance(node.outputs, racecheck.TrackedList)
+        assert isinstance(node.faults, racecheck.TrackedList)
+        assert callable(tcp._TRACK_NODE)
+    finally:
+        racecheck.disable()
+    # the constructor hook is restored to None, and new nodes get
+    # plain builtins again
+    assert tcp._TRACK_NODE is None
+    after = tcp.TcpNode(
+        "127.0.0.1:7001",
+        ["127.0.0.1:7001", "127.0.0.1:7002"],
+        lambda ni: object(),
+    )
+    assert type(after._writers) is dict
+    assert type(after.outputs) is list
+
+
 @pytest.mark.slow
 def test_cli_racecheck_driver_runs_clean():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
