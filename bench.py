@@ -281,6 +281,249 @@ def bench_headline(k: int = 65536, iters: int = 5):
 
 
 # ---------------------------------------------------------------------------
+# Multi-chip mesh headline (--mesh): per-device-count scaling rows
+# ---------------------------------------------------------------------------
+
+
+def bench_mesh_child(n_devices: int, k: int = 512, iters: int = 3):
+    """ONE per-device-count row, measured on the REAL flush path (not
+    the ``__graft_entry__`` dryrun): ``BatchingBackend.prefetch`` over
+    fresh BLS decryption obligations, with the product-MSM sharded
+    across ``n_devices`` by the mesh engine (``parallel/mesh.py``) for
+    ``n_devices > 1`` and the default single-device routing at 1.
+
+    Runs inside a child process whose device count was fixed before
+    jax came up (``bench_mesh`` sets the env; same pattern as
+    ``__graft_entry__._dryrun_child``).  The flush's ``device_op``
+    events are captured to a trace and the engines that ACTUALLY ran
+    are reported in the row — a mesh row that silently fell back to
+    host would be a lie the trajectory files can't detect."""
+    import os
+    import statistics
+    import tempfile
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # re-assert in config: a sitecustomize TPU plugin can outrank
+        # the env var (see __graft_entry__._dryrun_child)
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", int(n_devices))
+        except Exception:
+            pass
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"mesh child: need {n_devices} devices, have "
+            f"{len(jax.devices())} ({jax.default_backend()!r})"
+        )
+
+    from hbbft_tpu import native as NT
+    from hbbft_tpu.crypto import threshold as T
+    from hbbft_tpu.crypto.curve import G2_GEN
+    from hbbft_tpu.harness.batching import BatchingBackend, DecObligation
+    from hbbft_tpu.obs import recorder as obsrec
+    from hbbft_tpu.ops import limbs as LB
+    from hbbft_tpu.ops.backend_tpu import TpuBackend
+
+    rng = random.Random(0x3E5A)
+    n_nodes = min(1024, k)
+    groups = max(1, k // n_nodes)
+    k = n_nodes * groups
+    xs = [rng.randrange(1, LB.R) for _ in range(n_nodes)]
+    pk_shares = [T.PublicKeyShare(G2_GEN * x) for x in xs]
+    master_pk = T.SecretKey.random(rng).public_key()
+
+    def make_obs(tag: bytes):
+        cts = [
+            master_pk.encrypt(tag + b"-%d" % g, rng) for g in range(groups)
+        ]
+        obs = []
+        for ct in cts:
+            if NT.available():
+                wires = NT.g1_mul_many(NT.g1_wire(ct.u), xs)
+                shares = [
+                    T.DecryptionShare(NT.g1_unwire(w, type(ct.u)))
+                    for w in wires
+                ]
+            else:
+                shares = [T.DecryptionShare(ct.u * x) for x in xs]
+            obs.extend(
+                DecObligation(pk_shares[i], shares[i], ct)
+                for i in range(n_nodes)
+            )
+        return obs
+
+    inner = TpuBackend()  # mesh resolved from HBBFT_TPU_MESH
+    inner.G1_MESH_MIN = k  # open the gate for exactly the flush shape
+    if n_devices == 1:
+        # open the single-device gate too: the scaling baseline must
+        # be the same engine family as the mesh rows (device bit-scan
+        # MSM), not the host-arithmetic fallback the small-k routing
+        # band would pick
+        inner.G1_DEVICE_MIN = min(inner.G1_DEVICE_MIN, k)
+    mesh_on = inner._mesh_flush_active()
+    if n_devices > 1 and not mesh_on:
+        raise RuntimeError(
+            "mesh child: %d devices requested but the mesh engine is "
+            "inactive (HBBFT_TPU_MESH / HBBFT_TPU_MESH_CPU unset?)"
+            % n_devices
+        )
+
+    trace = tempfile.NamedTemporaryFile(
+        suffix=".jsonl", delete=False, mode="w"
+    )
+    trace.close()
+    obsrec.enable(trace.name)
+    flush_dts, phase_samples = [], []
+    try:
+        # one untimed warmup flush: the first iteration pays the XLA
+        # compile (~minutes cold on the CPU bit-scan engine) and would
+        # swamp the warm steady state the scaling row is about; its
+        # wall is reported separately as warm_s
+        t0 = time.perf_counter()
+        BatchingBackend(inner=inner).prefetch(make_obs(b"mesh-warm"))
+        warm_s = time.perf_counter() - t0
+        for i in range(iters):
+            obs_l = make_obs(b"mesh-%d" % i)
+            be = BatchingBackend(inner=inner)
+            t0 = time.perf_counter()
+            be.prefetch(obs_l)
+            flush_dts.append(time.perf_counter() - t0)
+            assert be.stats.fallback_items == 0
+            assert all(
+                be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
+                for o in obs_l
+            )
+            ph = getattr(be, "last_flush_phases", None)
+            if ph:
+                phase_samples.append(
+                    {kk: round(vv, 4) for kk, vv in ph.items()}
+                )
+    finally:
+        obsrec.disable()
+    engines = set()
+    with open(trace.name) as fh:
+        for line in fh:
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("ev") == "device_op" and row.get(
+                "op", ""
+            ).startswith("g1_msm"):
+                # collect per-group g1_msm engines too: at 1 device the
+                # fused product wrapper is host but the MSMs themselves
+                # run on the device engine — the row must say so
+                engines.add(row.get("engine"))
+    os.unlink(trace.name)
+    if n_devices > 1 and "mesh" not in engines:
+        raise RuntimeError(
+            "mesh child: flush never routed to the mesh engine "
+            f"(saw {sorted(engines)}) — the row would be a lie"
+        )
+
+    flush_s = statistics.median(flush_dts)
+    return _emit(
+        "share_verify_throughput",
+        k / flush_s,
+        "shares/s",
+        mesh_devices=n_devices,
+        engines=sorted(e for e in engines if e),
+        nodes=n_nodes,
+        groups=groups,
+        flush_s=round(flush_s, 3),
+        flush_min_s=round(min(flush_dts), 3),
+        flush_max_s=round(max(flush_dts), 3),
+        warm_s=round(warm_s, 3),
+        phases=phase_samples[-1] if phase_samples else None,
+    )
+
+
+def bench_mesh(k: int = 512, iters: int = 3, devices=(1, 2, 4, 8)):
+    """The MULTICHIP-style headline: ``share_verify_throughput`` per
+    device count from the REAL flush path, plus one scaling-summary
+    row.  Each count runs in its own child process (a JAX backend's
+    device count is fixed once initialized, so only a fresh interpreter
+    can host each mesh width — the ``__graft_entry__`` respawn
+    pattern); on a host without that many real chips the children run
+    a virtual CPU mesh (``HBBFT_TPU_MESH_CPU=1``), which validates the
+    sharded program and transfers but SERIALIZES shard compute on one
+    core — per-device speedup there is measured, not assumed, and the
+    summary row carries the host context so trajectory readers can
+    tell the regimes apart."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    import jax
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows = {}
+    virtual = False
+    for d in devices:
+        env = dict(os.environ)
+        env["HBBFT_TPU_MESH"] = str(d) if d > 1 else "0"
+        # force the full device share: the scaling row measures the
+        # mesh engine itself, not the host/device hybrid split
+        env["HBBFT_TPU_DEVICE_FRACTION"] = "1"
+        use_cpu = jax.default_backend() != "tpu" or len(jax.devices()) < d
+        if use_cpu:
+            virtual = True
+            env["JAX_PLATFORMS"] = "cpu"
+            env["HBBFT_TPU_MESH_CPU"] = "1"
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+",
+                "",
+                env.get("XLA_FLAGS", ""),
+            )
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={d}"
+            ).strip()
+        res = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(here, "bench.py"),
+                "--mesh-child",
+                str(d),
+                "--k",
+                str(k),
+                "--iters",
+                str(iters),
+            ],
+            cwd=here,
+            env=env,
+            capture_output=True,
+            text=True,
+        )
+        sys.stdout.write(res.stdout)
+        sys.stdout.flush()
+        if res.returncode != 0:
+            sys.stderr.write(res.stderr)
+            raise RuntimeError(
+                f"mesh child (devices={d}) failed rc={res.returncode}"
+            )
+        last = [
+            ln for ln in res.stdout.splitlines() if ln.startswith("{")
+        ][-1]
+        rows[d] = json.loads(last)
+    d0, d1 = min(rows), max(rows)
+    speedup = rows[d1]["value"] / rows[d0]["value"]
+    return _emit(
+        "mesh_share_verify_scaling",
+        speedup,
+        "x",
+        devices=sorted(rows),
+        rates={str(d): rows[d]["value"] for d in sorted(rows)},
+        flush_s={str(d): rows[d].get("flush_s") for d in sorted(rows)},
+        k=k,
+        virtual_cpu_mesh=virtual,
+        host_cores=os.cpu_count(),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Suite configs (BASELINE.md / SURVEY §6)
 # ---------------------------------------------------------------------------
 
@@ -1517,7 +1760,28 @@ def main() -> None:
     p.add_argument("--suite", action="store_true", help="run all configs")
     p.add_argument("--config", choices=sorted(SUITE), help="run one config")
     p.add_argument(
-        "--k", type=int, default=65536, help="headline batch size"
+        "--k",
+        type=int,
+        default=None,
+        help="batch size (default: 65536 headline, 512 --mesh)",
+    )
+    p.add_argument(
+        "--mesh",
+        action="store_true",
+        help="per-device-count mesh scaling rows from the real flush "
+        "path (spawns one child per device count; see scripts/"
+        "bench_mesh.sh)",
+    )
+    p.add_argument(
+        "--mesh-devices",
+        default="1,2,4,8",
+        help="comma-separated device counts for --mesh",
+    )
+    p.add_argument(
+        "--mesh-child", type=int, default=None, help=argparse.SUPPRESS
+    )
+    p.add_argument(
+        "--iters", type=int, default=3, help="flush iterations (--mesh)"
     )
     p.add_argument(
         "--trace",
@@ -1532,13 +1796,25 @@ def main() -> None:
 
         obsrec.enable(args.trace)
     try:
-        if args.config:
+        if args.mesh_child:
+            bench_mesh_child(
+                args.mesh_child, k=args.k or 512, iters=args.iters
+            )
+        elif args.mesh:
+            bench_mesh(
+                k=args.k or 512,
+                iters=args.iters,
+                devices=tuple(
+                    int(x) for x in args.mesh_devices.split(",") if x
+                ),
+            )
+        elif args.config:
             SUITE[args.config]()
         elif args.suite:
             for name in SUITE:
                 SUITE[name]()
         else:
-            bench_headline(k=args.k)
+            bench_headline(k=args.k or 65536)
     finally:
         if args.trace:
             obsrec.disable()

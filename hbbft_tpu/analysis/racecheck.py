@@ -466,6 +466,7 @@ class RaceChecker:
         from ..crypto import rs
         from ..obs import recorder
         from ..ops import gf256_jax, packed_msm, pallas_ec, staging
+        from ..parallel import mesh as _mesh
         from ..transport import tcp as _tcp
 
         lock_sites = [
@@ -476,6 +477,7 @@ class RaceChecker:
             (rs, "_TABLE16_LOCK", "crypto/rs._TABLE16_LOCK"),
             (gf256_jax, "_BITS16_LOCK", "ops/gf256_jax._BITS16_LOCK"),
             (recorder, "_SWITCH_LOCK", "obs/recorder._SWITCH_LOCK"),
+            (_mesh, "_RUNNERS_LOCK", "parallel/mesh._RUNNERS_LOCK"),
         ]
         for mod, attr, name in lock_sites:
             self._shim(mod, attr, self.track_lock(getattr(mod, attr), name))
@@ -484,6 +486,13 @@ class RaceChecker:
             pallas_ec,
             "_EXEC_MEM",
             self.track_dict(pallas_ec._EXEC_MEM, "ops/pallas_ec._EXEC_MEM"),
+        )
+        # mesh runner cache: prewarm threads and the flush path both
+        # build/look up sharded runners keyed by (mesh, shape, engine)
+        self._shim(
+            _mesh,
+            "_RUNNERS",
+            self.track_dict(_mesh._RUNNERS, "parallel/mesh._RUNNERS"),
         )
         self._shim(
             packed_msm,

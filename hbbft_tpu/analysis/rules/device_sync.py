@@ -17,6 +17,19 @@ protocol layer thinks it does.  The rule finds jit regions two ways:
 ``.shape``, ``len()``, ``.ndim``) and on literal constants is allowed
 — those are static under tracing.
 
+``shard_map`` regions (the multi-chip mesh flush in ``parallel/``)
+get the same body pass with sharper teeth: inside a shard_map body
+every host materialization is a *gather* — it pulls one shard's value
+back through the host and serializes the named-axis overlap window
+that the mesh flush exists to exploit.  The partial-sum reduction must
+stay on device (``ppermute`` ring or the Pallas async remote copy);
+``jax.device_get``/``np.asarray`` there is exactly the host gather the
+mesh engine was built to remove.  Regions are found the same two ways
+(decorators — including ``@functools.partial(shard_map, ...)`` — and
+``shard_map(f, ...)`` wrap sites); when a function is both jit- and
+shard_map-wrapped (``jax.jit(shard_map(...))`` is the normal stack),
+the shard_map diagnosis wins — it is the more specific one.
+
 ``ops/staging`` additionally gets a MODULE-WIDE pass: that module is
 the flush pipeline's overlap window (its whole point is to run
 marshalling + non-blocking ``device_put`` dispatch while the caller's
@@ -48,6 +61,13 @@ _NUMPY_SYNC = {
 _JIT_NAMES = {"jax.jit", "jit"}
 
 
+def _is_shard_map(name: str) -> bool:
+    """Match ``shard_map`` however it is spelled: bare, ``jax.shard_map``,
+    ``jax.experimental.shard_map.shard_map``, or a local re-export like
+    ``parallel.mesh``'s compat wrapper referenced as ``M.shard_map``."""
+    return name == "shard_map" or name.endswith(".shard_map")
+
+
 def _decorated_jit(fn: ast.AST) -> bool:
     for dec in getattr(fn, "decorator_list", []):
         name = dotted_name(dec)
@@ -63,10 +83,38 @@ def _decorated_jit(fn: ast.AST) -> bool:
     return False
 
 
+def _decorated_shard_map(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name = dotted_name(dec)
+        if name and _is_shard_map(name):
+            return True
+        if isinstance(dec, ast.Call):
+            cn = dotted_name(dec.func)
+            if cn and _is_shard_map(cn):
+                return True
+            if cn in ("functools.partial", "partial") and dec.args:
+                an = dotted_name(dec.args[0])
+                if an and _is_shard_map(an):
+                    return True
+    return False
+
+
 def _jit_wrapped_names(tree: ast.AST) -> Set[str]:
     names: Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES:
+            if node.args and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+    return names
+
+
+def _shard_map_wrapped_names(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = dotted_name(node.func)
+        if cn and _is_shard_map(cn):
             if node.args and isinstance(node.args[0], ast.Name):
                 names.add(node.args[0].id)
     return names
@@ -97,12 +145,16 @@ class DeviceSyncRule(Rule):
         if ctx.relpath.startswith("ops/staging"):
             out.extend(self._check_overlap_module(ctx))
         wrapped = _jit_wrapped_names(ctx.tree)
+        smapped = _shard_map_wrapped_names(ctx.tree)
         for fn in ast.walk(ctx.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if not (_decorated_jit(fn) or fn.name in wrapped):
-                continue
-            out.extend(self._check_jit_body(ctx, fn))
+            if _decorated_shard_map(fn) or fn.name in smapped:
+                # the usual stack is jax.jit(shard_map(f)) — the
+                # shard_map diagnosis is the more specific one
+                out.extend(self._check_shard_body(ctx, fn))
+            elif _decorated_jit(fn) or fn.name in wrapped:
+                out.extend(self._check_jit_body(ctx, fn))
         return out
 
     def _check_overlap_module(self, ctx: FileContext) -> List[Violation]:
@@ -196,6 +248,64 @@ class DeviceSyncRule(Rule):
                             node,
                             f"{name}() on a (possibly traced) value inside "
                             "@jit — concretization hazard",
+                        )
+                    )
+        return out
+
+    def _check_shard_body(
+        self, ctx: FileContext, fn: ast.AST
+    ) -> List[Violation]:
+        """A shard_map body runs once per device over the named axis;
+        any host materialization there is a per-shard host gather that
+        serializes the mesh overlap window.  Cross-shard data must move
+        by collective (``ppermute`` ring / Pallas async remote copy),
+        never through the host."""
+        out: List[Violation] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "item",
+                "block_until_ready",
+            ):
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f".{node.func.attr}() inside a shard_map body is a "
+                        "per-shard host sync — it stalls the named-axis "
+                        "overlap window on every device",
+                    )
+                )
+            elif name in ("jax.device_get", "device_get"):
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        "jax.device_get inside a shard_map body is a host "
+                        "gather of per-shard values — keep the reduction on "
+                        "device (ppermute ring / async remote copy)",
+                    )
+                )
+            elif name in _NUMPY_SYNC:
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f"{name} materializes a shard on host inside a "
+                        "shard_map body — a host gather breaks the mesh "
+                        "overlap window; reduce on device instead",
+                    )
+                )
+            elif name in ("int", "float", "bool") and len(node.args) == 1:
+                if not _mentions_static(node.args[0]):
+                    out.append(
+                        self.violation(
+                            ctx,
+                            node,
+                            f"{name}() on a (possibly traced) value inside "
+                            "a shard_map body — concretization hazard",
                         )
                     )
         return out

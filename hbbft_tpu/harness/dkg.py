@@ -511,6 +511,7 @@ class VectorizedDkg:
         import jax.numpy as jnp
 
         from ..ops import fr_jax as FJ
+        from ..ops import staging
 
         n, t = self.n, self.t
         tp1 = t + 1
@@ -583,17 +584,37 @@ class VectorizedDkg:
                     )
             else:
                 run_step = jax.jit(grids)
+                # staged matrix uploads (the flush pipeline's FIFO +
+                # buffer pool, ops/staging.py): dealer d+1's limb
+                # marshal + device_put runs on the worker while dealer
+                # d's grids execute — same uploads in the same order,
+                # so shares/pk stay byte-identical with staging off.
+                # The leased buffers stay live until the int(digest)
+                # sync below materializes every step (PJRT consumes
+                # host buffers lazily), then retire together.
+                lease = staging.buffers().lease()
+
+                def _upload(d):
+                    buf = lease.get((tp1, tp1, FJ.FR_LIMBS))
+                    buf[...] = FJ.fr_to_limbs(
+                        [c for row in coeffs[d] for c in row]
+                    ).reshape(tp1, tp1, FJ.FR_LIMBS)
+                    return jnp.asarray(buf)
+
+                nxt = staging.stager().submit(lambda: _upload(0))
                 for d in range(n):
-                    c_limbs = jnp.asarray(
-                        FJ.fr_to_limbs(
-                            [c for row in coeffs[d] for c in row]
-                        ).reshape(tp1, tp1, FJ.FR_LIMBS)
-                    )
+                    c_limbs = nxt.result()
+                    if d + 1 < n:
+                        nxt = staging.stager().submit(
+                            lambda dd=d + 1: _upload(dd)
+                        )
                     share_acc, row0_acc, digest = run_step(
                         c_limbs, share_acc, row0_acc, digest
                     )
 
             int(digest)  # sync: the full data plane has been computed
+            if coeffs is not None:
+                lease.retire()  # every staged upload has been consumed
 
         with _obs.span("dkg.generation", complete=n, engine="device"):
             share_vals = FJ.limbs_to_fr(np.asarray(share_acc))

@@ -1418,6 +1418,13 @@ class VectorizedHoneyBadgerSim:
             phases["dec_" + k] = v
         for k, v in (getattr(self.be, "last_flush_phases", None) or {}).items():
             phases["flush_" + k] = v
+        # which engine produced those flush walls: a mesh-configured
+        # backend shards the product MSM, and the per-device-count
+        # trajectory (bench --mesh, MULTICHIP files) needs the walls
+        # attributed to their device count to be comparable
+        _mesh = getattr(getattr(self.be, "inner", None), "mesh", None)
+        if _mesh is not None and _mesh.devices.size > 1:
+            phases["mesh_devices"] = float(_mesh.devices.size)
         # 6. batch assembly (honey_badger.rs:296-317)
         out_contribs: Dict[Any, Any] = {}
         for pid in sorted(dec.contributions):

@@ -40,6 +40,31 @@ from . import ec_jax, gf256_jax, sha256_jax
 _MIN_DEVICE_BATCH = 8
 
 
+def _mesh_from_env():
+    """Resolve the backend's default mesh from ``HBBFT_TPU_MESH``:
+    unset → auto (a real multi-device TPU host meshes itself over all
+    its chips); ``"0"`` / empty → explicitly off; an integer N → a
+    forced N-device mesh (the virtual-device path used by the tier-1
+    mesh tests and ``bench.py --mesh`` children)."""
+    env = os.environ.get("HBBFT_TPU_MESH")
+    try:
+        from ..parallel import mesh as M
+
+        if env is not None:
+            env = env.strip()
+            if not env or env == "0":
+                return None
+            n = int(env)
+            return M.make_mesh(n) if n > 1 else None
+        import jax
+
+        if jax.default_backend() == "tpu" and len(jax.devices()) > 1:
+            return M.make_mesh()
+    except Exception:
+        pass  # a broken mesh config must not break construction
+    return None
+
+
 class _DeviceMerkleTree(MerkleTree):
     """MerkleTree whose levels were hashed on device (same layout)."""
 
@@ -61,7 +86,7 @@ class TpuBackend(CpuBackend):
     name = "tpu"
 
     def __init__(self, mesh=None):
-        self.mesh = mesh
+        self.mesh = mesh if mesh is not None else _mesh_from_env()
         self._sharded_g1 = None
         # env overrides are read here (not at import) so operators and
         # tests can set them after the module loads
@@ -79,10 +104,24 @@ class TpuBackend(CpuBackend):
         # runs DKG/setup — the first flush then skips the per-
         # executable load wall that dominated the r05 cold flush
         try:
+            import jax
+
+            from . import packed_msm
+
             if jax.default_backend() == "tpu":
                 packed_msm.start_background_prewarm()
         except Exception:
             pass  # prewarm is an optimization; never block construction
+
+    def _mesh_flush_active(self) -> bool:
+        """Whether product flushes route to the sharded mesh engine:
+        a >1-device mesh on a backend the engine supports (real TPU,
+        or a virtual CPU mesh under ``HBBFT_TPU_MESH_CPU=1``)."""
+        if self.mesh is None or self.mesh.devices.size < 2:
+            return False
+        from . import packed_msm
+
+        return packed_msm._mesh_backend_ok()
 
     # -- hashing / merkle -------------------------------------------------
     # Like the MSMs, routed by measured capability: the native C++ host
@@ -307,6 +346,18 @@ class TpuBackend(CpuBackend):
         would not route to the device anyway."""
         points = list(points)
         if (
+            self._mesh_flush_active()
+            and len(points) >= self.G1_MESH_MIN
+        ):
+            from . import packed_msm
+
+            # the sharded marshal: per-shard blocks staged on the FIFO
+            # worker; ShippedPoints records the mesh so the product
+            # launch below routes to the same engine
+            return packed_msm.ship_points(
+                points, group_sizes, mesh=self.mesh
+            )
+        if (
             self.mesh is None
             and points
             and self._g1_in_device_band(len(points))
@@ -328,6 +379,25 @@ class TpuBackend(CpuBackend):
             else list(points)
         )
         rec = _obs.ACTIVE
+        if (
+            self._mesh_flush_active()
+            and pts_list
+            and len(pts_list) >= self.G1_MESH_MIN
+        ):
+            fin = packed_msm.g1_msm_product_async(
+                points, s_coeffs, t_coeffs, group_sizes, mesh=self.mesh
+            )
+            if fin is not None:
+                if rec is not None:
+                    rec.event(
+                        "device_op",
+                        op="g1_msm_product",
+                        k=len(pts_list),
+                        engine="mesh",
+                    )
+                return fin
+            # the mesh declined (no warm shard executable / zero device
+            # share): fall through to the host product path below
         if (
             self.mesh is None
             and pts_list

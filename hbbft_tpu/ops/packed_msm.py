@@ -744,14 +744,24 @@ def _save_rho() -> None:
         pass  # best-effort: losing the hint only costs re-convergence
 
 
-def learned_fraction(n: int, n_groups: int) -> float:
+def _shape_key(n: int, n_groups: int, mesh_dev: int = 0) -> str:
+    """Controller state key of one flush shape.  A mesh flush learns
+    its OWN balance per device count (``…:mD``) — the sharded engine's
+    rate has nothing to do with the single-device rate, and the bench's
+    per-device-count children must not poison each other's EMAs."""
+    if mesh_dev > 1:
+        return "%d:%d:m%d" % (n, n_groups, mesh_dev)
+    return "%d:%d" % (n, n_groups)
+
+
+def learned_fraction(n: int, n_groups: int, mesh_dev: int = 0) -> float:
     """The device fraction a flush of ``n_groups`` groups of ``n``
     points would use right now (env override or learned balance)."""
     env = _env_fraction()
     if env is not None:
         return env
     with _STATE_LOCK:
-        v = _rho_state().get("%d:%d" % (n, n_groups))
+        v = _rho_state().get(_shape_key(n, n_groups, mesh_dev))
         if v is None:
             return _RHO_DEFAULT
         if isinstance(v, dict):
@@ -759,8 +769,8 @@ def learned_fraction(n: int, n_groups: int) -> float:
         return float(v)
 
 
-def _shape_state(n: int, n_groups: int) -> dict:
-    key = "%d:%d" % (n, n_groups)
+def _shape_state(n: int, n_groups: int, mesh_dev: int = 0) -> dict:
+    key = _shape_key(n, n_groups, mesh_dev)
     with _STATE_LOCK:
         state = _rho_state()
         st = state.get(key)
@@ -797,6 +807,7 @@ def _adapt(
     t_host: float,
     t_dev: float,
     compressed: bool = False,
+    mesh_dev: int = 0,
 ) -> None:
     """One rate-balance step from one hybrid flush's measurements.
 
@@ -813,7 +824,7 @@ def _adapt(
     3×; the solved split converges in a couple of flushes and
     re-converges when the load regime shifts."""
     with _STATE_LOCK:  # one balance step is atomic vs waiter/prewarm
-        st = _shape_state(n, n_groups)
+        st = _shape_state(n, n_groups, mesh_dev)
         if k_host > 0:
             h_obs = k_host / max(t_host, 1e-6)
             if st["h"] is None:
@@ -850,6 +861,7 @@ def seed_rates(
     n_groups: int,
     d: Optional[float] = None,
     h: Optional[float] = None,
+    mesh_dev: int = 0,
 ) -> None:
     """Write exact single-engine rates (points/s) into the controller
     state and re-solve the split.
@@ -864,7 +876,7 @@ def seed_rates(
     EMAs track — a seed therefore only ever RAISES an estimate, never
     overwrites a converged (higher) one."""
     with _STATE_LOCK:
-        st = _shape_state(n, n_groups)
+        st = _shape_state(n, n_groups, mesh_dev)
         if d:
             st["d"] = max(st.get("d") or 0.0, float(d))
         if h:
@@ -977,6 +989,157 @@ def _split_plan(k: int, n_groups: int) -> List[int]:
 
 
 # ---------------------------------------------------------------------------
+# Mesh (multi-chip) product plane — ISSUE 7 tentpole
+# ---------------------------------------------------------------------------
+# A mesh-configured backend shards the device share of a product flush
+# across the 1-D named-axis mesh (``parallel/mesh.sharded_product_msm_fn``)
+# instead of running single-device chunks: the point axis splits
+# WITHIN every group, each shard computes its slice of every group's
+# inner sum, and the [G, 3, L] partials ring-reduce on device.  The
+# chunk ladder disappears (ONE sharded launch — the sharded
+# ``device_put`` pays the tunnel once and PJRT splits it per device);
+# everything else — staging leases, the rho controller, warm-shape
+# prewarm, the waiter/finalizer protocol — is the same machinery,
+# threaded through, not forked.
+
+
+def _mesh_backend_ok() -> bool:
+    """The sharded flush engages on a real TPU mesh, or on a virtual
+    CPU mesh when ``HBBFT_TPU_MESH_CPU=1`` (tier-1 mesh tests and the
+    bench's per-device-count scaling children; plain CPU runs keep the
+    single-device path so default behavior is unchanged)."""
+    return (
+        jax.default_backend() == "tpu"
+        or os.environ.get("HBBFT_TPU_MESH_CPU", "0") == "1"
+    )
+
+
+def _mesh_engine() -> str:
+    """Per-shard compute engine: the cached windowed Pallas kernel on
+    real TPUs, the XLA bit-serial scan on CPU meshes (interpret-mode
+    Pallas is orders slower and the XLA scan compiles in seconds —
+    results are byte-identical either way)."""
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _mesh_shard_rows(n: int, g_dev: int, n_dev: int, engine: str):
+    """(n_shard, kd_shard, kp_shard) of ONE shard's block: ``g_dev``
+    groups × ``ceil(n/n_dev)`` rows each, bucket-padded to the tile
+    grid for the Pallas engine (the XLA scan takes any row count)."""
+    n_shard = -(-n // n_dev)
+    kd_shard = g_dev * n_shard
+    kp_shard = _bucket_rows(kd_shard) if engine == "pallas" else kd_shard
+    return n_shard, kd_shard, kp_shard
+
+
+def _mesh_exec_keys(n: int, g_dev: int, n_dev: int, engine: str):
+    """``(name, key_parts)`` of the ONE sharded executable a mesh flush
+    of ``g_dev`` groups needs — shared by the warm-routing guard
+    (:func:`_mesh_ready`) and :func:`prewarm_shapes`, mirroring the
+    ``_product_exec_keys`` one-home rule for the single-device path."""
+    nb = _S_BITS // 8
+    _, _, kp_shard = _mesh_shard_rows(n, g_dev, n_dev, engine)
+    rows = n_dev * kp_shard
+    return [
+        (
+            "mesh_prod_g1_%dg_%dd" % (g_dev, n_dev),
+            (((rows, 96), "uint8"), ((rows, nb), "uint8")),
+        )
+    ]
+
+
+def _mesh_ready(n: int, g_dev: int, n_dev: int, engine: str) -> bool:
+    if engine != "pallas":
+        return True  # the XLA engine has no exec-cache gate
+    return all(
+        pallas_ec.exec_available(nm, p)
+        for nm, p in _mesh_exec_keys(n, g_dev, n_dev, engine)
+    )
+
+
+def _mesh_plan(k: int, n_groups: int, n_dev: int, engine: str) -> int:
+    """How many LEADING groups of a uniform product flush run on the
+    mesh (the rest host-side) — the mesh analogue of
+    :func:`_split_plan`.  The device share is ONE sharded launch; the
+    single-device chunk ladder existed to balance per-chunk tunnel
+    RPCs, which the sharded transfer pays exactly once.  The rho
+    controller's balance is learned per device count
+    (``_shape_key(..., mesh_dev)``); the per-SHARD group tree must stay
+    within the proven ``_MAX_GTREE`` row scale.  0 = no mesh share."""
+    if n_groups <= 0 or k % n_groups:
+        return 0
+    n = k // n_groups
+    n_shard = -(-n // n_dev)
+    cap = _MAX_GTREE // max(1, n_shard)
+    if cap == 0:
+        return 0  # one group's shard slice alone exceeds the tree scale
+    rho = learned_fraction(n, n_groups, mesh_dev=n_dev)
+    if rho <= 0.0:
+        return 0
+    g_dev = min(n_groups, cap, max(1, int(round(n_groups * min(rho, 1.0)))))
+    if _env_fraction() is None and g_dev >= n_groups and n_groups > 1:
+        # full-mesh plan: the host rate goes unmeasured — once stale,
+        # hand one group back to host to refresh it (same probe rule
+        # as the single-device planner)
+        with _STATE_LOCK:
+            st = _rho_state().get(_shape_key(n, n_groups, n_dev))
+            hage = st.get("hage", 0) if isinstance(st, dict) else 0
+        if hage >= _HOST_PROBE_IV:
+            g_dev -= 1
+    if (
+        engine == "pallas"
+        and not _allow_compile()
+        and not _mesh_ready(n, g_dev, n_dev, engine)
+    ):
+        return 0  # cold sharded executable: flush runs host-side
+    return g_dev
+
+
+def _put_shard_blocks(
+    rows: np.ndarray,
+    n: int,
+    g_dev: int,
+    n_dev: int,
+    engine: str,
+    mesh,
+    lease: Optional[staging.Lease] = None,
+    width: int = 96,
+):
+    """Group-major ``[g_dev·n, width]`` u8 rows → the sharded block
+    layout of ``parallel.mesh.sharded_product_msm_fn``: shard j holds
+    rows ``[j·n_shard, (j+1)·n_shard)`` of every group (group-major
+    within the shard), zero rows padding both the group remainder and
+    the Pallas tile bucket (all-zero wire = infinity, zero scalar = 0 —
+    absorbing either way).  One sharded ``device_put`` starts the
+    transfer; PJRT splits it per device.  With a ``lease`` the block
+    buffer comes zeroed from the staging pool and is retired by the
+    finalizer once the device results materialize — the same
+    provably-safe reuse protocol as the single-device chunks."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel import mesh as M
+
+    n_shard, kd_shard, kp_shard = _mesh_shard_rows(n, g_dev, n_dev, engine)
+    shape = (n_dev * kp_shard, width)
+    buf = (
+        lease.get(shape)
+        if lease is not None
+        else np.zeros(shape, dtype=np.uint8)
+    )
+    src = rows.reshape(g_dev, n, width)
+    for j in range(n_dev):
+        lo = j * n_shard
+        cnt = min(n_shard, n - lo)
+        if cnt <= 0:
+            break  # trailing shards hold only padding (n < n_dev)
+        dst = buf[j * kp_shard : j * kp_shard + kd_shard].reshape(
+            g_dev, n_shard, width
+        )
+        dst[:, :cnt] = src[:, lo : lo + cnt]
+    return jax.device_put(buf, NamedSharding(mesh, PartitionSpec(M.AXIS)))
+
+
+# ---------------------------------------------------------------------------
 # Persistent warm-start: flush-shape memory + background prewarm
 # ---------------------------------------------------------------------------
 # The controller persists the learned split (device_fraction.json) and
@@ -997,8 +1160,10 @@ def _warm_shapes_path() -> str:
 
 
 def _load_warm_shapes() -> dict:
-    """``{"n:n_groups": {"compressed": bool}}`` — per-entry tolerant,
-    like ``_rho_state`` (one malformed entry must not drop the rest)."""
+    """``{"n:n_groups": {"compressed": bool, "mesh": [n_dev, …]}}`` —
+    per-entry tolerant, like ``_rho_state`` (one malformed entry must
+    not drop the rest).  ``mesh`` lists the device counts whose sharded
+    executables this shape has shipped on (empty = single-device only)."""
     import json
 
     out: dict = {}
@@ -1011,29 +1176,41 @@ def _load_warm_shapes() -> dict:
         try:
             n, g = (int(x) for x in str(k).split(":"))
             if n > 0 and g > 0:
-                out["%d:%d" % (n, g)] = {
+                mesh: List[int] = []
+                if isinstance(v, dict):
+                    for d in v.get("mesh") or ():
+                        if int(d) > 1:
+                            mesh.append(int(d))
+                ent = {
                     "compressed": bool(v.get("compressed"))
                     if isinstance(v, dict)
-                    else False
+                    else False,
                 }
+                if mesh:  # absent = single-device only: the seed's
+                    ent["mesh"] = sorted(set(mesh))  # format, unchanged
+                out["%d:%d" % (n, g)] = ent
         except (TypeError, ValueError):
             continue
     return out
 
 
-def record_warm_shape(n: int, n_groups: int, compressed: bool) -> None:
+def record_warm_shape(
+    n: int, n_groups: int, compressed: bool, mesh_dev: int = 0
+) -> None:
     """Remember that shape ``(n, n_groups)`` shipped a device plan, so
     the NEXT process can prewarm its executables before its first
     flush.  Read-merge-replace keeps other processes' entries; a
     compressed sighting is sticky (both transfer modes get prewarmed
-    once a shape has probed compression).  Best-effort throughout —
+    once a shape has probed compression), and so is a mesh device
+    count (a mesh deployment keeps its per-device-count sharded
+    executable warm across restarts).  Best-effort throughout —
     losing the hint only costs one cold-start first flush.  The whole
     dedupe + read-merge-replace runs under ``_STATE_LOCK`` so two
     concurrent flushes can't interleave their merges and drop each
     other's entries."""
     import json
 
-    seen_key = ("%d:%d" % (n, n_groups), bool(compressed))
+    seen_key = ("%d:%d" % (n, n_groups), bool(compressed), int(mesh_dev))
     with _STATE_LOCK:
         if seen_key in _WARM_SEEN:
             return
@@ -1042,6 +1219,8 @@ def record_warm_shape(n: int, n_groups: int, compressed: bool) -> None:
             shapes = _load_warm_shapes()
             ent = shapes.setdefault(seen_key[0], {"compressed": False})
             ent["compressed"] = bool(ent.get("compressed")) or bool(compressed)
+            if mesh_dev > 1:
+                ent["mesh"] = sorted(set(ent.get("mesh") or []) | {mesh_dev})
             path = _warm_shapes_path()
             tmp = path + ".tmp.%d" % os.getpid()
             with open(tmp, "w") as fh:
@@ -1078,6 +1257,17 @@ def prewarm_shapes() -> int:
                 ):
                     if pallas_ec.preload_exec(name, parts):
                         warm += 1
+        # mesh deployments: preload the per-device-count sharded
+        # executables at the g_dev the planner would pick today (the
+        # _mesh_exec_keys one home keeps this exactly what routing
+        # will require)
+        for n_dev in ent.get("mesh") or ():
+            g_dev = _mesh_plan(n * n_groups, n_groups, n_dev, "pallas")
+            if not g_dev:
+                continue  # cold on disk too (or rho=0): nothing to load
+            for name, parts in _mesh_exec_keys(n, g_dev, n_dev, "pallas"):
+                if pallas_ec.preload_exec(name, parts):
+                    warm += 1
     return warm
 
 
@@ -1127,7 +1317,10 @@ class ShippedPoints:
     it, so no byte crosses the tunnel twice."""
 
     def __init__(
-        self, points: List[Any], group_sizes: Optional[Sequence[int]] = None
+        self,
+        points: List[Any],
+        group_sizes: Optional[Sequence[int]] = None,
+        mesh=None,
     ):
         self.points = points
         self.compressed = False
@@ -1136,11 +1329,40 @@ class ShippedPoints:
         self.lease = staging.buffers().lease()
         self.g_dev = 0
         self.k_dev = 0
+        self.mesh = None  # set iff the mesh plan took this flush
+        self.mesh_engine: Optional[str] = None
         k = len(points)
+        uniform = bool(group_sizes) and len(set(group_sizes)) == 1
+        mesh_dev = mesh.devices.size if mesh is not None else 0
+        if mesh_dev > 1 and _mesh_backend_ok() and uniform:
+            # mesh plan: the device share ships as per-shard blocks in
+            # ONE sharded transfer (always the uncompressed 96-byte
+            # wire — the sharded program keeps one executable per
+            # device count instead of two)
+            n = k // len(group_sizes)
+            engine = _mesh_engine()
+            g_dev = _mesh_plan(k, len(group_sizes), mesh_dev, engine)
+            if g_dev:
+                self.mesh = mesh
+                self.mesh_engine = engine
+                self.g_dev = g_dev
+                self.k_dev = g_dev * n
+                k_dev, lease = self.k_dev, self.lease
+
+                def _marshal_mesh():
+                    return _put_shard_blocks(
+                        g1_wires_batch(points[:k_dev]),
+                        n, g_dev, mesh_dev, engine, mesh, lease,
+                    )
+
+                self.task = staging.stager().submit(_marshal_mesh)
+                return
+            # no mesh share (cold executable / rho=0): fall through to
+            # the single-device plan below, which on a CPU mesh stays
+            # empty (backend guard) — the flush runs host-side
         if (
             jax.default_backend() != "tpu"
-            or not group_sizes
-            or len(set(group_sizes)) != 1  # factored path needs uniform
+            or not uniform
         ):
             return
         n = k // len(group_sizes)
@@ -1231,9 +1453,11 @@ def compress_rows(
 
 
 def ship_points(
-    points: Sequence[Any], group_sizes: Optional[Sequence[int]] = None
+    points: Sequence[Any],
+    group_sizes: Optional[Sequence[int]] = None,
+    mesh=None,
 ) -> ShippedPoints:
-    return ShippedPoints(list(points), group_sizes)
+    return ShippedPoints(list(points), group_sizes, mesh=mesh)
 
 
 class ProductFinalizer:
@@ -1316,6 +1540,7 @@ def g1_msm_product_async(
     t_coeffs: Sequence[int],
     group_sizes: Sequence[int],
     interpret: Optional[bool] = None,
+    mesh=None,
 ) -> Optional[Callable[[], Any]]:
     """Factored-form HYBRID MSM (``backend.g1_msm_product_async``
     semantics): the leading ``sum(plan)`` groups run on the device in
@@ -1348,15 +1573,38 @@ def g1_msm_product_async(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    mesh_dev = 0
+    mesh_engine: Optional[str] = None
     if shipped is not None:
         # routing off the synchronously-computed plan: the staged
         # marshal may still be in flight, and must not be waited on
         # here — the launch below resolves it on the FIFO worker
-        plan = shipped.plan
-        compressed = shipped.compressed
-        ship_task = shipped.task
-        if not plan:
+        if shipped.mesh is not None:
+            mesh = shipped.mesh
+            mesh_dev = mesh.devices.size
+            mesh_engine = shipped.mesh_engine
+            g_dev = shipped.g_dev
+            plan = []
+            compressed = False  # the sharded transfer is always 96-byte
+            ship_task = shipped.task
+        else:
+            plan = shipped.plan
+            compressed = shipped.compressed
+            ship_task = shipped.task
+            if not plan:
+                return None
+            g_dev = sum(plan)
+    elif (
+        mesh is not None and mesh.devices.size > 1 and _mesh_backend_ok()
+    ):
+        mesh_dev = mesh.devices.size
+        mesh_engine = _mesh_engine()
+        g_dev = _mesh_plan(k, n_groups, mesh_dev, mesh_engine)
+        if not g_dev:
             return None
+        plan = []
+        compressed = False
+        ship_task = None
     else:
         plan = _split_plan(k, n_groups)
         if not plan:
@@ -1373,22 +1621,22 @@ def g1_msm_product_async(
         ):
             return None
         ship_task = None
+        g_dev = sum(plan)
 
     nb = _S_BITS // 8
-    k_dev = sum(plan) * n
+    k_dev = g_dev * n
     # snapshots against caller mutation: the marshalling below runs on
     # the staging worker after this call returns
     s_head = list(s_coeffs[:k_dev])
     s_tail = list(s_coeffs[k_dev:])
     t_list = list(t_coeffs)
     host_pts = pts_list[k_dev:]
-    g_dev = sum(plan)
     lease = staging.buffers().lease()
 
     if not interpret:
         # this shape shipped a real device plan: remember it so the
         # next process can prewarm its executables during setup
-        record_warm_shape(n, n_groups, compressed)
+        record_warm_shape(n, n_groups, compressed, mesh_dev=mesh_dev)
 
     import time
 
@@ -1402,6 +1650,32 @@ def g1_msm_product_async(
         # marshal submitted earlier has completed; ``result()``
         # re-raises its errors here, which the waiter carries to the
         # finalizer (same surfacing point as the sequential path).
+        if mesh_dev:
+            # sharded engine: ONE launch over the whole device share —
+            # the sharded device_put pays the transfer once and PJRT
+            # splits it per shard, so there is no chunk ladder here
+            from ..parallel import mesh as M
+
+            dev_wires = (
+                ship_task.result()
+                if ship_task is not None
+                else _put_shard_blocks(
+                    g1_wires_batch(pts_list[:k_dev]),
+                    n, g_dev, mesh_dev, mesh_engine, mesh, lease,
+                )
+            )
+            sc = scalar_bytes_batch(s_head, nb)
+            dev_sc = _put_shard_blocks(
+                sc, n, g_dev, mesh_dev, mesh_engine, mesh, lease,
+                width=nb,
+            )
+            _, kd_shard, _ = _mesh_shard_rows(
+                n, g_dev, mesh_dev, mesh_engine
+            )
+            run = M.sharded_product_msm_fn(
+                mesh, g_dev, kd_shard, nb, mesh_engine
+            )
+            return [run(dev_wires, dev_sc)], time.perf_counter()
         chunks = (
             ship_task.result()
             if ship_task is not None
@@ -1503,6 +1777,7 @@ def g1_msm_product_async(
                 t_host,
                 t_dev,
                 compressed=compressed,
+                mesh_dev=mesh_dev,
             )
         group_pts = []
         for arr in arrs:

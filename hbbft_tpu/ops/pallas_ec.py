@@ -808,3 +808,77 @@ def g2_msm_pallas(
     bits = LB.scalars_to_bits(scalars, nbits)
     prods = scalar_mul_windowed_g2(pts, bits, interpret=interpret, trim=False)
     return ec_jax.g2_from_limbs(_tree_sum_chunked(prods, g2=True))
+
+
+# ---------------------------------------------------------------------------
+# Ring collective: neighbor permute over the mesh interconnect
+# ---------------------------------------------------------------------------
+# The mesh flush's partial-sum reduction (parallel/mesh.py) is a ring
+# all-reduce: n_dev-1 rounds of "pass the received buffer to the right
+# neighbor, fold it into the local accumulator with the complete EC
+# add".  The PERMUTE step is this kernel — one `make_async_remote_copy`
+# per round, DMA-semaphore paced, the buffer staying in HBM
+# (TPUMemorySpace.ANY) end to end, so no partial sum ever crosses the
+# host.  The EC adds between rounds stay in XLA (they reuse the jitted
+# complete-formula kernel; a Mosaic reimplementation would buy nothing
+# — the adds are bandwidth-trivial next to the per-shard MSM).
+
+
+def _ring_permute_kernel(
+    axis: str, n_dev: int, input_ref, output_ref, send_sem, recv_sem
+):
+    """Copy this shard's buffer to the right neighbor along ``axis``
+    (every shard does, so every shard also receives one — the classic
+    unidirectional ring step of SNIPPETS [1]/[3])."""
+    my_id = jax.lax.axis_index(axis)
+    right_neighbor = jax.lax.rem(my_id + 1, n_dev)
+    remote_copy_op = pltpu_mod().make_async_remote_copy(
+        src_ref=input_ref,
+        dst_ref=output_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=(right_neighbor,),
+        device_id_type=pltpu_mod().DeviceIdType.MESH,
+    )
+    remote_copy_op.start()
+    remote_copy_op.wait()
+
+
+def pltpu_mod():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu
+
+
+def ring_permute(x: jnp.ndarray, axis: str, n_dev: int) -> jnp.ndarray:
+    """Right-rotate ``x`` around the 1-D mesh ring named ``axis`` —
+    shard i's block lands on shard (i+1) % n_dev.  MUST be called
+    inside a ``shard_map`` body over ``axis``.  Real-TPU only (the
+    remote DMA has no interpret-mode emulation; CPU meshes use
+    ``jax.lax.ppermute``, which lowers to the same collective-permute
+    HLO and is the bit-identical fallback)."""
+    from jax.experimental import pallas as pl
+
+    pltpu = pltpu_mod()
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        # TPUMemorySpace.ANY keeps the buffer in HBM: the DMA streams
+        # HBM→ICI→HBM without staging through VMEM tiles
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=([pltpu.SemaphoreType.DMA] * 2),
+    )
+    # collective kernels need a collective_id so Mosaic can match the
+    # send/recv semaphore pairs across devices; the params class was
+    # renamed TPUCompilerParams → CompilerParams across jax releases
+    params_cls = getattr(pltpu, "TPUCompilerParams", None) or getattr(
+        pltpu, "CompilerParams"
+    )
+    # the grid (a single program instance; whole-ref DMA, no block
+    # tiling) lives inside grid_spec=, which the shape rule can't see
+    return pl.pallas_call(  # lint: ok(pallas-shape)
+        functools.partial(_ring_permute_kernel, axis, n_dev),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid_spec=grid_spec,
+        compiler_params=params_cls(collective_id=0),
+    )(x)

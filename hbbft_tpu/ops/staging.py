@@ -32,6 +32,14 @@ bodies).  The one place the flush *does* block — the waiter thread's
 ``HBBFT_TPU_STAGING=0`` disables the pipeline: ``submit`` runs the
 work inline on the caller thread, which is exactly the sequential
 path the determinism tests diff against.
+
+Consumers beyond the single-device flush: the multi-chip mesh flush
+(``packed_msm._put_shard_blocks`` marshals per-shard wire/scalar
+blocks into leased buffers and ships them through the FIFO) and the
+DKG dealing plane (``harness/dkg._run_real_device`` stages dealer
+``d+1``'s coefficient-matrix upload while the device consumes dealer
+``d``'s) — same worker, same lease discipline, same
+``HBBFT_TPU_STAGING=0`` escape hatch.
 """
 
 from __future__ import annotations

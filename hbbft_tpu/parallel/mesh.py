@@ -23,6 +23,8 @@ multi-chip TPU slice.
 from __future__ import annotations
 
 import functools
+import os
+import threading
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -228,6 +230,144 @@ def sharded_packed_msm_fn(mesh: Mesh, interpret: Optional[bool] = None):
         return _jitted(wires, sc)
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# Sharded factored-product engine — the fused flush's default on a mesh
+# ---------------------------------------------------------------------------
+# The flush's Σ_g t_g·(Σ_{i∈g} sᵢ·Pᵢ) shards the POINT axis *within*
+# every group: each shard holds an [n_groups, n_shard] block of packed
+# wires, computes its slice of every group's inner sum, and the
+# [n_groups, 3, L] partials meet in an on-device ring all-reduce (no
+# host gather anywhere on the reduction path — the device-sync lint's
+# sharded-body pass keeps it that way).  The tiny t-MSM over the G
+# replicated group sums stays on host, exactly like the single-device
+# product path (``packed_msm.g1_msm_product_async`` finalize).
+
+# Compiled sharded runners, keyed on everything that changes the traced
+# program: (device tuple, n_groups, kd_shard, kp_shard, nb, engine,
+# ring).  Built under a lock — the prewarm daemon and the flush path
+# can both miss the cache at once (shimmed by analysis/racecheck).
+_RUNNERS: dict = {}
+_RUNNERS_LOCK = threading.Lock()
+
+
+def _ring_mode(interpret: bool) -> str:
+    """The cross-shard reduction's permute primitive: the Pallas
+    ``make_async_remote_copy`` ring on real TPUs (HBBFT_TPU_MESH_RING=0
+    falls back), ``jax.lax.ppermute`` elsewhere (CPU meshes have no
+    remote DMA; ppermute lowers to the same collective-permute HLO and
+    is bit-identical — EC addition is exact in any order)."""
+    if interpret or os.environ.get("HBBFT_TPU_MESH_RING", "1") == "0":
+        return "ppermute"
+    return "pallas"
+
+
+def _ring_reduce(local, kern, n_dev: int, ring: str):
+    """Ring all-reduce of per-shard partial sums under ``shard_map``:
+    n_dev-1 rounds of right-neighbor permute + complete EC add.  Each
+    shard passes along the buffer it RECEIVED (not its accumulator), so
+    after the loop every shard has folded in every other shard's
+    original partial — the result is replicated by construction."""
+    from ..ops import pallas_ec
+
+    if n_dev <= 1:
+        return local
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    acc = local
+    msg = local
+    for _ in range(n_dev - 1):
+        if ring == "pallas":
+            msg = pallas_ec.ring_permute(msg, AXIS, n_dev)
+        else:
+            msg = jax.lax.ppermute(msg, AXIS, perm)
+        acc = kern.add(acc, msg)
+    return acc
+
+
+def product_runner_key(
+    mesh: Mesh, n_groups: int, kd_shard: int, nb: int, engine: str
+) -> tuple:
+    """The cache key (and exec-cache identity) of one sharded product
+    runner — one home shared with ``packed_msm._mesh_exec_keys`` so the
+    prewarmer loads exactly what the flush will route."""
+    from ..ops import packed_msm
+
+    kp_shard = (
+        packed_msm._bucket_rows(kd_shard) if engine == "pallas" else kd_shard
+    )
+    ring = _ring_mode(engine != "pallas")
+    devs = tuple(int(d.id) for d in mesh.devices.flat)
+    return (devs, n_groups, kd_shard, kp_shard, nb, engine, ring)
+
+
+def sharded_product_msm_fn(
+    mesh: Mesh, n_groups: int, kd_shard: int, nb: int, engine: str
+):
+    """Build (or fetch) the sharded product runner.
+
+    Inputs are the per-shard block layout ``packed_msm._put_shard_blocks``
+    marshals: ``wires [n_dev·kp_shard, 96] u8`` and ``sc [n_dev·kp_shard,
+    nb] u8``, sharded ``P(AXIS)`` — shard j's rows are group-major
+    ``[n_groups, n_shard]`` with identity/zero padding (absorbing).
+    Returns ``run(wires, sc) -> [n_groups, 3, L]`` replicated group sums.
+
+    ``engine="pallas"`` is the real-TPU path (on-device unpack → the
+    cached 4-bit windowed kernel → per-group trees → Pallas DMA ring);
+    ``engine="xla"`` is the CPU/virtual-mesh path (same unpack math,
+    bit-serial scan kernel, ppermute ring) — byte-identical results,
+    compile times in seconds instead of minutes."""
+    from ..ops import packed_msm, pallas_ec
+
+    key = product_runner_key(mesh, n_groups, kd_shard, nb, engine)
+    with _RUNNERS_LOCK:
+        run = _RUNNERS.get(key)
+    if run is not None:
+        return run
+
+    kp_shard = key[3]
+    ring = key[6]
+    n_dev = mesh.devices.size
+    kern = ec_jax.g1_kernel()
+    interpret = engine != "pallas" or jax.default_backend() != "tpu"
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=P(),
+    )
+    def _sharded(wires, sc):
+        if engine == "pallas":
+            pts_t, dig_t = packed_msm._unpack_fn(wires, sc)
+            prods_t = pallas_ec._run_tiles(
+                pallas_ec._windowed_kernel, pts_t, dig_t, interpret
+            )
+            prods = pallas_ec._untile(prods_t, kd_shard, kp_shard)
+        else:
+            b = packed_msm._bytes_to_bits_msb(wires.astype(jnp.int32))
+            xl = packed_msm._le_bits_to_limbs(jnp.flip(b[:, :384], axis=1))
+            yl = packed_msm._le_bits_to_limbs(jnp.flip(b[:, 384:], axis=1))
+            ident = jnp.all(wires == 0, axis=1)
+            pts = packed_msm._assemble_points(xl, yl, ident)
+            bits = packed_msm._bytes_to_bits_msb(sc.astype(jnp.int32))
+            prods = kern.scalar_mul(pts, bits)[:kd_shard]
+        local = packed_msm._group_tree(prods, n_groups)  # [G, 3, L]
+        return _ring_reduce(local, kern, n_dev, ring)
+
+    if engine == "pallas":
+        cache_name = "mesh_prod_g1_%dg_%dd" % (n_groups, n_dev)
+
+        def run(wires, sc):
+            return pallas_ec.cached_compiled(cache_name, _sharded, wires, sc)
+
+    else:
+        run = jax.jit(_sharded)
+
+    with _RUNNERS_LOCK:
+        # first builder wins; a racing duplicate is only wasted trace work
+        existing = _RUNNERS.setdefault(key, run)
+    return existing
 
 
 def sharded_windowed_g1_msm(

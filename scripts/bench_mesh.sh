@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Mesh scaling bench: run the mesh headline (`bench.py --mesh`) at
+# 1/2/4/8 virtual devices and emit a JSON scaling table for the
+# scenario/obs plane.  Each device count runs the REAL flush path
+# (BatchingBackend product-MSM sharded over parallel/mesh.py) in its
+# own child process — a JAX backend's device count is fixed once
+# initialized, so only fresh interpreters can host each mesh width.
+#
+# Examples:
+#   scripts/bench_mesh.sh                       # 1,2,4,8 devices, k=512
+#   MESH_K=8192 scripts/bench_mesh.sh           # bigger flush shape
+#   MESH_DEVICES=1,8 MESH_ITERS=5 scripts/bench_mesh.sh
+#   MESH_OUT=mesh_scaling.json scripts/bench_mesh.sh  # also write a file
+#
+# Output: the per-device-count `share_verify_throughput` rows (one
+# JSON line each, `mesh_devices` tagged) followed by one
+# `mesh_share_verify_scaling` summary row.  With MESH_OUT set, all
+# rows are also collected into a single JSON array at that path.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+k="${MESH_K:-512}"
+devices="${MESH_DEVICES:-1,2,4,8}"
+iters="${MESH_ITERS:-3}"
+out="${MESH_OUT:-}"
+
+log="$(mktemp)"
+trap 'rm -f "$log"' EXIT
+
+python bench.py --mesh --k "$k" --mesh-devices "$devices" \
+  --iters "$iters" 2>&1 | tee "$log"
+rc=${PIPESTATUS[0]}
+
+if [ -n "$out" ] && [ "$rc" = 0 ]; then
+  # collect the JSON rows into one array file for downstream tooling
+  python - "$log" "$out" <<'PY'
+import json, sys
+
+rows = []
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+with open(sys.argv[2], "w") as fh:
+    json.dump(rows, fh, indent=2)
+print("wrote %d rows to %s" % (len(rows), sys.argv[2]))
+PY
+fi
+
+exit "$rc"

@@ -241,6 +241,69 @@ def test_device_sync_staging_module_allows_host_arithmetic():
     assert _lint(src, "ops/staging.py", select="device-sync") == []
 
 
+def test_device_sync_flags_host_gather_in_shard_map_body():
+    # a shard_map body (the mesh flush plane) must never pull shard
+    # values through the host — that is exactly the gather the mesh
+    # engine removes
+    src = """
+        import functools
+        import jax
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P("s"),), out_specs=P())
+        def _sharded(wires):
+            local = reduce_local(wires)
+            host = jax.device_get(local)
+            back = np.asarray(host)
+            return back
+    """
+    vs = _lint(src, "parallel/fixture.py", select="device-sync")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 2
+    assert "host gather of per-shard values" in msgs
+    assert "breaks the mesh overlap window" in msgs
+    assert "shard_map body" in msgs
+
+
+def test_device_sync_shard_map_wrap_site_beats_jit_diagnosis():
+    # jax.jit(shard_map(f)) is the normal mesh stack: f must get the
+    # shard_map diagnosis (the more specific one), found via the
+    # wrap-site form and a dotted re-export spelling
+    src = """
+        import jax
+        from hbbft_tpu.parallel import mesh as M
+
+        def _body(x):
+            return x.sum().item()
+
+        _sharded = M.shard_map(_body, mesh=mesh, in_specs=(P("s"),), out_specs=P())
+        runner = jax.jit(_sharded)
+    """
+    vs = _lint(src, "parallel/fixture.py", select="device-sync")
+    assert len(vs) == 1
+    assert "inside a shard_map body" in vs[0].message
+    assert "per-shard host sync" in vs[0].message
+
+
+def test_device_sync_shard_map_allows_collectives_and_shapes():
+    # on-device collectives (all_gather / ppermute / the Pallas remote
+    # copy ring) and shape arithmetic are the legal moves in a
+    # shard_map body
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P("s"),), out_specs=P())
+        def _sharded(pts):
+            local = kern.tree_sum(kern.scalar_mul(pts, int(pts.shape[0])))
+            partials = jax.lax.all_gather(local, "s")
+            rolled = jax.lax.ppermute(local, "s", perm)
+            return kern.tree_sum(partials) + rolled
+    """
+    assert _lint(src, "parallel/fixture.py", select="device-sync") == []
+
+
 # ---------------------------------------------------------------------------
 # dtype-width
 # ---------------------------------------------------------------------------

@@ -30,6 +30,7 @@ from hbbft_tpu.analysis import racecheck
 from hbbft_tpu.analysis.racecheck import RaceChecker
 from hbbft_tpu.crypto import rs
 from hbbft_tpu.ops import packed_msm, pallas_ec, staging
+from hbbft_tpu.parallel import mesh as parallel_mesh
 
 # ---------------------------------------------------------------------------
 # The deliberate-race fixture: one source, caught twice
@@ -165,6 +166,10 @@ def test_enable_shims_known_globals_and_disable_restores(request):
         assert isinstance(packed_msm._STATE_LOCK, racecheck.TrackedLock)
         assert isinstance(staging._STAGER_LOCK, racecheck.TrackedLock)
         assert isinstance(staging._BUFFERS._free, racecheck.TrackedDict)
+        assert isinstance(parallel_mesh._RUNNERS, racecheck.TrackedDict)
+        assert isinstance(
+            parallel_mesh._RUNNERS_LOCK, racecheck.TrackedLock
+        )
         # nested enable shares the active checker (refcounted)
         assert racecheck.enable() is racecheck.active()
         racecheck.disable()
@@ -174,6 +179,7 @@ def test_enable_shims_known_globals_and_disable_restores(request):
     assert racecheck.active() is None
     assert type(pallas_ec._EXEC_MEM) is dict
     assert type(packed_msm._WARM_SEEN) is set
+    assert type(parallel_mesh._RUNNERS) is dict
     # contents loaded during the instrumented window survive
     assert pallas_ec._EXEC_MEM.pop("__racecheck_test__") == "kept"
     assert mem_before is not pallas_ec._EXEC_MEM or not mem_before
@@ -311,6 +317,55 @@ def test_stress_concurrent_pipeline_zero_races_and_byte_identity(
     recorded = json.loads(warm_seq)
     assert set(recorded) == {"%d:%d" % (n, g) for n, g, _ in _SHAPES}
     assert recorded["64:4"]["compressed"] is True  # sticky sighting
+
+
+def test_mesh_runner_cache_concurrent_build_zero_races():
+    """The mesh flush's shared surface: the prewarm daemon, the epoch
+    stage executor and the flush path can all miss ``mesh._RUNNERS`` at
+    once and build the same sharded runner.  Hammer the cache from five
+    worker threads plus the main thread under the checker — zero
+    candidate races, and first-builder-wins means every leg observes
+    the same runner object per key."""
+    mesh = parallel_mesh.make_mesh(4)
+    keys = [(2, 8), (4, 8), (2, 16)]
+    with parallel_mesh._RUNNERS_LOCK:
+        parallel_mesh._RUNNERS.clear()
+
+    racecheck.enable()
+    try:
+        assert isinstance(parallel_mesh._RUNNERS, racecheck.TrackedDict)
+        results = [[] for _ in range(6)]
+
+        def leg(out):
+            for g, kd in keys:
+                out.append(
+                    parallel_mesh.sharded_product_msm_fn(
+                        mesh, g, kd, 12, "xla"
+                    )
+                )
+                # the flush path's readback between builds
+                parallel_mesh.product_runner_key(mesh, g, kd, 12, "xla")
+
+        threads = [
+            threading.Thread(
+                target=leg,
+                args=(results[i],),
+                name="hbbft-mesh-warm-%d" % i,
+            )
+            for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        leg(results[5])  # main thread races the warm legs
+        for t in threads:
+            t.join()
+    finally:
+        reports = racecheck.disable()
+
+    assert reports == [], "\n".join(r.message() for r in reports)
+    # first builder wins: one runner object per key, shared by all legs
+    for per_key in zip(*results):
+        assert len({id(r) for r in per_key}) == 1
 
 
 # ---------------------------------------------------------------------------
