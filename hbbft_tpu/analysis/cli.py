@@ -20,6 +20,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import List, Optional
 
 from .core import Baseline, Violation, lint_paths
@@ -82,6 +83,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="comma-separated rule names to run (default: all)",
     )
     parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only python files in the git diff (staged + "
+        "unstaged) — but widen to a full run whenever a changed file "
+        "is in a whole-project rule's domain, because scoping an "
+        "interprocedural rule to the diff silently under-reports",
+    )
+    parser.add_argument(
+        "--trace",
+        metavar="PATH",
+        help="write a JSONL obs trace with a lint_run event for this run",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
     parser.add_argument(
@@ -115,6 +129,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         rules = [r for r in rules if r.name in wanted]
 
     paths = args.paths or _default_paths()
+    if args.changed:
+        changed = _git_changed_files()
+        if not changed:
+            print("lint: no changed python files")
+            return 0
+        widening = _widening_rules(changed, rules)
+        if widening:
+            print(
+                "lint: changed file(s) in the domain of whole-project "
+                f"rule(s) [{', '.join(sorted(widening))}] — widening to "
+                "a full run",
+                file=sys.stderr,
+            )
+        else:
+            paths = changed
     for p in paths:
         if not os.path.exists(p):
             print(f"no such path: {p}", file=sys.stderr)
@@ -130,7 +159,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 0
 
+    t0 = time.perf_counter()
     violations, errors = lint_paths(paths, rules)
+    wall = time.perf_counter() - t0
 
     if args.write_baseline is not None:
         bl = Baseline.from_violations(violations, args.write_baseline)
@@ -145,6 +176,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_baseline and os.path.exists(args.baseline):
         baseline = Baseline.load(args.baseline)
     new, baselined = baseline.split(violations)
+
+    if args.trace:
+        from .. import obs
+
+        rec = obs.enable(args.trace)
+        rec.event(
+            "lint_run",
+            rules=len(rules),
+            violations=len(new),
+            wall=round(wall, 6),
+            baselined=len(baselined),
+            errors=len(errors),
+            counts=_counts(new),
+            paths=len(paths),
+            changed=bool(args.changed),
+        )
+        obs.disable()
 
     if fmt == "json":
         print(
@@ -165,6 +213,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         for v in new:
             print(v.render())
+            for hop_path, hop_line, note in v.flow or ():
+                print(f"    flow: {hop_path}:{hop_line}: {note}")
         for e in errors:
             print(e)
         if new or errors:
@@ -177,6 +227,57 @@ def main(argv: Optional[List[str]] = None) -> int:
             suffix = f" ({len(baselined)} baselined)" if baselined else ""
             print(f"clean{suffix}")
     return 1 if (new or errors) else 0
+
+
+def _git_changed_files() -> List[str]:
+    """Python files in the git diff (staged + unstaged) that still
+    exist on disk, repo-root-relative → absolute."""
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(_HERE))
+    names = set()
+    for extra in ((), ("--cached",)):
+        try:
+            out = subprocess.run(
+                ["git", "diff", "--name-only", *extra, "HEAD", "--", "*.py"],
+                cwd=repo_root,
+                capture_output=True,
+                text=True,
+                check=True,
+            ).stdout
+        except (OSError, subprocess.CalledProcessError):
+            return []
+        names.update(line.strip() for line in out.splitlines() if line.strip())
+    files = []
+    for name in sorted(names):
+        abspath = os.path.join(repo_root, name)
+        if os.path.isfile(abspath):
+            files.append(abspath)
+    return files
+
+
+def _widening_rules(changed: List[str], rules) -> List[str]:
+    """Whole-project rules whose domain contains a changed file — the
+    rules for which a diff-scoped run silently under-reports."""
+    from .core import PACKAGE_NAME
+
+    widening = []
+    for rule in rules:
+        if not getattr(rule, "whole_project", False):
+            continue
+        for abspath in changed:
+            norm = abspath.replace(os.sep, "/")
+            marker = "/" + PACKAGE_NAME + "/"
+            idx = norm.rfind(marker)
+            if idx == -1:
+                continue  # outside the package: in no rule's domain
+            relpath = norm[idx + len(marker):]
+            if not rule.scope or any(
+                relpath.startswith(p) for p in rule.scope
+            ):
+                widening.append(rule.name)
+                break
+    return widening
 
 
 def _run_racecheck(test_expr: str, fmt: str) -> int:
@@ -248,28 +349,50 @@ def _counts(violations: List[Violation]) -> dict:
     return counts
 
 
+def _sarif_location(path: str, line: int, col: int = 0) -> dict:
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path},
+            "region": {
+                "startLine": max(line, 1),
+                "startColumn": col + 1,
+            },
+        }
+    }
+
+
 def _sarif(new: List[Violation], errors: List[str], rules) -> dict:
     """SARIF 2.1.0 — the minimal subset GitHub code scanning renders
-    as inline PR annotations."""
-    results = [
-        {
+    as inline PR annotations.  Dataflow findings additionally carry
+    ``codeFlows``/``threadFlows`` so viewers render the full
+    source→sanitizer→sink path."""
+    results = []
+    for v in new:
+        result = {
             "ruleId": v.rule,
             "level": "error",
             "message": {"text": v.message},
-            "locations": [
-                {
-                    "physicalLocation": {
-                        "artifactLocation": {"uri": v.path},
-                        "region": {
-                            "startLine": max(v.line, 1),
-                            "startColumn": v.col + 1,
-                        },
-                    }
-                }
-            ],
+            "locations": [_sarif_location(v.path, v.line, v.col)],
         }
-        for v in new
-    ]
+        if v.flow:
+            result["codeFlows"] = [
+                {
+                    "threadFlows": [
+                        {
+                            "locations": [
+                                {
+                                    "location": {
+                                        **_sarif_location(hop_path, hop_line),
+                                        "message": {"text": note},
+                                    }
+                                }
+                                for hop_path, hop_line, note in v.flow
+                            ]
+                        }
+                    ]
+                }
+            ]
+        results.append(result)
     for e in errors:
         path, _, msg = e.partition(": ")
         results.append(

@@ -45,6 +45,12 @@ class Violation:
     line: int
     col: int
     message: str
+    # Optional source→sink witness path for dataflow rules: a tuple of
+    # (path, line, note) hops.  Excluded from equality/baseline identity
+    # so flow-note wording can evolve without invalidating entries.
+    flow: Optional[Tuple[Tuple[str, int, str], ...]] = dataclasses.field(
+        default=None, compare=False
+    )
 
     def key(self) -> Tuple[str, str, str]:
         """Baseline identity: line/col excluded on purpose (see module
@@ -52,7 +58,14 @@ class Violation:
         return (self.rule, self.path, self.message)
 
     def as_dict(self) -> Dict[str, object]:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if self.flow is not None:
+            d["flow"] = [
+                {"path": p, "line": ln, "note": note} for p, ln, note in self.flow
+            ]
+        else:
+            d.pop("flow")
+        return d
 
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
@@ -108,6 +121,10 @@ class Rule:
     name: str = ""
     description: str = ""
     scope: Tuple[str, ...] = ()
+    # Whole-project rules reason across files: scoping their input to a
+    # subset (e.g. ``--changed``) silently under-reports, so the CLI
+    # widens to a full run whenever a changed file is in their domain.
+    whole_project: bool = False
 
     def applies(self, ctx: FileContext) -> bool:
         return not self.scope or ctx.in_dirs(self.scope)

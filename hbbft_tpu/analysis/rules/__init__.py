@@ -29,6 +29,10 @@
                           no re-acquisition of a held non-reentrant lock
 ``atomic-cache``          no unguarded check-then-act cache idioms in
                           modules the thread inventory marks concurrent
+``wire-taint``            interprocedural taint: deserialized wire data
+                          passes a dominating validator before keying
+                          state, entering crypto, sizing allocations,
+                          or recursing
 ========================  ==================================================
 """
 
@@ -49,6 +53,7 @@ from .pallas_shape import PallasShapeRule
 from .step_purity import StepPurityRule
 from .thread_shared_state import ThreadSharedStateRule
 from .wire_stability import WireStabilityRule
+from .wire_taint import WireTaintRule
 
 
 def all_rules() -> List[Rule]:
@@ -66,4 +71,5 @@ def all_rules() -> List[Rule]:
         ThreadSharedStateRule(),
         LockOrderRule(),
         AtomicCacheRule(),
+        WireTaintRule(),
     ]

@@ -1,0 +1,1407 @@
+"""Shared interprocedural dataflow engine for whole-project rules.
+
+Built for the ``wire-taint`` rule (PR 8) but rule-agnostic: a
+:class:`ProjectIndex` over every in-scope module's AST (call
+resolution through imports, ``self`` attributes, and constructor
+assignments), plus a summary-based taint analyzer
+(:class:`TaintAnalyzer`) that walks function bodies with a
+branch-scoped abstract environment.
+
+The abstract domain (see ``wire_taint.py`` for the threat model):
+
+- ``Taint(level, trace)`` — an attacker-influenced value.  ``level``
+  is ``"any"`` (arbitrary wire object: unhashable, uncomparable,
+  wrong-typed) or ``"int"`` (integer-shaped: survives arithmetic and
+  hashing, but its *magnitude* is still attacker-chosen, so it stays
+  dangerous for allocations and recursion depth).  ``trace`` is the
+  witness flow path rendered into SARIF ``codeFlows``.
+- ``CLEAN`` — proven harmless (validated, or never attacker-reachable).
+- ``Shape(classes, trace)`` — an ``isinstance``-checked wire object:
+  the *reference* is safe, but every manifest field re-taints on
+  access (``isinstance(m, AbaMsg)`` says nothing about ``m.epoch``).
+- ``Witness(paths, sanctioned)`` — the boolean result of a validator
+  call over tainted values; branching on it sanitizes those values
+  when the call was *sanctioned* (resolvable in scope, or wrapped in
+  ``try/except`` so a crashing validator is itself contained).
+
+Sanitizers recognized as branch assertions: ``isinstance`` (wire-type
+aware), ordering comparisons on int-shaped taint (bounds checks),
+membership tests, and validator witnesses — in every boolean
+combination, with the surviving environment of a terminating branch
+(``return``/``raise``/``continue``/``break``) carrying the assertion.
+
+An enclosing ``try/except`` marks a *rejecting context*: crash-class
+sinks (keying, ordering, crypto, dispatch) are contained by it, but
+resource sinks (allocation sizes, recursion depth) are NOT — a 2**62
+buffer is allocated before any exception fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from ._ast_util import dotted_name
+
+# Taint levels.
+ANY = "any"
+INT = "int"
+
+# How deep the call-summary chain may grow (cycle-independent guard).
+_MAX_CALL_DEPTH = 24
+
+# A flow hop: (package-relative path, line, human note).
+Hop = Tuple[str, int, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    level: str
+    trace: Tuple[Hop, ...]
+
+    def hop(self, path: str, line: int, note: str) -> "Taint":
+        return Taint(self.level, self.trace + ((path, line, note),))
+
+    def as_int(self) -> "Taint":
+        return Taint(INT, self.trace)
+
+
+@dataclasses.dataclass(frozen=True)
+class Shape:
+    """isinstance-sanitized reference to (possibly) wire classes."""
+
+    classes: Tuple[str, ...]
+    trace: Tuple[Hop, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Witness:
+    """Boolean result of a validator call over tainted paths."""
+
+    paths: FrozenSet[str]
+    sanctioned: bool
+
+
+CLEAN = "clean"  # sentinel entry: proven-harmless value
+
+Entry = Any  # Taint | Shape | Witness | CLEAN
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    kind: str  # sink class: state-key | arith | crypto | alloc | dispatch | recursion
+    message: str
+    trace: Tuple[Hop, ...]
+
+
+# ---------------------------------------------------------------------------
+# Project index
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str  # "relpath::Class.meth" | "relpath::func"
+    relpath: str
+    cls: Optional[str]
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    params: Tuple[str, ...]
+
+
+def _func_params(node: ast.AST) -> Tuple[str, ...]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return tuple(names)
+
+
+def _decorator_wire_name(cls: ast.ClassDef) -> Optional[str]:
+    for dec in cls.decorator_list:
+        if (
+            isinstance(dec, ast.Call)
+            and dotted_name(dec.func) in ("wire", "serialize.wire")
+            and dec.args
+            and isinstance(dec.args[0], ast.Constant)
+            and isinstance(dec.args[0].value, str)
+        ):
+            return dec.args[0].value
+    return None
+
+
+def _is_dataclass(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        name = dotted_name(dec.func if isinstance(dec, ast.Call) else dec)
+        if name and name.split(".")[-1] == "dataclass":
+            return True
+    return False
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> Tuple[str, ...]:
+    out = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            out.append(stmt.target.id)
+    return tuple(out)
+
+
+def _init_rejects_param(cls: ast.ClassDef, param: str) -> bool:
+    """True when ``__init__`` raises under an ``if`` that tests the
+    given constructor parameter — i.e. the field is range/type-guarded
+    at construction and its stored value is sanitized."""
+    for stmt in cls.body:
+        if (
+            isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and stmt.name == "__init__"
+        ):
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.If) and any(
+                    isinstance(n, ast.Raise) for n in ast.walk(node)
+                ):
+                    names = {
+                        d.id for d in ast.walk(node.test) if isinstance(d, ast.Name)
+                    }
+                    if param in names:
+                        return True
+    return False
+
+
+class ProjectIndex:
+    """Call resolution + wire-type facts over a set of parsed modules."""
+
+    def __init__(
+        self,
+        modules: Dict[str, ast.Module],
+        manifest: Optional[Dict[str, Any]] = None,
+    ):
+        self.modules = modules
+        self.functions: Dict[str, FuncInfo] = {}
+        self.module_funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        self.methods: Dict[str, Dict[str, FuncInfo]] = {}
+        self.class_module: Dict[str, str] = {}
+        # class -> attr -> class (from __init__ self.a = Cls(...) / annotations)
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        # class -> method -> return-annotation class
+        self.return_types: Dict[str, Dict[str, str]] = {}
+        # imports: relpath -> local name -> ("class"|"func"|"module", key)
+        self.imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # wire classes: class name -> attacker-controlled field tuple
+        self.wire_fields: Dict[str, Tuple[str, ...]] = {}
+        self._manifest_fields: Dict[str, Tuple[str, ...]] = {}
+        if manifest:
+            for name, info in manifest.get("types", {}).items():
+                self._manifest_fields[name] = tuple(info.get("fields") or ())
+        for relpath, tree in sorted(modules.items()):
+            self._index_module(relpath, tree)
+        self._link_imports()
+
+    # -- construction -------------------------------------------------------
+
+    def _index_module(self, relpath: str, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(
+                    f"{relpath}::{stmt.name}", relpath, None, stmt, _func_params(stmt)
+                )
+                self.functions[fi.qualname] = fi
+                self.module_funcs[(relpath, stmt.name)] = fi
+            elif isinstance(stmt, ast.ClassDef):
+                self._index_class(relpath, stmt)
+
+    def _index_class(self, relpath: str, cls: ast.ClassDef) -> None:
+        if cls.name not in self.class_module:
+            self.class_module[cls.name] = relpath
+        meths = self.methods.setdefault(cls.name, {})
+        attr_types = self.attr_types.setdefault(cls.name, {})
+        ret_types = self.return_types.setdefault(cls.name, {})
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fi = FuncInfo(
+                f"{relpath}::{cls.name}.{stmt.name}",
+                relpath,
+                cls.name,
+                stmt,
+                _func_params(stmt),
+            )
+            self.functions[fi.qualname] = fi
+            meths.setdefault(stmt.name, fi)
+            ret_ann = getattr(stmt, "returns", None)
+            if ret_ann is not None:
+                ann = None
+                if isinstance(ret_ann, ast.Constant) and isinstance(
+                    ret_ann.value, str
+                ):
+                    ann = ret_ann.value
+                else:
+                    ann = dotted_name(ret_ann)
+                if ann:
+                    ret_types[stmt.name] = ann.split(".")[-1].strip("\"'")
+            if stmt.name == "__init__":
+                self._index_init(stmt, attr_types)
+        wire_name = _decorator_wire_name(cls)
+        if wire_name is not None:
+            fields = self._manifest_fields.get(wire_name)
+            if fields is None and _is_dataclass(cls):
+                fields = _dataclass_fields(cls)
+            if not _is_dataclass(cls):
+                declared = fields or ()
+                fields = tuple(
+                    f for f in declared if not _init_rejects_param(cls, f)
+                )
+            self.wire_fields[cls.name] = tuple(fields or ())
+
+    def _index_init(
+        self, init: ast.AST, attr_types: Dict[str, str]
+    ) -> None:
+        ann_of_param: Dict[str, str] = {}
+        for p in init.args.args:
+            if p.annotation is not None:
+                ann = dotted_name(p.annotation)
+                if isinstance(p.annotation, ast.Constant) and isinstance(
+                    p.annotation.value, str
+                ):
+                    ann = p.annotation.value
+                if ann:
+                    ann_of_param[p.arg] = ann.split(".")[-1].strip("\"'")
+        for node in ast.walk(init):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            val = node.value
+            if isinstance(val, ast.Call):
+                name = dotted_name(val.func)
+                if name:
+                    attr_types.setdefault(tgt.attr, name.split(".")[-1])
+            elif isinstance(val, ast.Name) and val.id in ann_of_param:
+                attr_types.setdefault(tgt.attr, ann_of_param[val.id])
+
+    def _link_imports(self) -> None:
+        """Map ``from ..x import y`` locals to in-scope modules by tail
+        match (``..protocols.agreement`` → ``protocols/agreement.py``)."""
+        tails: Dict[str, str] = {}
+        for relpath in self.modules:
+            tails[relpath[:-3].replace("/", ".")] = relpath
+        for relpath, tree in self.modules.items():
+            imap = self.imports.setdefault(relpath, {})
+            for stmt in ast.walk(tree):
+                if isinstance(stmt, ast.ImportFrom) and stmt.module:
+                    mod = stmt.module.lstrip(".")
+                    target = None
+                    for tail, rp in tails.items():
+                        if tail == mod or tail.endswith("." + mod) or mod.endswith(tail):
+                            target = rp
+                            break
+                    if target is None:
+                        continue
+                    for alias in stmt.names:
+                        local = alias.asname or alias.name
+                        if (target, alias.name) in self.module_funcs:
+                            imap[local] = ("func", f"{target}::{alias.name}")
+                        elif alias.name in self.methods:
+                            imap[local] = ("class", alias.name)
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_call(
+        self,
+        func_expr: ast.AST,
+        relpath: str,
+        cls: Optional[str],
+        var_types: Dict[str, str],
+    ) -> Optional[FuncInfo]:
+        """Best-effort static resolution of a call target; None when
+        the callee is outside the project (treated optimistically)."""
+        name = dotted_name(func_expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            fi = self.module_funcs.get((relpath, parts[0]))
+            if fi is not None:
+                return fi
+            kind_key = self.imports.get(relpath, {}).get(parts[0])
+            if kind_key and kind_key[0] == "func":
+                return self.functions.get(kind_key[1])
+            if parts[0] in self.methods or (
+                kind_key and kind_key[0] == "class"
+            ):
+                cname = parts[0]
+                return self.methods.get(cname, {}).get("__init__")
+            return None
+        base, meth = parts[0], parts[-1]
+        if base == "self" and cls is not None:
+            if len(parts) == 2:
+                return self.methods.get(cls, {}).get(meth)
+            if len(parts) == 3:
+                attr_cls = self.attr_types.get(cls, {}).get(parts[1])
+                if attr_cls:
+                    return self.methods.get(attr_cls, {}).get(meth)
+            return None
+        if len(parts) == 2:
+            vcls = var_types.get(base)
+            if vcls:
+                return self.methods.get(vcls, {}).get(meth)
+            kind_key = self.imports.get(relpath, {}).get(base)
+            if kind_key and kind_key[0] == "class":
+                return self.methods.get(kind_key[1], {}).get(meth)
+        return None
+
+    def class_of_call(
+        self, call: ast.Call, relpath: str, var_types: Dict[str, str]
+    ) -> Optional[str]:
+        """The class a constructor call instantiates, if indexed."""
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        tail = name.split(".")[-1]
+        if tail in self.methods or tail in self.wire_fields:
+            return tail
+        kind_key = self.imports.get(relpath, {}).get(tail)
+        if kind_key and kind_key[0] == "class":
+            return kind_key[1]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Sink / source tables
+# ---------------------------------------------------------------------------
+
+# Crypto sinks: attacker data reaching threshold-crypto combination or
+# RNG seeding (verify/validate calls are deliberately NOT here — they
+# are the sanctioned checkpoints the sanitizer logic credits).
+CRYPTO_SINKS = {
+    "combine_signatures",
+    "combine_decryption_shares",
+    "combine_decryption_shares_many",
+    "decrypt_share",
+    "decrypt_share_no_verify",
+    "decrypt_shares_no_verify_batch",
+    "seed",
+}
+
+# Device/allocation sinks: a tainted argument is a size, grid, or
+# buffer length — resource exhaustion happens BEFORE any exception.
+ALLOC_SINKS = {
+    "readexactly",
+    "read",
+    "recv",
+    "recv_into",
+    "bytearray",
+    "zeros",
+    "empty",
+    "ones",
+    "full",
+    "pallas_call",
+    "lease",
+    "acquire",
+    "put_chunk",
+    "_marshal",
+}
+
+# Calls that return a harmless value regardless of their arguments.
+SAFE_CALLS = {
+    "isinstance",
+    "issubclass",
+    "len",
+    "bool",
+    "str",
+    "repr",
+    "type",
+    "id",
+    "print",
+    "format",
+    "hasattr",
+    "callable",
+}
+
+# Calls that pass their (first) argument's taint through.
+PROPAGATING_CALLS = {
+    "sorted",
+    "list",
+    "tuple",
+    "dict",
+    "set",
+    "frozenset",
+    "reversed",
+    "enumerate",
+    "zip",
+    "iter",
+    "next",
+    "min",
+    "max",
+    "sum",
+    "abs",
+    "getattr",
+    "copy",
+    "deepcopy",
+    "wait_for",
+}
+
+# Byte-stream reads whose *result* is attacker bytes.
+SOCKET_READS = {"readexactly", "recv", "recv_into"}
+
+# Methods whose result carries the receiver's taint.
+RECEIVER_PROPAGATING = {
+    "copy",
+    "decode",
+    "encode",
+    "split",
+    "strip",
+    "lower",
+    "upper",
+    "hex",
+    "keys",
+    "values",
+    "items",
+}
+
+# Dict/set methods where the FIRST argument is used as a hash key.
+KEYED_METHODS = {"get", "setdefault", "pop", "add", "discard", "remove"}
+
+
+def _sink_tail(name: Optional[str]) -> Optional[str]:
+    return name.split(".")[-1] if name else None
+
+
+# ---------------------------------------------------------------------------
+# The analyzer
+# ---------------------------------------------------------------------------
+
+_ORDERING_OPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+
+def merge_entry(a: Entry, b: Entry) -> Entry:
+    """Join of two branch environments' entries — taint wins."""
+    if a is b:
+        return a
+    for pick, other in ((a, b), (b, a)):
+        if isinstance(pick, Taint):
+            if isinstance(other, Taint) and other.level == ANY:
+                return other
+            return pick
+    for pick in (a, b):
+        if isinstance(pick, Shape):
+            return pick
+    for pick in (a, b):
+        if isinstance(pick, Witness):
+            return pick
+    return CLEAN
+
+
+def merge_envs(a: Dict[str, Entry], b: Dict[str, Entry]) -> Dict[str, Entry]:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = merge_entry(out[k], v) if k in out else v
+    return out
+
+
+class TaintAnalyzer:
+    """Summary-based interprocedural taint propagation."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, int, str]] = set()
+        # (qualname, taint levels, guarded) -> return entry
+        self._memo: Dict[Tuple, Entry] = {}
+        self._in_progress: Set[str] = set()
+
+    def report(self, finding: Finding) -> None:
+        key = (finding.path, finding.line, finding.kind)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.findings.append(finding)
+
+    def summarize(
+        self,
+        fi: FuncInfo,
+        arg_taints: Dict[str, Entry],
+        guarded: bool,
+        depth: int = 0,
+    ) -> Entry:
+        """Walk ``fi`` with the given parameter entries; returns the
+        function's return-value entry.  Findings are reported on the
+        first walk for a (function, taint-shape, context) key."""
+        levels = tuple(
+            sorted(
+                (p, t.level if isinstance(t, Taint) else "shape")
+                for p, t in arg_taints.items()
+                if isinstance(t, (Taint, Shape))
+            )
+        )
+        key = (fi.qualname, levels, guarded)
+        if key in self._memo:
+            return self._memo[key]
+        if fi.qualname in self._in_progress or depth > _MAX_CALL_DEPTH:
+            return CLEAN
+        self._in_progress.add(fi.qualname)
+        # until the walk completes, recursive self-calls return CLEAN
+        self._memo[key] = CLEAN
+        walker = _FunctionWalker(self, fi, dict(arg_taints), guarded, depth)
+        try:
+            ret = walker.run()
+        finally:
+            self._in_progress.discard(fi.qualname)
+        self._memo[key] = ret
+        return ret
+
+
+class _FunctionWalker:
+    """One function body, one abstract environment."""
+
+    def __init__(
+        self,
+        analyzer: TaintAnalyzer,
+        fi: FuncInfo,
+        env: Dict[str, Entry],
+        guarded: bool,
+        depth: int,
+    ):
+        self.an = analyzer
+        self.index = analyzer.index
+        self.fi = fi
+        self.env = env
+        self.guarded = guarded
+        self.depth = depth
+        self.var_types: Dict[str, str] = {}
+        self.return_entry: Entry = CLEAN
+        self.recursion_guarded = False
+
+    # -- plumbing -----------------------------------------------------------
+
+    def run(self) -> Entry:
+        self.visit_block(self.fi.node.body)
+        return self.return_entry
+
+    def _hop(self, node: ast.AST, note: str) -> Hop:
+        return (self.fi.relpath, getattr(node, "lineno", 0), note)
+
+    def _fn_label(self) -> str:
+        name = self.fi.qualname.split("::", 1)[1]
+        return f"{name}()"
+
+    def finding(
+        self, node: ast.AST, kind: str, message: str, trace: Tuple[Hop, ...]
+    ) -> None:
+        self.an.report(
+            Finding(
+                path=self.fi.relpath,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                kind=kind,
+                message=message,
+                trace=trace + (self._hop(node, f"sink: {kind} in {self._fn_label()}"),),
+            )
+        )
+
+    def _taint_of(self, entry: Entry) -> Optional[Taint]:
+        return entry if isinstance(entry, Taint) else None
+
+    # -- environment lookup --------------------------------------------------
+
+    def lookup(self, path: str) -> Entry:
+        """Longest-prefix entry lookup with wire-field re-tainting."""
+        if path in self.env:
+            return self.env[path]
+        parts = path.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:cut])
+            if prefix not in self.env:
+                continue
+            entry = self.env[prefix]
+            if isinstance(entry, Taint):
+                if entry.level == INT:
+                    return CLEAN  # attribute of an int-shaped value
+                return entry
+            if isinstance(entry, Shape):
+                field = parts[cut]
+                for cname in entry.classes:
+                    if field in self.index.wire_fields.get(cname, ()):
+                        return Taint(
+                            ANY,
+                            entry.trace
+                            + (
+                                (
+                                    self.fi.relpath,
+                                    0,
+                                    f"wire field .{field} of {cname} is "
+                                    "attacker-controlled",
+                                ),
+                            ),
+                        )
+                return CLEAN
+            return CLEAN
+        return CLEAN
+
+    def set_path(self, path: str, entry: Entry) -> None:
+        self.env[path] = entry
+        # a direct write invalidates stale sub-path entries
+        stale = [k for k in self.env if k.startswith(path + ".")]
+        for k in stale:
+            del self.env[k]
+
+    # -- expression evaluation ----------------------------------------------
+
+    def eval(self, node: ast.AST) -> Entry:
+        method = getattr(self, f"_eval_{type(node).__name__}", None)
+        if method is not None:
+            return method(node)
+        # default: evaluate children, propagate strongest taint
+        entry: Entry = CLEAN
+        for child in ast.iter_child_nodes(node):
+            entry = merge_entry(entry, self.eval(child))
+        return entry
+
+    def _eval_Constant(self, node: ast.Constant) -> Entry:
+        return CLEAN
+
+    def _eval_Name(self, node: ast.Name) -> Entry:
+        return self.lookup(node.id)
+
+    def _eval_Attribute(self, node: ast.Attribute) -> Entry:
+        path = dotted_name(node)
+        if path is not None:
+            return self.lookup(path)
+        base = self.eval(node.value)
+        if isinstance(base, Taint):
+            return base if base.level == ANY else CLEAN
+        if isinstance(base, Shape):
+            for cname in base.classes:
+                if node.attr in self.index.wire_fields.get(cname, ()):
+                    return Taint(ANY, base.trace)
+        return CLEAN
+
+    def _eval_Await(self, node: ast.Await) -> Entry:
+        return self.eval(node.value)
+
+    def _eval_Starred(self, node: ast.Starred) -> Entry:
+        return self.eval(node.value)
+
+    def _eval_NamedExpr(self, node: ast.NamedExpr) -> Entry:
+        entry = self.eval(node.value)
+        if isinstance(node.target, ast.Name):
+            self.set_path(node.target.id, entry)
+        return entry
+
+    def _eval_JoinedStr(self, node: ast.JoinedStr) -> Entry:
+        for child in ast.walk(node):
+            if isinstance(child, ast.FormattedValue):
+                self.eval(child.value)
+        return CLEAN
+
+    def _eval_BoolOp(self, node: ast.BoolOp) -> Entry:
+        # short-circuit: each operand evaluates under the assertions
+        # of the previous ones (``not isinstance(x, int) or x < 0``
+        # never compares a non-int)
+        saved = dict(self.env)
+        entry: Entry = CLEAN
+        for v in node.values:
+            entry = merge_entry(entry, self.eval(v))
+            true_env, false_env = self.assert_cond(v, self.env)
+            self.env = false_env if isinstance(node.op, ast.Or) else true_env
+        self.env = saved
+        return entry
+
+    def _eval_UnaryOp(self, node: ast.UnaryOp) -> Entry:
+        inner = self.eval(node.operand)
+        if isinstance(node.op, ast.Not):
+            return CLEAN
+        return inner
+
+    def _eval_BinOp(self, node: ast.BinOp) -> Entry:
+        left, right = self.eval(node.left), self.eval(node.right)
+        return merge_entry(left, right)
+
+    def _eval_IfExp(self, node: ast.IfExp) -> Entry:
+        self.eval(node.test)
+        return merge_entry(self.eval(node.body), self.eval(node.orelse))
+
+    def _eval_Compare(self, node: ast.Compare) -> Entry:
+        operands = [node.left] + list(node.comparators)
+        entries = [self.eval(op) for op in operands]
+        for i, op in enumerate(node.ops):
+            left_t = self._taint_of(entries[i])
+            right_t = self._taint_of(entries[i + 1])
+            if isinstance(op, _ORDERING_OPS):
+                for t in (left_t, right_t):
+                    if t is not None and t.level == ANY and not self.guarded:
+                        self.finding(
+                            node,
+                            "arith",
+                            "untrusted wire value reaches an ordering "
+                            f"comparison in {self._fn_label()} — a non-int "
+                            "payload raises TypeError; isinstance-guard it "
+                            "first",
+                            t.trace,
+                        )
+                        break
+            elif isinstance(op, (ast.In, ast.NotIn)):
+                if left_t is not None and left_t.level == ANY and not self.guarded:
+                    self.finding(
+                        node,
+                        "state-key",
+                        "untrusted wire value is membership-tested (hashed) "
+                        f"in {self._fn_label()} — an unhashable payload "
+                        "raises TypeError; isinstance-guard it or wrap in "
+                        "try/except TypeError",
+                        left_t.trace,
+                    )
+        # a membership test doubles as a validator witness: binding
+        # ``known = x in table`` and branching on it proves ``x`` is
+        # hashable and expected (the unguarded-hash hazard was already
+        # reported above)
+        if len(node.ops) == 1 and isinstance(node.ops[0], ast.In):
+            p = dotted_name(node.left)
+            if p is not None and isinstance(self.lookup(p), Taint):
+                return Witness(frozenset((p,)), True)
+        # the comparison result is a plain bool
+        return CLEAN
+
+    def _eval_Subscript(self, node: ast.Subscript) -> Entry:
+        base = self.eval(node.value)
+        key = self.eval(node.slice)
+        key_taint = self._taint_of(key)
+        if (
+            key_taint is not None
+            and key_taint.level == ANY
+            and not self.guarded
+            and not isinstance(node.slice, ast.Slice)
+        ):
+            self.finding(
+                node,
+                "state-key",
+                "untrusted wire value is used as a container key in "
+                f"{self._fn_label()} — an unhashable/abusive key corrupts "
+                "or crashes protocol state; validate it first",
+                key_taint.trace,
+            )
+        if isinstance(base, Taint):
+            return base
+        return CLEAN
+
+    def _eval_Lambda(self, node: ast.Lambda) -> Entry:
+        # walked in the enclosing environment with unknown-clean params
+        saved = dict(self.env)
+        for p in _func_params(node):
+            self.env[p] = CLEAN
+        self.eval(node.body)
+        self.env = saved
+        return CLEAN
+
+    def _eval_ListComp(self, node: ast.AST) -> Entry:
+        return self._eval_comp(node, (node.elt,))
+
+    def _eval_SetComp(self, node: ast.AST) -> Entry:
+        return self._eval_comp(node, (node.elt,))
+
+    def _eval_GeneratorExp(self, node: ast.AST) -> Entry:
+        return self._eval_comp(node, (node.elt,))
+
+    def _eval_DictComp(self, node: ast.AST) -> Entry:
+        return self._eval_comp(node, (node.key, node.value))
+
+    def _eval_comp(self, node: ast.AST, elts: Tuple[ast.AST, ...]) -> Entry:
+        saved = dict(self.env)
+        for gen in node.generators:
+            src = self.eval(gen.iter)
+            self._bind_target(gen.target, src)
+            for cond in gen.ifs:
+                self.eval(cond)
+        entry: Entry = CLEAN
+        for e in elts:
+            entry = merge_entry(entry, self.eval(e))
+        self.env = saved
+        return entry
+
+    def _eval_Tuple(self, node: ast.Tuple) -> Entry:
+        entry: Entry = CLEAN
+        for e in node.elts:
+            entry = merge_entry(entry, self.eval(e))
+        return entry
+
+    _eval_List = _eval_Tuple
+    _eval_Set = _eval_Tuple
+
+    def _eval_Dict(self, node: ast.Dict) -> Entry:
+        entry: Entry = CLEAN
+        for k in node.keys:
+            if k is not None:
+                entry = merge_entry(entry, self.eval(k))
+        for v in node.values:
+            entry = merge_entry(entry, self.eval(v))
+        return entry
+
+    # -- calls ---------------------------------------------------------------
+
+    def _eval_Call(self, node: ast.Call) -> Entry:
+        name = dotted_name(node.func)
+        tail = _sink_tail(name)
+        if tail is None and isinstance(node.func, ast.Attribute):
+            # a chained receiver (`d.get(epoch, {}).get(key)`) has no
+            # dotted name, but the method sink is named by the final
+            # attribute regardless of what it hangs off
+            tail = node.func.attr
+        arg_entries = [self.eval(a) for a in node.args]
+        kw_entries = [self.eval(kw.value) for kw in node.keywords]
+        all_entries = arg_entries + kw_entries
+        recv_entry: Entry = CLEAN
+        if isinstance(node.func, ast.Attribute):
+            recv_entry = self.eval(node.func.value)
+
+        # -- sources --------------------------------------------------------
+        if tail == "loads" and name is not None:
+            if not name.startswith(("pickle", "json", "marshal")):
+                return Taint(
+                    ANY, (self._hop(node, "loads() deserializes untrusted wire bytes"),)
+                )
+        if tail == "from_bytes":
+            src = merge_entry(
+                recv_entry,
+                all_entries[0] if all_entries else CLEAN,
+            )
+            taint = self._taint_of(src)
+            if taint is not None:
+                return taint.hop(
+                    self.fi.relpath,
+                    node.lineno,
+                    "int.from_bytes() — attacker-chosen magnitude",
+                ).as_int()
+            return CLEAN
+
+        # -- sinks on arguments ---------------------------------------------
+        tainted_args = [t for t in map(self._taint_of, all_entries) if t is not None]
+        recv_taint_any = self._taint_of(recv_entry)
+        if tail in ALLOC_SINKS and tainted_args:
+            t = tainted_args[0]
+            self.finding(
+                node,
+                "alloc",
+                f"attacker-influenced size reaches {tail}() in "
+                f"{self._fn_label()} — bound it before allocating "
+                "(resource exhaustion fires before any except clause)",
+                t.trace,
+            )
+        if tail in SOCKET_READS:
+            return Taint(
+                ANY, (self._hop(node, f"{tail}() reads bytes off the socket"),)
+            )
+        if (
+            tail == "to_bytes"
+            and recv_taint_any is not None
+            and recv_taint_any.level == ANY
+            and not self.guarded
+        ):
+            self.finding(
+                node,
+                "arith",
+                "untrusted wire value is serialized via .to_bytes() in "
+                f"{self._fn_label()} — a non-int/negative payload raises; "
+                "isinstance/bounds-guard it first",
+                recv_taint_any.trace,
+            )
+        if tail in CRYPTO_SINKS and tainted_args and not self.guarded:
+            t = tainted_args[0]
+            self.finding(
+                node,
+                "crypto",
+                f"unvalidated wire data reaches crypto sink {tail}() in "
+                f"{self._fn_label()} — verify shares/ciphertexts before "
+                "combining or seeding",
+                t.trace,
+            )
+        if name in ("random.Random", "Random") and tainted_args and not self.guarded:
+            self.finding(
+                node,
+                "crypto",
+                "attacker-influenced value seeds an RNG in "
+                f"{self._fn_label()}",
+                tainted_args[0].trace,
+            )
+        if tail == "hash" and name == "hash" and tainted_args:
+            t = tainted_args[0]
+            if t.level == ANY and not self.guarded:
+                self.finding(
+                    node,
+                    "state-key",
+                    "untrusted wire value is hashed in "
+                    f"{self._fn_label()} — an unhashable payload raises "
+                    "TypeError",
+                    t.trace,
+                )
+        if (
+            tail in KEYED_METHODS
+            and isinstance(node.func, ast.Attribute)
+            and node.args
+        ):
+            t = self._taint_of(arg_entries[0])
+            if t is not None and t.level == ANY and not self.guarded:
+                self.finding(
+                    node,
+                    "state-key",
+                    f"untrusted wire value is used as a .{tail}() key in "
+                    f"{self._fn_label()} — an unhashable/abusive key "
+                    "corrupts or crashes protocol state; validate it first",
+                    t.trace,
+                )
+
+        # -- queue handoff source -------------------------------------------
+        if (
+            tail in ("get", "get_nowait")
+            and name is not None
+            and "_inbox" in name
+        ):
+            return Taint(
+                ANY,
+                (self._hop(node, "message handed off from the transport inbox"),),
+            )
+
+        # -- safe / propagating builtins ------------------------------------
+        if name in SAFE_CALLS:
+            return CLEAN
+        if tail in PROPAGATING_CALLS and name is not None and len(name.split(".")) <= 2:
+            entry: Entry = merge_entry(recv_entry, CLEAN)
+            for e in all_entries:
+                entry = merge_entry(entry, e)
+            return entry
+        if tail in RECEIVER_PROPAGATING and isinstance(recv_entry, Taint):
+            return recv_entry
+
+        # -- resolution ------------------------------------------------------
+        fi = self.index.resolve_call(
+            node.func, self.fi.relpath, self.fi.cls, self.var_types
+        )
+        recv_taint = self._taint_of(recv_entry)
+        tainted_paths = self._tainted_arg_paths(node)
+        if fi is not None:
+            if fi.qualname == self.fi.qualname or fi.qualname in self.an._in_progress:
+                # only DIRECT self-recursion is a sink: mutual recursion
+                # through protocol methods is bounded by state flags
+                # (ready_sent etc.), but f(f(payload)) depth is the
+                # attacker's choice
+                if (
+                    fi.qualname == self.fi.qualname
+                    and (tainted_args or recv_taint)
+                    and not self.recursion_guarded
+                ):
+                    t = tainted_args[0] if tainted_args else recv_taint
+                    self.finding(
+                        node,
+                        "recursion",
+                        "recursion on attacker-controlled input in "
+                        f"{self._fn_label()} without a dominating depth/size "
+                        "guard — a nested payload exhausts the stack",
+                        t.trace,
+                    )
+                return CLEAN
+            ret = self._call_summary(node, fi, arg_entries, kw_entries, recv_entry)
+            if ret is CLEAN and (tainted_args or recv_taint) and tainted_paths:
+                return Witness(frozenset(tainted_paths), True)
+            return ret
+
+        # -- unresolved -------------------------------------------------------
+        if tail is not None and tail.startswith("handle_"):
+            any_tainted = [
+                t for t in tainted_args if t.level == ANY
+            ]
+            if (
+                any_tainted
+                and not self.guarded
+                and not self.fi.relpath.startswith("protocols/")
+            ):
+                self.finding(
+                    node,
+                    "dispatch",
+                    "untrusted message dispatched into an unresolvable "
+                    f"{tail}() in {self._fn_label()} without a containing "
+                    "try/except — a handler crash kills the pump",
+                    any_tainted[0].trace,
+                )
+        if (tainted_args or recv_taint is not None) and tainted_paths:
+            return Witness(frozenset(tainted_paths), self.guarded)
+        return CLEAN
+
+    def _tainted_arg_paths(self, node: ast.Call) -> List[str]:
+        paths = []
+        exprs = list(node.args) + [kw.value for kw in node.keywords]
+        if isinstance(node.func, ast.Attribute):
+            exprs.append(node.func.value)
+        for e in exprs:
+            p = dotted_name(e)
+            if p is not None and isinstance(self.lookup(p), Taint):
+                paths.append(p)
+        return paths
+
+    def _call_summary(
+        self,
+        node: ast.Call,
+        fi: FuncInfo,
+        arg_entries: List[Entry],
+        kw_entries: List[Entry],
+        recv_entry: Entry,
+    ) -> Entry:
+        params = list(fi.params)
+        is_method = fi.cls is not None and params and params[0] == "self"
+        if is_method:
+            params = params[1:]
+        call_taints: Dict[str, Entry] = {}
+        for p, entry in zip(params, arg_entries):
+            if isinstance(entry, (Taint, Shape)):
+                if isinstance(entry, Taint):
+                    entry = entry.hop(
+                        self.fi.relpath,
+                        node.lineno,
+                        f"passed to {fi.qualname.split('::', 1)[1]}() as '{p}'",
+                    )
+                call_taints[p] = entry
+        for kw, entry in zip(node.keywords, kw_entries):
+            if kw.arg and isinstance(entry, (Taint, Shape)):
+                call_taints[kw.arg] = entry
+        ret = self.an.summarize(fi, call_taints, self.guarded, self.depth + 1)
+        if isinstance(ret, Taint):
+            return ret.hop(
+                self.fi.relpath, node.lineno, f"returned by {fi.qualname.split('::', 1)[1]}()"
+            )
+        return CLEAN if not isinstance(ret, (Taint, Shape)) else ret
+
+    # -- statements -----------------------------------------------------------
+
+    def visit_block(self, stmts: Sequence[ast.stmt]) -> bool:
+        """Walk statements; True when the block terminates abruptly."""
+        for stmt in stmts:
+            if self.visit_stmt(stmt):
+                return True
+        return False
+
+    def visit_stmt(self, stmt: ast.stmt) -> bool:
+        method = getattr(self, f"_stmt_{type(stmt).__name__}", None)
+        if method is not None:
+            return bool(method(stmt))
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.eval(child)
+        return False
+
+    def _stmt_Expr(self, stmt: ast.Expr) -> bool:
+        self.eval(stmt.value)
+        return False
+
+    def _stmt_Return(self, stmt: ast.Return) -> bool:
+        if stmt.value is not None:
+            entry = self.eval(stmt.value)
+            if isinstance(entry, (Taint, Shape)):
+                self.return_entry = merge_entry(self.return_entry, entry)
+        return True
+
+    def _stmt_Raise(self, stmt: ast.Raise) -> bool:
+        if stmt.exc is not None:
+            self.eval(stmt.exc)
+        return True
+
+    def _stmt_Continue(self, stmt: ast.Continue) -> bool:
+        return True
+
+    def _stmt_Break(self, stmt: ast.Break) -> bool:
+        return True
+
+    def _stmt_Pass(self, stmt: ast.Pass) -> bool:
+        return False
+
+    def _stmt_Assert(self, stmt: ast.Assert) -> bool:
+        true_env, _ = self.assert_cond(stmt.test, dict(self.env))
+        self.env = true_env
+        return False
+
+    def _bind_target(self, target: ast.AST, entry: Entry) -> None:
+        if isinstance(target, ast.Name):
+            self.set_path(target.id, entry)
+        elif isinstance(target, ast.Attribute):
+            path = dotted_name(target)
+            if path is not None:
+                self.set_path(path, entry)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind_target(e, entry)
+        elif isinstance(target, ast.Subscript):
+            self.eval(target)  # key-sink check on the store
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, entry)
+
+    def _stmt_Assign(self, stmt: ast.Assign) -> bool:
+        entry = self.eval(stmt.value)
+        if (
+            isinstance(stmt.value, ast.Call)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+        ):
+            cls = self.index.class_of_call(
+                stmt.value, self.fi.relpath, self.var_types
+            )
+            if cls is not None:
+                self.var_types[stmt.targets[0].id] = cls
+        for tgt in stmt.targets:
+            self._bind_target(tgt, entry)
+        return False
+
+    def _stmt_AnnAssign(self, stmt: ast.AnnAssign) -> bool:
+        if stmt.value is not None:
+            self._bind_target(stmt.target, self.eval(stmt.value))
+        return False
+
+    def _stmt_AugAssign(self, stmt: ast.AugAssign) -> bool:
+        entry = merge_entry(self.eval(stmt.target), self.eval(stmt.value))
+        self._bind_target(stmt.target, entry)
+        return False
+
+    def _stmt_If(self, stmt: ast.If) -> bool:
+        self.eval(stmt.test)  # sink checks inside the condition itself
+        base = dict(self.env)
+        true_env, false_env = self.assert_cond(stmt.test, base)
+        if self._is_ordering_guard(stmt.test) and self._block_terminates(stmt.body):
+            self.recursion_guarded = True
+        self.env = true_env
+        body_term = self.visit_block(stmt.body)
+        body_env = self.env
+        self.env = false_env
+        else_term = self.visit_block(stmt.orelse) if stmt.orelse else False
+        else_env = self.env
+        if body_term and else_term:
+            self.env = merge_envs(body_env, else_env)
+            return True
+        if body_term:
+            self.env = else_env
+        elif else_term:
+            self.env = body_env
+        else:
+            self.env = merge_envs(body_env, else_env)
+        return False
+
+    def _is_ordering_guard(self, test: ast.AST) -> bool:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare) and any(
+                isinstance(op, _ORDERING_OPS) for op in node.ops
+            ):
+                return True
+        return False
+
+    def _block_terminates(self, stmts: Sequence[ast.stmt]) -> bool:
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+        )
+
+    def _stmt_For(self, stmt: ast.For) -> bool:
+        src = self.eval(stmt.iter)
+        self._bind_target(stmt.target, src if isinstance(src, Taint) else CLEAN)
+        before = dict(self.env)
+        self.visit_block(stmt.body)
+        self.env = merge_envs(before, self.env)
+        if stmt.orelse:
+            self.visit_block(stmt.orelse)
+        return False
+
+    _stmt_AsyncFor = _stmt_For
+
+    def _stmt_While(self, stmt: ast.While) -> bool:
+        self.eval(stmt.test)
+        true_env, _ = self.assert_cond(stmt.test, dict(self.env))
+        before = dict(self.env)
+        self.env = true_env
+        self.visit_block(stmt.body)
+        self.env = merge_envs(before, self.env)
+        if stmt.orelse:
+            self.visit_block(stmt.orelse)
+        return False
+
+    def _stmt_With(self, stmt: ast.With) -> bool:
+        for item in stmt.items:
+            entry = self.eval(item.context_expr)
+            if item.optional_vars is not None:
+                self._bind_target(item.optional_vars, entry)
+        return self.visit_block(stmt.body)
+
+    _stmt_AsyncWith = _stmt_With
+
+    def _stmt_Try(self, stmt: ast.Try) -> bool:
+        saved_guard = self.guarded
+        self.guarded = True
+        try_term = self.visit_block(stmt.body)
+        self.guarded = saved_guard
+        try_env = dict(self.env)
+        handler_envs = []
+        all_handlers_term = bool(stmt.handlers)
+        for handler in stmt.handlers:
+            self.env = dict(try_env)
+            h_term = self.visit_block(handler.body)
+            if not h_term:
+                all_handlers_term = False
+                handler_envs.append(self.env)
+        self.env = try_env
+        for henv in handler_envs:
+            self.env = merge_envs(self.env, henv)
+        if stmt.orelse:
+            self.visit_block(stmt.orelse)
+        if stmt.finalbody:
+            self.visit_block(stmt.finalbody)
+        return try_term and all_handlers_term
+
+    def _stmt_FunctionDef(self, stmt: ast.AST) -> bool:
+        # nested defs (callbacks): walked at the def site with clean params
+        saved = dict(self.env)
+        for p in _func_params(stmt):
+            self.env[p] = CLEAN
+        self.visit_block(stmt.body)
+        self.env = saved
+        return False
+
+    _stmt_AsyncFunctionDef = _stmt_FunctionDef
+
+    def _stmt_Delete(self, stmt: ast.Delete) -> bool:
+        for tgt in stmt.targets:
+            self.eval(tgt)
+        return False
+
+    # -- branch assertions ----------------------------------------------------
+
+    def assert_cond(
+        self, test: ast.AST, env: Dict[str, Entry]
+    ) -> Tuple[Dict[str, Entry], Dict[str, Entry]]:
+        """→ (env when test is true, env when test is false)."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            t, f = self.assert_cond(test.operand, env)
+            return f, t
+        if isinstance(test, ast.BoolOp):
+            if isinstance(test.op, ast.And):
+                true_env = dict(env)
+                for v in test.values:
+                    true_env, _ = self.assert_cond(v, true_env)
+                return true_env, dict(env)
+            false_env = dict(env)
+            for v in test.values:
+                _, false_env = self.assert_cond(v, false_env)
+            return dict(env), false_env
+        if isinstance(test, ast.Call):
+            return self._assert_call(test, env)
+        if isinstance(test, ast.Compare):
+            return self._assert_compare(test, env)
+        if isinstance(test, ast.Name):
+            entry = env.get(test.id)
+            if isinstance(entry, Witness) and entry.sanctioned:
+                true_env = dict(env)
+                for p in entry.paths:
+                    true_env[p] = CLEAN
+                return true_env, dict(env)
+        return dict(env), dict(env)
+
+    def _assert_call(
+        self, call: ast.Call, env: Dict[str, Entry]
+    ) -> Tuple[Dict[str, Entry], Dict[str, Entry]]:
+        name = dotted_name(call.func)
+        if name == "isinstance" and len(call.args) == 2:
+            path = dotted_name(call.args[0])
+            if path is None:
+                return dict(env), dict(env)
+            cur = env.get(path)
+            if not isinstance(cur, Taint):
+                cur = self.lookup(path) if path not in env else cur
+            if not isinstance(cur, Taint):
+                return dict(env), dict(env)
+            classes = self._isinstance_classes(call.args[1])
+            true_env = dict(env)
+            if classes == ("int",):
+                true_env[path] = cur.as_int()
+            else:
+                wire = tuple(
+                    c for c in classes if self.index.wire_fields.get(c)
+                )
+                if wire:
+                    true_env[path] = Shape(wire, cur.trace)
+                else:
+                    true_env[path] = CLEAN
+            return true_env, dict(env)
+        # validator call used directly as the branch condition
+        sanctioned = self.guarded
+        fi = self.index.resolve_call(
+            call.func, self.fi.relpath, self.fi.cls, self.var_types
+        )
+        if fi is not None:
+            sanctioned = True
+        if sanctioned:
+            paths = self._tainted_arg_paths_in(call, env)
+            if paths:
+                true_env = dict(env)
+                for p in paths:
+                    true_env[p] = CLEAN
+                return true_env, dict(env)
+        return dict(env), dict(env)
+
+    def _tainted_arg_paths_in(
+        self, call: ast.Call, env: Dict[str, Entry]
+    ) -> List[str]:
+        paths = []
+        exprs = list(call.args) + [kw.value for kw in call.keywords]
+        if isinstance(call.func, ast.Attribute):
+            exprs.append(call.func.value)
+        for e in exprs:
+            p = dotted_name(e)
+            if p is None:
+                continue
+            entry = env.get(p)
+            if entry is None:
+                entry = self.lookup(p)
+            if isinstance(entry, Taint):
+                paths.append(p)
+        return paths
+
+    def _isinstance_classes(self, node: ast.AST) -> Tuple[str, ...]:
+        if isinstance(node, ast.Tuple):
+            out: List[str] = []
+            for e in node.elts:
+                out.extend(self._isinstance_classes(e))
+            return tuple(out)
+        name = dotted_name(node)
+        if name is None:
+            return ()
+        return (name.split(".")[-1],)
+
+    def _assert_compare(
+        self, cmp: ast.Compare, env: Dict[str, Entry]
+    ) -> Tuple[Dict[str, Entry], Dict[str, Entry]]:
+        true_env, false_env = dict(env), dict(env)
+        operands = [cmp.left] + list(cmp.comparators)
+        for i, op in enumerate(cmp.ops):
+            left, right = operands[i], operands[i + 1]
+            if isinstance(op, _ORDERING_OPS):
+                # a bounds check on int-shaped taint cleans it in the
+                # SURVIVING branch of a rejecting guard (the caller
+                # keeps only the branch whose twin terminates)
+                for expr in (left, right):
+                    p = dotted_name(expr)
+                    if p is None:
+                        continue
+                    entry = env.get(p, None) or self.lookup(p)
+                    if isinstance(entry, Taint) and entry.level == INT:
+                        true_env[p] = CLEAN
+                        false_env[p] = CLEAN
+            elif isinstance(op, (ast.In, ast.NotIn)):
+                p = dotted_name(left)
+                if p is not None:
+                    entry = env.get(p, None) or self.lookup(p)
+                    if isinstance(entry, Taint):
+                        if isinstance(op, ast.In):
+                            true_env[p] = CLEAN
+                        else:
+                            false_env[p] = CLEAN
+        return true_env, false_env

@@ -35,6 +35,7 @@ class AtomicCacheRule(Rule):
         "check-then-act cache idioms in concurrent modules must hold "
         "one lock across the test and the update"
     )
+    whole_project = True
     scope = ()
 
     def begin_run(self) -> None:

@@ -41,6 +41,7 @@ class LockOrderRule(Rule):
         "re-acquisition of a non-reentrant lock already held"
     )
     scope = ()
+    whole_project = True
 
     def begin_run(self) -> None:
         self._inv = Inventory()

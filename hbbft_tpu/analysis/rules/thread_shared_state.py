@@ -48,6 +48,7 @@ class ThreadSharedStateRule(Rule):
         "main path must only be written under a lock; spawned threads "
         "carry stable hbbft-* names"
     )
+    whole_project = True
     scope = ()  # whole tree: spawn sites and shared state cross layers
 
     def begin_run(self) -> None:

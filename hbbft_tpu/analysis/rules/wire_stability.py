@@ -189,6 +189,7 @@ class WireStabilityRule(Rule):
         "field orders are append-only (regenerate with "
         "--write-wire-manifest)"
     )
+    whole_project = True
     # every package layer (wire types live in crypto/, protocols/,
     # core/, harness/ today) — but NOT tests/examples linted from the
     # repo root, whose throwaway @wire fixtures are manifest-exempt

@@ -1,0 +1,294 @@
+"""Rule ``wire-taint`` — untrusted wire bytes are validated before
+they touch protocol state, threshold crypto, or the device.
+
+The Honey Badger threat model says every field of every ``@wire``
+message is adversary-controlled.  PR 6's fuzzer proved the point
+dynamically (non-int epochs, unhashable proposers, codec depth bombs,
+handler crashes); this rule is the static dual: a whole-project
+interprocedural taint pass that demands a *dominating validator*
+between every deserialization source and every dangerous sink.
+
+Sources
+    - parameters of every ``protocols/*.handle_message`` (everything
+      after the sender id),
+    - the result of ``core.serialize.loads`` and raw socket reads
+      (``readexactly``/``recv``), and the transport ``_inbox`` handoff,
+    - the codec's own buffer: ``loads`` is analyzed with its parameter
+      carrying int-shaped byte taint, so the decoder's recursion and
+      allocation guards are checked too,
+    - every manifest field of a ``@wire`` class, inside that class's
+      own methods (``self.index`` in ``MerkleProof.validate`` is
+      attacker data),
+    - ``int.from_bytes`` narrows taint to *int-shaped* (hashable and
+      comparable, but attacker-magnitude).
+
+Sinks (see ``_dataflow.py`` for the engine)
+    - **state-key**: tainted value keyed/hashed into protocol state
+      (``d[k]``, ``.get/.setdefault/.pop/.add``, ``in``) — unhashable
+      payloads raise, abusive keys corrupt state,
+    - **arith**: ordering comparisons and ``.to_bytes`` on arbitrary
+      wire objects — type confusion raises ``TypeError``,
+    - **crypto**: share/ciphertext combination or RNG seeding from
+      unvalidated data,
+    - **alloc**: attacker-chosen sizes reaching reads, buffer or array
+      allocations, staging leases, or ``pallas_call`` — the static
+      dual of the fuzzer's huge-length DoS frames (NOT excused by
+      ``try/except``: the allocation happens first),
+    - **dispatch**: a message pump calling an unresolvable
+      ``handle_*`` outside ``protocols/`` without a containing
+      ``try/except`` — one malformed frame kills the pump,
+    - **recursion**: self-recursion on attacker input with no
+      dominating depth/size guard.
+
+Sanitizers
+    - ``isinstance`` checks (wire-type aware: the checked *reference*
+      is clean, its manifest fields stay tainted),
+    - bounds checks on int-shaped taint, membership tests,
+    - validator witnesses: branching on the boolean result of a
+      validation call over the tainted value — credited only when the
+      callee is resolvable in-project or the call is inside
+      ``try/except`` (an unresolvable, unguarded "validator" may
+      itself crash on the payload),
+    - fault-attribution exits: a rejecting branch that pushes a fault
+      and returns/continues sanitizes the surviving path.
+
+Findings carry the full source→sink flow path (rendered as SARIF
+``codeFlows`` by the CLI).  ``finish_run`` findings are attributed to
+real lines, so this rule applies ``# lint: ok(wire-taint)``
+suppression itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List
+
+from ..core import FileContext, Rule, Violation
+from . import _dataflow as df
+from .wire_stability import DEFAULT_MANIFEST
+
+
+class WireTaintRule(Rule):
+    name = "wire-taint"
+    description = (
+        "interprocedural taint: deserialized wire data must pass a "
+        "dominating validator before keying state, entering crypto, "
+        "sizing allocations, or recursing"
+    )
+    scope = (
+        "protocols/",
+        "core/serialize.py",
+        "transport/",
+        "harness/",
+        "crypto/merkle.py",
+    )
+    whole_project = True
+
+    def __init__(self) -> None:
+        self.manifest_path = DEFAULT_MANIFEST
+        self._files: Dict[str, FileContext] = {}
+
+    def begin_run(self) -> None:
+        self._files = {}
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        self._files[ctx.relpath] = ctx
+        return ()
+
+    # -- roots ---------------------------------------------------------------
+
+    def _handler_roots(self, index: df.ProjectIndex) -> List:
+        roots = []
+        # handle_message is the DistAlgorithm entry point; handle_part /
+        # handle_ack are the DKG wire entry points (driven with
+        # deserialized KeyGenMessage payloads).  Other handle_* methods
+        # (handle_bval, handle_input, ...) receive already-validated
+        # values from within the protocol and are NOT roots.
+        entry_points = ("handle_message", "handle_part", "handle_ack")
+        for qualname in sorted(index.functions):
+            fi = index.functions[qualname]
+            if (
+                fi.cls is None
+                or fi.node.name not in entry_points
+                or not fi.relpath.startswith("protocols/")
+            ):
+                continue
+            params = [p for p in fi.params if p != "self"]
+            if len(params) < 2:
+                continue
+            # params[0] is the sender id; params[1] is the message —
+            # trailing params (rng handles etc.) are local, not wire
+            p = params[1]
+            taints = {
+                p: df.Taint(
+                    df.ANY,
+                    (
+                        (
+                            fi.relpath,
+                            fi.node.lineno,
+                            f"wire message '{p}' enters "
+                            f"{fi.cls}.{fi.node.name}() off the network",
+                        ),
+                    ),
+                )
+            }
+            roots.append((fi, taints))
+        return roots
+
+    def _wire_method_roots(self, index: df.ProjectIndex) -> List:
+        roots = []
+        for cname in sorted(index.wire_fields):
+            fields = index.wire_fields[cname]
+            module = index.class_module.get(cname, "")
+            if not fields:
+                continue
+            if not (
+                module.startswith("protocols/") or module == "crypto/merkle.py"
+            ):
+                continue
+            for mname in sorted(index.methods.get(cname, {})):
+                if mname.startswith("__"):
+                    continue
+                fi = index.methods[cname][mname]
+                taints = {
+                    f"self.{f}": df.Taint(
+                        df.ANY,
+                        (
+                            (
+                                fi.relpath,
+                                fi.node.lineno,
+                                f"wire field {cname}.{f} is "
+                                "attacker-controlled",
+                            ),
+                        ),
+                    )
+                    for f in fields
+                }
+                roots.append((fi, taints))
+        return roots
+
+    def _codec_roots(self, index: df.ProjectIndex) -> List:
+        """The codec's own entry point: ``loads`` receives raw wire
+        bytes by definition, so the decoder is analyzed with its buffer
+        tainted.  Byte taint is int-shaped (indexing, slicing, and
+        decoding bytes yield primitives — hashable and comparable), so
+        the codec hazards are recursion and allocation, not keying."""
+        roots = []
+        for qualname in sorted(index.functions):
+            fi = index.functions[qualname]
+            if fi.cls is not None or fi.node.name != "loads":
+                continue
+            if not fi.relpath.endswith("serialize.py"):
+                continue
+            params = [p for p in fi.params if p != "self"]
+            if not params:
+                continue
+            roots.append(
+                (
+                    fi,
+                    {
+                        params[0]: df.Taint(
+                            df.INT,
+                            (
+                                (
+                                    fi.relpath,
+                                    fi.node.lineno,
+                                    "raw wire bytes enter the codec "
+                                    "via loads()",
+                                ),
+                            ),
+                        )
+                    },
+                )
+            )
+        return roots
+
+    def _source_roots(self, index: df.ProjectIndex) -> List:
+        """Functions that reach a source expression are analyzed even
+        when unreachable from a handler root (the epoch driver and
+        fuzzer call ``loads`` on frames no handler ever routed; the
+        accept loop takes bytes via ``_read_frame``).  Transitive to a
+        fixpoint so a caller of a source-returning helper is a root
+        too."""
+        import ast
+
+        from ._ast_util import dotted_name
+
+        sourcing = set()
+        for qualname, fi in index.functions.items():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                tail = name.split(".")[-1] if name else None
+                if tail in df.SOCKET_READS:
+                    sourcing.add(qualname)
+                    break
+                if (
+                    tail == "loads"
+                    and name
+                    and not name.startswith(("pickle", "json", "marshal"))
+                ):
+                    sourcing.add(qualname)
+                    break
+                if tail in ("get", "get_nowait") and name and "_inbox" in name:
+                    sourcing.add(qualname)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for qualname, fi in index.functions.items():
+                if qualname in sourcing:
+                    continue
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = index.resolve_call(
+                        node.func, fi.relpath, fi.cls, {}
+                    )
+                    if callee is not None and callee.qualname in sourcing:
+                        sourcing.add(qualname)
+                        changed = True
+                        break
+        return [(index.functions[q], {}) for q in sorted(sourcing)]
+
+    # -- run -----------------------------------------------------------------
+
+    def finish_run(self) -> Iterable[Violation]:
+        if not self._files:
+            return ()
+        modules = {rp: ctx.tree for rp, ctx in self._files.items()}
+        manifest = None
+        if self.manifest_path and os.path.exists(self.manifest_path):
+            try:
+                with open(self.manifest_path, "r") as fh:
+                    manifest = json.load(fh)
+            except (OSError, ValueError):
+                manifest = None
+        index = df.ProjectIndex(modules, manifest)
+        analyzer = df.TaintAnalyzer(index)
+        for fi, taints in (
+            self._handler_roots(index)
+            + self._wire_method_roots(index)
+            + self._codec_roots(index)
+            + self._source_roots(index)
+        ):
+            analyzer.summarize(fi, taints, guarded=False)
+        out: List[Violation] = []
+        for f in analyzer.findings:
+            ctx = self._files.get(f.path)
+            if ctx is not None and ctx.suppressed(self.name, f.line):
+                continue
+            out.append(
+                Violation(
+                    rule=self.name,
+                    path=f.path,
+                    line=f.line,
+                    col=f.col,
+                    message=f.message,
+                    flow=f.trace,
+                )
+            )
+        out.sort(key=lambda v: (v.path, v.line, v.col))
+        return out

@@ -75,4 +75,11 @@ EVENTS: Dict[str, EventSpec] = {
         {"surface", "cases", "failures"},
         {"decoded", "rejected", "delivered", "faults"},
     ),
+    # static-analysis runs (additive): one row per badgerlint CLI run,
+    # so lint results land on the same tracing plane as scenario /
+    # fuzz_summary rows
+    "lint_run": _spec(
+        {"rules", "violations", "wall"},
+        {"baselined", "errors", "counts", "paths", "changed"},
+    ),
 }

@@ -196,6 +196,12 @@ class SyncKeyGen:
             return "malformed ack"
         if len(ack.values) != len(self.node_ids):
             return "wrong node count"
+        if not isinstance(ack.proposer_idx, int) or isinstance(
+            ack.proposer_idx, bool
+        ):
+            # the wire can carry anything here — an unhashable
+            # proposer_idx would TypeError the dict lookup below
+            return "malformed proposer index"
         part = self.parts.get(ack.proposer_idx)
         if part is None:
             return "sender does not exist"

@@ -208,8 +208,15 @@ class TcpNode:
         ):
             writer.close()
             return
-        if peer not in self.peer_addrs or peer in self._writers:
-            # unknown claim, or an impostor claiming a peer whose link
+        if (
+            not isinstance(peer, (str, int))
+            or peer not in self.peer_addrs
+            or peer in self._writers
+        ):
+            # a non-id handshake payload (the wire can carry anything,
+            # including an unhashable value that would TypeError the
+            # membership tests), an unknown claim, or an impostor
+            # claiming a peer whose link
             # is already LIVE — reject rather than displace the writer.
             # (Dead links are unregistered on recv-loop exit, so a
             # legitimately restarted peer can always re-handshake; a

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# One-shot verification gate, in dependency order:
+#
+#   1. badgerlint — all 13 static rules over the package tree
+#   2. racecheck smoke — the lockset-checker test module under
+#      `pytest --racecheck` (runtime thread-safety)
+#   3. wire-manifest verification — the @wire registry still matches
+#      the checked-in golden manifest (serialization stability)
+#
+# Each stage runs even if an earlier one failed (you want the full
+# report, not the first stopper), but the exit code is non-zero if ANY
+# stage failed.  Under pipefail + tee the per-stage exit codes come
+# from PIPESTATUS, not tee's.
+#
+#   scripts/check.sh              # everything
+#   CHECK_LOG=/tmp/check.log scripts/check.sh
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+log() {
+  if [ -n "${CHECK_LOG:-}" ]; then
+    tee -a "$CHECK_LOG"
+  else
+    cat
+  fi
+}
+
+rc=0
+
+echo "== [1/3] badgerlint (all rules) ==" | log
+python -m hbbft_tpu.analysis 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+
+echo "== [2/3] racecheck smoke ==" | log
+env JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
+  -p no:cacheprovider --racecheck 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+
+echo "== [3/3] wire manifest ==" | log
+python -m hbbft_tpu.analysis --select wire-stability 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+
+if [ "$rc" -eq 0 ]; then
+  echo "check: all gates clean" | log
+else
+  echo "check: FAILED (see stages above)" | log
+fi
+exit "$rc"

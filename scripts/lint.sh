@@ -6,44 +6,23 @@
 #   scripts/lint.sh --select determinism,layering hbbft_tpu/protocols
 #   scripts/lint.sh --select thread-shared-state,lock-order,atomic-cache
 #   scripts/lint.sh --racecheck tests/test_racecheck.py   # runtime lockset checker
-#   scripts/lint.sh --changed            # only files in git diff (pre-commit)
+#   scripts/lint.sh --changed            # git-diff scope (pre-commit);
+#                                        # the CLI widens to a full run when
+#                                        # a changed file is in a
+#                                        # whole-project rule's domain
 #   LINT_LOG=/tmp/lint.log scripts/lint.sh
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-changed=0
-args=()
-for a in "$@"; do
-  if [ "$a" = "--changed" ]; then
-    changed=1
-  else
-    args+=("$a")
-  fi
-done
-
-targets=()
-if [ "$changed" = 1 ]; then
-  # staged + unstaged python files still on disk
-  while IFS= read -r f; do
-    [ -f "$f" ] && targets+=("$f")
-  done < <(
-    { git diff --name-only HEAD -- '*.py'
-      git diff --cached --name-only -- '*.py'; } | sort -u
-  )
-  if [ "${#targets[@]}" -eq 0 ]; then
-    echo "lint: no changed python files"
-    exit 0
-  fi
-fi
+# --changed used to be resolved here with git; it now lives in the CLI
+# so the whole-project widening logic has one home.
 
 # Under pipefail, ${PIPESTATUS[0]} is the lint's own exit code even
 # when the output is piped through tee — the old `exec` form lost it
 # as soon as a log pipe was added.
 if [ -n "${LINT_LOG:-}" ]; then
-  python -m hbbft_tpu.analysis "${args[@]+"${args[@]}"}" \
-    "${targets[@]+"${targets[@]}"}" 2>&1 | tee "$LINT_LOG"
+  python -m hbbft_tpu.analysis "$@" 2>&1 | tee "$LINT_LOG"
   exit "${PIPESTATUS[0]}"
 fi
-python -m hbbft_tpu.analysis "${args[@]+"${args[@]}"}" \
-  "${targets[@]+"${targets[@]}"}"
+python -m hbbft_tpu.analysis "$@"
 exit $?

@@ -1290,3 +1290,595 @@ def test_cli_module_entry_point():
     assert proc.returncode == 0
     for rule in RULES:
         assert rule.name in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# wire-taint
+# ---------------------------------------------------------------------------
+
+
+def _wt(source, relpath="protocols/taintfix.py"):
+    return _lint(source, relpath, select="wire-taint")
+
+
+def test_wire_taint_flags_dict_key_sink():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                self.seen[message.epoch] = True
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "container key" in out[0].message
+
+
+def test_wire_taint_sender_param_is_not_tainted():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                self.seen[sender_id] = True
+                return None
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_handle_bval_is_not_a_root():
+    # handle_bval receives already-validated values from within the
+    # protocol — only handle_message/handle_part/handle_ack are entry
+    # points
+    out = _wt(
+        """
+        class Proto:
+            def handle_bval(self, sender_id, value):
+                self.votes[value] = True
+                return None
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_handle_part_is_a_root():
+    out = _wt(
+        """
+        class KeyGen:
+            def handle_part(self, sender_idx, part):
+                self.parts[part.idx] = part
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+
+
+def test_wire_taint_isinstance_int_sanitizes_key():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                epoch = message.epoch
+                if not isinstance(epoch, int) or isinstance(epoch, bool):
+                    return None
+                self.seen[epoch] = True
+                return None
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_ordering_compare_flags():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                if message.epoch < self.epoch:
+                    return None
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "ordering comparison" in out[0].message
+
+
+def test_wire_taint_ordering_after_isinstance_clean():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                if not isinstance(message.epoch, int):
+                    return None
+                if message.epoch < self.epoch:
+                    return None
+                return None
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_membership_unguarded_flags():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                if message.pid in self.instances:
+                    return None
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "membership-tested" in out[0].message
+
+
+def test_wire_taint_membership_in_try_clean():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                try:
+                    if message.pid in self.instances:
+                        return None
+                except TypeError:
+                    return None
+                return None
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_validator_witness_sanitizes():
+    # the common_subset pattern: branch on the boolean result of a
+    # guarded membership probe, then key state with the probed value
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                try:
+                    known = message.pid in self.instances
+                except TypeError:
+                    return None
+                if not known:
+                    return None
+                self.instances[message.pid].deliver()
+                return None
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_chained_get_key_flags():
+    # `d.get(e, {}).get(k)` has no dotted name — the keyed sink must
+    # still see the trailing .get()
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                return self.cts.get(0, {}).get(message.pid)
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert ".get() key" in out[0].message
+
+
+def test_wire_taint_setdefault_key_flags():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                self.queue.setdefault(message.epoch, []).append(sender_id)
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert ".setdefault() key" in out[0].message
+
+
+def test_wire_taint_hash_sink_flags():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                return hash(message.payload)
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "hashed" in out[0].message
+
+
+def test_wire_taint_to_bytes_sink_flags():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                return message.length.to_bytes(4, "big")
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert ".to_bytes()" in out[0].message
+
+
+def test_wire_taint_int_shaped_key_is_hashable():
+    # int.from_bytes narrows to int-shaped taint: hashable and
+    # comparable, so keying is fine (magnitude hazards are the alloc
+    # sink's job)
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                n = int.from_bytes(message.raw, "big")
+                return self.parts.get(n)
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_crypto_sink_flags():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                return self.pk_set.combine_signatures(message.shares)
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "crypto sink combine_signatures()" in out[0].message
+
+
+def test_wire_taint_crypto_sink_guarded_clean():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                try:
+                    return self.pk_set.combine_signatures(message.shares)
+                except Exception:
+                    return None
+        """
+    )
+    assert out == []
+
+
+def test_wire_taint_rng_seed_flags():
+    out = _wt(
+        """
+        import random
+
+        class Proto:
+            def handle_message(self, sender_id, message):
+                self.rng = random.Random(message.seed)
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "seeds an RNG" in out[0].message
+
+
+def test_wire_taint_alloc_fires_even_inside_try(tmp_path):
+    # resource exhaustion happens before any except clause runs, so
+    # try/except earns no credit at alloc sinks
+    out = _wt(
+        """
+        async def pump(reader):
+            header = await reader.readexactly(4)
+            n = int.from_bytes(header, "big")
+            try:
+                return bytearray(n)
+            except MemoryError:
+                return None
+        """,
+        relpath="transport/pumpfix.py",
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "size reaches bytearray()" in out[0].message
+
+
+def test_wire_taint_bounds_check_clears_alloc():
+    out = _wt(
+        """
+        async def pump(reader):
+            header = await reader.readexactly(4)
+            n = int.from_bytes(header, "big")
+            if n > 65536:
+                raise ValueError("oversized")
+            return bytearray(n)
+        """,
+        relpath="transport/pumpfix.py",
+    )
+    assert out == []
+
+
+def test_wire_taint_loads_result_tainted_in_harness():
+    out = _wt(
+        """
+        from ..core.serialize import loads
+
+        def replay(frame, table):
+            msg = loads(frame)
+            table[msg] = 1
+            return msg
+        """,
+        relpath="harness/replayfix.py",
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "container key" in out[0].message
+
+
+def test_wire_taint_socket_read_membership_flags():
+    out = _wt(
+        """
+        async def accept(reader, registry):
+            peer = await reader.readexactly(16)
+            if peer in registry:
+                return None
+            return peer
+        """,
+        relpath="transport/acceptfix.py",
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "membership-tested" in out[0].message
+
+
+def test_wire_taint_recursion_unguarded_flags():
+    out = _wt(
+        """
+        from ..core.serialize import loads
+
+        def walk(node):
+            for child in node:
+                walk(child)
+            return node
+
+        def pump(frame):
+            return walk(loads(frame))
+        """,
+        relpath="harness/walkfix.py",
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "recursion on attacker-controlled input" in out[0].message
+
+
+def test_wire_taint_recursion_depth_guard_clean():
+    out = _wt(
+        """
+        from ..core.serialize import loads
+
+        def walk(node, depth=0):
+            if depth > 64:
+                raise ValueError("too deep")
+            for child in node:
+                walk(child, depth + 1)
+            return node
+
+        def pump(frame):
+            return walk(loads(frame))
+        """,
+        relpath="harness/walkfix.py",
+    )
+    assert out == []
+
+
+def test_wire_taint_dispatch_outside_protocols_flags():
+    out = _wt(
+        """
+        from ..core.serialize import loads
+
+        def pump(algo, frame):
+            msg = loads(frame)
+            return algo.handle_message("peer", msg)
+        """,
+        relpath="transport/dispatchfix.py",
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "dispatched" in out[0].message
+
+
+def test_wire_taint_dispatch_guarded_clean():
+    out = _wt(
+        """
+        from ..core.serialize import loads
+
+        def pump(algo, frame):
+            msg = loads(frame)
+            try:
+                return algo.handle_message("peer", msg)
+            except Exception:
+                return None
+        """,
+        relpath="transport/dispatchfix.py",
+    )
+    assert out == []
+
+
+def test_wire_taint_wire_class_methods_are_roots():
+    # a @wire class's own fields are attacker data inside its methods
+    out = _wt(
+        """
+        import dataclasses
+        from ..core.serialize import wire
+
+        @wire("FixProofX")
+        @dataclasses.dataclass(frozen=True)
+        class FixProofX:
+            index: int
+
+            def check(self, n):
+                return 0 <= self.index < n
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "ordering comparison" in out[0].message
+    assert any("FixProofX" in note for _, _, note in out[0].flow)
+
+
+def test_wire_taint_isinstance_wire_class_keeps_fields_tainted():
+    # isinstance(message, WireCls) proves the *shape*, not the fields:
+    # every manifest field is still attacker-chosen
+    out = _wt(
+        """
+        import dataclasses
+        from ..core.serialize import wire
+
+        @wire("FixMsgX")
+        @dataclasses.dataclass(frozen=True)
+        class FixMsgX:
+            epoch: int
+
+        class Proto:
+            def handle_message(self, sender_id, message):
+                if not isinstance(message, FixMsgX):
+                    return None
+                self.queue[message.epoch] = 1
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    assert "container key" in out[0].message
+
+
+def test_wire_taint_interprocedural_flow_through_helper():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                return self._queue(message.epoch)
+
+            def _queue(self, epoch):
+                self.pending[epoch] = 1
+                return None
+        """
+    )
+    assert _names(out) == ["wire-taint"]
+    v = out[0]
+    # the finding lands in the helper but the flow starts at the
+    # handler boundary
+    assert "_queue" in v.message
+    assert v.flow is not None and len(v.flow) >= 3
+    assert "handle_message" in v.flow[0][2]
+    assert "sink:" in v.flow[-1][2]
+
+
+def test_wire_taint_flow_hops_name_real_lines():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                self.seen[message.epoch] = True
+                return None
+        """
+    )
+    (v,) = out
+    for path, line, note in v.flow:
+        assert path == "protocols/taintfix.py"
+        assert line > 0
+        assert note
+
+
+def test_wire_taint_suppression_comment():
+    out = _wt(
+        """
+        class Proto:
+            def handle_message(self, sender_id, message):
+                self.seen[message.epoch] = True  # lint: ok(wire-taint)
+                return None
+        """
+    )
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# wire-taint CLI surface: flow in --json / SARIF, --changed widening,
+# --trace lint_run
+# ---------------------------------------------------------------------------
+
+_WT_CLI_FIXTURE = """
+class Proto:
+    def handle_message(self, sender_id, message):
+        self.seen[message.epoch] = True
+        return None
+"""
+
+
+def test_cli_json_carries_flow(tmp_path, capsys):
+    f = _write_pkg_file(tmp_path, "protocols/taintfix.py", _WT_CLI_FIXTURE)
+    rc = cli_main(
+        ["--json", "--no-baseline", "--select", "wire-taint", str(f)]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    (v,) = out["violations"]
+    assert v["rule"] == "wire-taint"
+    assert isinstance(v["flow"], list) and len(v["flow"]) >= 2
+    for hop in v["flow"]:
+        assert set(hop) == {"path", "line", "note"}
+    assert "handle_message" in v["flow"][0]["note"]
+
+
+def test_cli_json_omits_flow_when_absent(tmp_path, capsys):
+    f = _write_pkg_file(
+        tmp_path, "protocols/fixture.py", "import time\nx = time.time()\n"
+    )
+    cli_main(["--json", "--no-baseline", "--select", "determinism", str(f)])
+    out = json.loads(capsys.readouterr().out)
+    assert all("flow" not in v for v in out["violations"])
+
+
+def test_cli_sarif_code_flows(tmp_path, capsys):
+    f = _write_pkg_file(tmp_path, "protocols/taintfix.py", _WT_CLI_FIXTURE)
+    rc = cli_main(
+        ["--format", "sarif", "--no-baseline", "--select", "wire-taint",
+         str(f)]
+    )
+    sarif = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    (result,) = sarif["runs"][0]["results"]
+    (thread_flow,) = result["codeFlows"][0]["threadFlows"]
+    locs = thread_flow["locations"]
+    assert len(locs) >= 2
+    for loc in locs:
+        phys = loc["location"]["physicalLocation"]
+        assert phys["artifactLocation"]["uri"] == "protocols/taintfix.py"
+        assert loc["location"]["message"]["text"]
+
+
+def test_changed_widening_covers_whole_project_domains():
+    from hbbft_tpu.analysis.cli import _widening_rules
+
+    # a protocols file is in the wire-taint (and wire-stability) domain
+    widened = _widening_rules(
+        ["/x/hbbft_tpu/protocols/agreement.py"], RULES
+    )
+    assert "wire-taint" in widened
+    assert "wire-stability" in widened
+    # an ops kernel is outside wire-taint's scope
+    widened = _widening_rules(["/x/hbbft_tpu/ops/pallas_ec.py"], RULES)
+    assert "wire-taint" not in widened
+    # a file outside the package is in no rule's domain
+    assert _widening_rules(["/x/tests/test_foo.py"], RULES) == []
+    # only whole-project rules ever widen
+    per_file = [r for r in RULES if not getattr(r, "whole_project", False)]
+    assert _widening_rules(
+        ["/x/hbbft_tpu/protocols/agreement.py"], per_file
+    ) == []
+
+
+def test_cli_trace_emits_lint_run_event(tmp_path, capsys):
+    from hbbft_tpu.obs.schema import EVENTS
+
+    assert "lint_run" in EVENTS
+
+    f = _write_pkg_file(tmp_path, "protocols/taintfix.py", _WT_CLI_FIXTURE)
+    trace = tmp_path / "trace.jsonl"
+    rc = cli_main(
+        ["--no-baseline", "--select", "wire-taint", "--trace", str(trace),
+         str(f)]
+    )
+    capsys.readouterr()
+    assert rc == 1
+    events = [json.loads(l) for l in trace.read_text().splitlines()]
+    (run,) = [e for e in events if e.get("ev") == "lint_run"]
+    assert run["rules"] == 1
+    assert run["violations"] == 1
+    assert run["wall"] > 0
+    assert run["counts"] == {"wire-taint": 1}
+    assert run["changed"] is False

@@ -1,0 +1,253 @@
+"""Fuzzer↔lint differential suite for the ``wire-taint`` rule.
+
+PR 6's wire fuzzer cracked a set of handler paths and each got a
+guard.  These tests pin that the *static* pass would have caught every
+one of them: each test copies the in-scope tree into a fixture,
+reverts exactly one PR-6 hardening guard by text substitution, runs
+the whole-project wire-taint pass over the reverted tree, and asserts
+the rule reports that precise source→sink flow — file, function, and
+sink class.
+
+The unreverted copy is asserted clean once up front, so a failure
+here means the revert (and only the revert) re-opened the hole.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from hbbft_tpu.analysis import lint_paths
+from hbbft_tpu.analysis.rules.wire_taint import WireTaintRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "hbbft_tpu")
+
+# everything in the wire-taint scope
+_SCOPE_DIRS = ("protocols", "transport", "harness")
+_SCOPE_FILES = ("core/serialize.py", "crypto/merkle.py")
+
+
+def _copy_scope(tmp_path):
+    dst = tmp_path / "hbbft_tpu"
+    for d in _SCOPE_DIRS:
+        shutil.copytree(
+            os.path.join(PKG, d),
+            dst / d,
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+    for f in _SCOPE_FILES:
+        target = dst / f
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(PKG, f), target)
+    return dst
+
+
+def _revert_and_lint(tmp_path, relpath, old, new):
+    """Apply one textual guard-revert and run wire-taint over the tree."""
+    root = _copy_scope(tmp_path)
+    target = root / relpath
+    text = target.read_text()
+    assert old in text, (
+        f"guard text not found in {relpath} — the differential revert "
+        "needs updating alongside the guard"
+    )
+    target.write_text(text.replace(old, new))
+    violations, errors = lint_paths([str(root)], [WireTaintRule()])
+    assert not errors
+    return violations
+
+
+def _flows(violations, path):
+    return [v for v in violations if v.path == path]
+
+
+def test_unreverted_scope_copy_is_clean(tmp_path):
+    root = _copy_scope(tmp_path)
+    violations, errors = lint_paths([str(root)], [WireTaintRule()])
+    assert not errors
+    assert violations == []
+
+
+def test_codec_depth_cap_revert_redetects_recursion(tmp_path):
+    # PR 6: `_decode` got a depth cap after the fuzzer's nesting bomb
+    violations = _revert_and_lint(
+        tmp_path,
+        "core/serialize.py",
+        'if depth > _MAX_DECODE_DEPTH:\n        raise SerializationError("nesting too deep")\n    ',
+        "",
+    )
+    hits = [
+        v
+        for v in _flows(violations, "core/serialize.py")
+        if "recursion" in v.message and "_decode" in v.message
+    ]
+    assert hits, violations
+    # the flow names the byte source and the recursive sink
+    flow_notes = " | ".join(note for _, _, note in hits[0].flow)
+    assert "recursion" in flow_notes
+
+
+def test_honey_badger_epoch_guard_revert_redetects(tmp_path):
+    # PR 6: non-int epochs faulted before comparison / queue keying
+    violations = _revert_and_lint(
+        tmp_path,
+        "protocols/honey_badger.py",
+        "        if not isinstance(epoch, int) or isinstance(epoch, bool):\n"
+        "            return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)\n",
+        "",
+    )
+    hits = _flows(violations, "protocols/honey_badger.py")
+    assert any("handle_message" in v.message for v in hits), violations
+    # both hazards the guard closed: the ordering comparison and the
+    # incoming_queue keying
+    assert any("ordering comparison" in v.message for v in hits)
+    assert any("key" in v.message for v in hits)
+    flagged = next(v for v in hits if "ordering comparison" in v.message)
+    assert any("handle_message" in note for _, _, note in flagged.flow)
+
+
+def test_agreement_epoch_guard_revert_redetects(tmp_path):
+    violations = _revert_and_lint(
+        tmp_path,
+        "protocols/agreement.py",
+        "        if not isinstance(message.epoch, int) or isinstance(message.epoch, bool):\n"
+        "            return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)\n",
+        "",
+    )
+    hits = _flows(violations, "protocols/agreement.py")
+    assert any(
+        "ordering comparison" in v.message and "handle_message" in v.message
+        for v in hits
+    ), violations
+
+
+def test_honey_badger_proposer_guard_revert_redetects(tmp_path):
+    # PR 6: unhashable proposer_id faulted via try/except TypeError
+    # around the validator probe.  Reverted, the unresolvable,
+    # unguarded probe earns no sanitization credit and the proposer
+    # reaches dict keying tainted.
+    violations = _revert_and_lint(
+        tmp_path,
+        "protocols/honey_badger.py",
+        "        try:\n"
+        "            known = self.netinfo.is_node_validator(proposer_id)\n"
+        "        except TypeError:\n"
+        "            return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)\n"
+        "        if not known:",
+        "        known = self.netinfo.is_node_validator(proposer_id)\n"
+        "        if not known:",
+    )
+    hits = [
+        v
+        for v in _flows(violations, "protocols/honey_badger.py")
+        if "key" in v.message
+    ]
+    assert hits, violations
+
+
+def test_common_subset_proposer_guard_revert_redetects(tmp_path):
+    # PR 6: the unhashable-proposer membership test went under
+    # try/except TypeError
+    violations = _revert_and_lint(
+        tmp_path,
+        "protocols/common_subset.py",
+        "            try:\n"
+        "                known = message.proposer_id in self.broadcast_instances\n"
+        "            except TypeError:\n"
+        "                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)\n",
+        "            known = message.proposer_id in self.broadcast_instances\n",
+    )
+    hits = [
+        v
+        for v in _flows(violations, "protocols/common_subset.py")
+        if "membership" in v.message and "handle_message" in v.message
+    ]
+    assert hits, violations
+    assert any("proposer" in note or "message" in note for _, _, note in hits[0].flow)
+
+
+def test_merkle_type_guard_revert_redetects(tmp_path):
+    # PR 6: MerkleProof.validate got the isinstance block after the
+    # fuzzer's type-confusion frames
+    violations = _revert_and_lint(
+        tmp_path,
+        "crypto/merkle.py",
+        "        if (\n"
+        "            not isinstance(self.index, int)\n"
+        "            or isinstance(self.index, bool)\n"
+        "            or not isinstance(self.value, bytes)\n"
+        "            or not isinstance(self.lemma, (tuple, list))\n"
+        "            or not isinstance(self.root_hash, bytes)\n"
+        "        ):\n"
+        "            return False\n",
+        "",
+    )
+    hits = [
+        v
+        for v in _flows(violations, "crypto/merkle.py")
+        if "validate" in v.message
+    ]
+    assert hits, violations
+    assert any(
+        "MerkleProof" in note for v in hits for _, _, note in v.flow
+    )
+
+
+def test_tcp_handler_catch_revert_redetects_dispatch(tmp_path):
+    # PR 6: the TcpNode pump stopped crashing on handler exceptions —
+    # malformed-but-deserializable messages become attributed faults
+    violations = _revert_and_lint(
+        tmp_path,
+        "transport/tcp.py",
+        "            try:\n"
+        "                step = self.algo.handle_message(sender, message)\n"
+        "            except Exception:",
+        "            if True:\n"
+        "                step = self.algo.handle_message(sender, message)\n"
+        "            if False:",
+    )
+    hits = [
+        v
+        for v in _flows(violations, "transport/tcp.py")
+        if "dispatched" in v.message and "run" in v.message
+    ]
+    assert hits, violations
+    assert any("inbox" in note for _, _, note in hits[0].flow)
+
+
+def test_tcp_frame_bound_revert_redetects_alloc(tmp_path):
+    # the huge-length DoS dual: dropping the _MAX_FRAME bound leaves an
+    # attacker-magnitude length sizing readexactly()
+    violations = _revert_and_lint(
+        tmp_path,
+        "transport/tcp.py",
+        "    if length > _MAX_FRAME:\n"
+        '        raise ConnectionError(f"oversized frame: {length} bytes")\n',
+        "",
+    )
+    hits = [
+        v
+        for v in _flows(violations, "transport/tcp.py")
+        if "size reaches readexactly()" in v.message
+    ]
+    assert hits, violations
+
+
+def test_sync_key_gen_proposer_idx_guard_revert_redetects(tmp_path):
+    # the guard this PR itself added after wire-taint flagged the
+    # unvalidated Ack.proposer_idx dict key
+    violations = _revert_and_lint(
+        tmp_path,
+        "protocols/sync_key_gen.py",
+        "        if not isinstance(ack.proposer_idx, int) or isinstance(\n"
+        "            ack.proposer_idx, bool\n"
+        "        ):\n",
+        "        if False:\n",
+    )
+    hits = [
+        v
+        for v in _flows(violations, "protocols/sync_key_gen.py")
+        if ".get() key" in v.message
+    ]
+    assert hits, violations
