@@ -142,8 +142,15 @@ def bench_headline(k: int = 65536, iters: int = 5):
     # cache, nothing on a warm-started one — so cold vs warm startup
     # is a measured pair instead of a footnote.
     with rec.span("bench.flush", leg="cold", k=k) as sp:
-        BatchingBackend(inner=TpuBackend()).prefetch(make_obs(b"warm"))
+        cold_be = BatchingBackend(inner=TpuBackend())
+        cold_be.prefetch(make_obs(b"warm"))
     flush_cold_s = sp.dur
+    cold_phases = {
+        name: round(v, 3)
+        for name, v in (
+            getattr(cold_be, "last_flush_phases", None) or {}
+        ).items()
+    }
 
     # host leg: band forced shut so native host Pippenger runs the
     # same flushes — the r3 shipping configuration, kept measured so
@@ -263,6 +270,12 @@ def bench_headline(k: int = 65536, iters: int = 5):
         flush_min_s=round(min(ship_dts), 2),
         flush_max_s=round(max(ship_dts), 2),
         flush_cold_s=round(flush_cold_s, 2),
+        # cold÷warm: the startup tax in flush units.  With a primed
+        # ``.palexe`` cache this should sit near 1 (the acceptance band
+        # is ≤3×); a virgin cache pays the compiles here instead of in
+        # an epoch.  ``cold_phases`` localizes whatever tax remains.
+        cold_warm_ratio=round(flush_cold_s / ship_dt, 2),
+        cold_phases=cold_phases,
         prewarm_s=round(prewarm_s, 2),
         device_flush_s=round(dev_dt, 2),
         device_rate=round(k / dev_dt, 1),
@@ -277,6 +290,99 @@ def bench_headline(k: int = 65536, iters: int = 5):
         ctl_d=round(ctl.get("d") or 0.0, 1),
         ctl_dc=round(ctl.get("dc") or 0.0, 1),
         ctl_h=round(ctl.get("h") or 0.0, 1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Cold-start probe (--cold): one fresh-process first flush, traced
+# ---------------------------------------------------------------------------
+
+
+def bench_cold(k: int = 4096):
+    """The FIRST flush of THIS process, timed under a compile-event
+    trace — the row ``scripts/bench_cold.sh`` captures twice against
+    one ``HBBFT_TPU_EXEC_CACHE`` dir: once virgin (pays the compiles,
+    writes every ``.palexe``) and once primed (the prewarm plan
+    preloads them all and the flush must log ZERO ``compile`` events).
+    Emits one JSON row: total flush wall, per-phase walls, the prewarm
+    join time, and the compile-event count + total compile seconds.
+
+    The device leg is forced (``G1_DEVICE_MIN = 1``; pair with
+    ``HBBFT_TPU_DEVICE_FRACTION=1`` to suppress the host split) so the
+    row measures the device path's cold wall, not the routing guard's
+    host fallback.  Obligation generation runs outside the timed span.
+    """
+    from hbbft_tpu import native as NT
+    from hbbft_tpu.crypto import threshold as T
+    from hbbft_tpu.crypto.curve import G2_GEN
+    from hbbft_tpu.harness.batching import BatchingBackend, DecObligation
+    from hbbft_tpu.obs import recorder as obsrec
+    from hbbft_tpu.ops import limbs as LB
+    from hbbft_tpu.ops import packed_msm
+    from hbbft_tpu.ops.backend_tpu import TpuBackend
+
+    rec = obsrec.active() or obsrec.enable()
+
+    rng = random.Random(0xC01D)
+    n_nodes = min(1024, k)
+    groups = max(1, k // n_nodes)
+    k = n_nodes * groups
+    xs = [rng.randrange(1, LB.R) for _ in range(n_nodes)]
+    pk_shares = [T.PublicKeyShare(G2_GEN * x) for x in xs]
+    master_pk = T.SecretKey.random(rng).public_key()
+    t_gen = time.perf_counter()
+    cts = [master_pk.encrypt(b"cold-%d" % g, rng) for g in range(groups)]
+    obs = []
+    for ct in cts:
+        if NT.available():
+            wires = NT.g1_mul_many(NT.g1_wire(ct.u), xs)
+            shares = [
+                T.DecryptionShare(NT.g1_unwire(w, type(ct.u))) for w in wires
+            ]
+        else:
+            shares = [T.DecryptionShare(ct.u * x) for x in xs]
+        obs.extend(
+            DecObligation(pk_shares[i], shares[i], ct)
+            for i in range(n_nodes)
+        )
+    gen_s = time.perf_counter() - t_gen
+
+    # join the persistent-cache prewarm BEFORE the timed flush, exactly
+    # as production hides it under DKG/setup — on a primed cache this
+    # is where every planned executable deserializes
+    t0 = time.perf_counter()
+    pw = packed_msm.start_background_prewarm()
+    if pw is not None:
+        pw.join()
+    prewarm_s = time.perf_counter() - t0
+
+    inner = TpuBackend()
+    inner.G1_DEVICE_MIN = 1
+    be = BatchingBackend(inner=inner)
+    with rec.span("bench.flush", leg="cold", k=k) as sp:
+        be.prefetch(obs)
+    sample = obs[:: max(1, len(obs) // 64)]
+    assert all(
+        be.verify_dec_share(o.pk_share, o.share, o.ciphertext)
+        for o in sample
+    )
+    compiles = [e for e in rec.events if e.get("ev") == "compile"]
+    return _emit(
+        "cold_flush",
+        sp.dur,
+        "s",
+        k=k,
+        engine=packed_msm._product_engine(),
+        prewarm_s=round(prewarm_s, 3),
+        gen_s=round(gen_s, 3),
+        compile_events=len(compiles),
+        compile_s=round(sum(e.get("wall") or 0.0 for e in compiles), 3),
+        phases={
+            name: round(v, 3)
+            for name, v in (
+                getattr(be, "last_flush_phases", None) or {}
+            ).items()
+        },
     )
 
 
@@ -1751,10 +1857,16 @@ def main() -> None:
     # ops/backend_tpu._device_g1_msm falls back to host when cold)
     os.environ.setdefault("HBBFT_TPU_WARM", "1")
 
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
-    os.makedirs(cache, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # --cold measures the ``.palexe`` mechanism in isolation, so it
+    # must NOT get a lift from jax's own persistent compilation cache
+    cold_mode = "--cold" in __import__("sys").argv
+    if not cold_mode:
+        cache = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".xla_cache"
+        )
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--suite", action="store_true", help="run all configs")
@@ -1784,6 +1896,12 @@ def main() -> None:
         "--iters", type=int, default=3, help="flush iterations (--mesh)"
     )
     p.add_argument(
+        "--cold",
+        action="store_true",
+        help="one fresh-process first flush under a compile-event "
+        "trace (see scripts/bench_cold.sh for the virgin/primed pair)",
+    )
+    p.add_argument(
         "--trace",
         metavar="PATH",
         default=None,
@@ -1796,7 +1914,9 @@ def main() -> None:
 
         obsrec.enable(args.trace)
     try:
-        if args.mesh_child:
+        if args.cold:
+            bench_cold(k=args.k or 4096)
+        elif args.mesh_child:
             bench_mesh_child(
                 args.mesh_child, k=args.k or 512, iters=args.iters
             )

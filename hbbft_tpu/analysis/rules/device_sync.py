@@ -30,6 +30,17 @@ mesh engine was built to remove.  Regions are found the same two ways
 shard_map-wrapped (``jax.jit(shard_map(...))`` is the normal stack),
 the shard_map diagnosis wins — it is the more specific one.
 
+A DONATION pass gates the flush engine's buffer-donation property
+(the AOT/donation PR): any function that ships staged buffers
+(``jax.device_put`` / lease ``get`` / ``staging.`` submits) and then
+wraps a program with bare ``jax.jit(...)`` lacking ``donate_argnums``
+is flagged — the staged operands are exactly the large buffers whose
+device allocation the runtime could reuse, and the sanctioned route
+(``pallas_ec.cached_compiled(..., donate=...)``) also makes the
+program AOT-loadable from the ``.palexe`` cache.  Suppress with
+``# lint: ok(device-sync)`` where donation is genuinely wrong (e.g.
+an operand reused by a later launch).
+
 ``ops/staging`` additionally gets a MODULE-WIDE pass: that module is
 the flush pipeline's overlap window (its whole point is to run
 marshalling + non-blocking ``device_put`` dispatch while the caller's
@@ -144,6 +155,7 @@ class DeviceSyncRule(Rule):
         out: List[Violation] = []
         if ctx.relpath.startswith("ops/staging"):
             out.extend(self._check_overlap_module(ctx))
+        out.extend(self._check_donation(ctx))
         wrapped = _jit_wrapped_names(ctx.tree)
         smapped = _shard_map_wrapped_names(ctx.tree)
         for fn in ast.walk(ctx.tree):
@@ -156,6 +168,71 @@ class DeviceSyncRule(Rule):
             elif _decorated_jit(fn) or fn.name in wrapped:
                 out.extend(self._check_jit_body(ctx, fn))
         return out
+
+    def _check_donation(self, ctx: FileContext) -> List[Violation]:
+        """Donation pass (the AOT/donation PR's gated property): a
+        flush-path function that SHIPS staged buffers (calls
+        ``jax.device_put``, leases pool buffers, or submits staging
+        tasks) and then wraps a program with bare ``jax.jit(...)``
+        without ``donate_argnums`` keeps two device copies of every
+        large staged operand alive across the launch — the runtime
+        could have reused the input allocation for the output.  Route
+        such programs through ``pallas_ec.cached_compiled(...,
+        donate=...)`` (which also makes them AOT-loadable) or pass
+        ``donate_argnums`` explicitly; genuinely non-donatable sites
+        say why with ``# lint: ok(device-sync)``.  Functions that
+        never touch staged buffers (CPU-fallback jit wrappers, shape
+        probes) are out of scope by construction."""
+        out: List[Violation] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not self._ships_staged(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted_name(node.func) not in _JIT_NAMES:
+                    continue
+                if any(
+                    kw.arg == "donate_argnums" for kw in node.keywords
+                ):
+                    continue
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        "jax.jit without donate_argnums in a function "
+                        "shipping staged buffers — donate the lease-backed "
+                        "operands (or use pallas_ec.cached_compiled(..., "
+                        "donate=...)) so the runtime reuses the input "
+                        "allocation",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _ships_staged(fn: ast.AST) -> bool:
+        """Does this function start staged transfers?  Markers: a
+        ``jax.device_put`` call, a ``.get(...)`` on a lease, or a
+        ``staging.…`` call (stager submit / buffer pool)."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in ("jax.device_put", "device_put"):
+                return True
+            if name and (
+                name.startswith("staging.") or ".stager" in name
+            ):
+                return True
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and "lease" in ast.dump(node.func.value).lower()
+            ):
+                return True
+        return False
 
     def _check_overlap_module(self, ctx: FileContext) -> List[Violation]:
         """``ops/staging`` is an overlap window, not a jit body: every

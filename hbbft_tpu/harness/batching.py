@@ -452,6 +452,10 @@ class BatchingBackend:
             # its transfer + kernel with the host G2 MSMs below
             _t0 = _time.perf_counter()
             agg_share_fin = self.g1_msm_async(all_shares, all_coeffs)
+            # double-buffered finalize: the materializing fetch runs on
+            # its own drain thread, overlapping the G2 MSMs below and —
+            # under the epoch pipeline — the NEXT flush's launch
+            getattr(agg_share_fin, "start_drain", lambda: None)()
             ph["launch"] = _time.perf_counter() - _t0
             _t0 = _time.perf_counter()
             pairs = []
@@ -535,6 +539,10 @@ class BatchingBackend:
         agg_share_fin = self.g1_msm_product_async(
             shipped, all_s, group_ts, group_sizes
         )
+        # double-buffered finalize (ProductFinalizer.start_drain): the
+        # host Pippenger tail + device drain run on their own thread,
+        # overlapping the G2 MSMs below and the next flush's launch
+        getattr(agg_share_fin, "start_drain", lambda: None)()
         ph["launch"] = _time.perf_counter() - _t0
         _t0 = _time.perf_counter()
         pairs = []

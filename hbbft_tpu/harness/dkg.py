@@ -565,7 +565,17 @@ class VectorizedDkg:
         digest = jnp.zeros((), jnp.int32)
         with _obs.span("dkg.dealing", n=n, threshold=t, engine="device"):
             if coeffs is None:
-                run_step = jax.jit(step_sampled)
+                # exec-cache route, donating the chained accumulators
+                # (each step's outputs replace its inputs in place):
+                # AOT-loadable and donation-clean under the device-sync
+                # lint's donation pass
+                def run_step(key, sa, ra, dg):
+                    from ..ops import pallas_ec
+
+                    return pallas_ec.cached_compiled(
+                        "dkg_deal_sampled", step_sampled, key, sa, ra,
+                        dg, donate=(1, 2, 3),
+                    )
                 # chain 8×32 bits of caller entropy into the threefry key
                 # (a bare PRNGKey(getrandbits(63)) capped the whole era's
                 # key material at 63 bits of seed entropy — ADVICE r4 #1).
@@ -583,7 +593,16 @@ class VectorizedDkg:
                         keys[d], share_acc, row0_acc, digest
                     )
             else:
-                run_step = jax.jit(grids)
+                # exec-cache route: donate the staged coefficient
+                # matrix (consumed once per dealer) and the chained
+                # accumulators
+                def run_step(c_limbs, sa, ra, dg):
+                    from ..ops import pallas_ec
+
+                    return pallas_ec.cached_compiled(
+                        "dkg_deal_grids", grids, c_limbs, sa, ra, dg,
+                        donate=(0, 1, 2, 3),
+                    )
                 # staged matrix uploads (the flush pipeline's FIFO +
                 # buffer pool, ops/staging.py): dealer d+1's limb
                 # marshal + device_put runs on the worker while dealer

@@ -1046,6 +1046,9 @@ class VectorizedAgreement:
         # MSMs below (same pattern as the fused flush, batching.py)
         if hasattr(ops, "g1_msm_async"):
             agg_share_fin = ops.g1_msm_async(shares, coeffs)
+            # drain on its own thread so the fetch overlaps the host
+            # G2 MSMs below (double-buffered finalize)
+            getattr(agg_share_fin, "start_drain", lambda: None)()
         else:
             agg_share = ops.g1_msm(shares, coeffs)
             agg_share_fin = lambda: agg_share  # noqa: E731

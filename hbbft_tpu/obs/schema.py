@@ -61,6 +61,10 @@ EVENTS: Dict[str, EventSpec] = {
         {"occupancy", "dur", "groups", "fallback_groups", "phases"},
     ),
     "device_op": _spec({"op", "k", "engine"}),
+    # one XLA/Mosaic compile paid by the executable cache (a primed
+    # ``.palexe`` cache run emits ZERO of these — the AOT acceptance
+    # gate greps the trace for them)
+    "compile": _spec({"name", "key", "wall"}),
     # fault attribution
     "fault": _spec({"fault", "node", "kind"}),
     # real TCP mesh wire plane (additive)

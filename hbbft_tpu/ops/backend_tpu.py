@@ -104,11 +104,9 @@ class TpuBackend(CpuBackend):
         # runs DKG/setup — the first flush then skips the per-
         # executable load wall that dominated the r05 cold flush
         try:
-            import jax
+            from . import packed_msm, pallas_ec
 
-            from . import packed_msm
-
-            if jax.default_backend() == "tpu":
+            if pallas_ec.exec_cache_active():
                 packed_msm.start_background_prewarm()
         except Exception:
             pass  # prewarm is an optimization; never block construction
@@ -282,15 +280,26 @@ class TpuBackend(CpuBackend):
         when the shape has no warm executables (cold Mosaic compiles
         are minutes each; the caller falls back to the host path, and
         warming entry points — ``HBBFT_TPU_WARM=1`` — compile new
-        shapes).  On real TPU this is the packed-wire path
-        (``ops/packed_msm.py``); on CPU (tests, interpret mode) the
+        shapes).  On real TPU — or any backend running the AOT
+        executable cache (``HBBFT_TPU_AOT=1``) — this is the
+        packed-wire path (``ops/packed_msm.py``), whose per-chunk
+        executables load from ``.palexe`` instead of paying the
+        module-level ``ec_jax.g1_msm`` XLA compile (minutes cold on
+        CPU — the r05 wall).  On plain CPU (tests, interpret mode) the
         XLA limb path keeps its fast compiles."""
         import jax
 
-        if jax.default_backend() == "tpu":
+        from . import pallas_ec
+
+        if jax.default_backend() == "tpu" or pallas_ec.exec_cache_active():
             from . import packed_msm
 
-            return packed_msm.g1_msm_packed_async(points, scalars)
+            fin = packed_msm.g1_msm_packed_async(points, scalars)
+            if fin is None:
+                return None
+            # uniform finalizer protocol (ready/poll/start_drain) for
+            # the epoch driver's drain overlap
+            return packed_msm.ProductFinalizer(fin)
         result = ec_jax.g1_msm(points, scalars)
         return _backend.EagerFinalizer(result)
 
@@ -364,7 +373,12 @@ class TpuBackend(CpuBackend):
         ):
             import jax
 
-            if jax.default_backend() == "tpu":
+            from . import pallas_ec
+
+            if (
+                jax.default_backend() == "tpu"
+                or pallas_ec.exec_cache_active()
+            ):
                 from . import packed_msm
 
                 return packed_msm.ship_points(points, group_sizes)
@@ -405,7 +419,12 @@ class TpuBackend(CpuBackend):
         ):
             import jax
 
-            if jax.default_backend() == "tpu":
+            from . import pallas_ec
+
+            if (
+                jax.default_backend() == "tpu"
+                or pallas_ec.exec_cache_active()
+            ):
                 fin = packed_msm.g1_msm_product_async(
                     points, s_coeffs, t_coeffs, group_sizes
                 )

@@ -73,6 +73,26 @@ def _allow_compile() -> bool:
     return os.environ.get("HBBFT_TPU_WARM", "0") == "1"
 
 
+def _product_engine() -> str:
+    """The device engine a product/flat flush uses on THIS backend:
+
+    - ``"pallas"`` — real TPU: the cached windowed Pallas kernel.
+    - ``"xla"`` — the executable cache is the compile authority but
+      there is no TPU (CPU AOT runs, ``HBBFT_TPU_AOT=1``): fused XLA
+      programs through the same ``.palexe`` cache + cold-guard, so a
+      restarted CPU host gets the identical never-compile-on-the-flush
+      property (the r05-class multi-minute XLA scan compiles were the
+      CPU cold wall).
+    - ``"interp"`` — plain CPU (tests, default): eager jit paths,
+      behavior unchanged from before the AOT work.
+    """
+    if jax.default_backend() == "tpu":
+        return "pallas"
+    if pallas_ec.exec_cache_active():
+        return "xla"
+    return "interp"
+
+
 def _tree_parts(kp: int, g2: bool = False):
     """The executable-cache keys the tree reduction will need — one
     home for both groups (the shapes differ only in the Fq2 axis and
@@ -89,13 +109,27 @@ def _tree_parts(kp: int, g2: bool = False):
     ]
 
 
-def _flat_ready(kp: int, nb: int, g2: bool = False) -> bool:
-    """All executables of one flat packed chunk are warm (G1 or G2 —
-    the guard keys mirror exactly what the device path will build, so
-    the two groups share one home and cannot drift separately)."""
+def _flat_exec_keys(
+    kp: int, nb: int, g2: bool = False, engine: str = "pallas"
+):
+    """The ``(name, key_parts)`` of every executable one flat packed
+    chunk needs on the given engine (G1 or G2 — the guard keys mirror
+    exactly what the device path will build, so the two groups share
+    one home and cannot drift separately).  The XLA engine fuses
+    unpack + scalar-mul + tree into ONE cached program per chunk shape;
+    interpret mode needs none."""
     L = LB.FQ_LIMBS
     T = pallas_ec.TILE
     G = kp // T
+    if engine == "xla":
+        return [
+            (
+                "flat_g2_xla" if g2 else "flat_g1_xla",
+                (((kp, 192 if g2 else 96), "uint8"), ((kp, nb), "uint8")),
+            )
+        ]
+    if engine != "pallas":
+        return []
     if g2:
         checks = [
             ("unpack_g2_v1", (((kp, 192), "uint8"), ((kp, nb), "uint8"))),
@@ -106,39 +140,65 @@ def _flat_ready(kp: int, nb: int, g2: bool = False) -> bool:
             ("unpack_g1_v1", (((kp, 96), "uint8"), ((kp, nb), "uint8"))),
             ("win_g1", ((G, 3, L, T), (G, nb * 2, T))),
         ]
-    checks += _tree_parts(kp, g2)
-    return all(pallas_ec.exec_available(n, p) for n, p in checks)
+    return checks + _tree_parts(kp, g2)
 
 
-def _product_exec_keys(kd: int, n_groups: int, compressed: bool):
+def _flat_ready(
+    kp: int, nb: int, g2: bool = False, engine: str = "pallas"
+) -> bool:
+    """All executables of one flat packed chunk are warm."""
+    return all(
+        pallas_ec.exec_available(n, p)
+        for n, p in _flat_exec_keys(kp, nb, g2, engine)
+    )
+
+
+def _product_exec_keys(
+    kd: int, n_groups: int, compressed: bool, engine: str = "pallas"
+):
     """The ``(name, key_parts)`` of every executable ONE
     factored-product device chunk needs — the ONE home shared by the
     warm-routing guard (:func:`_product_ready`) and the warm-start
-    prewarmer (:func:`prewarm_shapes`), so what the prewarmer loads can
+    prewarmer (:func:`prewarm_plan`), so what the prewarmer loads can
     never drift from what routing requires.
 
-    ``kd`` is the chunk's true point count (``n_groups`` × group size);
-    the transfer/unpack/kernel run on the bucket-padded ``kp`` rows and
-    the padding is sliced off before the per-group tree, so the tree's
-    executable is keyed on the exact ``kd``."""
+    ``kd`` is the chunk's true point count (``n_groups`` × group size).
+    The v2 unpack programs are keyed on the EXACT ``kd`` — the tunnel
+    ships kd rows and the bucket padding to ``kp`` happens on device
+    inside the unpack program (the v1 programs padded on host); the
+    key space stays bounded because ``_split_plan`` quantizes kd.  The
+    XLA engine (CPU AOT) fuses unpack + scalar-mul + group-tree into
+    ONE cached program per chunk; interpret mode needs none."""
     L = LB.FQ_LIMBS
     T = pallas_ec.TILE
     kp = _bucket_rows(kd)
     G = kp // T
     nb = _S_BITS // 8
+    if engine == "xla":
+        # the XLA engine always ships the uncompressed 96-byte wire:
+        # the compressed path's on-device sqrt exists to trade tunnel
+        # bytes for TPU compute, a trade that has no meaning on-host
+        return [
+            (
+                "prod_g1_xla_%d" % n_groups,
+                (((kd, 96), "uint8"), ((kd, nb), "uint8")),
+            )
+        ]
+    if engine != "pallas":
+        return []
     if compressed:
         unpack = (
-            "unpack_g1c_v1",
+            "unpack_g1c_v2",
             (
-                ((kp, 48), "uint8"),
-                ((2, kp // 8), "uint8"),
-                ((kp, nb), "uint8"),
+                ((kd, 48), "uint8"),
+                ((kd,), "uint8"),
+                ((kd, nb), "uint8"),
             ),
         )
     else:
         unpack = (
-            "unpack_g1_v1",
-            (((kp, 96), "uint8"), ((kp, nb), "uint8")),
+            "unpack_g1_v2",
+            (((kd, 96), "uint8"), ((kd, nb), "uint8")),
         )
     return [
         unpack,
@@ -147,11 +207,13 @@ def _product_exec_keys(kd: int, n_groups: int, compressed: bool):
     ]
 
 
-def _product_ready(kd: int, n_groups: int, compressed: bool) -> bool:
+def _product_ready(
+    kd: int, n_groups: int, compressed: bool, engine: str = "pallas"
+) -> bool:
     """All executables of ONE factored-product device chunk are warm."""
     return all(
         pallas_ec.exec_available(n, p)
-        for n, p in _product_exec_keys(kd, n_groups, compressed)
+        for n, p in _product_exec_keys(kd, n_groups, compressed, engine)
     )
 
 
@@ -292,28 +354,27 @@ def _sqrt_chain(w: jnp.ndarray) -> jnp.ndarray:
     return acc
 
 
-def _unpack_fn_compressed(
-    x_u8: jnp.ndarray, meta_u8: jnp.ndarray, sc_u8: jnp.ndarray
+def _unpack_compressed_core(
+    x_u8: jnp.ndarray,
+    parity: jnp.ndarray,
+    ident: jnp.ndarray,
+    sc_u8: jnp.ndarray,
 ):
-    """Compressed-wire unpack: [Kp, 48] x-bytes + [2, Kp/8] packed
-    meta bits (row 0: y parity, row 1: infinity/padding flag) +
-    [Kp, nb] scalar bytes → the kernel's (pts_t, dig_t) layout.
+    """Shared body of the compressed unpack programs: [Kp, 48] x-bytes
+    + [Kp] parity bits + [Kp] identity mask + [Kp, nb] scalar bytes →
+    the kernel's (pts_t, dig_t) layout.
 
     y is RECOVERED on device (y = sqrt(x³+4), sign-corrected against
-    the parity bit) — the tunnel ships 48+¼ bytes per point instead of
+    the parity bit) — the tunnel ships ~49 bytes per point instead of
     96, and the sqrt chain costs a fraction of the windowed kernel's
     scan (measured r4).  Only points this process serialized itself
     are shipped compressed (always on-curve), so the root always
     exists."""
     L = LB.FQ_LIMBS
     f = LB.fq()
-    Kp = x_u8.shape[0]
 
     xb = _bytes_to_bits_msb(x_u8.astype(jnp.int32))  # [Kp, 384]
     xl = _le_bits_to_limbs(jnp.flip(xb, axis=1))
-    meta_bits = _bytes_to_bits_msb(meta_u8.astype(jnp.int32))  # [2, Kp]
-    parity = meta_bits[0, :Kp]
-    ident = meta_bits[1, :Kp].astype(bool)
 
     four = jnp.zeros((L,), jnp.int32).at[0].set(4)
     w = f.add(f.mul(f.mul(xl, xl), xl), four[None, :])
@@ -326,18 +387,74 @@ def _unpack_fn_compressed(
     return _tile_layout(pts, _scalar_digits(sc_u8))
 
 
+def _unpack_fn_compressed(
+    x_u8: jnp.ndarray, meta_u8: jnp.ndarray, sc_u8: jnp.ndarray
+):
+    """v1 compressed-wire unpack: [Kp, 48] x-bytes (HOST-padded to the
+    tile bucket) + [2, Kp/8] packed meta bits (row 0: y parity, row 1:
+    infinity/padding flag) + [Kp, nb] scalar bytes."""
+    Kp = x_u8.shape[0]
+    meta_bits = _bytes_to_bits_msb(meta_u8.astype(jnp.int32))  # [2, Kp]
+    parity = meta_bits[0, :Kp]
+    ident = meta_bits[1, :Kp].astype(bool)
+    return _unpack_compressed_core(x_u8, parity, ident, sc_u8)
+
+
+def _unpack_fn_compressed_v2(
+    x_u8: jnp.ndarray, meta_u8: jnp.ndarray, sc_u8: jnp.ndarray
+):
+    """v2 compressed-wire unpack: EXACT [kd, 48] x-bytes + [kd] meta
+    bytes (bit 0: y parity, bit 1: infinity flag) + [kd, nb] scalar
+    bytes.  The tile-bucket padding happens HERE, on device — the
+    tunnel carries only real rows, and the host marshal is one column
+    copy plus one vectorized meta-byte expression (the remaining
+    byte-wrangling of the v1 ``compress_rows`` — pad buffers, packbits
+    — moved into this program)."""
+    kd = x_u8.shape[0]
+    kp = _bucket_rows(kd)
+    x_u8 = jnp.pad(x_u8, ((0, kp - kd), (0, 0)))
+    # pad meta = 2: the infinity flag, so pad rows become the identity
+    meta = jnp.pad(
+        meta_u8.astype(jnp.int32), (0, kp - kd), constant_values=2
+    )
+    sc_u8 = jnp.pad(sc_u8, ((0, kp - kd), (0, 0)))
+    parity = jnp.bitwise_and(meta, 1)
+    ident = jnp.bitwise_and(jnp.right_shift(meta, 1), 1).astype(bool)
+    return _unpack_compressed_core(x_u8, parity, ident, sc_u8)
+
+
 def _unpack_fn(pts_u8: jnp.ndarray, sc_u8: jnp.ndarray):
     """[Kp, 96] u8 + [Kp, nb] u8 → (pts_t [G, 3, L, T], dig_t [G, nwin, T]).
 
     All-zero point rows (the ``native.g1_wire`` infinity encoding, and
     the bucket padding) become the projective identity (0 : 1 : 0).
     """
-    b = _bytes_to_bits_msb(pts_u8.astype(jnp.int32))  # [Kp, 768]
+    pts = _wire_points_g1(pts_u8)
+    return _tile_layout(pts, _scalar_digits(sc_u8))
+
+
+def _wire_points_g1(pts_u8: jnp.ndarray) -> jnp.ndarray:
+    """[K, 96] u8 wires → [K, 3, L] projective point limbs (all-zero
+    rows → identity) — the unpack math shared by the tile-layout
+    programs, the mesh shard body, and the fused XLA engine."""
+    b = _bytes_to_bits_msb(pts_u8.astype(jnp.int32))  # [K, 768]
     xl = _le_bits_to_limbs(jnp.flip(b[:, :384], axis=1))
     yl = _le_bits_to_limbs(jnp.flip(b[:, 384:], axis=1))
     ident = jnp.all(pts_u8 == 0, axis=1)
-    pts = _assemble_points(xl, yl, ident)
-    return _tile_layout(pts, _scalar_digits(sc_u8))
+    return _assemble_points(xl, yl, ident)
+
+
+def _unpack_fn_v2(pts_u8: jnp.ndarray, sc_u8: jnp.ndarray):
+    """v2 uncompressed unpack: EXACT [kd, 96] wire rows + [kd, nb]
+    scalar bytes, tile-bucket padding ON DEVICE (a zero wire row is
+    the infinity encoding and a zero scalar contributes nothing, so
+    zero-padding is absorbing by construction).  Kills the host-side
+    pad-buffer copy of the v1 marshal: ``ship`` is the raw transfer."""
+    kd = pts_u8.shape[0]
+    kp = _bucket_rows(kd)
+    pts_u8 = jnp.pad(pts_u8, ((0, kp - kd), (0, 0)))
+    sc_u8 = jnp.pad(sc_u8, ((0, kp - kd), (0, 0)))
+    return _unpack_fn(pts_u8, sc_u8)
 
 
 @functools.lru_cache(maxsize=None)
@@ -364,6 +481,95 @@ def _unpack_compressed_device(dev_x, dev_meta, dev_sc):
             "unpack_g1c_v1", _unpack_fn_compressed, dev_x, dev_meta, dev_sc
         )
     return _unpack_compressed_jit()(dev_x, dev_meta, dev_sc)
+
+
+@functools.lru_cache(maxsize=None)
+def _unpack_jit_v2():
+    return jax.jit(_unpack_fn_v2)
+
+
+def _unpack_device_v2(dev_pts, dev_sc):
+    """The product flush's uncompressed unpack (exact rows, device-side
+    pad).  Donates the staged wire/scalar buffers: the unpack consumes
+    them in one pass and the lease protocol guarantees the host never
+    touches them again before ``retire()``."""
+    if jax.default_backend() == "tpu":
+        return pallas_ec.cached_compiled(
+            "unpack_g1_v2", _unpack_fn_v2, dev_pts, dev_sc, donate=(0, 1)
+        )
+    return _unpack_jit_v2()(dev_pts, dev_sc)
+
+
+@functools.lru_cache(maxsize=None)
+def _unpack_compressed_jit_v2():
+    return jax.jit(_unpack_fn_compressed_v2)
+
+
+def _unpack_compressed_device_v2(dev_x, dev_meta, dev_sc):
+    if jax.default_backend() == "tpu":
+        return pallas_ec.cached_compiled(
+            "unpack_g1c_v2",
+            _unpack_fn_compressed_v2,
+            dev_x,
+            dev_meta,
+            dev_sc,
+            donate=(0, 1, 2),
+        )
+    return _unpack_compressed_jit_v2()(dev_x, dev_meta, dev_sc)
+
+
+# ---------------------------------------------------------------------------
+# Fused XLA engine programs (CPU AOT, HBBFT_TPU_AOT=1) — one cached
+# executable per chunk shape, so a restarted CPU host never compiles
+# on the flush path either (the multi-minute XLA scan compile of
+# ``ec_jax.g1_msm_device`` was the measured r05-class CPU cold wall).
+# ---------------------------------------------------------------------------
+
+
+def _prod_xla_fn(n_groups: int):
+    """Build the fused product-chunk program: [kd, 96] wires +
+    [kd, nb] scalars → [n_groups, 3, L] group sums (unpack →
+    bit-serial scalar-mul scan → per-group tree, one program)."""
+    from . import ec_jax
+
+    def fn(pts_u8, sc_u8):
+        pts = _wire_points_g1(pts_u8)
+        bits = _bytes_to_bits_msb(sc_u8.astype(jnp.int32))
+        prods = ec_jax.g1_kernel().scalar_mul(pts, bits)
+        return _group_tree(prods, n_groups)
+
+    return fn
+
+
+def _flat_xla_fn(g2: bool):
+    """Build the fused flat-chunk program: [kp, 96|192] wires +
+    [kp, nb] scalars → one [3, (2,) L] partial sum."""
+    from . import ec_jax
+
+    def fn(pts_u8, sc_u8):
+        if g2:
+            pts = _wire_points_g2(pts_u8)
+            kern = ec_jax.g2_kernel()
+        else:
+            pts = _wire_points_g1(pts_u8)
+            kern = ec_jax.g1_kernel()
+        bits = _bytes_to_bits_msb(sc_u8.astype(jnp.int32))
+        return kern.msm(pts, bits)
+
+    return fn
+
+
+def _flat_msm_xla(pts_u8: np.ndarray, sc_u8: np.ndarray, g2: bool):
+    """One flat chunk through the fused XLA engine (cached)."""
+    dev_pts = jax.device_put(pts_u8)
+    dev_sc = jax.device_put(sc_u8)
+    return pallas_ec.cached_compiled(
+        "flat_g2_xla" if g2 else "flat_g1_xla",
+        _flat_xla_fn(g2),
+        dev_pts,
+        dev_sc,
+        donate=(0, 1),
+    )
 
 
 def _msm_chunk_device(pts_u8, sc_u8, interpret: bool):
@@ -401,15 +607,18 @@ def g1_msm_packed_async(
     if not points:
         return lambda: G1.infinity()
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        engine = _product_engine()
+    else:
+        engine = "interp" if interpret else "pallas"
+    interpret = engine != "pallas"
     w = ec_jax._width(scalars, nbits)
     nb = -(-w // 8)
     k = len(points)
-    if not interpret and not _allow_compile():
+    if engine != "interp" and not _allow_compile():
         # cold-compile guard: every chunk shape must be warm
         for lo in range(0, k, _MAX_CHUNK):
             kc = min(_MAX_CHUNK, k - lo)
-            if not _flat_ready(_bucket_rows(kc), nb):
+            if not _flat_ready(_bucket_rows(kc), nb, engine=engine):
                 return None
     wires = g1_wires_batch(points)
     sc = scalar_bytes_batch(scalars, nb)
@@ -427,7 +636,12 @@ def g1_msm_packed_async(
             sc_chunk = np.concatenate(
                 [sc_chunk, np.zeros((kp - kc, nb), dtype=np.uint8)]
             )
-        partials.append(_msm_chunk_device(chunk, sc_chunk, interpret))
+        if engine != "interp":
+            record_flat_shape(kp, nb, g2=False)
+        if engine == "xla":
+            partials.append(_flat_msm_xla(chunk, sc_chunk, g2=False))
+        else:
+            partials.append(_msm_chunk_device(chunk, sc_chunk, interpret))
 
     def finalize():
         acc = ec_jax.g1_from_limbs(partials[0])
@@ -472,21 +686,26 @@ def g1_msm_packed(
 _MAX_CHUNK_G2 = 1 << 17
 
 
-def _unpack_fn_g2(pts_u8: jnp.ndarray, sc_u8: jnp.ndarray):
-    """[Kp, 192] u8 (x.c0‖x.c1‖y.c0‖y.c1, big-endian — exactly
-    ``native.g2_wire``) + [Kp, nb] u8 scalars → the G2 kernel's
-    ([G, 3, 2, L, T], [G, nwin, T]) layout; all-zero rows (infinity
-    encoding, chunk padding) become the projective identity via the
-    shared ``_assemble_points`` home."""
-    b = _bytes_to_bits_msb(pts_u8.astype(jnp.int32))  # [Kp, 1536]
+def _wire_points_g2(pts_u8: jnp.ndarray) -> jnp.ndarray:
+    """[K, 192] u8 wires (x.c0‖x.c1‖y.c0‖y.c1, big-endian — exactly
+    ``native.g2_wire``) → [K, 3, 2, L] projective point limbs;
+    all-zero rows (infinity encoding, chunk padding) become the
+    projective identity via the shared ``_assemble_points`` home."""
+    b = _bytes_to_bits_msb(pts_u8.astype(jnp.int32))  # [K, 1536]
     comps = [
         _le_bits_to_limbs(jnp.flip(b[:, i * 384 : (i + 1) * 384], axis=1))
         for i in range(4)
     ]
-    x = jnp.stack([comps[0], comps[1]], axis=1)  # [Kp, 2, L]
+    x = jnp.stack([comps[0], comps[1]], axis=1)  # [K, 2, L]
     y = jnp.stack([comps[2], comps[3]], axis=1)
     ident = jnp.all(pts_u8 == 0, axis=1)
-    pts = _assemble_points(x, y, ident)  # [Kp, 3, 2, L]
+    return _assemble_points(x, y, ident)
+
+
+def _unpack_fn_g2(pts_u8: jnp.ndarray, sc_u8: jnp.ndarray):
+    """[Kp, 192] u8 + [Kp, nb] u8 scalars → the G2 kernel's
+    ([G, 3, 2, L, T], [G, nwin, T]) layout."""
+    pts = _wire_points_g2(pts_u8)  # [Kp, 3, 2, L]
     return _tile_layout(pts, _scalar_digits(sc_u8))
 
 
@@ -521,12 +740,15 @@ def g2_msm_packed_wires_async(
     if k == 0:
         return lambda: b"\x00" * 192
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        engine = _product_engine()
+    else:
+        engine = "interp" if interpret else "pallas"
+    interpret = engine != "pallas"
     nb = -(-nbits // 8)
-    if not interpret and not _allow_compile():
+    if engine != "interp" and not _allow_compile():
         for lo in range(0, k, _MAX_CHUNK_G2):
             kc = min(_MAX_CHUNK_G2, k - lo)
-            if not _flat_ready(_bucket_rows(kc), nb, g2=True):
+            if not _flat_ready(_bucket_rows(kc), nb, g2=True, engine=engine):
                 return None
     pts_u8 = np.frombuffer(b"".join(wires), dtype=np.uint8).reshape(
         k, 192
@@ -546,6 +768,11 @@ def g2_msm_packed_wires_async(
             sc_chunk = np.concatenate(
                 [sc_chunk, np.zeros((kp - kc, nb), dtype=np.uint8)]
             )
+        if engine != "interp":
+            record_flat_shape(kp, nb, g2=True)
+        if engine == "xla":
+            partials.append(_flat_msm_xla(chunk, sc_chunk, g2=True))
+            continue
         dev_pts = jax.device_put(chunk)
         dev_sc = jax.device_put(sc_chunk)
         pts_t, dig_t = _unpack_g2_device(dev_pts, dev_sc)
@@ -964,15 +1191,14 @@ def _split_plan(k: int, n_groups: int) -> List[int]:
         return []
     # pack the m quanta into the fewest available chunks, largest-first
     sizes = []
-    check_warm = (
-        jax.default_backend() == "tpu" and not _allow_compile()
-    )
-    compressed = _use_compressed() and jax.default_backend() == "tpu"
+    engine = _product_engine()
+    check_warm = engine != "interp" and not _allow_compile()
+    compressed = _use_compressed() and engine == "pallas"
     for mult in _CHUNK_LADDER:
         c = q * mult
         if c > cap or c > m * q:
             continue
-        if check_warm and not _product_ready(c * n, c, compressed):
+        if check_warm and not _product_ready(c * n, c, compressed, engine):
             continue
         sizes.append(c)
     if not sizes:
@@ -1049,15 +1275,17 @@ def _mesh_exec_keys(n: int, g_dev: int, n_dev: int, engine: str):
 
 
 def _mesh_ready(n: int, g_dev: int, n_dev: int, engine: str) -> bool:
-    if engine != "pallas":
-        return True  # the XLA engine has no exec-cache gate
+    if engine != "pallas" and not pallas_ec.exec_cache_active():
+        return True  # plain-CPU XLA engine: no exec-cache gate
     return all(
         pallas_ec.exec_available(nm, p)
         for nm, p in _mesh_exec_keys(n, g_dev, n_dev, engine)
     )
 
 
-def _mesh_plan(k: int, n_groups: int, n_dev: int, engine: str) -> int:
+def _mesh_plan(
+    k: int, n_groups: int, n_dev: int, engine: str, assume_warm: bool = False
+) -> int:
     """How many LEADING groups of a uniform product flush run on the
     mesh (the rest host-side) — the mesh analogue of
     :func:`_split_plan`.  The device share is ONE sharded launch; the
@@ -1065,7 +1293,10 @@ def _mesh_plan(k: int, n_groups: int, n_dev: int, engine: str) -> int:
     RPCs, which the sharded transfer pays exactly once.  The rho
     controller's balance is learned per device count
     (``_shape_key(..., mesh_dev)``); the per-SHARD group tree must stay
-    within the proven ``_MAX_GTREE`` row scale.  0 = no mesh share."""
+    within the proven ``_MAX_GTREE`` row scale.  0 = no mesh share.
+    ``assume_warm`` skips the cold-executable guard — the prewarm plan
+    enumerates what routing WILL demand once warm, so it must see the
+    pick even before the first ``.palexe`` lands on disk."""
     if n_groups <= 0 or k % n_groups:
         return 0
     n = k // n_groups
@@ -1087,7 +1318,7 @@ def _mesh_plan(k: int, n_groups: int, n_dev: int, engine: str) -> int:
         if hage >= _HOST_PROBE_IV:
             g_dev -= 1
     if (
-        engine == "pallas"
+        not assume_warm
         and not _allow_compile()
         and not _mesh_ready(n, g_dev, n_dev, engine)
     ):
@@ -1159,20 +1390,33 @@ def _warm_shapes_path() -> str:
     return os.path.join(pallas_ec._exec_cache_dir(), "warm_shapes.json")
 
 
-def _load_warm_shapes() -> dict:
-    """``{"n:n_groups": {"compressed": bool, "mesh": [n_dev, …]}}`` —
+# warm_shapes.json schema: version 2 wraps the per-shape dict in
+# {"version": 2, "shapes": {...}, "flat": [[kp, nb, "g1"|"g2"], ...]}
+# so the flat MSM paths (batch_verify_shares, DKG G2) prewarm too.
+# Version-1 files (a bare shapes dict) load transparently; entries
+# whose key/format predates the PR-7 mesh keys parse per-entry
+# tolerant and are PRUNED on the next rewrite (stale keys used to
+# linger forever and bloat the prewarm plan).
+_WARM_SCHEMA = 2
+
+
+def _load_warm_file() -> dict:
+    """The full warm-shapes document, normalized to the v2 schema —
     per-entry tolerant, like ``_rho_state`` (one malformed entry must
-    not drop the rest).  ``mesh`` lists the device counts whose sharded
-    executables this shape has shipped on (empty = single-device only)."""
+    not drop the rest; a malformed entry is also GONE after the next
+    ``_write_warm``, which is the tolerate-and-prune half)."""
     import json
 
-    out: dict = {}
+    doc: dict = {"version": _WARM_SCHEMA, "shapes": {}, "flat": []}
     try:
         with open(_warm_shapes_path()) as fh:
             raw = json.load(fh)
     except Exception:
-        return out
-    for k, v in raw.items() if isinstance(raw, dict) else ():
+        return doc
+    if not isinstance(raw, dict):
+        return doc
+    shapes = raw.get("shapes") if "shapes" in raw else raw  # v1: bare dict
+    for k, v in shapes.items() if isinstance(shapes, dict) else ():
         try:
             n, g = (int(x) for x in str(k).split(":"))
             if n > 0 and g > 0:
@@ -1188,10 +1432,39 @@ def _load_warm_shapes() -> dict:
                 }
                 if mesh:  # absent = single-device only: the seed's
                     ent["mesh"] = sorted(set(mesh))  # format, unchanged
-                out["%d:%d" % (n, g)] = ent
+                doc["shapes"]["%d:%d" % (n, g)] = ent
         except (TypeError, ValueError):
             continue
-    return out
+    for ent in raw.get("flat") or ():
+        try:
+            kp, nb, grp = int(ent[0]), int(ent[1]), str(ent[2])
+            if kp > 0 and nb > 0 and grp in ("g1", "g2"):
+                row = [kp, nb, grp]
+                if row not in doc["flat"]:
+                    doc["flat"].append(row)
+        except (TypeError, ValueError, IndexError):
+            continue
+    return doc
+
+
+def _write_warm(doc: dict) -> None:
+    """Atomic v2-format rewrite (call under ``_STATE_LOCK``)."""
+    import json
+
+    doc = dict(doc)
+    doc["version"] = _WARM_SCHEMA
+    path = _warm_shapes_path()
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+
+
+def _load_warm_shapes() -> dict:
+    """``{"n:n_groups": {"compressed": bool, "mesh": [n_dev, …]}}`` —
+    the product-shape half of the warm file (the historical return
+    shape; flat shapes ride :func:`_load_warm_file`)."""
+    return _load_warm_file()["shapes"]
 
 
 def record_warm_shape(
@@ -1208,66 +1481,167 @@ def record_warm_shape(
     dedupe + read-merge-replace runs under ``_STATE_LOCK`` so two
     concurrent flushes can't interleave their merges and drop each
     other's entries."""
-    import json
-
     seen_key = ("%d:%d" % (n, n_groups), bool(compressed), int(mesh_dev))
     with _STATE_LOCK:
         if seen_key in _WARM_SEEN:
             return
         _WARM_SEEN.add(seen_key)
         try:
-            shapes = _load_warm_shapes()
-            ent = shapes.setdefault(seen_key[0], {"compressed": False})
+            doc = _load_warm_file()
+            ent = doc["shapes"].setdefault(seen_key[0], {"compressed": False})
             ent["compressed"] = bool(ent.get("compressed")) or bool(compressed)
             if mesh_dev > 1:
                 ent["mesh"] = sorted(set(ent.get("mesh") or []) | {mesh_dev})
-            path = _warm_shapes_path()
-            tmp = path + ".tmp.%d" % os.getpid()
-            with open(tmp, "w") as fh:
-                json.dump(shapes, fh)
-            os.replace(tmp, path)
+            _write_warm(doc)
         except Exception:
             pass
 
 
-def prewarm_shapes() -> int:
-    """Bring every recorded shape's executables disk → memory, WITHOUT
-    compiling (``pallas_ec.preload_exec``).  Each shape's chunk plan is
-    recomputed at the PERSISTED split (``device_fraction.json``) via
-    the same ``_split_plan`` routing uses, and the chunk → executable
-    mapping is the shared ``_product_exec_keys`` — so what the
-    prewarmer loads is exactly what the first flush will route, by
-    construction.  The uncompressed executables are always included
-    (the controller's periodic mode probe can flip a shape's transfer
-    mode at any flush).  Returns how many executables are warm in
-    memory afterwards; a missing ``.palexe`` simply stays cold and
-    routing falls back exactly as before."""
-    warm = 0
-    for skey, ent in sorted(_load_warm_shapes().items()):
+def record_flat_shape(kp: int, nb: int, g2: bool = False) -> None:
+    """Remember one FLAT chunk shape that shipped to the device
+    (``batch_verify_shares``/epoch aggregation G1, the DKG plane's G2)
+    so the prewarm plan covers it — flat shapes used to be invisible
+    to the prewarmer and recompiled cold every process (the CPU-AOT
+    cold wall's biggest term, and a real TPU restart's unpack/tree
+    reload wall)."""
+    seen_key = ("flat", int(kp), int(nb), bool(g2))
+    with _STATE_LOCK:
+        if seen_key in _WARM_SEEN:
+            return
+        _WARM_SEEN.add(seen_key)
+        try:
+            doc = _load_warm_file()
+            row = [int(kp), int(nb), "g2" if g2 else "g1"]
+            if row not in doc["flat"]:
+                doc["flat"].append(row)
+                _write_warm(doc)
+        except Exception:
+            pass
+
+
+def prewarm_plan() -> list:
+    """Every ``(name, key_parts)`` the recorded warm state implies for
+    the CURRENT backend — the ONE enumeration shared by
+    :func:`prewarm_shapes` (which preloads each entry and GCs the rest)
+    and the tier-1 completeness test (which asserts every shape the
+    epoch driver can emit appears here), so a future shape addition
+    that skips the plan fails a test instead of silently reintroducing
+    a cold compile.
+
+    Covers, per recorded product shape: the chunk plan at the
+    PERSISTED split (``device_fraction.json``) via the same
+    ``_split_plan`` routing uses, BOTH transfer modes when the shape
+    has probed compression (the controller's periodic mode probe can
+    flip at any flush), and the per-device-count mesh exec keys; plus
+    every recorded flat chunk shape (G1 and the DKG plane's G2)."""
+    engine = _product_engine()
+    doc = _load_warm_file()
+    keys: list = []
+    for skey, ent in sorted(doc["shapes"].items()):
         try:
             n, n_groups = (int(x) for x in skey.split(":"))
         except ValueError:
             continue
         plan = _split_plan(n * n_groups, n_groups)
-        modes = {False, bool(ent.get("compressed"))}
+        modes = (
+            {False, bool(ent.get("compressed"))}
+            if engine == "pallas"
+            else {False}
+        )
         for g in plan:
             for compressed in sorted(modes):
-                for name, parts in _product_exec_keys(
-                    g * n, g, compressed
-                ):
-                    if pallas_ec.preload_exec(name, parts):
-                        warm += 1
-        # mesh deployments: preload the per-device-count sharded
-        # executables at the g_dev the planner would pick today (the
-        # _mesh_exec_keys one home keeps this exactly what routing
-        # will require)
+                keys.extend(
+                    _product_exec_keys(g * n, g, compressed, engine)
+                )
+        # mesh deployments: the per-device-count sharded executables at
+        # the g_dev the planner would pick today (the _mesh_exec_keys
+        # one home keeps this exactly what routing will require)
+        m_engine = _mesh_engine()
         for n_dev in ent.get("mesh") or ():
-            g_dev = _mesh_plan(n * n_groups, n_groups, n_dev, "pallas")
+            g_dev = _mesh_plan(
+                n * n_groups, n_groups, n_dev, m_engine, assume_warm=True
+            )
             if not g_dev:
-                continue  # cold on disk too (or rho=0): nothing to load
-            for name, parts in _mesh_exec_keys(n, g_dev, n_dev, "pallas"):
-                if pallas_ec.preload_exec(name, parts):
-                    warm += 1
+                continue  # rho=0 or over tree scale: nothing routable
+            keys.extend(_mesh_exec_keys(n, g_dev, n_dev, m_engine))
+    for kp, nb, grp in doc["flat"]:
+        keys.extend(_flat_exec_keys(kp, nb, grp == "g2", engine))
+    seen: set = set()
+    out: list = []
+    for name, parts in keys:
+        if (name, parts) not in seen:
+            seen.add((name, parts))
+            out.append((name, parts))
+    return out
+
+
+# ``.palexe`` families OWNED by the prewarm plan — eligible for GC
+# when no longer reachable from it.  Shared families (win_*, tree_*,
+# scan_*) serve non-flush MSM paths too and are never touched.
+_GC_FAMILIES = (
+    "unpack_g1_v1-",
+    "unpack_g1_v2-",
+    "unpack_g1c_v1-",
+    "unpack_g1c_v2-",
+    "unpack_g2_v1-",
+    "prod_g1_xla_",
+    "flat_g1_xla-",
+    "flat_g2_xla-",
+    "mesh_prod_g1_",
+    "gtree_g1_",
+)
+
+
+def _gc_palexe(reachable_fnames) -> int:
+    """Garbage-collect ``.palexe`` files no longer reachable from the
+    prewarm plan (stale shapes, pre-PR-7 key formats, renamed
+    programs).  Deliberately narrow: only files whose key suffix
+    matches THIS process (jax version + device kind — other backends'
+    caches are not ours to judge) and whose name family the plan owns
+    (``_GC_FAMILIES``).  Best-effort; returns how many were removed."""
+    tail = (
+        "-".join(
+            str(p)
+            for p in (jax.__version__, jax.devices()[0].device_kind)
+        ).replace(" ", "").replace("/", "_")
+        + ".palexe"
+    )
+    reach = set(reachable_fnames)
+    removed = 0
+    try:
+        d = pallas_ec._exec_cache_dir()
+        for fname in os.listdir(d):
+            if not fname.endswith(tail) or fname in reach:
+                continue
+            if not fname.startswith(_GC_FAMILIES):
+                continue
+            try:
+                os.remove(os.path.join(d, fname))
+                removed += 1
+            except OSError:
+                pass
+    except Exception:
+        pass
+    return removed
+
+
+def prewarm_shapes() -> int:
+    """Bring every planned executable disk → memory, WITHOUT compiling
+    (``pallas_ec.preload_exec``), then GC the unreachable ``.palexe``
+    files of the plan-owned families.  The plan is
+    :func:`prewarm_plan` — exactly what the first flush will route, by
+    construction.  Returns how many executables are warm in memory
+    afterwards; a missing ``.palexe`` simply stays cold and routing
+    falls back exactly as before."""
+    warm = 0
+    reachable = []
+    for name, parts in prewarm_plan():
+        reachable.append(
+            pallas_ec._exec_fname(pallas_ec._exec_key(name, parts))
+        )
+        if pallas_ec.preload_exec(name, parts):
+            warm += 1
+    _gc_palexe(reachable)
     return warm
 
 
@@ -1360,20 +1734,24 @@ class ShippedPoints:
             # no mesh share (cold executable / rho=0): fall through to
             # the single-device plan below, which on a CPU mesh stays
             # empty (backend guard) — the flush runs host-side
-        if (
-            jax.default_backend() != "tpu"
-            or not uniform
-        ):
+        engine = _product_engine()
+        if engine == "interp" or not uniform:
             return
         n = k // len(group_sizes)
         plan = _split_plan(k, len(group_sizes))
         if not plan:
             return
         # transfer mode: measured per shape (controller "d" vs "dc"
-        # EMAs, periodic trial) unless HBBFT_TPU_COMPRESS pins it
-        self.compressed = _choose_compressed(n, len(group_sizes), plan)
+        # EMAs, periodic trial) unless HBBFT_TPU_COMPRESS pins it.
+        # The XLA engine always ships the 96-byte wire (its fused
+        # program unpacks uncompressed; compression is a TPU
+        # tunnel-bandwidth trade).
+        self.compressed = engine == "pallas" and _choose_compressed(
+            n, len(group_sizes), plan
+        )
         if not _allow_compile() and not all(
-            _product_ready(g * n, g, self.compressed) for g in plan
+            _product_ready(g * n, g, self.compressed, engine)
+            for g in plan
         ):
             return  # cold shapes — the flush will run host-side
         self.plan = plan
@@ -1391,8 +1769,7 @@ class ShippedPoints:
             for g in plan:
                 kd = g * n
                 dev, dev_meta = _put_chunk(
-                    wires[lo : lo + kd], kd, _bucket_rows(kd),
-                    compressed, lease,
+                    wires[lo : lo + kd], kd, compressed, lease
                 )
                 chunks.append((g, kd, dev, dev_meta))
                 lo += kd
@@ -1404,30 +1781,46 @@ class ShippedPoints:
 def _put_chunk(
     wires: np.ndarray,
     kd: int,
-    kp: int,
     compressed: bool,
     lease: Optional[staging.Lease] = None,
 ):
-    """Pad one device chunk's wires to the ``kp`` bucket and start its
-    transfer — (dev, dev_meta); the ONE home for the pad/compress/ship
-    step shared by the eager (``ShippedPoints``) and lazy
-    (``g1_msm_product_async`` fallback) marshalling paths.  With a
-    ``lease`` the pad buffer comes preallocated from the staging pool
+    """Start one device chunk's transfer — (dev, dev_meta); the ONE
+    home for the compress/ship step shared by the eager
+    (``ShippedPoints``) and lazy (``g1_msm_product_async`` fallback)
+    marshalling paths.  v2 wire discipline: the transfer carries
+    EXACTLY the ``kd`` live rows — bucket padding to ``kp`` happens ON
+    DEVICE inside the v2 unpack programs (``_unpack_fn_v2`` /
+    ``_unpack_fn_compressed_v2``), so the tunnel never ships padding
+    bytes and the host never touches a pad buffer.  With a ``lease``
+    the compressed x-block comes preallocated from the staging pool
     (retired by the finalizer once the device results materialize —
     i.e. once the transfer provably completed)."""
     if compressed:
-        x, meta = compress_rows(wires, kp, lease)
+        x, meta = compress_rows_v2(wires, lease)
         return jax.device_put(x), jax.device_put(meta)
-    if kp != kd:
-        if lease is not None:
-            buf = lease.get((kp, 96))
-            buf[:kd] = wires
-            wires = buf
-        else:
-            wires = np.concatenate(
-                [wires, np.zeros((kp - kd, 96), dtype=np.uint8)]
-            )
     return jax.device_put(wires), None
+
+
+def compress_rows_v2(
+    wires: np.ndarray, lease: Optional[staging.Lease] = None
+) -> tuple:
+    """[k, 96] wires → ([k, 48] x bytes, [k] meta bytes).  Meta bit 0
+    is y parity (last wire byte & 1), bit 1 the infinity flag
+    (all-zero wire — ``native.g1_wire``'s encoding).  Unlike the v1
+    ``compress_rows`` there is no bucket padding and no host packbits:
+    exact rows cross the tunnel and the device pads with meta value 2
+    (infinity) in ``_unpack_fn_compressed_v2``."""
+    k = wires.shape[0]
+    x = (
+        lease.get((k, 48))
+        if lease is not None
+        else np.empty((k, 48), dtype=np.uint8)
+    )
+    x[:] = wires[:, :48]
+    meta = (wires[:, 95] & 1) | (
+        (wires == 0).all(axis=1).astype(np.uint8) << 1
+    )
+    return x, meta
 
 
 def compress_rows(
@@ -1461,7 +1854,8 @@ def ship_points(
 
 
 class ProductFinalizer:
-    """Callable finalizer handle with a non-blocking readiness probe.
+    """Callable finalizer handle with a non-blocking readiness probe
+    and a double-buffering drain.
 
     ``fin()`` blocks exactly like the plain closure it replaces (host
     Pippenger tail, then the device drain); ``fin.ready()`` /
@@ -1469,21 +1863,59 @@ class ProductFinalizer:
     results have already materialized, so a driver can interleave
     other work (serializing the next round's obligations, the epoch
     pipeline's staging) until the drain completes instead of sitting
-    inside ``agg_share_fin()``.  Idempotent: the first call runs the
-    finalizer, later calls return the memoized result."""
+    inside ``agg_share_fin()``.
 
-    __slots__ = ("_fn", "_probe", "_done", "_result")
+    ``fin.start_drain()`` moves the whole finalizer body — host
+    Pippenger tail AND the materializing device fetch — onto a daemon
+    thread, so flush k's finalize overlaps flush k+1's launch instead
+    of serializing behind it (the r05 11.7 s cold ``finalize`` wall).
+    A later ``fin()`` just joins the drain.  Idempotent and memoizing
+    either way: the body runs exactly once; a failure re-raises at
+    EVERY subsequent call (same surfacing point as the synchronous
+    path, never swallowed by the thread)."""
+
+    __slots__ = ("_fn", "_probe", "_done", "_result", "_err", "_lock", "_drain")
 
     def __init__(self, fn: Callable[[], Any], probe: Optional[Callable[[], bool]] = None):
         self._fn = fn
         self._probe = probe
         self._done = False
         self._result: Any = None
+        self._err: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._drain: Optional[threading.Thread] = None
+
+    def _run(self):
+        # sole writer of the memo: _run executes only on the one drain
+        # thread start_drain creates under its lock, so two bodies can
+        # never race
+        try:
+            res = self._fn()
+        except BaseException as e:
+            self._err = e
+            self._done = True
+            return
+        self._result = res
+        self._done = True
+
+    def start_drain(self) -> "ProductFinalizer":
+        """Begin (or adopt) the background drain; returns self."""
+        with self._lock:
+            if self._done or self._drain is not None:
+                return self
+            th = threading.Thread(
+                target=self._run, name="hbbft-msm-drain", daemon=True
+            )
+            self._drain = th
+        th.start()
+        return self
 
     def __call__(self):
-        if not self._done:
-            self._result = self._fn()
-            self._done = True
+        th = self.start_drain()._drain
+        if th is not None:
+            th.join()
+        if self._err is not None:
+            raise self._err
         return self._result
 
     def ready(self) -> bool:
@@ -1571,7 +2003,12 @@ def g1_msm_product_async(
     if n * n_groups != k:
         return None
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        engine = _product_engine()
+    else:
+        # explicit override (tests, hardware smoke): True pins the
+        # interpreter, False pins the Pallas engine
+        engine = "interp" if interpret else "pallas"
+    interpret = engine != "pallas"
 
     mesh_dev = 0
     mesh_engine: Optional[str] = None
@@ -1609,14 +2046,15 @@ def g1_msm_product_async(
         plan = _split_plan(k, n_groups)
         if not plan:
             return None
-        compressed = not interpret and _choose_compressed(
+        compressed = engine == "pallas" and _choose_compressed(
             n, n_groups, plan
         )
         if (
-            not interpret
+            engine != "interp"
             and not _allow_compile()
             and not all(
-                _product_ready(g * n, g, compressed) for g in plan
+                _product_ready(g * n, g, compressed, engine)
+                for g in plan
             )
         ):
             return None
@@ -1633,7 +2071,7 @@ def g1_msm_product_async(
     host_pts = pts_list[k_dev:]
     lease = staging.buffers().lease()
 
-    if not interpret:
+    if engine != "interp":
         # this shape shipped a real device plan: remember it so the
         # next process can prewarm its executables during setup
         record_warm_shape(n, n_groups, compressed, mesh_dev=mesh_dev)
@@ -1685,23 +2123,37 @@ def g1_msm_product_async(
         gsums = []
         lo = 0
         for g, kd, dev, dev_meta in chunks:
-            kp = _bucket_rows(kd)
-            sc_chunk = sc[lo : lo + kd]
-            if kp != kd:
-                buf = lease.get((kp, nb))
-                buf[:kd] = sc_chunk
-                sc_chunk = buf
-            dev_sc = jax.device_put(sc_chunk)
+            # v2 wire discipline: EXACT kd scalar rows cross the
+            # tunnel too — the device unpack pads both operands to the
+            # kp bucket (zero scalar rows contribute identity)
+            dev_sc = jax.device_put(sc[lo : lo + kd])
             if dev is None:  # lazy marshalling (no ShippedPoints handle)
                 dev, dev_meta = _put_chunk(
                     g1_wires_batch(pts_list[lo : lo + kd]),
-                    kd, kp, compressed, lease,
+                    kd, compressed, lease,
                 )
+            if engine == "xla":
+                # ONE fused program per chunk: device-side unpack →
+                # scalar ladder → per-group trees, no tile round-trip
+                gsums.append(
+                    pallas_ec.cached_compiled(
+                        "prod_g1_xla_%d" % g,
+                        _prod_xla_fn(g),
+                        dev,
+                        dev_sc,
+                        donate=(0, 1),
+                    )
+                )
+                lo += kd
+                continue
+            kp = _bucket_rows(kd)
             # _put_chunk returns meta iff compressed, on both paths
             if dev_meta is not None:
-                pts_t, dig_t = _unpack_compressed_device(dev, dev_meta, dev_sc)
+                pts_t, dig_t = _unpack_compressed_device_v2(
+                    dev, dev_meta, dev_sc
+                )
             else:
-                pts_t, dig_t = _unpack_device(dev, dev_sc)
+                pts_t, dig_t = _unpack_device_v2(dev, dev_sc)
             out_t = pallas_ec._windowed_tiles(pts_t, dig_t, interpret)
             prods = pallas_ec._untile(out_t, kd, kp)  # slice the padding
             gsums.append(_group_tree_device(prods, g))
@@ -1767,7 +2219,7 @@ def g1_msm_product_async(
         t_dev = (waiter["t"] or time.perf_counter()) - (
             waiter["t_disp"] or t_call
         )
-        if not interpret and _env_fraction() is None:
+        if engine != "interp" and _env_fraction() is None:
             _adapt(
                 n,
                 n_groups,

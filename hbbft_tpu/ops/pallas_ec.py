@@ -396,6 +396,41 @@ def _exec_cache_dir() -> "str":
     return d
 
 
+def exec_cache_active() -> bool:
+    """Whether the executable disk cache is the compile authority for
+    this backend.  Always on for real TPU (Mosaic compiles cost
+    minutes).  On CPU/GPU it is OPT-IN via ``HBBFT_TPU_AOT=1``: the
+    XLA fall-back compiles there are seconds-to-minutes (the cold-flush
+    wall), so AOT-minded entry points (bench, the epoch driver on a
+    primed host) turn it on, while tests and casual use keep the plain
+    eager/jit paths and their behavior."""
+    import os
+
+    if jax.default_backend() == "tpu":
+        return True
+    return os.environ.get("HBBFT_TPU_AOT", "0") == "1"
+
+
+def _donate_supported() -> bool:
+    """Buffer donation is implemented by the TPU/GPU PJRT runtimes
+    only; jax on CPU warns and ignores ``donate_argnums``, so we skip
+    it there to keep traces/warnings clean."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def _emit_compile_event(name: str, key: tuple, wall: float) -> None:
+    try:
+        from ..obs import recorder as _obs
+
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "compile", name=name, key=_exec_fname(key), wall=round(wall, 6)
+            )
+    except Exception:
+        pass  # tracing must never break the compile path
+
+
 def _cached_tiles(name: str, kernel, pts_t, aux_t):
     """Run one tile program through the executable cache (TPU only —
     interpret mode and CPU use the plain jit path)."""
@@ -411,22 +446,37 @@ def _cached_tiles(name: str, kernel, pts_t, aux_t):
     return out
 
 
-def cached_compiled(name: str, fn, *args, key_parts=None):
+def cached_compiled(name: str, fn, *args, key_parts=None, donate=()):
     """Run ``jax.jit(fn)(*args)`` through the compiled-executable disk
     cache — the one home for the load/compile/serialize dance (used by
     the per-tile kernels via ``_cached_tiles`` and by programs that
     embed Pallas kernels inside larger jitted bodies, e.g. the
     shard_map'd mesh MSM).  ``key_parts`` overrides the shape part of
     the cache key (``_cached_tiles`` passes bare shapes to keep the
-    legacy ``.palexe`` filenames valid)."""
+    legacy ``.palexe`` filenames valid).  ``donate`` names argnums
+    whose buffers the program may consume in place — flush-path
+    callers pass their staged lease buffers here (safe because a lease
+    is donate-until-consumed: the host never reads the buffer again
+    until ``retire()`` recycles it).  Donation is applied only on
+    runtimes that implement it (TPU/GPU) and is deliberately NOT part
+    of the cache key: a donating and a non-donating call of the same
+    program compute the same function, and the flush path donates
+    consistently per name.  Every compile this function performs emits
+    a ``compile`` obs event — a primed AOT run must show zero."""
     import os
     import pickle
+    import time
 
     if key_parts is None:
         key_parts = tuple(
             (tuple(a.shape), str(getattr(a, "dtype", ""))) for a in args
         )
     key = _exec_key(name, key_parts)
+    jit_kw = (
+        {"donate_argnums": tuple(donate)}
+        if donate and _donate_supported()
+        else {}
+    )
 
     def exec_path() -> str:
         return os.path.join(_exec_cache_dir(), _exec_fname(key))
@@ -447,7 +497,9 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
                 except Exception:
                     loaded = None
             if loaded is None:
-                loaded = jax.jit(fn).lower(*args).compile()
+                t0 = time.perf_counter()
+                loaded = jax.jit(fn, **jit_kw).lower(*args).compile()
+                _emit_compile_event(name, key, time.perf_counter() - t0)
                 _save_exec(loaded, path)
             _EXEC_MEM[key] = loaded
     try:
@@ -456,7 +508,9 @@ def cached_compiled(name: str, fn, *args, key_parts=None):
         # a stale on-disk executable whose signature no longer matches
         # (e.g. serialized before the np-constant fix, when closed-over
         # jnp arrays were hidden const-inputs): recompile and replace
-        compiled = jax.jit(fn).lower(*args).compile()
+        t0 = time.perf_counter()
+        compiled = jax.jit(fn, **jit_kw).lower(*args).compile()
+        _emit_compile_event(name, key, time.perf_counter() - t0)
         with _EXEC_LOCK:
             _EXEC_MEM[key] = compiled
         _save_exec(compiled, exec_path())
@@ -734,8 +788,9 @@ def _tree_sum_exec(prods, g2: bool):
     hardware — its XLA compile at flush shapes is ~3 min on this host
     and does NOT land in a persistent cache, so every bench/epoch
     process used to repay it (measured r4); the serialized executable
-    reloads in ~1 s."""
-    if jax.default_backend() == "tpu":
+    reloads in ~1 s.  Routed by ``exec_cache_active`` — CPU AOT runs
+    (``HBBFT_TPU_AOT=1``) cache it too."""
+    if exec_cache_active():
         return cached_compiled(
             "tree_g2" if g2 else "tree_g1",
             _tree_sum_g2_fn if g2 else _tree_sum_g1_fn,

@@ -40,6 +40,20 @@ DKG dealing plane (``harness/dkg._run_real_device`` stages dealer
 ``d+1``'s coefficient-matrix upload while the device consumes dealer
 ``d``'s) — same worker, same lease discipline, same
 ``HBBFT_TPU_STAGING=0`` escape hatch.
+
+The lease discipline is also what makes BUFFER DONATION safe: the
+flush-path jitted programs (``pallas_ec.cached_compiled(...,
+donate=...)`` at the v2 unpack, fused-XLA product/flat, and sharded
+mesh call sites) mark their staged inputs ``donate_argnums``, letting
+the runtime reuse the device-side input allocation for outputs.
+Donation consumes the DEVICE buffer, never the leased HOST array — a
+lease is donate-until-consumed: the host never reads a leased buffer
+after ``device_put``, and ``retire()`` recycles it only once the
+device results materialize.  The donated-finalize consumer is
+``packed_msm.ProductFinalizer.start_drain`` — flush k's materializing
+fetch (which retires the lease) runs on its own drain thread while
+flush k+1 launches into freshly leased buffers, so donation and
+double buffering compose instead of racing.
 """
 
 from __future__ import annotations

@@ -355,14 +355,19 @@ def sharded_product_msm_fn(
         local = packed_msm._group_tree(prods, n_groups)  # [G, 3, L]
         return _ring_reduce(local, kern, n_dev, ring)
 
-    if engine == "pallas":
+    if engine == "pallas" or pallas_ec.exec_cache_active():
+        # exec-cache route: AOT-loadable from ``.palexe`` (the prewarm
+        # plan's ``_mesh_exec_keys`` name this executable), donating the
+        # staged shard blocks — leases are donate-until-consumed
         cache_name = "mesh_prod_g1_%dg_%dd" % (n_groups, n_dev)
 
         def run(wires, sc):
-            return pallas_ec.cached_compiled(cache_name, _sharded, wires, sc)
+            return pallas_ec.cached_compiled(
+                cache_name, _sharded, wires, sc, donate=(0, 1)
+            )
 
     else:
-        run = jax.jit(_sharded)
+        run = jax.jit(_sharded)  # lint: ok(device-sync) plain-CPU test path
 
     with _RUNNERS_LOCK:
         # first builder wins; a racing duplicate is only wasted trace work

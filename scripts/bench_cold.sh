@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Cold-start pair: time the FIRST flush of a fresh process twice
+# against the SAME `.palexe` exec-cache directory —
+#
+#   run 1  VIRGIN cache + HBBFT_TPU_WARM=1: pays every compile and
+#          serializes the planned executables to disk
+#   run 2  PRIMED cache, warming OFF: the prewarm plan must preload
+#          everything, and the flush must log ZERO compile events
+#
+# Each run is its own interpreter (`bench.py --cold`) because a
+# process only ever has one first flush.  Both runs force the device
+# leg (G1_DEVICE_MIN=1, HBBFT_TPU_DEVICE_FRACTION=1) so the row
+# measures the device path's cold wall, not the host fallback, and
+# run under HBBFT_TPU_AOT=1 so the CPU host exercises the same
+# exec-cache machinery a TPU host does.
+#
+# Examples:
+#   scripts/bench_cold.sh                     # k=4096, tmp cache dir
+#   COLD_K=8192 scripts/bench_cold.sh
+#   COLD_CACHE=/var/cache/hbbft scripts/bench_cold.sh  # keep the cache
+#
+# Output: the two `cold_flush` JSON rows, then one `cold_prime_ratio`
+# summary row (virgin wall ÷ primed wall) with the primed run's
+# compile-event count — nonzero means the prewarm plan has a hole.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+k="${COLD_K:-4096}"
+cache="${COLD_CACHE:-}"
+keep_cache=1
+if [ -z "$cache" ]; then
+  cache="$(mktemp -d)"
+  keep_cache=0
+fi
+
+log1="$(mktemp)"; log2="$(mktemp)"
+cleanup() {
+  rm -f "$log1" "$log2"
+  [ "$keep_cache" = 0 ] && rm -rf "$cache"
+}
+trap cleanup EXIT
+
+common_env=(
+  JAX_PLATFORMS=cpu
+  HBBFT_TPU_AOT=1
+  HBBFT_TPU_EXEC_CACHE="$cache"
+  HBBFT_TPU_DEVICE_FRACTION=1
+)
+
+echo "# run 1: virgin cache (compiles + serializes)" >&2
+env "${common_env[@]}" HBBFT_TPU_WARM=1 \
+  python bench.py --cold --k "$k" 2>&1 | tee "$log1"
+rc1=${PIPESTATUS[0]}
+
+echo "# run 2: primed cache (prewarm preloads; zero compiles expected)" >&2
+env "${common_env[@]}" HBBFT_TPU_WARM=0 \
+  python bench.py --cold --k "$k" 2>&1 | tee "$log2"
+rc2=${PIPESTATUS[0]}
+
+[ "$rc1" = 0 ] && [ "$rc2" = 0 ] || exit 1
+
+python - "$log1" "$log2" <<'PY'
+import json, sys
+
+def row(path):
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line.startswith("{"):
+                r = json.loads(line)
+                if r.get("metric") == "cold_flush":
+                    return r
+    raise SystemExit("no cold_flush row in %s" % path)
+
+virgin, primed = row(sys.argv[1]), row(sys.argv[2])
+summary = {
+    "metric": "cold_prime_ratio",
+    "value": round(virgin["value"] / max(primed["value"], 1e-9), 2),
+    "unit": "x",
+    "virgin_s": virgin["value"],
+    "primed_s": primed["value"],
+    "virgin_compiles": virgin.get("compile_events"),
+    "primed_compiles": primed.get("compile_events"),
+}
+print(json.dumps(summary))
+if primed.get("compile_events"):
+    raise SystemExit(
+        "FAIL: primed run still compiled %d program(s) — the prewarm "
+        "plan has a hole" % primed["compile_events"]
+    )
+PY

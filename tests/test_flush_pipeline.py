@@ -404,7 +404,8 @@ def test_prewarm_shapes_loads_recorded_plan(monkeypatch, tmp_path):
         for name, parts in packed_msm._product_exec_keys(g * 3, g, False)
     }
     assert any(name.startswith("gtree_g1_") for name, _ in keys)
-    assert any(name == "unpack_g1_v1" for name, _ in keys)
+    # v2 wire discipline: exact-row transfer, on-device bucket padding
+    assert any(name == "unpack_g1_v2" for name, _ in keys)
 
 
 def test_start_background_prewarm_idempotent(monkeypatch, tmp_path):

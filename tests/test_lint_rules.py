@@ -304,6 +304,65 @@ def test_device_sync_shard_map_allows_collectives_and_shapes():
     assert _lint(src, "parallel/fixture.py", select="device-sync") == []
 
 
+def test_device_sync_donation_flags_undonated_jit_on_staged_buffers():
+    # a flush-path launcher that leases pool buffers / device_puts and
+    # then wraps the program with bare jax.jit keeps two device copies
+    # of every staged operand alive — the donation pass gates this
+    src = """
+        import jax
+
+        def launch_chunk(wires, sc, lease):
+            buf = lease.get((128, 96))
+            buf[: wires.shape[0]] = wires
+            dev = jax.device_put(buf)
+            dev_sc = jax.device_put(sc)
+            run = jax.jit(_unpack_and_sum)
+            return run(dev, dev_sc)
+    """
+    vs = _lint(src, "ops/fixture.py", select="device-sync")
+    assert len(vs) == 1
+    assert "donate_argnums" in vs[0].message
+
+
+def test_device_sync_donation_allows_donated_and_unstaged_sites():
+    # donate_argnums at the wrap site (or routing through
+    # cached_compiled's donate=) satisfies the pass; jit wrappers in
+    # functions that never touch staged buffers are out of scope
+    src = """
+        import functools
+        import jax
+
+        def launch_chunk(wires, sc, lease):
+            dev = jax.device_put(lease.get((128, 96)))
+            dev_sc = jax.device_put(sc)
+            run = jax.jit(_unpack_and_sum, donate_argnums=(0, 1))
+            return run(dev, dev_sc)
+
+        def launch_cached(dev, dev_sc):
+            jax.device_put(dev)
+            return pallas_ec.cached_compiled(
+                "prog", _unpack_and_sum, dev, dev_sc, donate=(0, 1)
+            )
+
+        @functools.lru_cache(maxsize=None)
+        def _cpu_fallback_jit():
+            return jax.jit(_unpack_and_sum)
+    """
+    assert _lint(src, "ops/fixture.py", select="device-sync") == []
+
+
+def test_device_sync_donation_suppressible_inline():
+    src = """
+        import jax
+
+        def launch_chunk(wires, lease):
+            dev = jax.device_put(lease.get((128, 96)))
+            run = jax.jit(_sum)  # lint: ok(device-sync) operand reused by later launch
+            return run(dev)
+    """
+    assert _lint(src, "ops/fixture.py", select="device-sync") == []
+
+
 # ---------------------------------------------------------------------------
 # dtype-width
 # ---------------------------------------------------------------------------

@@ -214,7 +214,7 @@ def test_ready_predicates_mirror_cached_keys(monkeypatch):
 
     built = []
 
-    def rec_cc(name, fn, *args, key_parts=None):
+    def rec_cc(name, fn, *args, key_parts=None, donate=()):
         if key_parts is None:
             key_parts = tuple(
                 (tuple(a.shape), str(getattr(a, "dtype", "")))
@@ -314,13 +314,15 @@ def test_split_plan_warm_filtering(monkeypatch):
     monkeypatch.setattr(
         packed_msm,
         "_product_ready",
-        lambda kd, g, compressed: g == 8,
+        lambda kd, g, compressed, engine="pallas": g == 8,
     )
     assert packed_msm._split_plan(65536, 64) == [8] * 8
     # nothing warm at all: the quantum survives as the last resort and
     # the caller's own readiness check routes the flush host-side
     monkeypatch.setattr(
-        packed_msm, "_product_ready", lambda kd, g, compressed: False
+        packed_msm,
+        "_product_ready",
+        lambda kd, g, compressed, engine="pallas": False,
     )
     assert packed_msm._split_plan(65536, 64) == [4] * 16
     # warming mode uses the full ladder regardless of cache state

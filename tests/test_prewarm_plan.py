@@ -1,0 +1,214 @@
+"""Prewarm-plan completeness — the AOT gate's tier-1 contract.
+
+The first real flush of a fresh process must never compile; that holds
+only while :func:`packed_msm.prewarm_plan` covers EVERY executable the
+epoch driver can route to.  These tests enumerate the driver's shape
+families — G1 product chunks in both transfer modes (uncompressed and
+compressed), the flat G1 band, the DKG plane's G2 flat MSM, the
+per-chunk gtree fused-check reductions, and the per-device-count mesh
+exec keys — and assert each appears in the plan, so a future shape
+addition that skips the plan fails HERE instead of silently
+reintroducing a multi-second (CPU) or multi-minute (TPU) cold compile.
+
+The ``.palexe`` loadability half runs a real tiny flush under
+``HBBFT_TPU_AOT=1`` and proves every planned executable round-trips
+disk → memory through ``preload_exec`` WITHOUT compiling; the GC half
+proves :func:`packed_msm._gc_palexe` prunes exactly the plan-owned
+stale files and nothing else.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from hbbft_tpu.ops import packed_msm, pallas_ec
+
+
+@pytest.fixture
+def warm_env(monkeypatch, tmp_path):
+    """Isolated warm-state world: tmp exec cache + fresh seen/rho."""
+    monkeypatch.setenv("HBBFT_TPU_EXEC_CACHE", str(tmp_path))
+    monkeypatch.setattr(packed_msm, "_WARM_SEEN", set())
+    monkeypatch.setattr(packed_msm, "_RHO_STATE", None)
+    return tmp_path
+
+
+def _plan_names(plan):
+    return {name for name, _ in plan}
+
+
+def test_prewarm_plan_covers_epoch_driver_shapes(warm_env, monkeypatch):
+    """Every shape family the epoch driver can emit has a plan entry.
+
+    Records the families exactly the way production records them
+    (``record_warm_shape`` / ``record_flat_shape``) and asserts the
+    plan contains, per family, the executables routing will demand —
+    by the shared key builders, so the assertion can't drift from the
+    cache it guards."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    # product shapes: both transfer modes (sticky compressed), plus a
+    # recorded 8-device mesh deployment of the same shape
+    packed_msm.record_warm_shape(3, 4, False)
+    packed_msm.record_warm_shape(3, 4, True)
+    packed_msm.record_warm_shape(3, 4, False, mesh_dev=8)
+    # flat band: a G1 chunk and the DKG fused-check plane's G2 chunk
+    packed_msm.record_flat_shape(128, 12, g2=False)
+    packed_msm.record_flat_shape(128, 32, g2=True)
+
+    plan = packed_msm.prewarm_plan()
+    names = _plan_names(plan)
+
+    # G1 product, uncompressed + compressed wire (v2 device unpack)
+    assert "unpack_g1_v2" in names
+    assert "unpack_g1c_v2" in names
+    # gtree fused-check reductions ride every product chunk
+    assert any(n.startswith("gtree_g1_") for n in names)
+    # flat G1 and the G2 plane
+    assert "unpack_g1_v1" in names  # flat keeps the v1 host padding
+    assert "unpack_g2_v1" in names
+    # per-device-count mesh exec keys (PR 7 format)
+    assert any(
+        n.startswith("mesh_prod_g1_") and n.endswith("_8d") for n in names
+    )
+
+    # completeness against the shared key builders: every executable
+    # the recorded product shape routes to is planned
+    plan_set = set(plan)
+    for g in packed_msm._split_plan(12, 4):
+        for compressed in (False, True):
+            for key in packed_msm._product_exec_keys(
+                g * 3, g, compressed, "pallas"
+            ):
+                assert key in plan_set, key
+    for key in packed_msm._flat_exec_keys(128, 32, True, "pallas"):
+        assert key in plan_set, key
+
+
+def test_prewarm_plan_follows_engine(warm_env, monkeypatch):
+    """The plan enumerates for the CURRENT engine: on a CPU AOT host
+    (``HBBFT_TPU_AOT=1``) the product chunks are the fused XLA
+    programs, never Pallas tile kernels; with the cache inactive the
+    plan is empty (plain-CPU interp never compiles through it)."""
+    packed_msm.record_warm_shape(3, 4, False)
+    packed_msm.record_flat_shape(128, 12, g2=False)
+
+    monkeypatch.delenv("HBBFT_TPU_AOT", raising=False)
+    assert packed_msm.prewarm_plan() == []
+
+    monkeypatch.setenv("HBBFT_TPU_AOT", "1")
+    names = _plan_names(packed_msm.prewarm_plan())
+    assert any(n.startswith("prod_g1_xla_") for n in names)
+    assert "flat_g1_xla" in names
+    assert not any("unpack" in n or n.startswith("win_") for n in names)
+
+
+@pytest.mark.slow  # pays one real XLA compile (~2 min on a CPU host)
+def test_plan_entries_preload_loadable_and_first_flush_compile_free(
+    warm_env, monkeypatch
+):
+    """The zero-compile contract, end to end on this host: a warming
+    flush populates ``.palexe``; a simulated fresh process (cleared
+    in-memory cache) preloads every planned executable from disk and
+    re-runs the same flush with ZERO compile events in the obs trace."""
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto.curve import G1_GEN
+    from hbbft_tpu.obs import recorder as obs
+
+    monkeypatch.setenv("HBBFT_TPU_AOT", "1")
+    monkeypatch.setenv("HBBFT_TPU_WARM", "1")
+
+    rng = random.Random(11)
+    pts = [G1_GEN * rng.randrange(1, 997) for _ in range(5)]
+    scalars = [rng.getrandbits(16) for _ in range(5)]
+    ref = CpuBackend().g1_msm(pts, scalars)
+
+    assert packed_msm.g1_msm_packed(pts, scalars, nbits=16) == ref
+
+    plan = packed_msm.prewarm_plan()
+    assert ("flat_g1_xla" in _plan_names(plan)) and plan
+    # the warming run wrote every planned executable to disk
+    for name, parts in plan:
+        fname = pallas_ec._exec_fname(pallas_ec._exec_key(name, parts))
+        assert os.path.exists(os.path.join(str(warm_env), fname)), name
+
+    # simulated fresh process: drop the in-memory executables, then
+    # prewarm (disk → memory, no compiling) and re-flush under a trace
+    monkeypatch.setattr(pallas_ec, "_EXEC_MEM", {})
+    monkeypatch.delenv("HBBFT_TPU_WARM", raising=False)
+    assert packed_msm.prewarm_shapes() == len(plan)
+
+    rec = obs.Recorder()
+    monkeypatch.setattr(obs, "ACTIVE", rec)
+    assert packed_msm.g1_msm_packed(pts, scalars, nbits=16) == ref
+    compiles = [e for e in rec.events if e.get("ev") == "compile"]
+    assert compiles == []  # the first timed flush never compiles
+
+
+def test_gc_palexe_prunes_only_stale_owned_files(warm_env, monkeypatch):
+    """GC removes exactly: plan-owned families, this process's key
+    suffix, not reachable from the plan.  Foreign-backend files and
+    shared kernel families survive."""
+    monkeypatch.setenv("HBBFT_TPU_AOT", "1")
+    packed_msm.record_flat_shape(128, 12, g2=False)
+    plan = packed_msm.prewarm_plan()
+    reachable = [
+        pallas_ec._exec_fname(pallas_ec._exec_key(name, parts))
+        for name, parts in plan
+    ]
+    tail = "-".join(
+        str(p)
+        for p in (jax.__version__, jax.devices()[0].device_kind)
+    ).replace(" ", "").replace("/", "_") + ".palexe"
+
+    live = os.path.join(str(warm_env), reachable[0])
+    stale = os.path.join(str(warm_env), "flat_g1_xla-((64,96),'uint8')-" + tail)
+    shared = os.path.join(str(warm_env), "win_g1-(1,3,12,128)-" + tail)
+    foreign = os.path.join(
+        str(warm_env), "flat_g1_xla-((64,96),'uint8')-0.0.0-OtherChip.palexe"
+    )
+    for p in (live, stale, shared, foreign):
+        with open(p, "wb") as f:
+            f.write(b"x")
+
+    removed = packed_msm._gc_palexe(reachable)
+    assert removed == 1
+    assert not os.path.exists(stale)  # owned + stale: pruned
+    assert os.path.exists(live)  # reachable: kept
+    assert os.path.exists(shared)  # shared kernel family: never touched
+    assert os.path.exists(foreign)  # other backend's cache: not ours
+
+
+def test_warm_file_v2_schema_and_legacy_pruning(warm_env):
+    """``warm_shapes.json`` hygiene: the v2 document carries a
+    ``version`` field and a ``flat`` plane; legacy v1 bare-dict files
+    load tolerantly; garbage entries (pre-PR-7 key formats, malformed
+    rows) are pruned on load and disappear on the next write."""
+    path = os.path.join(str(warm_env), "warm_shapes.json")
+
+    # legacy v1 bare dict with stale/garbage entries
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "64:2": {"compressed": False},
+                "64:2:mesh8": {"compressed": False},  # pre-PR-7 junk key
+                "bogus": 1,
+                "0:3": {},
+            },
+            f,
+        )
+    doc = packed_msm._load_warm_file()
+    assert doc["version"] == 2
+    assert doc["shapes"] == {"64:2": {"compressed": False}}
+    assert doc["flat"] == []
+
+    # a write round-trips to the v2 schema and drops the junk for good
+    packed_msm.record_flat_shape(256, 12, g2=True)
+    raw = json.load(open(path))
+    assert raw["version"] == 2
+    assert raw["shapes"] == {"64:2": {"compressed": False}}
+    assert raw["flat"] == [[256, 12, "g2"]]
