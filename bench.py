@@ -1361,6 +1361,22 @@ def bench_hb_1024_latency(nodes: int = 1024, n_dead: int = 50):
     sim.run_epoch(contribs, dead=dead)  # warm
     res = sim.run_epoch(contribs, dead=dead)
     v = res.virtual
+    # fold the virtual round/cpu breakdown into the three commit-path
+    # phases the latency arc optimizes: RBC, the agreement+coin rounds
+    # (cross-instance coin batching's target), and decryption (the
+    # speculative combine's target)
+    rbc_s = coin_s = agree_s = dec_s = 0.0
+    for label, secs in v.breakdown.items():
+        if label.startswith("coin-"):
+            coin_s += secs
+        elif label.startswith(("bval-", "aux-", "conf-")) or label == (
+            "cpu:agreement"
+        ):
+            agree_s += secs
+        elif label in ("decshares", "cpu:decrypt", "cpu:assembly"):
+            dec_s += secs
+        else:  # value/echo/ready + cpu:propose/cpu:rbc
+            rbc_s += secs
     return _emit(
         "hb_1024_epoch_latency_s",
         v.total_s,
@@ -1372,10 +1388,191 @@ def bench_hb_1024_latency(nodes: int = 1024, n_dead: int = 50):
         per_node_mb=round(v.per_node_bytes / 1e6, 2),
         network_s=round(v.network_s, 2),
         cpu_s=round(v.cpu_s, 2),
+        rbc_s=round(rbc_s, 2),
+        acs_vote_s=round(agree_s, 2),
+        coin_s=round(coin_s, 2),
+        decrypt_s=round(dec_s, 2),
         lag_ms=100,
         bw_kbit_s=2000,
         crypto="mock",
     )
+
+
+def bench_latency(nodes: int = 13, epochs: int = 5, vec_nodes: int = 64):
+    """Commit-latency A-B matrix (PR 10 arc) on the per-node protocol
+    stack (``protocols/honey_badger.py`` over the TestNetwork message
+    scheduler, REAL BLS): {eager, speculative} decryption × {serial,
+    pipelined} epoch driving.  Eager is the protocol-prescribed
+    verify-before-combine path — every received decryption share
+    costs a pairing check before the combine, the latency price of
+    arXiv:2407.12172; speculative combines the lowest f+1 shares
+    unverified and pays one combined ciphertext check.  Serial
+    barriers every epoch (commit latency = epoch wall); pipelined
+    lets each node propose epoch e+1 the moment its own epoch e
+    commits (``max_future_epochs`` in flight; commit latency =
+    inter-commit gap).  Same seed everywhere; within each driving
+    mode the A-B asserts byte-identical batches, then one p50/p99
+    row per leg lands plus the headline speedup row
+    (speculative+pipelined vs eager+serial — the ≥1.5× gate).  A
+    second section reports the vectorized epoch driver
+    (``harness/epoch.py``) serial vs deep-staged inter-commit gap —
+    tentpole (c)'s staging-FIFO overlap, which needs spare cores to
+    hide epoch e+1's propose/RBC wall inside epoch e's decrypt."""
+    import hashlib as _hl
+    import random as _r
+
+    from hbbft_tpu.harness.network import (
+        MessageScheduler,
+        SilentAdversary,
+        TestNetwork,
+    )
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger
+
+    f = (nodes - 1) // 3
+
+    def run(speculative, pipelined):
+        rng = _r.Random(0x1A7)
+        net = TestNetwork(
+            nodes - f,
+            f,
+            lambda adv: SilentAdversary(
+                MessageScheduler(MessageScheduler.FIRST, rng)
+            ),
+            lambda ni: HoneyBadger(
+                ni,
+                rng=_r.Random(f"{ni.our_id}-lat"),
+                speculative=speculative,
+            ),
+            rng,
+            mock_crypto=False,
+        )
+
+        def commits():
+            return min(len(n.outputs) for n in net.nodes.values())
+
+        proposed = {nid: 0 for nid in net.nodes}
+        lats = []
+        guard = 0
+        t0 = time.perf_counter()
+        while commits() < epochs:
+            guard += 1
+            assert guard < 500_000, "latency bench failed to commit"
+            before = commits()
+            for nid in sorted(net.nodes):
+                node = net.nodes[nid]
+                if proposed[nid] >= epochs or node.instance.has_input():
+                    continue
+                # serial: epoch e+1 proposals wait for the global
+                # commit barrier; pipelined: a node re-proposes the
+                # moment its own epoch commits
+                if pipelined or proposed[nid] <= before:
+                    node.handle_input(
+                        [b"lat-%02d-%02d" % (proposed[nid], nid)]
+                    )
+                    msgs = list(node.messages)
+                    node.messages.clear()
+                    net.dispatch_messages(nid, msgs)
+                    proposed[nid] += 1
+            if net.any_busy():
+                net.step()
+            after = commits()
+            if after > before:
+                now = time.perf_counter()
+                lats.extend(
+                    (now - t0) / (after - before)
+                    for _ in range(after - before)
+                )
+                t0 = now
+        digest = _hl.sha256()
+        for nid in sorted(net.nodes):
+            for b in net.nodes[nid].outputs:
+                for k in sorted(b.contributions):
+                    digest.update(b"%d:" % k)
+                    for tx in b.contributions[k]:
+                        digest.update(tx)
+        return sorted(lats[1:]), digest.hexdigest()  # epoch 0: warmup
+
+    def pct(lats, q):
+        return lats[min(len(lats) - 1, int(q * len(lats)))]
+
+    legs = [
+        ("eager/serial", False, False),
+        ("eager/pipelined", False, True),
+        ("spec/serial", True, False),
+        ("spec/pipelined", True, True),
+    ]
+    p50 = {}
+    digests = {}
+    for label, spec, pipelined in legs:
+        lats, digest = run(spec, pipelined)
+        digests[label] = digest
+        p50[label] = pct(lats, 0.50)
+        _emit(
+            "commit_latency_p50_s",
+            p50[label],
+            "s",
+            mode=label,
+            p99_s=round(pct(lats, 0.99), 3),
+            epochs=epochs,
+            nodes=nodes,
+            crypto="real",
+        )
+    # honest-node batches byte-identical across the speculative A-B
+    # (same seed + same scheduler ⇒ same message order per mode)
+    assert digests["eager/serial"] == digests["spec/serial"]
+    assert digests["eager/pipelined"] == digests["spec/pipelined"]
+    _emit(
+        "commit_latency_speedup",
+        p50["eager/serial"] / p50["spec/pipelined"],
+        "x",
+        vs_baseline=p50["eager/serial"] / p50["spec/pipelined"],
+        baseline="eager/serial p50",
+        nodes=nodes,
+        batches_identical=True,
+    )
+
+    # -- vectorized epoch driver: serial wall vs deep-staged gap ---------
+    from hbbft_tpu.harness.epoch import VectorizedHoneyBadgerSim
+
+    def vec(mode):
+        rng = _r.Random(0x1A7)
+        sim = VectorizedHoneyBadgerSim(
+            vec_nodes,
+            rng,
+            mock=False,
+            verify_honest=True,
+            emit_minimal=True,
+            speculative=True,
+        )
+        seq = [
+            {i: [b"lat-%02d-%04d" % (e, i)] for i in range(vec_nodes)}
+            for e in range(epochs)
+        ]
+        results = sim.run_epochs(seq, pipeline=mode)
+        lats = sorted(r.phases["commit_latency"] for r in results[1:])
+        return results, lats
+
+    vec_batches = None
+    for label, mode in (("serial", False), ("staged", "deep")):
+        results, lats = vec(mode)
+        batches = [r.batch for r in results]
+        if vec_batches is None:
+            vec_batches = batches
+        else:
+            assert batches == vec_batches, "staged epochs diverged"
+        _emit(
+            "vec_commit_gap_p50_s",
+            pct(lats, 0.50),
+            "s",
+            mode=label,
+            p99_s=round(pct(lats, 0.99), 3),
+            epochs=epochs,
+            nodes=vec_nodes,
+            spec_hits=sum(
+                int(r.phases.get("spec_hits", 0)) for r in results
+            ),
+            crypto="real",
+        )
 
 
 def bench_qhb_dyn_1024(nodes: int = 1024, n_dead: int = 50):
@@ -1832,6 +2029,7 @@ SUITE = {
     "hb_1024_observer": bench_hb_1024_observer,
     "qhb_dyn_1024": bench_qhb_dyn_1024,
     "hb_1024_latency": bench_hb_1024_latency,
+    "latency": bench_latency,
     "dkg_verified": bench_dkg_verified,
     "dkg_256": bench_dkg_256,
     "dkg_verified_256": bench_dkg_verified_256,
@@ -1896,6 +2094,16 @@ def main() -> None:
         "--iters", type=int, default=3, help="flush iterations (--mesh)"
     )
     p.add_argument(
+        "--latency",
+        action="store_true",
+        help="commit-latency A-B matrix: {eager, speculative} decryption "
+        "× {serial, pipelined} epochs on the protocol stack, real BLS "
+        "(see scripts/bench_latency.sh)",
+    )
+    p.add_argument(
+        "--epochs", type=int, default=5, help="epochs per leg (--latency)"
+    )
+    p.add_argument(
         "--cold",
         action="store_true",
         help="one fresh-process first flush under a compile-event "
@@ -1914,7 +2122,9 @@ def main() -> None:
 
         obsrec.enable(args.trace)
     try:
-        if args.cold:
+        if args.latency:
+            bench_latency(nodes=args.k or 13, epochs=args.epochs)
+        elif args.cold:
             bench_cold(k=args.k or 4096)
         elif args.mesh_child:
             bench_mesh_child(

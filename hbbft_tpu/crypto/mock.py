@@ -253,6 +253,37 @@ class MockPublicKeySet:
         first = shares[sorted(shares)[0]]
         return xor_stream(first.key, ct.v)
 
+    def combine_and_check_decryption_shares(
+        self, shares: Dict[int, MockDecryptionShare], ct: MockCiphertext
+    ) -> Optional[bytes]:
+        """Speculative combine-first twin of the real scheme: returns
+        the plaintext if the lowest-(t+1) subset combines to a valid
+        result, ``None`` on mismatch (caller falls back to per-share
+        verification for fault attribution).  Real Lagrange combination
+        depends on *every* subset share, so this checks each subset
+        member against the group key — a bogus share anywhere in the
+        subset fails the combined check exactly as it would perturb
+        the real interpolation off the s·U ray."""
+        if len(shares) <= self.threshold_:
+            raise ValueError("not enough decryption shares")
+        idxs = sorted(shares)[: self.threshold_ + 1]
+        key = _enc_key(self.seed, ct.nonce)
+        for i in idxs:
+            share = shares[i]
+            if share.key != key or share.tag != _tag(
+                b"DECSHARE", self.seed, _idx(i), key
+            ):
+                return None
+        return xor_stream(key, ct.v)
+
+    def combine_and_check_decryption_shares_many(
+        self, rows, cts
+    ) -> list:
+        return [
+            self.combine_and_check_decryption_shares(row, ct)
+            for row, ct in zip(rows, cts)
+        ]
+
     def verify_signature(self, sig: MockSignature, msg: bytes) -> bool:
         return sig.tag == _tag(b"SIG", self.seed, msg)
 

@@ -1,0 +1,105 @@
+"""Signature-scheme seam — pluggable threshold-signature backends.
+
+The commit-latency literature the harness benchmarks against compares
+threshold BLS (one pairing-heavy verify, tiny aggregate) with
+committee-style EdDSA batch verification (arXiv:2302.00418: cheaper
+per-share verifies, larger certificates).  Everything above this module
+talks to the scheme through :class:`SignatureScheme`, so an EdDSA
+implementation only has to fill in this interface — no protocol or
+harness changes.
+
+Only BLS12-381 is implemented today (it delegates to
+``crypto/threshold.py``, including the speculative
+``combine_and_check`` surface).  The EdDSA entry is a registered stub:
+``get_scheme("eddsa")`` resolves, but using it raises with a pointer to
+the comparison it is reserved for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from . import threshold as T
+
+
+@dataclasses.dataclass(frozen=True)
+class SignatureScheme:
+    """The operations a threshold-signature backend must provide.
+
+    ``sign_share`` / ``verify_share`` / ``combine`` / ``verify`` are
+    the eager per-share surface the protocols use;
+    ``batch_verify_shares`` is the fused-flush hook the batching plane
+    routes through; ``combine_and_check`` is the speculative
+    combine-first surface (PR 10) — schemes without a cheap combined
+    check may set it to ``None`` and the callers fall back to eager
+    verification.
+    """
+
+    name: str
+    sign_share: Callable[[Any, bytes], Any]  # (secret_key_share, msg)
+    verify_share: Callable[[Any, Any, bytes], bool]  # (pk_share, share, msg)
+    combine: Callable[[Any, Dict[int, Any]], Any]  # (pk_set, shares)
+    verify: Callable[[Any, Any, bytes], bool]  # (pk_set, sig, msg)
+    batch_verify_shares: Optional[Callable[..., bool]] = None
+    combine_and_check: Optional[Callable[..., Optional[bytes]]] = None
+
+
+def _bls_scheme() -> SignatureScheme:
+    return SignatureScheme(
+        name="bls381",
+        sign_share=lambda sks, msg: sks.sign(msg),
+        verify_share=lambda pk, share, msg: pk.verify_signature_share(
+            share, msg
+        ),
+        combine=lambda pk_set, shares: pk_set.combine_signatures(shares),
+        verify=lambda pk_set, sig, msg: pk_set.verify_signature(sig, msg),
+        batch_verify_shares=T.batch_verify_shares,
+        combine_and_check=(
+            lambda pk_set, shares, ct: pk_set.combine_and_check_decryption_shares(
+                shares, ct
+            )
+        ),
+    )
+
+
+def _eddsa_unavailable(*_args: Any, **_kwargs: Any) -> bool:
+    raise NotImplementedError(
+        "eddsa scheme is a landing spot only (committee batch-verify "
+        "comparison, arXiv:2302.00418); use get_scheme('bls381')"
+    )
+
+
+def _eddsa_scheme() -> SignatureScheme:
+    return SignatureScheme(
+        name="eddsa",
+        sign_share=_eddsa_unavailable,
+        verify_share=_eddsa_unavailable,
+        combine=_eddsa_unavailable,
+        verify=_eddsa_unavailable,
+        batch_verify_shares=None,
+        combine_and_check=None,
+    )
+
+
+_FACTORIES: Dict[str, Callable[[], SignatureScheme]] = {
+    "bls381": _bls_scheme,
+    "eddsa": _eddsa_scheme,
+}
+
+DEFAULT_SCHEME = "bls381"
+
+
+def available_schemes() -> Sequence[str]:
+    return tuple(sorted(_FACTORIES))
+
+
+def get_scheme(name: str = DEFAULT_SCHEME) -> SignatureScheme:
+    try:
+        factory = _FACTORIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown signature scheme {name!r}; "
+            f"available: {', '.join(available_schemes())}"
+        ) from None
+    return factory()

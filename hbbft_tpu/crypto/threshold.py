@@ -451,6 +451,123 @@ class PublicKeySet:
                     )
         return out
 
+    def _combine_decryption_points(
+        self, rows: Sequence[Dict[int, DecryptionShare]]
+    ) -> List[G1]:
+        """The combine half of :meth:`combine_decryption_shares_many`
+        with the combined G1 points kept (the speculative path still
+        needs them for the master-key check before deriving keys).
+        Same grouping + native many-MSM dispatch, bit-identical
+        points."""
+        from .. import native as NT
+
+        groups: Dict[Tuple[int, ...], List[int]] = {}
+        for i, row in enumerate(rows):
+            idxs = tuple(sorted(row)[: self.threshold + 1])
+            if len(idxs) <= self.threshold:
+                raise ValueError("not enough decryption shares")
+            groups.setdefault(idxs, []).append(i)
+        out: List[Optional[G1]] = [None] * len(rows)
+        for idxs, members in sorted(groups.items()):
+            sample = rows[members[0]][idxs[0]]
+            xs = [i + 1 for i in idxs]
+            lams = lagrange_coefficients_at_zero(xs)
+            if (
+                NT.available()
+                and len(members) >= 4
+                and isinstance(sample, DecryptionShare)
+                and isinstance(sample.point, G1)
+            ):
+                import numpy as np
+
+                kbuf = np.frombuffer(
+                    b"".join(int(l % R).to_bytes(32, "big") for l in lams),
+                    dtype=np.uint8,
+                )
+                pts = np.frombuffer(
+                    b"".join(
+                        NT.g1_wire(rows[i][j].point)
+                        for i in members
+                        for j in idxs
+                    ),
+                    dtype=np.uint8,
+                )
+                raw = NT.g1_msm_many_raw(
+                    len(members), len(idxs), pts, kbuf
+                ).tobytes()
+                for mi, i in enumerate(members):
+                    out[i] = NT.g1_unwire(raw[mi * 96 : (mi + 1) * 96], G1)
+            else:
+                for i in members:
+                    out[i] = g1_multi_exp(
+                        [rows[i][j].point for j in idxs], lams
+                    )
+        return out
+
+    def combine_and_check_decryption_shares(
+        self, shares: Dict[int, DecryptionShare], ct: Ciphertext
+    ) -> Optional[bytes]:
+        """Speculative combine-first decryption (arXiv:2407.12172):
+        Lagrange-combine the lowest t+1 shares *unverified*, then
+        validate the single combined point against the master key with
+        one check — the correct combination is s·U, so
+        e(s_comb, P₂) == e(U, mpk₂) holds iff every subset share was
+        honest (a bad share perturbs the interpolation off the s·U
+        ray).  Returns the plaintext, or ``None`` on mismatch so the
+        caller can fall back to per-share verification for fault
+        attribution.  On the happy path this replaces t+1 two-pairing
+        share verifies with one combine (already paid) plus one
+        two-pairing check."""
+        idxs = sorted(shares)[: self.threshold + 1]
+        if len(idxs) <= self.threshold:
+            raise ValueError("not enough decryption shares")
+        xs = [i + 1 for i in idxs]
+        lams = lagrange_coefficients_at_zero(xs)
+        s = g1_multi_exp([shares[i].point for i in idxs], lams)
+        if not pairing_check(
+            [(s, G2_GEN), (-ct.u, self.commitment.evaluate(0))]
+        ):
+            return None
+        key = sha256(DST_ENC + s.to_bytes())
+        return xor_stream(key, ct.v)
+
+    def combine_and_check_decryption_shares_many(
+        self,
+        rows: Sequence[Dict[int, DecryptionShare]],
+        cts: Sequence[Ciphertext],
+    ) -> List[Optional[bytes]]:
+        """Batched speculative combine across proposers: combine every
+        row (native many-MSM path), then validate ALL combined points
+        with ONE two-pairing RLC check —
+        e(Σᵢ rᵢ·sᵢ, P₂) == e(Σᵢ rᵢ·Uᵢ, mpk₂) — valid because every
+        proposer's check shares the same G2 side (the master public
+        key).  A whole epoch's P proposer checks collapse to two
+        P-point G1 MSMs and two pairings.  On aggregate mismatch each
+        row is re-checked individually, so exactly the bad rows come
+        back ``None``.  Row-wise equal to mapping
+        :meth:`combine_and_check_decryption_shares`."""
+        if not rows:
+            return []
+        pts = self._combine_decryption_points(rows)
+        mpk2 = self.commitment.evaluate(0)
+        rs = _rlc_coeffs(
+            b"hbbft_tpu spec combine",
+            [p.to_bytes() for p in pts] + [ct.u.to_bytes() for ct in cts],
+        )[: len(rows)]
+        agg_s = g1_multi_exp(pts, rs)
+        agg_u = g1_multi_exp([ct.u for ct in cts], rs)
+        def _key(p: G1, ct: Ciphertext) -> bytes:
+            return xor_stream(sha256(DST_ENC + p.to_bytes()), ct.v)
+
+        if pairing_check([(agg_s, G2_GEN), (-agg_u, mpk2)]):
+            return [_key(p, ct) for p, ct in zip(pts, cts)]
+        return [
+            _key(p, ct)
+            if pairing_check([(p, G2_GEN), (-ct.u, mpk2)])
+            else None
+            for p, ct in zip(pts, cts)
+        ]
+
     def verify_signature(self, sig: Signature, msg: bytes) -> bool:
         h = hash_to_g1(msg, DST_SIG)
         return pairing_check(

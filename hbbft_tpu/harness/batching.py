@@ -224,6 +224,17 @@ class BatchingBackend:
         self.stats.flushes += 1
         shipped = len(real) + len(other)
         self.stats.prefetched += shipped
+        # which share plane this flush serves: the epoch driver's coin
+        # rounds ship pure-sig flushes, the decryption phase pure-dec —
+        # traces need the split to attribute coin vs decrypt walls
+        kinds = {
+            "s" if isinstance(ob, SigObligation) else "d"
+            for _, ob in real + other
+        }
+        plane = {
+            frozenset("s"): "sig",
+            frozenset("d"): "dec",
+        }.get(frozenset(kinds), "mixed")
         t0 = _time.perf_counter() if rec is not None else 0.0
         fb_groups0 = self.stats.fallback_groups
         self.last_flush_groups = 0
@@ -242,6 +253,7 @@ class BatchingBackend:
                 groups=self.last_flush_groups,
                 dur=round(_time.perf_counter() - t0, 9),
                 fallback_groups=self.stats.fallback_groups - fb_groups0,
+                plane=plane,
                 # stage walls only when the product-form path actually
                 # ran this flush (otherwise they'd be a stale carryover)
                 phases=getattr(self, "last_flush_phases", None) if real else None,

@@ -141,14 +141,27 @@ def _run_bad_share(cfg: ScenarioConfig) -> ScenarioResult:
         rng.randrange(2**256).to_bytes(32, "big"),
         rng.randrange(2**256).to_bytes(32, "big"),
     )
+    in_forger = 0  # inside the speculative f+1 combine window
     sim = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
     twin = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    # speculative legs (PR 10): forger n-1 sits past the lowest-f+1
+    # combine window — the combined check hits and the leftover audit
+    # must still flag it; forger 0 sits inside the window — the check
+    # misses and the eager fallback must attribute identically
+    spec = VectorizedHoneyBadgerSim(
+        n, random.Random(cfg.seed), mock=True, speculative=True
+    )
+    spec_in = VectorizedHoneyBadgerSim(
+        n, random.Random(cfg.seed), mock=True, speculative=True
+    )
+    eager_in = VectorizedHoneyBadgerSim(
+        n, random.Random(cfg.seed), mock=True
+    )
     faults = 0
     for e in range(cfg.epochs):
         contribs = _contribs(n, b"bs%d" % e)
-        res = sim.run_epoch(
-            contribs, forged_dec={forger: {p: bogus for p in range(n)}}
-        )
+        forged = {forger: {p: bogus for p in range(n)}}
+        res = sim.run_epoch(contribs, forged_dec=forged)
         ref = twin.run_epoch(contribs)
         _check(
             res.batch.contributions == ref.batch.contributions,
@@ -163,10 +176,33 @@ def _run_bad_share(cfg: ScenarioConfig) -> ScenarioResult:
             ref.fault_log.is_empty(),
             f"epoch {e}: fault-free twin logged faults",
         )
+        sres = spec.run_epoch(contribs, forged_dec=forged)
+        _check(
+            sres.batch.contributions == ref.batch.contributions,
+            f"epoch {e}: speculative batch diverges from twin",
+        )
+        _check(
+            {fl.node_id for fl in sres.fault_log} == flagged,
+            f"epoch {e}: speculative leftover-audit attribution differs",
+        )
+        forged_in = {in_forger: {p: bogus for p in range(n)}}
+        sin = spec_in.run_epoch(contribs, forged_dec=forged_in)
+        ein = eager_in.run_epoch(contribs, forged_dec=forged_in)
+        _check(
+            sin.batch.contributions == ein.batch.contributions,
+            f"epoch {e}: fallback batch diverges from eager",
+        )
+        _check(
+            {fl.node_id for fl in sin.fault_log} == {in_forger}
+            and {fl.node_id for fl in ein.fault_log} == {in_forger},
+            f"epoch {e}: in-window fallback attribution differs",
+        )
         faults += len(list(res.fault_log))
     return ScenarioResult(
         "bad-share", True, n, cfg.epochs, cfg.seed, faults,
-        f"forger {forger} attributed, batches bit-identical to twin",
+        f"forger {forger} attributed (eager + speculative audit), "
+        f"in-window forger {in_forger} via fallback, batches "
+        "bit-identical to twin",
     )
 
 

@@ -316,6 +316,9 @@ class DecryptionRound:
     phases: Dict[str, float] = dataclasses.field(
         default_factory=dict
     )  # wall seconds: staging / emit / flush / lookup / combine
+    spec: Dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )  # speculative combine counters: hits / misses (empty when eager)
 
 
 class VectorizedHoneyBadgerRound:
@@ -466,6 +469,7 @@ def decrypt_round(
     verify_honest: bool = True,
     emit_minimal: bool = False,
     shares: Optional[Dict[Any, Dict[Any, Any]]] = None,
+    speculative: bool = False,
 ) -> DecryptionRound:
     """One epoch's decryption: every live node emits a share per
     proposer; each distinct (sender, proposer) share is verified
@@ -495,6 +499,20 @@ def decrypt_round(
     redundant deliveries a real network sends for liveness against
     senders that might be slow, which the synchronous co-simulation
     schedule never needs.
+
+    ``speculative=True`` (arXiv:2407.12172) combines each proposer's
+    lowest t+1 *emitted* shares unverified and validates the combined
+    result with one check per proposer (batched across proposers for
+    real BLS: two pairings total).  On a hit, the subset's per-share
+    obligations are dropped from the verification flush; emitted
+    shares *outside* the subset are still audited by the flush, so a
+    forger past the window is flagged exactly as eagerly.  On a miss
+    (a bad share inside the window) the proposer falls through to the
+    eager per-share path — same valid/invalid partition, same
+    ``INVALID_DECRYPTION_SHARE`` attribution, bit-identical
+    plaintexts (a hit proves the subset valid, and the lowest t+1
+    emitted-and-valid indices are the lowest t+1 valid indices the
+    eager combine would pick).
     """
     dead = dead or set()
     forged = forged or {}
@@ -575,6 +593,65 @@ def decrypt_round(
 
     phases["emit"] = _time.perf_counter() - _t0
 
+    # 1b. speculative combine-first: one combined check per proposer
+    # instead of t+1 share verifies (see docstring for the
+    # attribution-parity argument)
+    _t0 = _time.perf_counter()
+    spec_out: Dict[Any, bytes] = {}
+    spec_stats: Dict[str, int] = {}
+    if speculative:
+        spec_hits = spec_misses = 0
+        spec_rows: List[Dict[int, Any]] = []
+        spec_cts: List[Any] = []
+        spec_pids: List[Any] = []
+        spec_senders: List[Set[Any]] = []
+        for pid, ct in sorted_cts:
+            by_idx = {
+                ref.node_index(nid): (nid, s)
+                for nid, s in emitted.get(pid, {}).items()
+            }
+            if len(by_idx) <= num_faulty:
+                continue
+            idxs = sorted(by_idx)[: num_faulty + 1]
+            spec_rows.append({i: by_idx[i][1] for i in idxs})
+            spec_cts.append(ct)
+            spec_pids.append(pid)
+            spec_senders.append({by_idx[i][0] for i in idxs})
+        results: List[Optional[bytes]] = []
+        if spec_rows:
+            many = getattr(
+                pk_set, "combine_and_check_decryption_shares_many", None
+            )
+            if many is not None:
+                try:
+                    results = many(spec_rows, spec_cts)
+                except Exception:
+                    results = [None] * len(spec_rows)
+            else:
+                one = getattr(
+                    pk_set, "combine_and_check_decryption_shares", None
+                )
+                for row, ct in zip(spec_rows, spec_cts):
+                    try:
+                        pt = one(row, ct) if one is not None else None
+                    except Exception:
+                        pt = None
+                    results.append(pt)
+        consumed: Set = set()
+        for pid, senders_sub, pt in zip(spec_pids, spec_senders, results):
+            if pt is not None:
+                spec_hits += 1
+                spec_out[pid] = pt
+                consumed.update((pid, nid) for nid in senders_sub)
+            else:
+                spec_misses += 1
+        if consumed:
+            entries = [
+                e for e in entries if (e[0], e[1]) not in consumed
+            ]
+        spec_stats = {"hits": spec_hits, "misses": spec_misses}
+    phases["spec"] = _time.perf_counter() - _t0
+
     # 2. one grouped verification flush for everything still in question
     _t0 = _time.perf_counter()
     be.prefetch(ob for _, _, ob in entries)
@@ -596,6 +673,11 @@ def decrypt_round(
     out: Dict[Any, bytes] = {}
     rows, row_cts, row_pids = [], [], []
     for pid, ct in sorted_cts:
+        if pid in spec_out:
+            # speculative hit: ≥ t+1 shares proven valid by the
+            # combined check, plaintext already derived
+            out[pid] = spec_out[pid]
+            continue
         by_idx = {
             ref.node_index(nid): s for nid, s in valid.get(pid, {}).items()
         }
@@ -620,4 +702,5 @@ def decrypt_round(
         shares_verified=n_verified,
         emitted=emitted,
         phases=phases,
+        spec=spec_stats,
     )

@@ -55,10 +55,16 @@ EVENTS: Dict[str, EventSpec] = {
         {"epoch", "min_time", "max_time", "txs", "msgs_per_node", "bytes_per_node"}
     ),
     "epoch_phases": _spec({"epoch", "phases", "shares", "coin_flips", "faults"}),
+    # commit-latency arc (additive): speculative combine-first
+    # decryption counters (hits = combined-check successes, misses =
+    # fallbacks to per-share verification) and the per-epoch commit
+    # latency the pipelined driver measures
+    "spec_combine": _spec({"hits", "misses"}, {"epoch", "fallback_items"}),
+    "commit_latency": _spec({"epoch", "latency_s"}, {"mode"}),
     # crypto batching / device routing
     "flush": _spec(
         {"queued", "shipped", "real", "inline"},
-        {"occupancy", "dur", "groups", "fallback_groups", "phases"},
+        {"occupancy", "dur", "groups", "fallback_groups", "phases", "plane"},
     ),
     "device_op": _spec({"op", "k", "engine"}),
     # one XLA/Mosaic compile paid by the executable cache (a primed

@@ -90,11 +90,16 @@ class TpuBackend(CpuBackend):
         self._sharded_g1 = None
         # env overrides are read here (not at import) so operators and
         # tests can set them after the module loads
+        # G2_DEVICE_MIN joined the tunable set with the batched coin
+        # plane: cross-instance coin flushes spend their host half in
+        # per-sender-class G2 MSMs, so operators balancing that plane
+        # need the same override the G1 bands have
         for attr in (
             "G1_DEVICE_MIN",
             "G1_DEVICE_MAX",
             "G1_FLAT_MAX",
             "G1_MESH_MIN",
+            "G2_DEVICE_MIN",
         ):
             env = os.environ.get("HBBFT_TPU_" + attr)
             if env is not None:

@@ -27,10 +27,11 @@ import random
 from typing import Any, Dict, List, Optional, Set
 
 from ..core.algorithm import DistAlgorithm, UnknownSenderError
-from ..core.fault import FaultKind
+from ..core.fault import FaultKind, FaultLog
 from ..core.network_info import NetworkInfo
 from ..core.serialize import SerializationError, dumps, loads, wire
 from ..core.step import Step
+from ..obs import recorder as _obs
 from .common_subset import CommonSubset
 
 
@@ -82,6 +83,7 @@ class HoneyBadger(DistAlgorithm):
         netinfo: NetworkInfo,
         max_future_epochs: int = 3,
         rng: Optional[random.Random] = None,
+        speculative: bool = False,
     ):
         self.netinfo = netinfo
         self.epoch = 0
@@ -94,6 +96,17 @@ class HoneyBadger(DistAlgorithm):
         self.decrypted_contributions: Dict[Any, bytes] = {}
         # epoch -> proposer -> ciphertext
         self.ciphertexts: Dict[int, Dict[Any, Any]] = {}
+        # speculative combine-first decryption (arXiv:2407.12172):
+        # store shares unverified, combine the lowest f+1 at decrypt
+        # time and validate the combined result once; per-share
+        # verification runs only as the mismatch fallback (fault
+        # attribution unchanged — see _try_decrypt_speculative).
+        # Faults found by that deferred fallback accumulate here until
+        # the next Step leaves this instance.
+        self.speculative = speculative
+        self._spec_hits = 0
+        self._spec_misses = 0
+        self._pending_faults = FaultLog()
         # deterministic per-node default (badgerlint: determinism) —
         # replayable and co-simulation-stable; the seed folds in our
         # secret key so the ciphertext randomness stays unpredictable
@@ -192,7 +205,7 @@ class HoneyBadger(DistAlgorithm):
         if not known:
             return Step.from_fault(sender_id, FaultKind.UNEXPECTED_PROPOSER)
         ciphertext = self.ciphertexts.get(epoch, {}).get(proposer_id)
-        if ciphertext is not None:
+        if ciphertext is not None and not self.speculative:
             if not self._verify_decryption_share(
                 sender_id, share, ciphertext
             ):
@@ -246,13 +259,14 @@ class HoneyBadger(DistAlgorithm):
             if not valid:
                 step.add_fault(proposer_id, FaultKind.INVALID_CIPHERTEXT)
                 continue
-            incorrect, faults = self._verify_pending_decryption_shares(
-                proposer_id, ciphertext, epoch
-            )
-            self._remove_incorrect_decryption_shares(
-                proposer_id, incorrect, epoch
-            )
-            step.fault_log.merge(faults)
+            if not self.speculative:
+                incorrect, faults = self._verify_pending_decryption_shares(
+                    proposer_id, ciphertext, epoch
+                )
+                self._remove_incorrect_decryption_shares(
+                    proposer_id, incorrect, epoch
+                )
+                step.fault_log.merge(faults)
             if self.netinfo.is_validator:
                 step.extend(
                     self._send_decryption_share(proposer_id, ciphertext, epoch)
@@ -306,6 +320,12 @@ class HoneyBadger(DistAlgorithm):
             if new_step is None:
                 break
             step.extend(new_step)
+        if not self._pending_faults.is_empty():
+            # faults found by the speculative-combine fallback: surface
+            # them on whichever Step leaves the instance next (the eager
+            # path reports at share arrival; the set is identical)
+            step.fault_log.merge(self._pending_faults)
+            self._pending_faults = FaultLog()
         return step
 
     def _try_output_batch(self) -> Optional[Step]:
@@ -328,6 +348,17 @@ class HoneyBadger(DistAlgorithm):
         self.decrypted_contributions = {}
         batch = Batch(self.epoch, contributions)
         step.output.append(batch)
+        if self.speculative:
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.event(
+                    "spec_combine",
+                    hits=self._spec_hits,
+                    misses=self._spec_misses,
+                    epoch=batch.epoch,
+                )
+            self._spec_hits = 0
+            self._spec_misses = 0
         step.extend(self._update_epoch())
         return step
 
@@ -338,6 +369,10 @@ class HoneyBadger(DistAlgorithm):
         if not shares or len(shares) <= self.netinfo.num_faulty:
             return False
         ciphertext = self.ciphertexts[self.epoch][proposer_id]
+        if self.speculative:
+            return self._try_decrypt_speculative(
+                proposer_id, ciphertext, shares
+            )
         shares_by_idx = {
             self.netinfo.node_index(nid): share
             for nid, share in shares.items()
@@ -353,6 +388,63 @@ class HoneyBadger(DistAlgorithm):
             # contribution is skipped (reference logs and continues,
             # ``honey_badger.rs:344-346``).
             pass
+        return True
+
+    def _try_decrypt_speculative(
+        self, proposer_id, ciphertext, shares
+    ) -> bool:
+        """Combine-first decryption: combine the lowest f+1 received
+        shares *unverified* and validate the combined result with one
+        check.  Only on mismatch (a bad share inside the window) run
+        the exact eager ``_verify_pending_decryption_shares`` sweep —
+        the same senders are faulted with ``INVALID_DECRYPTION_SHARE``
+        (deferred to the next outgoing Step), the bad shares are
+        dropped, and the combine retries from what survives."""
+        combine = getattr(
+            self.netinfo.public_key_set,
+            "combine_and_check_decryption_shares",
+            None,
+        )
+        if combine is not None:
+            shares_by_idx = {
+                self.netinfo.node_index(nid): share
+                for nid, share in shares.items()
+            }
+            sub_idxs = sorted(shares_by_idx)[: self.netinfo.num_faulty + 1]
+            try:
+                contrib = combine(
+                    {i: shares_by_idx[i] for i in sub_idxs}, ciphertext
+                )
+            except Exception:
+                contrib = None
+            if contrib is not None:
+                self._spec_hits += 1
+                self.decrypted_contributions[proposer_id] = contrib
+                return True
+            self._spec_misses += 1
+        # fallback: the eager path, verbatim — verify every pending
+        # share, fault + drop the bad ones, recombine from the rest
+        incorrect, faults = self._verify_pending_decryption_shares(
+            proposer_id, ciphertext, self.epoch
+        )
+        self._remove_incorrect_decryption_shares(
+            proposer_id, incorrect, self.epoch
+        )
+        self._pending_faults.merge(faults)
+        shares = self.received_shares.get(self.epoch, {}).get(proposer_id)
+        if not shares or len(shares) <= self.netinfo.num_faulty:
+            return False
+        shares_by_idx = {
+            self.netinfo.node_index(nid): share
+            for nid, share in shares.items()
+        }
+        try:
+            contrib = self.netinfo.public_key_set.combine_decryption_shares(
+                shares_by_idx, ciphertext
+            )
+            self.decrypted_contributions[proposer_id] = contrib
+        except Exception:
+            pass  # see the eager branch above
         return True
 
     def _update_epoch(self) -> Step:
@@ -386,6 +478,7 @@ class HoneyBadgerBuilder:
         self.netinfo = netinfo
         self._max_future_epochs = 3
         self._rng: Optional[random.Random] = None
+        self._speculative = False
 
     def max_future_epochs(self, value: int) -> "HoneyBadgerBuilder":
         self._max_future_epochs = value
@@ -395,9 +488,17 @@ class HoneyBadgerBuilder:
         self._rng = rng
         return self
 
+    def speculative(self, value: bool = True) -> "HoneyBadgerBuilder":
+        """Combine-first decryption: one combined check per
+        contribution instead of per-share verifies (fallback on
+        mismatch keeps fault attribution)."""
+        self._speculative = value
+        return self
+
     def build(self) -> HoneyBadger:
         return HoneyBadger(
             self.netinfo,
             max_future_epochs=self._max_future_epochs,
             rng=self._rng,
+            speculative=self._speculative,
         )

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Commit-latency A-B bench: run `bench.py --latency` — the {eager,
+# speculative} decryption × {serial, pipelined} epoch matrix on the
+# per-node protocol stack (protocols/honey_badger.py over the
+# TestNetwork scheduler, REAL BLS), plus the vectorized epoch
+# driver's serial-vs-staged inter-commit gap.  The headline row is
+# `commit_latency_speedup` (speculative+pipelined p50 vs the
+# eager/serial verify-before-combine baseline, same seed,
+# byte-identical batches) — the PR-10 acceptance gate is >= 1.5x.
+#
+# Examples:
+#   scripts/bench_latency.sh                 # n=13 protocol net, 5 epochs
+#   LAT_NODES=16 scripts/bench_latency.sh    # bigger protocol net
+#   LAT_EPOCHS=8 scripts/bench_latency.sh    # more latency samples
+#   LAT_OUT=latency.json scripts/bench_latency.sh  # also write a file
+#
+# Output: one `commit_latency_p50_s` JSON row per leg, the
+# `commit_latency_speedup` headline, then two `vec_commit_gap_p50_s`
+# rows.  With LAT_OUT set, all rows are collected into a JSON array.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+nodes="${LAT_NODES:-13}"
+epochs="${LAT_EPOCHS:-5}"
+out="${LAT_OUT:-}"
+
+log="$(mktemp)"
+trap 'rm -f "$log"' EXIT
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --latency \
+  --k "$nodes" --epochs "$epochs" 2>&1 | tee "$log"
+rc=${PIPESTATUS[0]}
+
+if [ -n "$out" ] && [ "$rc" = 0 ]; then
+  python - "$log" "$out" <<'PY'
+import json, sys
+
+rows = []
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+with open(sys.argv[2], "w") as fh:
+    json.dump(rows, fh, indent=2)
+print("wrote %d rows to %s" % (len(rows), sys.argv[2]))
+PY
+fi
+
+exit "$rc"

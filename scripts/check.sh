@@ -6,6 +6,8 @@
 #      `pytest --racecheck` (runtime thread-safety)
 #   3. wire-manifest verification — the @wire registry still matches
 #      the checked-in golden manifest (serialization stability)
+#   4. scenarios smoke — bad-share (the speculative-combine fallback
+#      and leftover-audit attribution gate) + equivocate
 #
 # Each stage runs even if an earlier one failed (you want the full
 # report, not the first stopper), but the exit code is non-zero if ANY
@@ -27,19 +29,25 @@ log() {
 
 rc=0
 
-echo "== [1/3] badgerlint (all rules) ==" | log
+echo "== [1/4] badgerlint (all rules) ==" | log
 python -m hbbft_tpu.analysis 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [2/3] racecheck smoke ==" | log
+echo "== [2/4] racecheck smoke ==" | log
 env JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
   -p no:cacheprovider --racecheck 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [3/3] wire manifest ==" | log
+echo "== [3/4] wire manifest ==" | log
 python -m hbbft_tpu.analysis --select wire-stability 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+
+echo "== [4/4] scenarios smoke ==" | log
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
+  --only bad-share --only equivocate 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 

@@ -313,8 +313,9 @@ def test_stress_concurrent_pipeline_zero_races_and_byte_identity(
     assert reports == [], "\n".join(r.message() for r in reports)
     assert warm_staged == warm_seq
     assert rho_staged == rho_seq
-    # sanity: the caches really did record the driven shapes
-    recorded = json.loads(warm_seq)
+    # sanity: the caches really did record the driven shapes (v2
+    # schema: the per-shape dict lives in the "shapes" plane)
+    recorded = json.loads(warm_seq)["shapes"]
     assert set(recorded) == {"%d:%d" % (n, g) for n, g, _ in _SHAPES}
     assert recorded["64:4"]["compressed"] is True  # sticky sighting
 
