@@ -2012,6 +2012,83 @@ def bench_qhb_scale(nodes: int = 32, txs: int = 320, batch: int = 64):
     )
 
 
+def bench_serve(
+    duration: float = 5.0,
+    clients: int = 2,
+    tenants: int = 2,
+    rate_hz: float = 60.0,
+    nodes: int = 4,
+):
+    """The serving headline: concurrent clients over the real TCP mesh
+    through the gateway — sustained committed tx/s with exactly-once
+    acks, plus the client-observed commit-latency percentiles."""
+    from hbbft_tpu.serve.loadgen import default_tenants, run_tcp
+
+    summary = run_tcp(
+        default_tenants(tenants, clients, rate_hz, mean_payload=256),
+        n_validators=nodes,
+        duration_s=duration,
+        seed=0x5EB0,
+    )
+    _emit(
+        "serve_tx_per_s",
+        summary["tx_per_s"],
+        "tx/s",
+        nodes=nodes,
+        tenants=summary["tenants"],
+        clients=summary["clients"],
+        submitted=summary["submitted"],
+        committed=summary["committed"],
+        reject_rate=summary["reject_rate"],
+        unacked=summary["unacked"],
+        duration_s=summary["duration_s"],
+    )
+    return _emit(
+        "serve_commit_latency",
+        summary["commit_p50_s"],
+        "s",
+        p50_s=summary["commit_p50_s"],
+        p99_s=summary["commit_p99_s"],
+        nodes=nodes,
+    )
+
+
+def bench_serve_vector(epochs: int = 100, nodes: int = 1024):
+    """BASELINE config #5 behind the gateway: n=1024 adversarial
+    (f crashed), 100 epochs, fed by superposed million-client tenant
+    arrival processes through the real frame/decode/admission path."""
+    from hbbft_tpu.serve.loadgen import default_tenants, run_vector
+
+    summary = run_vector(
+        default_tenants(4, 2, 50.0, mean_payload=256),
+        n=nodes,
+        epochs=epochs,
+        seed=0x5EB1,
+    )
+    _emit(
+        "serve_vector_tx_per_s",
+        summary["tx_per_s"],
+        "tx/s",
+        nodes=nodes,
+        epochs=epochs,
+        dead=summary["dead"],
+        tenants=summary["tenants"],
+        clients_simulated=summary["clients_simulated"],
+        submitted=summary["submitted"],
+        committed=summary["committed"],
+        reject_rate=summary["reject_rate"],
+        duration_s=summary["duration_s"],
+    )
+    return _emit(
+        "serve_vector_commit_latency",
+        summary["commit_p50_s"],
+        "s",
+        p50_s=summary["commit_p50_s"],
+        p99_s=summary["commit_p99_s"],
+        nodes=nodes,
+    )
+
+
 SUITE = {
     "sim_default": lambda: bench_sim_default(batched=False),
     "sim_batched": lambda: bench_sim_default(batched=True),
@@ -2040,6 +2117,8 @@ SUITE = {
     "qhb_dyn_1024_real": bench_qhb_dyn_1024_real,
     "broadcast_vec_1024": bench_broadcast_vec_1024,
     "hb_epoch64_real": bench_hb_epoch64_real,
+    "serve": bench_serve,
+    "serve_vector": bench_serve_vector,
 }
 
 
@@ -2110,6 +2189,21 @@ def main() -> None:
         "trace (see scripts/bench_cold.sh for the virgin/primed pair)",
     )
     p.add_argument(
+        "--serve",
+        action="store_true",
+        help="serving-gateway headline: concurrent clients over the real "
+        "TCP mesh, tx/s + commit p50/p99 (see scripts/bench_serve.sh)",
+    )
+    p.add_argument(
+        "--serve-vector",
+        action="store_true",
+        help="BASELINE config #5 (n=1024, adversarial, 100 epochs) "
+        "behind the gateway with synthetic million-client tenants",
+    )
+    p.add_argument(
+        "--duration", type=float, default=5.0, help="seconds (--serve)"
+    )
+    p.add_argument(
         "--trace",
         metavar="PATH",
         default=None,
@@ -2122,7 +2216,11 @@ def main() -> None:
 
         obsrec.enable(args.trace)
     try:
-        if args.latency:
+        if args.serve:
+            bench_serve(duration=args.duration)
+        elif args.serve_vector:
+            bench_serve_vector(epochs=args.epochs if args.epochs != 5 else 100)
+        elif args.latency:
             bench_latency(nodes=args.k or 13, epochs=args.epochs)
         elif args.cold:
             bench_cold(k=args.k or 4096)

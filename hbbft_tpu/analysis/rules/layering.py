@@ -40,8 +40,20 @@ ALLOWED: Dict[str, Set[str]] = {
         "native",
         "obs",
         "transport",
+        "serve",
     },
     "transport": {"transport", "protocols", "core", "crypto", "obs"},
+    # the serving front door sits above the mesh and the protocol stack;
+    # its loadgen leg drives the vectorized harness driver
+    "serve": {
+        "serve",
+        "transport",
+        "protocols",
+        "core",
+        "crypto",
+        "obs",
+        "harness",
+    },
     # "analysis" and "<root>" deliberately absent: unconstrained.
 }
 

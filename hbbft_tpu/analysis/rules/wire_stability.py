@@ -204,6 +204,7 @@ class WireStabilityRule(Rule):
         "analysis/",
         "parallel/",
         "native/",
+        "serve/",
     )
 
     def __init__(self, manifest: Optional[Dict[str, object]] = None):

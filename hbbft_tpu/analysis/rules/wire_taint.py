@@ -82,6 +82,7 @@ class WireTaintRule(Rule):
         "transport/",
         "harness/",
         "crypto/merkle.py",
+        "serve/",
     )
     whole_project = True
 

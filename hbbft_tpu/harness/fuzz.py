@@ -7,7 +7,7 @@ type-confused — field values, then mutates the raw bytes (truncation,
 bit flips, bad tags, inflated length prefixes, unknown type names,
 wrong-arity objects, pathological nesting).
 
-Three attack surfaces, one invariant each:
+Four attack surfaces, one invariant each:
 
 - :func:`fuzz_codec` — ``core.serialize.loads`` must either decode or
   raise ``SerializationError``; any other exception type is a crash
@@ -18,6 +18,10 @@ Three attack surfaces, one invariant each:
 - :func:`fuzz_handlers` — every ``handle_*`` surface fed a
   malformed-but-deserializable message from a known sender must return
   a ``Step`` (possibly carrying ``Fault``\\ s), never raise.
+- :func:`fuzz_gateway` — the serving front door: client framing,
+  handshake, submit/ack handlers, and the gossip intercept must
+  cleanly reject or attribute every hostile input, never crash or
+  hang.
 
 All randomness flows from one seeded ``random.Random`` — a failing
 seed reproduces exactly.  The manifest is loaded from its JSON file by
@@ -398,6 +402,184 @@ def fuzz_handlers(
     return report
 
 
+# -- surface 4: the serving gateway -----------------------------------------
+
+
+def _build_gateway_targets(rng: random.Random) -> Tuple[Any, Any]:
+    """A fresh sans-IO gateway core plus a ``GatewayAlgo`` over a real
+    (mock-crypto) QueueingHoneyBadger — the two state machines a
+    hostile client or peer can reach."""
+    from ..protocols.dynamic_honey_badger import DynamicHoneyBadgerBuilder
+    from ..protocols.queueing_honey_badger import QueueingHoneyBadger
+    from ..serve.gateway import AdmissionQueues, GatewayAlgo, GatewayCore
+
+    core = GatewayCore(
+        AdmissionQueues(per_tenant_limit=64, global_limit=128)
+    )
+    ids = list(range(4))
+    netinfos = NetworkInfo.generate_map(ids, rng, mock=True)
+    dhb = DynamicHoneyBadgerBuilder().build(netinfos[0])
+    algo = GatewayAlgo(
+        QueueingHoneyBadger(dhb, batch_size=8, rng=random.Random(rng.random()))
+    )
+    return core, algo
+
+
+def _client_stream(rng: random.Random, manifest: Dict[str, Any]) -> bytes:
+    """One hostile client byte-stream: a length-prefixed frame whose
+    payload/header may be honest, type-confused, mutated, truncated, or
+    a lying oversize header."""
+    from ..serve import protocol as _sp
+
+    k = rng.randrange(8)
+    if k == 0:  # honest handshake
+        payload = dumps(_sp.ClientHello(_sp.PROTO_VERSION, f"t{rng.randrange(3)}", f"c{rng.randrange(4)}"))
+    elif k == 1:  # honest submission
+        payload = dumps(
+            _sp.SubmitTx(rng.randrange(2**20), bytes(rng.randrange(0, 64)))
+        )
+    elif k == 2:  # handshake lie / confused fields
+        payload = dumps(
+            _sp.ClientHello(
+                rng.choice([0, 2, -1, "1", None, b"\x01"]),
+                rng.choice(["", "x" * 65, 7, None, "\x00evil"]),
+                rng.choice(["c", b"c", 0, "\t"]),
+            )
+        )
+    elif k == 3:  # payload bomb attempt (within the frame bound)
+        payload = dumps(_sp.SubmitTx(0, bytes(_sp.MAX_PAYLOAD + rng.randrange(1, 64))))
+    else:  # arbitrary manifest object, possibly byte-mutated
+        payload = _random_obj_frame(rng, manifest)
+        for _ in range(rng.randrange(0, 3)):
+            payload = _mutate(rng, payload)
+    header_kind = rng.randrange(8)
+    if header_kind == 0:  # oversize header: must be rejected pre-allocation
+        return (_sp.CLIENT_MAX_FRAME + 1 + rng.randrange(2**24)).to_bytes(
+            _sp.LEN_BYTES, "big"
+        )
+    frame = len(payload).to_bytes(_sp.LEN_BYTES, "big") + payload
+    if header_kind == 1:  # slow-loris-shaped truncation mid-frame
+        return frame[: rng.randrange(len(frame))]
+    return frame
+
+
+def fuzz_gateway(
+    seed: int, cases: int, manifest: Optional[Dict[str, Any]] = None
+) -> FuzzReport:
+    """Fuzz the serving front door: the client framing layer
+    (``serve.protocol.read_frame``), the handshake and submit handlers
+    of the sans-IO ``GatewayCore``, the commit-ack path, the total
+    client-side validators, and ``GatewayAlgo``'s gossip intercept.
+    The contract everywhere: clean rejection or attribution, never an
+    exception escaping, never a hang."""
+    from ..serve import protocol as _sp
+    from ..serve.protocol import ProtocolError
+
+    rng = random.Random(seed)
+    manifest = manifest or load_manifest()
+    register_manifest_types(manifest)
+    report = FuzzReport(surface="gateway")
+    core, algo = _build_gateway_targets(rng)
+    validators = (
+        _sp.validate_hello,
+        _sp.validate_submit,
+        _sp.validate_gossip,
+        _sp.validate_hello_ack,
+        _sp.validate_submit_ack,
+        _sp.validate_commit_ack,
+    )
+
+    async def read_one(stream: bytes) -> Any:
+        reader = asyncio.StreamReader()
+        reader.feed_data(stream)
+        reader.feed_eof()
+        msg, _ = await asyncio.wait_for(_sp.read_frame(reader), FRAME_TIMEOUT_S)
+        return msg
+
+    async def run_all() -> None:
+        nonlocal core, algo
+        for i in range(cases):
+            report.cases += 1
+            if i and i % 64 == 0:
+                core, algo = _build_gateway_targets(rng)
+            stream = _client_stream(rng, manifest)
+            try:
+                message = await read_one(stream)
+                report.decoded += 1
+            except (ProtocolError, SerializationError, asyncio.IncompleteReadError):
+                report.rejected += 1
+                continue
+            except asyncio.TimeoutError:
+                report.failures.append(
+                    f"read_frame hung on {stream[:32].hex()}…len={len(stream)}"
+                )
+                continue
+            except Exception as exc:
+                report.failures.append(
+                    f"read_frame({stream[:32].hex()}…) raised "
+                    f"{type(exc).__name__}: {exc}"
+                )
+                continue
+            conn = f"fz{rng.randrange(6)}"
+            now = float(i)
+            for label, call in (
+                ("on_hello", lambda: core.on_hello(conn, message)),
+                ("on_submit", lambda: core.on_submit(conn, message, now)),
+            ):
+                try:
+                    _, dropped = call()
+                    if dropped:
+                        report.faults += 1
+                except Exception as exc:
+                    report.failures.append(
+                        f"GatewayCore.{label}({message!r:.120}) raised "
+                        f"{type(exc).__name__}: {exc}"
+                    )
+            try:
+                core.on_committed(message, rng.choice([0, 1, -1, "e", None]), now)
+            except Exception as exc:
+                report.failures.append(
+                    f"GatewayCore.on_committed({message!r:.120}) raised "
+                    f"{type(exc).__name__}: {exc}"
+                )
+            for v in validators:
+                try:
+                    verdict = v(message)
+                    if type(verdict) is not bool:
+                        report.failures.append(
+                            f"{v.__name__} returned {type(verdict).__name__}"
+                        )
+                except Exception as exc:
+                    report.failures.append(
+                        f"{v.__name__}({message!r:.120}) raised "
+                        f"{type(exc).__name__}: {exc}"
+                    )
+            try:
+                _sp.decode_tx(message)
+            except Exception as exc:
+                report.failures.append(
+                    f"decode_tx({message!r:.120}) raised "
+                    f"{type(exc).__name__}: {exc}"
+                )
+            try:
+                step = algo.handle_message(1, message)
+            except Exception as exc:
+                report.failures.append(
+                    f"GatewayAlgo.handle_message({message!r:.120}) raised "
+                    f"{type(exc).__name__}: {exc}"
+                )
+                continue
+            if not isinstance(step, Step):
+                report.failures.append(
+                    f"GatewayAlgo.handle_message returned {type(step).__name__}"
+                )
+                continue
+            report.faults += len(step.fault_log)
+
+    asyncio.run(run_all())
+    return report
+
+
 # -- the full corpus --------------------------------------------------------
 
 
@@ -406,11 +588,13 @@ def run_corpus(
     codec_cases: int = 400,
     frame_cases: int = 60,
     handler_cases: int = 200,
+    gateway_cases: int = 200,
 ) -> List[FuzzReport]:
-    """The pinned-seed corpus: all three surfaces, deterministic."""
+    """The pinned-seed corpus: all four surfaces, deterministic."""
     manifest = load_manifest()
     return [
         fuzz_codec(seed, codec_cases, manifest),
         fuzz_frames(seed + 1, frame_cases, manifest),
         fuzz_handlers(seed + 2, handler_cases, manifest),
+        fuzz_gateway(seed + 3, gateway_cases, manifest),
     ]

@@ -28,9 +28,15 @@ module packages them as a named, seeded, CLI-drivable matrix (reference
 - **churn**: DynamicHoneyBadger membership churn (Remove → Add with
   on-chain DKG era switches) through the vectorized harness; every
   proposed transaction commits and honest fault logs stay empty.
+- **hostile-clients**: honest tenants and every hostile-client class
+  (handshake lies, submit-before-hello, oversized payloads, malformed
+  frames, slow-loris) share one serving gateway; each hostile
+  connection is attributed and disconnected exactly once, and the
+  honest side's committed batches are bit-identical to a hostile-free
+  same-seed twin.
 - **fuzz**: the wire-format fuzzer corpus (:mod:`hbbft_tpu.harness.fuzz`)
-  over the codec, the TCP framing layer and the ``handle_*`` surface —
-  zero crashes, hangs or unlogged failures.
+  over the codec, the TCP framing layer, the ``handle_*`` surface and
+  the serving gateway — zero crashes, hangs or unlogged failures.
 
 Run ``python -m hbbft_tpu.harness.scenarios`` (``--list`` for the
 matrix, ``--only`` to select, ``--json`` for machine-readable rows).
@@ -421,6 +427,222 @@ def _run_churn(cfg: ScenarioConfig) -> ScenarioResult:
     )
 
 
+# -- serving gateway under hostile clients -----------------------------------
+
+
+def _run_hostile_clients(cfg: ScenarioConfig) -> ScenarioResult:
+    """Honest tenants and hostile clients share one gateway; the hostile
+    traffic must change *nothing* for the honest side.
+
+    Two sans-IO gateway cores run the identical seeded honest workload;
+    one additionally absorbs every hostile-client class (handshake lies,
+    submit-before-hello, oversized payloads, malformed frames,
+    slow-loris timeouts).  The hostile core must (a) attribute and
+    disconnect each hostile connection exactly once, and (b) drain a
+    byte-identical admitted batch.  Both batches then drive two
+    identically-seeded sequential networks of ``GatewayAlgo`` nodes to
+    a committed epoch whose batches must be bit-identical, with every
+    admitted transaction commit-acked exactly once — and an invalid
+    ``TxGossip`` from a validator must be attributed as
+    ``INVALID_MESSAGE``."""
+    from ..core.fault import FaultKind
+    from ..protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from ..protocols.queueing_honey_badger import QueueingHoneyBadger
+    from ..serve.gateway import AdmissionQueues, GatewayAlgo, GatewayCore
+    from ..serve.protocol import ClientHello, SubmitTx, TxGossip
+
+    n = max(4, min(cfg.n, 5))  # sequential consensus: keep it small
+
+    def new_core() -> GatewayCore:
+        return GatewayCore(
+            AdmissionQueues(weights={"alpha": 2, "beta": 1})
+        )
+
+    def honest_traffic(core: GatewayCore) -> None:
+        rng = random.Random(cfg.seed)
+        clients = [
+            (f"conn-{t}-{c}", t, f"{t}-c{c}")
+            for t in ("alpha", "beta")
+            for c in range(2)
+        ]
+        for conn, tenant, cid in clients:
+            replies, dropped = core.on_hello(conn, ClientHello(1, tenant, cid))
+            _check(
+                not dropped and replies and replies[0].ok,
+                f"honest hello rejected for {cid}",
+            )
+        for s in range(3):
+            for conn, _, cid in clients:
+                payload = bytes(rng.randrange(8, 64)) + cid.encode()
+                replies, dropped = core.on_submit(
+                    conn, SubmitTx(s, payload), float(s)
+                )
+                _check(
+                    not dropped and replies and replies[0].admitted,
+                    f"honest submit rejected for {cid} seq {s}",
+                )
+
+    hostile = new_core()
+    twin = new_core()
+    honest_traffic(twin)
+
+    # interleave: half the honest workload, then every hostile class,
+    # then the rest (the cores are order-sensitive state machines, so
+    # run the honest stream once and fire the hostile volleys around it)
+    hostile_events: List[Any] = []
+
+    def volley(core: GatewayCore) -> None:
+        # handshake lie: wrong proto version
+        _, dropped = core.on_hello("h-lie", ClientHello(99, "alpha", "evil"))
+        _check(dropped, "handshake lie not disconnected")
+        # handshake lie: unprintable tenant
+        _, dropped = core.on_hello("h-tenant", ClientHello(1, "\x00", "evil"))
+        _check(dropped, "bad tenant not disconnected")
+        # submit before hello
+        _, dropped = core.on_submit("h-early", SubmitTx(0, b"x"), 0.0)
+        _check(dropped, "submit-before-hello not disconnected")
+        # oversized payload behind a valid session
+        replies, dropped = core.on_hello("h-big", ClientHello(1, "alpha", "big"))
+        _check(not dropped, "hostile session open failed")
+        from ..serve.protocol import MAX_PAYLOAD
+
+        _, dropped = core.on_submit(
+            "h-big", SubmitTx(0, bytes(MAX_PAYLOAD + 1)), 0.0
+        )
+        _check(dropped, "oversized payload not disconnected")
+        # malformed frame + slow-loris (the asyncio shell reports these
+        # to the same attribution path)
+        core.on_bad_frame("h-garbage")
+        core.on_timeout("h-loris")
+
+    honest_traffic(hostile)
+    volley(hostile)
+
+    expected_drops = [
+        ("h-lie", "bad-hello"),
+        ("h-tenant", "bad-hello"),
+        ("h-early", "submit-before-hello"),
+        ("h-big", "bad-submit"),
+        ("h-garbage", "malformed-frame"),
+        ("h-loris", "slow-loris"),
+    ]
+    _check(
+        hostile.drops == expected_drops,
+        f"attribution mismatch: {hostile.drops} != {expected_drops}",
+    )
+    _check(twin.drops == [], f"hostile-free twin attributed: {twin.drops}")
+
+    batch_hostile = tuple(hostile.drain(64))
+    batch_twin = tuple(twin.drain(64))
+    _check(
+        batch_hostile == batch_twin,
+        "admitted batch diverges from the hostile-free twin "
+        f"({len(batch_hostile)} vs {len(batch_twin)} txs)",
+    )
+    _check(len(batch_twin) == 12, f"expected 12 admitted txs, got {len(batch_twin)}")
+
+    # consensus leg: identically-seeded networks, one per core
+    def new_net() -> TestNetwork:
+        rng = random.Random(cfg.seed + 1)
+
+        def new_algo(ni):
+            arng = random.Random(f"hc-{ni.our_id}")
+            return GatewayAlgo(
+                QueueingHoneyBadger(
+                    DynamicHoneyBadger(ni, rng=arng), batch_size=16, rng=arng
+                )
+            )
+
+        return TestNetwork(
+            n,
+            0,
+            lambda adv: SilentAdversary(
+                MessageScheduler(MessageScheduler.RANDOM, rng)
+            ),
+            new_algo,
+            rng,
+            mock_crypto=True,
+        )
+
+    def batch_key(b) -> Any:
+        return (
+            b.epoch,
+            tuple(
+                sorted(
+                    (str(k), tuple(v)) for k, v in b.contributions.items()
+                )
+            ),
+            repr(b.change),
+        )
+
+    def run_net(net: TestNetwork, batch) -> List[Any]:
+        net.input(0, TxGossip(batch))
+        for _ in range(200_000):
+            if all(nd.outputs for nd in net.nodes.values()):
+                break
+            if net.any_busy():
+                net.step()
+                continue
+            for nid, nd in net.nodes.items():  # idle kick: re-propose
+                step = nd.instance.propose()
+                if not step.is_empty():
+                    nd._absorb(step)
+                    msgs = list(nd.messages)
+                    nd.messages.clear()
+                    net.dispatch_messages(nid, msgs)
+            if not net.any_busy():
+                break
+        _check(
+            all(nd.outputs for nd in net.nodes.values()),
+            "consensus leg stalled before every node output a batch",
+        )
+        keys = [batch_key(nd.outputs[0]) for _, nd in sorted(net.nodes.items())]
+        _check(
+            len(set(keys)) == 1, "validators disagree on the first batch"
+        )
+        return keys
+
+    net_a, net_b = new_net(), new_net()
+    keys_a = run_net(net_a, batch_hostile)
+    keys_b = run_net(net_b, batch_twin)
+    _check(
+        keys_a == keys_b,
+        "committed batches diverge from the hostile-free twin network",
+    )
+
+    # commit-ack leg: every admitted tx acked exactly once
+    first_batch = net_a.nodes[0].outputs[0]
+    committed = [tx for tx in first_batch.tx_iter()]
+    acked = 0
+    for tx in committed:
+        r = hostile.on_committed(tx, first_batch.epoch, 10.0)
+        if r is not None:
+            acked += 1
+            _check(
+                hostile.on_committed(tx, first_batch.epoch, 10.0) is None,
+                "duplicate commit ack",
+            )
+    _check(acked > 0, "no admitted tx committed in the first batch")
+
+    # a validator gossiping garbage must be attributed, not crash
+    step = net_a.nodes[0].instance.handle_message(1, TxGossip(b"not-a-tuple"))
+    gossip_faults = list(step.fault_log)
+    _check(
+        len(gossip_faults) == 1
+        and gossip_faults[0].node_id == 1
+        and gossip_faults[0].kind == FaultKind.INVALID_MESSAGE,
+        f"invalid gossip attribution wrong: {gossip_faults}",
+    )
+
+    faults = len(hostile.drops) + len(gossip_faults)
+    return ScenarioResult(
+        "hostile-clients", True, n, 1, cfg.seed, faults,
+        f"{len(expected_drops)} hostile clients attributed, "
+        f"{len(batch_twin)} honest txs bit-identical to twin, "
+        f"{acked} commit-acked exactly once",
+    )
+
+
 # -- wire-format fuzzing -----------------------------------------------------
 
 
@@ -468,6 +690,7 @@ SCENARIOS: Dict[str, Callable[[ScenarioConfig], ScenarioResult]] = {
     "delay": _run_delay,
     "partition-heal": _run_partition_heal,
     "churn": _run_churn,
+    "hostile-clients": _run_hostile_clients,
     "fuzz": _run_fuzz,
 }
 

@@ -92,4 +92,12 @@ EVENTS: Dict[str, EventSpec] = {
         {"rules", "violations", "wall"},
         {"baselined", "errors", "counts", "paths", "changed"},
     ),
+    # serving gateway (additive): admission decisions, the client-side
+    # commit-latency arc, and periodic queue-depth snapshots
+    "gateway_admit": _spec({"tenant", "depth"}, {"client", "seq"}),
+    "gateway_reject": _spec(
+        {"tenant", "reason"}, {"client", "seq", "retry_after_ms"}
+    ),
+    "client_commit_latency": _spec({"latency_s"}, {"tenant", "epoch"}),
+    "queue_depth": _spec({"depth"}, {"pending"}),
 }

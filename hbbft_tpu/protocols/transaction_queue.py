@@ -21,9 +21,25 @@ class TransactionQueue:
         self.queue.append(tx)
 
     def remove_all(self, txs: Iterable) -> None:
-        tx_set = set(txs)
+        """Drop every committed transaction from the queue in one pass.
+
+        Builds the committed set once — O(n + m) with hashable
+        transactions instead of the O(n·m) scan this used to be, which
+        dominated the per-epoch commit path at gateway load.  Batches
+        may carry unhashable foreign transactions injected by other
+        proposers; those fall back to list membership rather than
+        raising TypeError out of the commit path."""
+        committed = list(txs)
+        try:
+            lookup = set(committed)
+            self.queue = collections.deque(
+                tx for tx in self.queue if tx not in lookup
+            )
+            return
+        except TypeError:
+            pass  # unhashable tx in the batch or the queue
         self.queue = collections.deque(
-            tx for tx in self.queue if tx not in tx_set
+            tx for tx in self.queue if tx not in committed
         )
 
     def choose(self, amount: int, batch_size: int, rng) -> List:

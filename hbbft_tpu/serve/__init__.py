@@ -1,0 +1,72 @@
+"""The serving front door — a client-facing gateway for QueueingHoneyBadger.
+
+The validator mesh (``transport/tcp.py``) moves *protocol* messages
+between nodes that already trust the codec and attribute each other's
+faults.  This package is the other half of a production system: the
+side that talks to **clients**, who are assumed hostile by default.
+
+- :mod:`.protocol` — the client wire protocol: ``@wire`` request /
+  response / ack types, length-prefixed framing shared with the mesh,
+  and total (never-raising) validators for every inbound surface.
+- :mod:`.gateway` — admission control with bounded per-tenant queues
+  and explicit backpressure, weighted-fair batching into
+  ``QueueingHoneyBadger`` epochs, commit acknowledgement with
+  exactly-once semantics, and attribution/disconnection of hostile
+  clients.  The core is a sans-IO deterministic state machine; a thin
+  asyncio shell serves real sockets.
+- :mod:`.loadgen` — the synthetic million-user harness: open-loop
+  Poisson and bursty arrivals, heavy-tail payload sizes, N tenants,
+  reporting sustained tx/s, commit p50/p99, reject rate and
+  queue-depth timelines.
+"""
+
+from .gateway import AdmissionQueues, Gateway, GatewayAlgo, GatewayCore
+from .protocol import (
+    CLIENT_MAX_FRAME,
+    MAX_PAYLOAD,
+    PROTO_VERSION,
+    ClientHello,
+    CommitAck,
+    HelloAck,
+    ProtocolError,
+    SubmitAck,
+    SubmitTx,
+    TxGossip,
+    decode_tx,
+    encode_tx,
+    frame,
+    read_frame,
+    validate_commit_ack,
+    validate_gossip,
+    validate_hello,
+    validate_hello_ack,
+    validate_submit,
+    validate_submit_ack,
+)
+
+__all__ = [
+    "AdmissionQueues",
+    "Gateway",
+    "GatewayAlgo",
+    "GatewayCore",
+    "CLIENT_MAX_FRAME",
+    "MAX_PAYLOAD",
+    "PROTO_VERSION",
+    "ClientHello",
+    "CommitAck",
+    "HelloAck",
+    "ProtocolError",
+    "SubmitAck",
+    "SubmitTx",
+    "TxGossip",
+    "decode_tx",
+    "encode_tx",
+    "frame",
+    "read_frame",
+    "validate_commit_ack",
+    "validate_gossip",
+    "validate_hello",
+    "validate_hello_ack",
+    "validate_submit",
+    "validate_submit_ack",
+]

@@ -1,0 +1,537 @@
+"""The gateway node — admission, fairness, batching, commit acks.
+
+Three layers, separated so the adversarial harness can drive the whole
+serving path deterministically:
+
+- :class:`AdmissionQueues` — bounded per-tenant FIFO queues with
+  explicit backpressure (reject-with-retry-after, never a silent drop)
+  and deterministic weighted round-robin drain.
+- :class:`GatewayCore` — the sans-IO state machine: handshake state per
+  connection, total validation of every inbound message, admission,
+  the pending→acked exactly-once commit ledger, and attribution of
+  hostile behaviour (``drops``).  All timing enters via explicit
+  ``now`` arguments; the core touches no sockets, clocks or ambient
+  randomness, so a seeded scenario run is bit-reproducible.
+- :class:`Gateway` — the asyncio shell: a client listener in front of a
+  :class:`~hbbft_tpu.transport.tcp.TcpNode` running
+  :class:`GatewayAlgo`, with per-frame deadlines (slow-loris defence),
+  a flush pump that gossips admitted batches into the mesh, and a
+  commit watcher that turns batch outputs into ``CommitAck`` frames.
+
+:class:`GatewayAlgo` wraps ``QueueingHoneyBadger`` for *every*
+validator in a served mesh: it intercepts validated ``TxGossip``
+relays into the local transaction queue (so all N validators propose
+client transactions — the N−f rule needs more than one proposer) and
+attributes invalid gossip as ``INVALID_MESSAGE``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
+
+from ..core.algorithm import DistAlgorithm
+from ..core.fault import FaultKind
+from ..core.serialize import SerializationError
+from ..core.step import Step
+from ..obs import recorder as _obs
+from ..protocols.queueing_honey_badger import QueueingHoneyBadger
+from ..transport.tcp import TcpNode
+from .protocol import (
+    CLIENT_MAX_FRAME,
+    MAX_PAYLOAD,
+    CommitAck,
+    HelloAck,
+    ProtocolError,
+    SubmitAck,
+    TxGossip,
+    encode_tx,
+    frame,
+    read_frame,
+    validate_gossip,
+    validate_hello,
+    validate_submit,
+)
+
+# -- admission ---------------------------------------------------------------
+
+
+class AdmissionQueues:
+    """Bounded per-tenant FIFO queues with weighted-fair drain.
+
+    ``offer`` admits into the claiming tenant's queue or rejects with
+    an explicit ``retry_after_ms`` (tenant bound first, then the global
+    bound — one noisy tenant cannot starve the others' headroom).
+    ``take`` drains with deterministic weighted round-robin: tenants in
+    sorted order from a rotating cursor, up to ``weight`` transactions
+    per tenant per pass."""
+
+    def __init__(
+        self,
+        weights: Optional[Dict[str, int]] = None,
+        default_weight: int = 1,
+        per_tenant_limit: int = 1024,
+        global_limit: int = 8192,
+        retry_after_ms: int = 50,
+    ):
+        self._weights = dict(weights or {})
+        self._default_weight = max(1, int(default_weight))
+        self.per_tenant_limit = int(per_tenant_limit)
+        self.global_limit = int(global_limit)
+        self.retry_after_ms = int(retry_after_ms)
+        self._queues: Dict[str, Deque[bytes]] = {}
+        self._total = 0
+        self._cursor = 0
+
+    def weight(self, tenant: str) -> int:
+        try:
+            w = int(self._weights.get(tenant, self._default_weight))
+        except (TypeError, ValueError):
+            w = self._default_weight
+        return max(1, w)
+
+    def depth(self, tenant: str) -> int:
+        q = self._queues.get(tenant)
+        return len(q) if q is not None else 0
+
+    def total_depth(self) -> int:
+        return self._total
+
+    def offer(self, tenant: str, tx: bytes) -> Tuple[bool, int, str]:
+        """→ (admitted, retry_after_ms, detail)."""
+        q = self._queues.get(tenant)
+        if q is not None and len(q) >= self.per_tenant_limit:
+            # the noisy tenant backs off proportionally to its own
+            # backlog share, not the gateway's
+            return False, self.retry_after_ms, "tenant-full"
+        if self._total >= self.global_limit:
+            return False, 2 * self.retry_after_ms, "gateway-full"
+        if q is None:
+            q = self._queues.setdefault(tenant, collections.deque())
+        q.append(tx)
+        self._total += 1
+        return True, 0, "ok"
+
+    def take(self, max_n: int) -> List[bytes]:
+        """Drain up to ``max_n`` transactions, weighted-fair."""
+        out: List[bytes] = []
+        if max_n <= 0:
+            return out
+        tenants = sorted(t for t, q in self._queues.items() if q)
+        if not tenants:
+            return out
+        start = self._cursor % len(tenants)
+        while len(out) < max_n:
+            progressed = False
+            for i in range(len(tenants)):
+                t = tenants[(start + i) % len(tenants)]
+                q = self._queues[t]
+                for _ in range(self.weight(t)):
+                    if not q or len(out) >= max_n:
+                        break
+                    out.append(q.popleft())
+                    self._total -= 1
+                    progressed = True
+            if not progressed:
+                break
+        # rotate which tenant leads the next drain so equal-weight
+        # tenants alternate priority across flushes
+        self._cursor += 1
+        return out
+
+
+# -- the sans-IO core --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Pending:
+    conn_id: str
+    tenant: str
+    client_id: str
+    seq: int
+    t_admit: float
+
+
+class GatewayCore:
+    """Deterministic gateway state machine.
+
+    Every ``on_*`` handler is total over arbitrary inbound values and
+    returns ``(replies, drop)`` — wire messages to send back, and
+    whether to disconnect the client.  Hostile behaviour lands in
+    ``drops`` as ``(conn_id, reason)`` attribution, never as an
+    exception.
+
+    The ``pending → acked`` ledger gives exactly-once commit acks: a
+    transaction admitted once is acked on its *first* appearance in a
+    committed batch; duplicates across proposer samples (expected —
+    proposers draw overlapping random samples) are ignored.  ``acked``
+    retains envelope hashes for the life of the core (bench/test scale;
+    a long-lived deployment would age it out by epoch)."""
+
+    def __init__(
+        self,
+        admission: Optional[AdmissionQueues] = None,
+        max_payload: int = MAX_PAYLOAD,
+    ):
+        self.admission = admission if admission is not None else AdmissionQueues()
+        self.max_payload = int(max_payload)
+        self.sessions: Dict[str, Tuple[str, str]] = {}
+        self.pending: Dict[bytes, _Pending] = {}
+        self.acked: Set[bytes] = set()
+        self.drops: List[Tuple[str, str]] = []
+        self.admitted = 0
+        self.rejected = 0
+        self.commits = 0
+
+    # -- connection lifecycle ------------------------------------------------
+
+    def on_hello(self, conn_id: str, msg: Any) -> Tuple[List[Any], bool]:
+        if conn_id in self.sessions:
+            self._drop(conn_id, "double-hello")
+            return [], True
+        if not validate_hello(msg):
+            self._drop(conn_id, "bad-hello")
+            return [HelloAck(False, "bad hello", self.max_payload)], True
+        self.sessions[conn_id] = (msg.tenant, msg.client_id)
+        return [HelloAck(True, "ok", self.max_payload)], False
+
+    def on_submit(
+        self, conn_id: str, msg: Any, now: float
+    ) -> Tuple[List[Any], bool]:
+        sess = self.sessions.get(conn_id)
+        if sess is None:
+            self._drop(conn_id, "submit-before-hello")
+            return [], True
+        if not validate_submit(msg, self.max_payload):
+            self._drop(conn_id, "bad-submit")
+            return [], True
+        tenant, client_id = sess
+        tx = encode_tx(tenant, client_id, msg.seq, msg.payload)
+        if tx in self.pending or tx in self.acked:
+            # idempotent resubmission — already admitted; the commit
+            # will still be acked exactly once
+            return [SubmitAck(msg.seq, True, 0, "duplicate")], False
+        ok, retry_ms, detail = self.admission.offer(tenant, tx)
+        rec = _obs.ACTIVE
+        if ok:
+            self.pending[tx] = _Pending(conn_id, tenant, client_id, msg.seq, now)
+            self.admitted += 1
+            if rec is not None:
+                rec.event(
+                    "gateway_admit",
+                    tenant=tenant,
+                    depth=self.admission.depth(tenant),
+                    client=client_id,
+                    seq=msg.seq,
+                )
+                rec.count("gateway.admitted")
+            return [SubmitAck(msg.seq, True, 0, "ok")], False
+        self.rejected += 1
+        if rec is not None:
+            rec.event(
+                "gateway_reject",
+                tenant=tenant,
+                reason=detail,
+                client=client_id,
+                seq=msg.seq,
+                retry_after_ms=retry_ms,
+            )
+            rec.count("gateway.rejected")
+        return [SubmitAck(msg.seq, False, retry_ms, detail)], False
+
+    def on_bad_frame(
+        self, conn_id: str, reason: str = "malformed-frame"
+    ) -> Tuple[List[Any], bool]:
+        self._drop(conn_id, reason)
+        return [], True
+
+    def on_timeout(self, conn_id: str) -> Tuple[List[Any], bool]:
+        self._drop(conn_id, "slow-loris")
+        return [], True
+
+    def disconnect(self, conn_id: str) -> None:
+        """Clean close — no attribution; undelivered commit acks for
+        this connection are simply dropped on the floor."""
+        self.sessions.pop(conn_id, None)
+
+    def _drop(self, conn_id: str, reason: str) -> None:
+        self.drops.append((conn_id, reason))
+        self.sessions.pop(conn_id, None)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.count(f"gateway.drop.{reason}")
+
+    # -- the mesh side -------------------------------------------------------
+
+    def drain(self, max_n: int) -> List[bytes]:
+        """Admitted transactions for the next gossip relay, weighted
+        fairly across tenants; emits the queue-depth timeline row."""
+        batch = self.admission.take(max_n)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "queue_depth",
+                depth=self.admission.total_depth(),
+                pending=len(self.pending),
+            )
+        return batch
+
+    def on_committed(
+        self, tx: Any, epoch: Any, now: float
+    ) -> Optional[Tuple[str, CommitAck, float]]:
+        """One transaction from a committed batch → at most one
+        ``(conn_id, CommitAck, latency_s)``; ``None`` for foreign
+        transactions, duplicates, and anything already acked."""
+        if not isinstance(tx, bytes) or tx in self.acked:
+            return None
+        p = self.pending.pop(tx, None)
+        if p is None:
+            return None
+        self.acked.add(tx)
+        self.commits += 1
+        latency = max(0.0, now - p.t_admit)
+        ep = epoch if type(epoch) is int else -1
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "client_commit_latency",
+                latency_s=latency,
+                tenant=p.tenant,
+                epoch=ep,
+            )
+            rec.observe("gateway.commit_latency_s", latency)
+        return p.conn_id, CommitAck(p.seq, ep), latency
+
+
+# -- the mesh-side algorithm wrapper ----------------------------------------
+
+
+class GatewayAlgo(DistAlgorithm):
+    """``QueueingHoneyBadger`` + the ``TxGossip`` relay plane.
+
+    Every validator of a served mesh runs this wrapper.  The gateway
+    node inputs ``TxGossip`` batches locally (queuing them and
+    multicasting the relay); peers queue validated relays and propose.
+    Invalid gossip is attributed ``INVALID_MESSAGE`` and ignored —
+    exactly like any other malformed protocol message."""
+
+    def __init__(self, qhb: QueueingHoneyBadger):
+        self.qhb = qhb
+
+    def handle_input(self, input: Any) -> Step:
+        if isinstance(input, TxGossip):
+            if not validate_gossip(input):
+                raise ValueError("invalid local TxGossip input")
+            step: Step = Step()
+            for tx in input.txs:
+                self.qhb.queue.push(tx)
+            step.send_all(input)
+            step.extend(self.qhb.propose())
+            return step
+        return self.qhb.handle_input(input)
+
+    def handle_message(self, sender_id: Any, message: Any) -> Step:
+        if isinstance(message, TxGossip):
+            if not validate_gossip(message):
+                return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
+            step = Step()
+            for tx in message.txs:
+                self.qhb.queue.push(tx)
+            step.extend(self.qhb.propose())
+            return step
+        return self.qhb.handle_message(sender_id, message)
+
+    def propose(self) -> Step:
+        return self.qhb.propose()
+
+    def terminated(self) -> bool:
+        return False
+
+    def our_id(self) -> Any:
+        return self.qhb.our_id()
+
+
+# -- the asyncio shell -------------------------------------------------------
+
+
+class Gateway:
+    """Client listener + mesh pump around a :class:`TcpNode` running
+    :class:`GatewayAlgo`.
+
+    Hostile-client defences, all attribution-first:
+
+    - **handshake deadline** — a connection that does not complete its
+      ``ClientHello`` within ``handshake_timeout`` is ``slow-loris``
+      attributed and closed;
+    - **per-frame deadline** — an established connection gets
+      ``idle_timeout`` per frame, so trickling one byte per minute
+      cannot pin a reader task forever;
+    - **oversized header / malformed payload** — rejected by
+      :func:`read_frame` before allocation / by the codec, attributed,
+      disconnected;
+    - **handler exceptions** — anything escaping the core on hostile
+      input is contained per-connection, never taking the listener or
+      the mesh pump down."""
+
+    def __init__(
+        self,
+        node: TcpNode,
+        listen_addr: str,
+        core: Optional[GatewayCore] = None,
+        handshake_timeout: float = 5.0,
+        idle_timeout: float = 30.0,
+        batch_max: int = 256,
+        flush_interval: float = 0.005,
+        max_frame: int = CLIENT_MAX_FRAME,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.node = node
+        self.core = core if core is not None else GatewayCore()
+        self.listen_addr = listen_addr
+        self.handshake_timeout = handshake_timeout
+        self.idle_timeout = idle_timeout
+        self.batch_max = batch_max
+        self.flush_interval = flush_interval
+        self.max_frame = max_frame
+        self._clock = clock
+        self._clients: Dict[str, asyncio.StreamWriter] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._pump_task: Optional[asyncio.Task] = None
+        self._closing = False
+        node.on_output = self._on_batch
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        return asyncio.get_event_loop().time()
+
+    async def start(self) -> None:
+        host, port = self.listen_addr.rsplit(":", 1)
+        self._server = await asyncio.start_server(
+            self._serve_client, host, int(port)
+        )
+        self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def run(self, until=None, timeout: Optional[float] = None) -> List[Any]:
+        return await self.node.run(until=until, timeout=timeout)
+
+    async def close(self) -> None:
+        self._closing = True
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+        for w in list(self._clients.values()):
+            w.close()
+        self._clients.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self.node.close()
+
+    # -- client side ---------------------------------------------------------
+
+    async def _serve_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        conn_id = (
+            f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple) else repr(peer)
+        )
+        core = self.core
+        try:
+            try:
+                hello, _ = await asyncio.wait_for(
+                    read_frame(reader, self.max_frame), self.handshake_timeout
+                )
+            except asyncio.TimeoutError:
+                core.on_timeout(conn_id)
+                return
+            except (SerializationError, ProtocolError):
+                core.on_bad_frame(conn_id, "bad-handshake")
+                return
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                core.disconnect(conn_id)
+                return
+            replies, drop = core.on_hello(conn_id, hello)
+            await self._send(writer, replies)
+            if drop:
+                return
+            self._clients[conn_id] = writer
+            while not self._closing:
+                try:
+                    msg, _ = await asyncio.wait_for(
+                        read_frame(reader, self.max_frame), self.idle_timeout
+                    )
+                except asyncio.TimeoutError:
+                    core.on_timeout(conn_id)
+                    return
+                except (SerializationError, ProtocolError):
+                    core.on_bad_frame(conn_id)
+                    return
+                except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                    core.disconnect(conn_id)
+                    return
+                try:
+                    replies, drop = core.on_submit(conn_id, msg, self._now())
+                except Exception:
+                    # the core's handlers are total; this is belt and
+                    # braces — a hostile payload must never escalate
+                    # past its own connection
+                    core.on_bad_frame(conn_id, "handler-error")
+                    rec = _obs.ACTIVE
+                    if rec is not None:
+                        rec.count("gateway.handler_errors")
+                    return
+                await self._send(writer, replies)
+                if drop:
+                    return
+        finally:
+            self._clients.pop(conn_id, None)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _send(
+        self, writer: asyncio.StreamWriter, messages: List[Any]
+    ) -> None:
+        if not messages:
+            return
+        for m in messages:
+            writer.write(frame(m))
+        try:
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    # -- mesh side -----------------------------------------------------------
+
+    async def _pump(self) -> None:
+        """Flush admitted transactions into the mesh as gossip batches."""
+        while not self._closing:
+            await asyncio.sleep(self.flush_interval)
+            batch = self.core.drain(self.batch_max)
+            if not batch:
+                continue
+            await self.node.input(TxGossip(tuple(batch)))
+
+    def _on_batch(self, batch: Any) -> None:
+        """Commit watcher (TcpNode ``on_output``): ack every first-seen
+        pending transaction of a committed batch."""
+        tx_iter = getattr(batch, "tx_iter", None)
+        if tx_iter is None:
+            return
+        epoch = getattr(batch, "epoch", -1)
+        now = self._now()
+        for tx in tx_iter():
+            res = self.core.on_committed(tx, epoch, now)
+            if res is None:
+                continue
+            conn_id, ack, _latency = res
+            w = self._clients.get(conn_id)
+            if w is not None:
+                try:
+                    w.write(frame(ack))
+                except (ConnectionError, OSError):
+                    pass

@@ -1,0 +1,607 @@
+"""Synthetic multi-tenant load generator for the serving gateway.
+
+Two legs, one reporting contract:
+
+- **tcp** — N real validators (``TcpNode`` + :class:`GatewayAlgo`) on
+  localhost sockets, a :class:`Gateway` in front, and M concurrent
+  clients per tenant submitting over the client wire protocol.  This is
+  the end-to-end serving path: framing, handshake, admission,
+  weighted-fair batching, gossip, consensus, commit acks.
+- **vector** — the BASELINE.md config #5 shape (n=1024 validators,
+  adversarial: f crashed, 100 epochs) through the vectorized epoch
+  driver, fed by the *same* gateway core and the same framed-bytes
+  client path (encode → ``loads`` → validate → admission).  This is
+  how "million-user" tenant populations are simulated: per-tenant
+  open-loop arrival processes superpose their clients, so the client
+  count is a parameter, not a task count.
+
+Arrival processes are open-loop (submission rate does not slow down
+when the system does — the honest model for overload): Poisson with
+exponential gaps, or bursty on/off phases.  Payload sizes are
+heavy-tail (bounded Pareto).  The report carries sustained tx/s,
+commit-latency p50/p99, admission-reject rate and a queue-depth
+timeline — as obs events when a trace is active, and as one JSON
+summary on stdout.
+
+CLI::
+
+    python -m hbbft_tpu.serve.loadgen --mode tcp --n 4 --tenants 2 \
+        --clients 2 --rate 50 --duration 3
+    python -m hbbft_tpu.serve.loadgen --mode vector --n 1024 --epochs 100
+    python -m hbbft_tpu.serve.loadgen --smoke   # the check.sh gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import math
+import random
+import sys
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..core.serialize import SerializationError, loads
+from ..obs import recorder as _obs
+from .gateway import AdmissionQueues, Gateway, GatewayAlgo, GatewayCore
+from .protocol import (
+    LEN_BYTES,
+    MAX_PAYLOAD,
+    PROTO_VERSION,
+    ClientHello,
+    ProtocolError,
+    SubmitTx,
+    frame,
+    read_frame,
+    validate_commit_ack,
+    validate_hello_ack,
+    validate_submit_ack,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's traffic shape."""
+
+    name: str
+    weight: int = 1
+    clients: int = 2
+    rate_hz: float = 50.0  # per-client arrival rate
+    arrival: str = "poisson"  # "poisson" | "bursty"
+    burst_on_s: float = 0.5
+    burst_off_s: float = 0.5
+    burst_gain: float = 4.0  # rate multiplier during an on-phase
+    mean_payload: int = 256
+
+
+def default_tenants(
+    n_tenants: int,
+    clients: int,
+    rate_hz: float,
+    mean_payload: int = 256,
+    bursty_every: int = 2,
+) -> List[TenantSpec]:
+    """A mixed tenant population: alternating weights, every
+    ``bursty_every``-th tenant bursty instead of Poisson."""
+    specs = []
+    for i in range(n_tenants):
+        specs.append(
+            TenantSpec(
+                name=f"tenant-{i}",
+                weight=1 + (i % 2),
+                clients=clients,
+                rate_hz=rate_hz,
+                arrival="bursty" if bursty_every and i % bursty_every == 1 else "poisson",
+                mean_payload=mean_payload,
+            )
+        )
+    return specs
+
+
+def _heavy_tail_size(rng: random.Random, mean: int, alpha: float = 1.5) -> int:
+    """Bounded-Pareto payload size with E[X] ≈ mean (heavy tail: a few
+    payloads are orders of magnitude above the median)."""
+    xm = max(1, int(mean * (alpha - 1) / alpha))
+    size = int(xm / max(1e-9, rng.random()) ** (1.0 / alpha))
+    return max(1, min(MAX_PAYLOAD, size))
+
+
+def _poisson(rng: random.Random, lam: float) -> int:
+    """Poisson count sample (inversion for small λ, normal approx for
+    large — superposing a tenant's whole client population)."""
+    if lam <= 0:
+        return 0
+    if lam < 30:
+        limit = math.exp(-lam)
+        k, p = 0, 1.0
+        while True:
+            p *= rng.random()
+            if p <= limit:
+                return k
+            k += 1
+    return max(0, int(rng.gauss(lam, math.sqrt(lam)) + 0.5))
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[i]
+
+
+def _free_addrs(n: int) -> List[str]:
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    addrs = sorted(f"127.0.0.1:{s.getsockname()[1]}" for s in socks)
+    for s in socks:
+        s.close()
+    return addrs
+
+
+def _new_algo_factory(batch_size: int):
+    from ..protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from ..protocols.queueing_honey_badger import QueueingHoneyBadger
+
+    def new_algo(ni):
+        dhb = DynamicHoneyBadger(ni, rng=random.Random(f"serve-{ni.our_id}"))
+        qhb = QueueingHoneyBadger(
+            dhb, batch_size=batch_size, rng=random.Random(f"serve-q-{ni.our_id}")
+        )
+        return GatewayAlgo(qhb)
+
+    return new_algo
+
+
+# -- the real-TCP leg --------------------------------------------------------
+
+
+async def _client_session(
+    spec: TenantSpec,
+    ci: int,
+    client_addr: str,
+    stop_t: float,
+    grace_s: float,
+    rng: random.Random,
+    stats: Dict[str, Any],
+    latencies: List[float],
+) -> None:
+    loop = asyncio.get_event_loop()
+    host, port = client_addr.rsplit(":", 1)
+    cid = f"{spec.name}-c{ci}"
+    try:
+        reader, writer = await asyncio.open_connection(host, int(port))
+    except OSError as exc:
+        stats["errors"].append(f"{cid}: connect failed: {exc}")
+        return
+    try:
+        writer.write(frame(ClientHello(PROTO_VERSION, spec.name, cid)))
+        await writer.drain()
+        try:
+            ack, _ = await asyncio.wait_for(read_frame(reader), 10.0)
+        except Exception:
+            stats["errors"].append(f"{cid}: no hello ack")
+            return
+        if not validate_hello_ack(ack) or not ack.ok:
+            stats["errors"].append(f"{cid}: hello rejected: {ack!r}")
+            return
+
+        submit_t: Dict[int, float] = {}
+        admitted: Set[int] = set()
+        acked: Set[int] = set()
+
+        async def _recv() -> None:
+            while True:
+                try:
+                    msg, _ = await read_frame(reader)
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionError,
+                    OSError,
+                    SerializationError,
+                    ProtocolError,
+                ):
+                    return
+                if validate_submit_ack(msg):
+                    if msg.admitted:
+                        if msg.seq in submit_t:
+                            admitted.add(msg.seq)
+                    else:
+                        stats["rejected"] += 1
+                        stats["retry_ms"].append(msg.retry_after_ms)
+                        submit_t.pop(msg.seq, None)
+                elif validate_commit_ack(msg):
+                    if msg.seq in acked:
+                        stats["duplicate_acks"] += 1
+                    elif msg.seq in submit_t:
+                        acked.add(msg.seq)
+                        latencies.append(loop.time() - submit_t[msg.seq])
+
+        recv_task = asyncio.ensure_future(_recv())
+        seq = 0
+        burst_on = True
+        next_toggle = loop.time() + spec.burst_on_s
+        while loop.time() < stop_t:
+            rate = spec.rate_hz
+            if spec.arrival == "bursty":
+                now = loop.time()
+                if now >= next_toggle:
+                    burst_on = not burst_on
+                    next_toggle = now + (
+                        spec.burst_on_s if burst_on else spec.burst_off_s
+                    )
+                if not burst_on:
+                    await asyncio.sleep(
+                        max(0.001, min(spec.burst_off_s, next_toggle - now))
+                    )
+                    continue
+                rate *= spec.burst_gain
+            await asyncio.sleep(rng.expovariate(max(1e-3, rate)))
+            if loop.time() >= stop_t:
+                break
+            payload = bytes(_heavy_tail_size(rng, spec.mean_payload))
+            submit_t[seq] = loop.time()
+            stats["submitted"] += 1
+            writer.write(frame(SubmitTx(seq, payload)))
+            try:
+                await writer.drain()
+            except (ConnectionError, OSError):
+                stats["errors"].append(f"{cid}: connection lost mid-stream")
+                break
+            seq += 1
+        # open-loop senders stop at the deadline; then wait (bounded)
+        # for outstanding commit acks
+        grace_end = loop.time() + grace_s
+        while loop.time() < grace_end and len(acked) < len(admitted):
+            await asyncio.sleep(0.02)
+        recv_task.cancel()
+        stats["admitted"] += len(admitted)
+        stats["acked"] += len(acked)
+        stats["unacked"] += len(admitted - acked)
+    finally:
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+
+async def _run_tcp_async(
+    tenants: List[TenantSpec],
+    n_validators: int,
+    duration_s: float,
+    seed: int,
+    batch_size: int = 64,
+    grace_s: float = 20.0,
+    flush_interval: float = 0.005,
+    idle_timeout: float = 30.0,
+) -> Dict[str, Any]:
+    from ..transport.tcp import TcpNode
+
+    addrs = _free_addrs(n_validators + 1)
+    client_addr, mesh_addrs = addrs[0], addrs[1:]
+    new_algo = _new_algo_factory(batch_size)
+    nodes = [
+        TcpNode(a, [x for x in mesh_addrs if x != a], new_algo)
+        for a in mesh_addrs
+    ]
+    core = GatewayCore(
+        AdmissionQueues(
+            weights={t.name: t.weight for t in tenants},
+            per_tenant_limit=4096,
+            global_limit=16384,
+        )
+    )
+    gateway = Gateway(
+        nodes[0],
+        client_addr,
+        core=core,
+        idle_timeout=idle_timeout,
+        flush_interval=flush_interval,
+    )
+    await asyncio.gather(*(node.start() for node in nodes))
+    await gateway.start()
+
+    run_tasks = [
+        asyncio.ensure_future(node.run(until=lambda nd: False))
+        for node in nodes
+    ]
+    loop = asyncio.get_event_loop()
+    t0 = loop.time()
+    stop_t = t0 + duration_s
+    stats: Dict[str, Any] = {
+        "submitted": 0,
+        "admitted": 0,
+        "acked": 0,
+        "unacked": 0,
+        "rejected": 0,
+        "duplicate_acks": 0,
+        "retry_ms": [],
+        "errors": [],
+    }
+    latencies: List[float] = []
+    timeline: List[Tuple[float, int, int]] = []
+
+    async def _sampler() -> None:
+        while True:
+            timeline.append(
+                (
+                    round(loop.time() - t0, 3),
+                    core.admission.total_depth(),
+                    len(core.pending),
+                )
+            )
+            await asyncio.sleep(0.05)
+
+    sampler = asyncio.ensure_future(_sampler())
+    client_tasks = []
+    ci_rng = random.Random(seed)
+    for t in tenants:
+        for ci in range(t.clients):
+            client_tasks.append(
+                asyncio.ensure_future(
+                    _client_session(
+                        t,
+                        ci,
+                        client_addr,
+                        stop_t,
+                        grace_s,
+                        random.Random(f"{seed}/{t.name}/{ci}/{ci_rng.random()}"),
+                        stats,
+                        latencies,
+                    )
+                )
+            )
+    await asyncio.gather(*client_tasks)
+    wall = loop.time() - t0
+    sampler.cancel()
+    for rt in run_tasks:
+        rt.cancel()
+    await asyncio.gather(*run_tasks, return_exceptions=True)
+    await gateway.close()
+    await asyncio.gather(*(node.close() for node in nodes[1:]))
+
+    lat = sorted(latencies)
+    committed = len(latencies)
+    return {
+        "mode": "tcp",
+        "n": n_validators,
+        "tenants": len(tenants),
+        "clients": sum(t.clients for t in tenants),
+        "duration_s": round(wall, 3),
+        "submitted": stats["submitted"],
+        "admitted": stats["admitted"],
+        "rejected": stats["rejected"],
+        "committed": committed,
+        "unacked": stats["unacked"],
+        "duplicate_acks": stats["duplicate_acks"],
+        "tx_per_s": round(committed / wall, 3) if wall > 0 else 0.0,
+        "commit_p50_s": round(_pct(lat, 0.50), 4),
+        "commit_p99_s": round(_pct(lat, 0.99), 4),
+        "reject_rate": round(
+            stats["rejected"] / max(1, stats["submitted"]), 4
+        ),
+        "gateway_drops": core.drops,
+        "errors": stats["errors"],
+        "queue_depth_timeline": timeline[:: max(1, len(timeline) // 50)],
+    }
+
+
+def run_tcp(
+    tenants: List[TenantSpec],
+    n_validators: int = 4,
+    duration_s: float = 3.0,
+    seed: int = 0x5EB0,
+    **kw: Any,
+) -> Dict[str, Any]:
+    return asyncio.run(
+        _run_tcp_async(tenants, n_validators, duration_s, seed, **kw)
+    )
+
+
+# -- the vectorized config-#5 leg --------------------------------------------
+
+
+def run_vector(
+    tenants: List[TenantSpec],
+    n: int = 1024,
+    epochs: int = 100,
+    seed: int = 0x5EB1,
+    batch_size: int = 1024,
+    arrivals_per_epoch: float = 256.0,
+    clients_per_tenant: int = 1_000_000,
+) -> Dict[str, Any]:
+    """BASELINE config #5 (n=1024, adversarial, 100 epochs) behind the
+    gateway: per-tenant open-loop arrival processes (client populations
+    up to ``clients_per_tenant`` superposed per tenant) push framed
+    bytes through the real decode/validate/admission path; the drained
+    weighted-fair batches feed the vectorized QueueingHoneyBadger
+    driver with f validators crashed."""
+    from ..harness.epoch import VectorizedQueueingSim
+
+    rng = random.Random(seed)
+    core = GatewayCore(
+        AdmissionQueues(
+            weights={t.name: t.weight for t in tenants},
+            per_tenant_limit=8192,
+            global_limit=32768,
+        )
+    )
+    sim = VectorizedQueueingSim(
+        n,
+        random.Random(seed),
+        batch_size=batch_size,
+        mock=True,
+        verify_honest=False,
+        emit_minimal=True,
+    )
+    f = (n - 1) // 3
+    dead = set(range(n - f, n))  # config #5: adversarial, f crashed
+
+    # superposed per-tenant client populations: seq counters appear
+    # lazily per (tenant, client) as arrivals name them
+    seqs: Dict[Tuple[str, str], int] = {}
+    helloed: Set[str] = set()
+    burst_on: Dict[str, bool] = {t.name: True for t in tenants}
+    latencies: List[float] = []
+    timeline: List[Tuple[int, int, int]] = []
+    submitted = 0
+    t0 = time.perf_counter()
+
+    def _push(tenant: TenantSpec, now: float) -> None:
+        nonlocal submitted
+        cid = f"c{rng.randrange(max(1, clients_per_tenant))}"
+        conn = f"{tenant.name}/{cid}"
+        if conn not in helloed:
+            buf = frame(ClientHello(PROTO_VERSION, tenant.name, cid))
+            core.on_hello(conn, loads(buf[LEN_BYTES:]))
+            helloed.add(conn)
+        key = (tenant.name, cid)
+        seq = seqs.get(key, 0)
+        seqs[key] = seq + 1
+        payload = bytes(_heavy_tail_size(rng, tenant.mean_payload))
+        # the real wire path: framed bytes through the codec, then the
+        # total validators, then admission
+        buf = frame(SubmitTx(seq, payload))
+        core.on_submit(conn, loads(buf[LEN_BYTES:]), now)
+        submitted += 1
+
+    for e in range(epochs):
+        now = time.perf_counter() - t0
+        for t in tenants:
+            lam = arrivals_per_epoch * t.weight
+            if t.arrival == "bursty":
+                if rng.random() < 0.3:
+                    burst_on[t.name] = not burst_on[t.name]
+                lam = lam * t.burst_gain if burst_on[t.name] else 0.0
+            for _ in range(_poisson(rng, lam)):
+                _push(t, now)
+        batch = core.drain(batch_size)
+        sim.input_all(batch)
+        res = sim.run_epoch(dead=dead)
+        now = time.perf_counter() - t0
+        for tx in res.batch.tx_iter():
+            r = core.on_committed(tx, res.batch.epoch, now)
+            if r is not None:
+                latencies.append(r[2])
+        timeline.append(
+            (e, core.admission.total_depth(), len(core.pending))
+        )
+    wall = time.perf_counter() - t0
+    lat = sorted(latencies)
+    clients_named = len(seqs)
+    return {
+        "mode": "vector",
+        "n": n,
+        "epochs": epochs,
+        "dead": len(dead),
+        "tenants": len(tenants),
+        "clients_simulated": clients_named,
+        "duration_s": round(wall, 3),
+        "submitted": submitted,
+        "admitted": core.admitted,
+        "rejected": core.rejected,
+        "committed": core.commits,
+        "pending_at_end": len(core.pending),
+        "tx_per_s": round(core.commits / wall, 3) if wall > 0 else 0.0,
+        "commit_p50_s": round(_pct(lat, 0.50), 4),
+        "commit_p99_s": round(_pct(lat, 0.99), 4),
+        "reject_rate": round(core.rejected / max(1, submitted), 4),
+        "gateway_drops": core.drops,
+        "queue_depth_timeline": timeline[:: max(1, len(timeline) // 50)],
+    }
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _smoke() -> int:
+    """The check.sh gate: a small real-TCP serving run that must keep
+    every guarantee — no gateway crash, every admitted tx committed and
+    acked exactly once, zero spurious drops."""
+    tenants = default_tenants(2, 2, rate_hz=40.0, mean_payload=128)
+    summary = run_tcp(tenants, n_validators=4, duration_s=2.0, seed=0x57A6E)
+    problems = []
+    if summary["committed"] <= 0:
+        problems.append("no transactions committed")
+    if summary["unacked"]:
+        problems.append(f"{summary['unacked']} admitted txs never acked")
+    if summary["duplicate_acks"]:
+        problems.append(f"{summary['duplicate_acks']} duplicate commit acks")
+    if summary["gateway_drops"]:
+        problems.append(f"honest clients attributed: {summary['gateway_drops']}")
+    if summary["errors"]:
+        problems.append("; ".join(summary["errors"]))
+    print(json.dumps({k: v for k, v in summary.items() if k != "queue_depth_timeline"}))
+    if problems:
+        print("serve smoke FAILED: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(
+        f"serve smoke: {summary['committed']} txs committed+acked exactly "
+        f"once at {summary['tx_per_s']} tx/s "
+        f"(p50 {summary['commit_p50_s']}s, p99 {summary['commit_p99_s']}s)"
+    )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hbbft_tpu.serve.loadgen",
+        description="Synthetic multi-tenant load against the serving "
+        "gateway: open-loop Poisson/bursty arrivals, heavy-tail "
+        "payloads, real TCP mesh or the vectorized n=1024 driver.",
+    )
+    ap.add_argument("--mode", choices=("tcp", "vector"), default="tcp")
+    ap.add_argument("--n", type=int, default=None, help="validators")
+    ap.add_argument("--tenants", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=2, help="clients per tenant (tcp)")
+    ap.add_argument("--rate", type=float, default=50.0, help="per-client tx/s (tcp)")
+    ap.add_argument("--duration", type=float, default=3.0, help="seconds (tcp)")
+    ap.add_argument("--epochs", type=int, default=100, help="epochs (vector)")
+    ap.add_argument(
+        "--arrivals", type=float, default=256.0,
+        help="mean arrivals per epoch per unit tenant weight (vector)",
+    )
+    ap.add_argument("--mean-payload", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=0x5EB0)
+    ap.add_argument("--smoke", action="store_true", help="check.sh gate")
+    ap.add_argument("--trace", metavar="PATH", default=None)
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        _obs.enable(args.trace)
+    try:
+        if args.smoke:
+            return _smoke()
+        tenants = default_tenants(
+            args.tenants, args.clients, args.rate, args.mean_payload
+        )
+        if args.mode == "tcp":
+            summary = run_tcp(
+                tenants,
+                n_validators=args.n or 4,
+                duration_s=args.duration,
+                seed=args.seed,
+            )
+        else:
+            summary = run_vector(
+                tenants,
+                n=args.n or 1024,
+                epochs=args.epochs,
+                seed=args.seed,
+                arrivals_per_epoch=args.arrivals,
+            )
+        print(json.dumps(summary))
+        return 0
+    finally:
+        if args.trace:
+            _obs.disable()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

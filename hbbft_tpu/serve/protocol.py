@@ -1,0 +1,271 @@
+"""The client wire protocol — requests, acks, framing, validators.
+
+Clients speak the same canonical codec (``core/serialize.py``) and the
+same 4-byte big-endian length-prefixed framing as the validator mesh
+(``transport/tcp.py``), but over a *much* smaller frame bound: a client
+frame carries one transaction plus envelope, not an epoch batch, so the
+mesh's 64 MiB ``_MAX_FRAME`` would be a free amplification primitive in
+hostile hands.
+
+Session shape::
+
+    client                             gateway
+      | -- ClientHello(proto,tenant,id) -> |     (one per connection)
+      | <- HelloAck(ok,detail,max_payload) |
+      | -- SubmitTx(seq,payload) --------> |
+      | <- SubmitAck(seq,admitted,         |     (admission decision:
+      |      retry_after_ms,detail) ------ |      explicit backpressure)
+      | <- CommitAck(seq,epoch) ---------- |     (later: exactly once per
+      |                                    |      committed transaction)
+
+``TxGossip`` is the one *validator-mesh* message this module defines:
+the gateway relays admitted transaction envelopes to every validator so
+each node's ``TransactionQueue`` holds them and the N−f proposer rule
+is met without every client dialing every validator.
+
+Threat model: every field of every inbound message is
+adversary-controlled.  The ``validate_*`` functions are **total** — any
+Python value in, ``bool`` out, never an exception — and are the taint
+witnesses the ``wire-taint`` rule demands between ``loads`` and any
+state-keying or allocation sink.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Optional, Tuple
+
+from ..core.serialize import SerializationError, dumps, loads, wire
+
+#: Version spoken in :class:`ClientHello`; bumped on incompatible change.
+PROTO_VERSION = 1
+
+#: Framing: same 4-byte big-endian length prefix as the validator mesh.
+LEN_BYTES = 4
+
+#: Client-link frame ceiling (the mesh's ``_MAX_FRAME`` is 64 MiB; a
+#: client never legitimately needs more than one payload + envelope).
+#: Checked against the header *before* any allocation happens.
+CLIENT_MAX_FRAME = 1 * 1024 * 1024
+
+#: Hard ceiling on one transaction payload.
+MAX_PAYLOAD = 256 * 1024
+
+#: Tenant / client identifier length bound.
+MAX_ID_LEN = 64
+
+#: Submission sequence numbers live in [0, 2**63).
+MAX_SEQ = 2**63
+
+#: Per-relay bound on gossiped transactions and on one envelope's size.
+MAX_GOSSIP_TXS = 8192
+MAX_TX_BYTES = MAX_PAYLOAD + 4 * MAX_ID_LEN + 64
+
+
+class ProtocolError(Exception):
+    """A client violated the serving protocol (oversized header,
+    overlong frame) — grounds for attribution + disconnect, never for
+    crashing the gateway."""
+
+
+# -- wire types --------------------------------------------------------------
+
+
+@wire("SrvHello")
+@dataclasses.dataclass(frozen=True)
+class ClientHello:
+    """Connection opener: protocol version + claimed (tenant, client)."""
+
+    proto: Any
+    tenant: Any
+    client_id: Any
+
+
+@wire("SrvHelloAck")
+@dataclasses.dataclass(frozen=True)
+class HelloAck:
+    """Gateway's handshake verdict; ``max_payload`` tells the client its
+    per-transaction byte budget."""
+
+    ok: Any
+    detail: Any
+    max_payload: Any
+
+
+@wire("SrvSubmit")
+@dataclasses.dataclass(frozen=True)
+class SubmitTx:
+    """One transaction submission; ``seq`` is client-chosen and scopes
+    all acks for this connection's (tenant, client_id)."""
+
+    seq: Any
+    payload: Any
+
+
+@wire("SrvSubmitAck")
+@dataclasses.dataclass(frozen=True)
+class SubmitAck:
+    """Admission decision.  ``admitted=False`` is explicit backpressure:
+    ``retry_after_ms`` tells the client when to retry (never a silent
+    drop)."""
+
+    seq: Any
+    admitted: Any
+    retry_after_ms: Any
+    detail: Any
+
+
+@wire("SrvCommitAck")
+@dataclasses.dataclass(frozen=True)
+class CommitAck:
+    """Sent exactly once when the admitted transaction lands in a
+    committed epoch batch."""
+
+    seq: Any
+    epoch: Any
+
+
+@wire("SrvGossip")
+@dataclasses.dataclass(frozen=True)
+class TxGossip:
+    """Validator-mesh relay of admitted transaction envelopes (a tuple
+    of canonical ``encode_tx`` bytes); every validator queues them so
+    the anti-stall proposer rule is satisfied."""
+
+    txs: Any
+
+
+# -- framing -----------------------------------------------------------------
+
+
+def frame(message: Any) -> bytes:
+    """Length-prefixed canonical frame (same layout as the mesh)."""
+    payload = dumps(message)
+    if len(payload) > CLIENT_MAX_FRAME:
+        raise ProtocolError(f"frame too large to send: {len(payload)} bytes")
+    return len(payload).to_bytes(LEN_BYTES, "big") + payload
+
+
+async def read_frame(
+    reader: asyncio.StreamReader, max_frame: int = CLIENT_MAX_FRAME
+) -> Tuple[Any, int]:
+    """→ (decoded message, payload length).
+
+    Raises :class:`ProtocolError` on an oversized header (before the
+    body is read — attacker-chosen sizes never reach an allocation),
+    :class:`SerializationError` on an undecodable payload, and
+    ``asyncio.IncompleteReadError`` on truncation/EOF."""
+    header = await reader.readexactly(LEN_BYTES)
+    length = int.from_bytes(header, "big")
+    if length > max_frame:
+        raise ProtocolError(f"oversized frame: {length} bytes")
+    return loads(await reader.readexactly(length)), length
+
+
+# -- validators (total: any value in, bool out, never raise) -----------------
+
+
+def _id_ok(v: Any) -> bool:
+    return isinstance(v, str) and 0 < len(v) <= MAX_ID_LEN and v.isprintable()
+
+
+def _seq_ok(v: Any) -> bool:
+    # bool is an int subclass; a True/False "sequence number" is a lie
+    return isinstance(v, int) and not isinstance(v, bool) and 0 <= v < MAX_SEQ
+
+
+def validate_hello(msg: Any) -> bool:
+    return (
+        isinstance(msg, ClientHello)
+        and type(msg.proto) is int
+        and msg.proto == PROTO_VERSION
+        and _id_ok(msg.tenant)
+        and _id_ok(msg.client_id)
+    )
+
+
+def validate_submit(msg: Any, max_payload: int = MAX_PAYLOAD) -> bool:
+    return (
+        isinstance(msg, SubmitTx)
+        and _seq_ok(msg.seq)
+        and isinstance(msg.payload, bytes)
+        and len(msg.payload) <= max_payload
+    )
+
+
+def validate_gossip(msg: Any) -> bool:
+    if not isinstance(msg, TxGossip):
+        return False
+    txs = msg.txs
+    if not isinstance(txs, tuple) or not 0 < len(txs) <= MAX_GOSSIP_TXS:
+        return False
+    return all(
+        isinstance(tx, bytes) and 0 < len(tx) <= MAX_TX_BYTES for tx in txs
+    )
+
+
+def validate_hello_ack(msg: Any) -> bool:
+    return (
+        isinstance(msg, HelloAck)
+        and isinstance(msg.ok, bool)
+        and isinstance(msg.detail, str)
+        and type(msg.max_payload) is int
+        and 0 <= msg.max_payload <= CLIENT_MAX_FRAME
+    )
+
+
+def validate_submit_ack(msg: Any) -> bool:
+    return (
+        isinstance(msg, SubmitAck)
+        and _seq_ok(msg.seq)
+        and isinstance(msg.admitted, bool)
+        and type(msg.retry_after_ms) is int
+        and 0 <= msg.retry_after_ms < 2**31
+        and isinstance(msg.detail, str)
+    )
+
+
+def validate_commit_ack(msg: Any) -> bool:
+    return (
+        isinstance(msg, CommitAck)
+        and _seq_ok(msg.seq)
+        and type(msg.epoch) is int
+        and msg.epoch >= 0
+    )
+
+
+# -- the transaction envelope ------------------------------------------------
+
+
+def encode_tx(tenant: str, client_id: str, seq: int, payload: bytes) -> bytes:
+    """The committed transaction bytes: canonical encoding of
+    ``(tenant, client_id, seq, payload)``.  Canonical + deterministic,
+    so a direct-input twin run feeding the same four fields produces
+    byte-identical transactions (and therefore byte-identical
+    batches)."""
+    return dumps((tenant, client_id, seq, payload))
+
+
+def decode_tx(tx: Any) -> Optional[Tuple[str, str, int, bytes]]:
+    """Inverse of :func:`encode_tx`; ``None`` for anything that is not a
+    well-formed envelope (total — committed batches may carry foreign
+    transactions injected by other validators)."""
+    if not isinstance(tx, (bytes, bytearray)):
+        return None
+    try:
+        obj = loads(bytes(tx))
+    except SerializationError:
+        return None
+    if not isinstance(obj, tuple) or len(obj) != 4:
+        return None
+    tenant, client_id, seq, payload = obj
+    if not (
+        _id_ok(tenant)
+        and _id_ok(client_id)
+        and _seq_ok(seq)
+        and isinstance(payload, bytes)
+        and len(payload) <= MAX_PAYLOAD
+    ):
+        return None
+    return tenant, client_id, seq, payload

@@ -112,6 +112,10 @@ class TcpNode:
         self.algo = new_algo(self.netinfo)
         self.outputs: List[Any] = []
         self.faults: List[Any] = []
+        # Optional synchronous observer invoked once per algorithm
+        # output (e.g. the serving gateway's commit-ack watcher); a
+        # misbehaving hook must not take down the protocol pump.
+        self.on_output: Optional[Callable[[Any], None]] = None
         self._writers: Dict[str, asyncio.StreamWriter] = {}
         self._inbox: asyncio.Queue = asyncio.Queue()
         self._server: Optional[asyncio.base_events.Server] = None
@@ -262,7 +266,15 @@ class TcpNode:
     # -- the protocol pump --------------------------------------------------
 
     async def _route(self, step: Step) -> None:
-        self.outputs.extend(step.output)
+        for out in step.output:
+            self.outputs.append(out)
+            if self.on_output is not None:
+                try:
+                    self.on_output(out)
+                except Exception:
+                    rec = _obs.ACTIVE
+                    if rec is not None:
+                        rec.count("wire.output_hook_errors")
         self.faults.extend(step.fault_log)
         rec = _obs.ACTIVE
         touched = []

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Serving-gateway bench: run `bench.py --serve` — concurrent clients
+# over a real n=4 TCP validator mesh through the gateway (admission,
+# weighted-fair batching, gossip, consensus, commit acks).  Headline
+# rows: `serve_tx_per_s` (sustained committed tx/s with exactly-once
+# acks) and `serve_commit_latency` (client-observed p50/p99).  With
+# SERVE_VECTOR=1, also run `bench.py --serve-vector` — BASELINE
+# config #5 (n=1024, adversarial, 100 epochs) behind the same gateway
+# core fed by synthetic million-client tenant arrival processes.
+#
+# Examples:
+#   scripts/bench_serve.sh                     # 5 s TCP headline
+#   SERVE_DURATION=10 scripts/bench_serve.sh   # longer sample
+#   SERVE_VECTOR=1 scripts/bench_serve.sh      # + the n=1024 leg
+#   SERVE_OUT=serve.json scripts/bench_serve.sh  # also write a file
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+duration="${SERVE_DURATION:-5}"
+out="${SERVE_OUT:-}"
+
+log="$(mktemp)"
+trap 'rm -f "$log"' EXIT
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --serve \
+  --duration "$duration" 2>&1 | tee "$log"
+rc=${PIPESTATUS[0]}
+
+if [ "${SERVE_VECTOR:-0}" = 1 ] && [ "$rc" = 0 ]; then
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --serve-vector \
+    2>&1 | tee -a "$log"
+  rc=${PIPESTATUS[0]}
+fi
+
+if [ -n "$out" ] && [ "$rc" = 0 ]; then
+  python - "$log" "$out" <<'PY'
+import json, sys
+
+rows = []
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+with open(sys.argv[2], "w") as fh:
+    json.dump(rows, fh, indent=2)
+print("wrote %d rows to %s" % (len(rows), sys.argv[2]))
+PY
+fi
+
+exit "$rc"

@@ -7,7 +7,11 @@
 #   3. wire-manifest verification — the @wire registry still matches
 #      the checked-in golden manifest (serialization stability)
 #   4. scenarios smoke — bad-share (the speculative-combine fallback
-#      and leftover-audit attribution gate) + equivocate
+#      and leftover-audit attribution gate) + equivocate +
+#      hostile-clients (gateway attribution and twin bit-identity)
+#   5. gateway smoke — a real-TCP serving run (n=4 validators, 2
+#      tenants x 2 clients); every admitted tx committed exactly once
+#      and acked, zero spurious attributions
 #
 # Each stage runs even if an earlier one failed (you want the full
 # report, not the first stopper), but the exit code is non-zero if ANY
@@ -29,25 +33,30 @@ log() {
 
 rc=0
 
-echo "== [1/4] badgerlint (all rules) ==" | log
+echo "== [1/5] badgerlint (all rules) ==" | log
 python -m hbbft_tpu.analysis 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [2/4] racecheck smoke ==" | log
+echo "== [2/5] racecheck smoke ==" | log
 env JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
   -p no:cacheprovider --racecheck 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [3/4] wire manifest ==" | log
+echo "== [3/5] wire manifest ==" | log
 python -m hbbft_tpu.analysis --select wire-stability 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [4/4] scenarios smoke ==" | log
+echo "== [4/5] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
-  --only bad-share --only equivocate 2>&1 | log
+  --only bad-share --only equivocate --only hostile-clients 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+
+echo "== [5/5] gateway smoke ==" | log
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.serve.loadgen --smoke 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 

@@ -1,0 +1,562 @@
+"""Serving gateway tests — protocol, admission, core, consensus, TCP.
+
+Covers the acceptance surface of the serving front door: total
+validators and pre-allocation frame bounds, weighted-fair admission
+with explicit backpressure, the exactly-once commit-ack ledger,
+hostile-client attribution, bit-identity of the client path against a
+direct-input twin, and the real-TCP load test (4 clients x 2 tenants
+over an n=4 validator mesh).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from hbbft_tpu.core.fault import FaultKind
+from hbbft_tpu.core.network_info import NetworkInfo
+from hbbft_tpu.core.serialize import SerializationError, dumps, loads
+from hbbft_tpu.core.step import Step
+from hbbft_tpu.protocols.transaction_queue import TransactionQueue
+from hbbft_tpu.serve.gateway import AdmissionQueues, GatewayAlgo, GatewayCore
+from hbbft_tpu.serve.protocol import (
+    CLIENT_MAX_FRAME,
+    LEN_BYTES,
+    MAX_PAYLOAD,
+    PROTO_VERSION,
+    ClientHello,
+    CommitAck,
+    HelloAck,
+    ProtocolError,
+    SubmitAck,
+    SubmitTx,
+    TxGossip,
+    decode_tx,
+    encode_tx,
+    frame,
+    read_frame,
+    validate_commit_ack,
+    validate_gossip,
+    validate_hello,
+    validate_hello_ack,
+    validate_submit,
+    validate_submit_ack,
+)
+
+
+def _tx(tenant, n):
+    return encode_tx(tenant, "c0", n, b"p%d" % n)
+
+
+# ---------------------------------------------------------------------------
+# admission: weighted fairness + explicit backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_fair_drain_respects_weights():
+    adm = AdmissionQueues(weights={"heavy": 2, "light": 1})
+    for i in range(6):
+        assert adm.offer("heavy", _tx("heavy", i))[0]
+        assert adm.offer("light", _tx("light", i))[0]
+    out = adm.take(6)
+    by_tenant = [decode_tx(tx)[0] for tx in out]
+    # sorted tenants, cursor 0: heavy x2, light x1 per pass
+    assert by_tenant == ["heavy", "heavy", "light", "heavy", "heavy", "light"]
+    assert adm.total_depth() == 6
+
+
+def test_drain_cursor_rotates_lead_tenant():
+    adm = AdmissionQueues()
+    for i in range(4):
+        adm.offer("a", _tx("a", i))
+        adm.offer("b", _tx("b", i))
+    first = decode_tx(adm.take(1)[0])[0]
+    second = decode_tx(adm.take(1)[0])[0]
+    assert {first, second} == {"a", "b"}  # equal weights alternate lead
+
+
+def test_tenant_full_is_explicit_backpressure_not_silent_drop():
+    adm = AdmissionQueues(per_tenant_limit=2, retry_after_ms=50)
+    assert adm.offer("t", _tx("t", 0)) == (True, 0, "ok")
+    assert adm.offer("t", _tx("t", 1)) == (True, 0, "ok")
+    ok, retry, detail = adm.offer("t", _tx("t", 2))
+    assert (ok, retry, detail) == (False, 50, "tenant-full")
+    # the other tenant still has headroom
+    assert adm.offer("u", _tx("u", 0))[0]
+
+
+def test_gateway_full_backs_off_harder():
+    adm = AdmissionQueues(per_tenant_limit=10, global_limit=2, retry_after_ms=50)
+    adm.offer("a", _tx("a", 0))
+    adm.offer("b", _tx("b", 0))
+    ok, retry, detail = adm.offer("c", _tx("c", 0))
+    assert (ok, retry, detail) == (False, 100, "gateway-full")
+
+
+def test_drain_empties_queues_and_depth_tracks():
+    adm = AdmissionQueues()
+    for i in range(5):
+        adm.offer("t", _tx("t", i))
+    got = adm.take(100)
+    assert len(got) == 5
+    assert adm.total_depth() == 0
+    assert adm.take(10) == []
+
+
+# ---------------------------------------------------------------------------
+# framing: bounds enforced before allocation, clean exception taxonomy
+# ---------------------------------------------------------------------------
+
+
+def _fed_reader(stream: bytes) -> asyncio.StreamReader:
+    reader = asyncio.StreamReader()
+    reader.feed_data(stream)
+    reader.feed_eof()
+    return reader
+
+
+def test_read_frame_round_trip():
+    async def run():
+        msg = SubmitTx(7, b"payload")
+        got, size = await read_frame(_fed_reader(frame(msg)))
+        assert got == msg and size == len(dumps(msg))
+
+    asyncio.run(run())
+
+
+def test_read_frame_rejects_oversized_header_before_allocation():
+    async def run():
+        lying = (CLIENT_MAX_FRAME + 1).to_bytes(LEN_BYTES, "big")
+        with pytest.raises(ProtocolError):
+            await read_frame(_fed_reader(lying + b"\x00"))
+
+    asyncio.run(run())
+
+
+def test_read_frame_raises_serialization_error_on_garbage():
+    async def run():
+        garbage = b"\xff\xfe\xfd\xfc"
+        stream = len(garbage).to_bytes(LEN_BYTES, "big") + garbage
+        with pytest.raises(SerializationError):
+            await read_frame(_fed_reader(stream))
+
+    asyncio.run(run())
+
+
+def test_read_frame_raises_incomplete_on_truncation():
+    async def run():
+        full = frame(SubmitTx(0, b"xxxx"))
+        with pytest.raises(asyncio.IncompleteReadError):
+            await read_frame(_fed_reader(full[:-2]))
+
+    asyncio.run(run())
+
+
+def test_frame_refuses_oversized_outbound():
+    with pytest.raises(ProtocolError):
+        frame(SubmitTx(0, bytes(CLIENT_MAX_FRAME + 1)))
+
+
+def test_validators_are_total():
+    hostile = [
+        None, True, 0, -1, 2**80, b"", b"\x00" * 8, "", "x" * 200,
+        (), (1, 2), [], {}, object(),
+        ClientHello("1", None, b"x"), SubmitTx(True, "not-bytes"),
+        SubmitAck(-1, "yes", None, 0), CommitAck(None, "e"),
+        HelloAck(1, None, -5), TxGossip([b"list-not-tuple"]),
+        TxGossip(()), TxGossip((b"",)),
+    ]
+    for v in (
+        validate_hello, validate_submit, validate_gossip,
+        validate_hello_ack, validate_submit_ack, validate_commit_ack,
+    ):
+        for msg in hostile:
+            assert v(msg) is False, (v.__name__, msg)
+    assert validate_hello(ClientHello(PROTO_VERSION, "t", "c"))
+    assert validate_submit(SubmitTx(0, b""))
+    assert validate_gossip(TxGossip((b"x",)))
+    assert validate_hello_ack(HelloAck(True, "ok", MAX_PAYLOAD))
+    assert validate_submit_ack(SubmitAck(0, False, 50, "tenant-full"))
+    assert validate_commit_ack(CommitAck(0, 0))
+    # a bool is an int subclass but not a sequence number
+    assert not validate_submit(SubmitTx(False, b""))
+
+
+def test_envelope_round_trip_and_totality():
+    tx = encode_tx("tenant", "client", 9, b"payload")
+    assert decode_tx(tx) == ("tenant", "client", 9, b"payload")
+    assert decode_tx(b"\xff\xfe") is None
+    assert decode_tx("not-bytes") is None
+    assert decode_tx(dumps((1, 2))) is None
+    assert decode_tx(dumps(("t", "c", True, b""))) is None
+
+
+# ---------------------------------------------------------------------------
+# the sans-IO core: sessions, exactly-once ledger, attribution
+# ---------------------------------------------------------------------------
+
+
+def test_core_happy_path_exactly_once_ack():
+    core = GatewayCore()
+    replies, drop = core.on_hello("conn", ClientHello(1, "t", "c"))
+    assert not drop and replies[0].ok
+    replies, drop = core.on_submit("conn", SubmitTx(3, b"pay"), 1.0)
+    assert not drop and replies[0].admitted
+    (tx,) = core.drain(10)
+    assert decode_tx(tx) == ("t", "c", 3, b"pay")
+    got = core.on_committed(tx, 5, 2.5)
+    assert got == ("conn", CommitAck(3, 5), 1.5)
+    # duplicates across proposer samples: acked exactly once
+    assert core.on_committed(tx, 5, 2.5) is None
+    # foreign transactions from other proposers: ignored
+    assert core.on_committed(b"foreign", 5, 2.5) is None
+    assert core.on_committed(None, 5, 2.5) is None
+    assert core.commits == 1 and core.drops == []
+
+
+def test_core_duplicate_submit_is_idempotent():
+    core = GatewayCore()
+    core.on_hello("conn", ClientHello(1, "t", "c"))
+    core.on_submit("conn", SubmitTx(0, b"p"), 0.0)
+    replies, drop = core.on_submit("conn", SubmitTx(0, b"p"), 0.1)
+    assert not drop and replies[0].admitted and replies[0].detail == "duplicate"
+    assert core.admitted == 1
+    assert len(core.drain(10)) == 1  # queued once
+
+
+def test_core_attributes_every_hostile_class():
+    core = GatewayCore()
+    _, drop = core.on_hello("lie", ClientHello(2, "t", "c"))
+    assert drop
+    _, drop = core.on_submit("early", SubmitTx(0, b"p"), 0.0)
+    assert drop
+    core.on_hello("big", ClientHello(1, "t", "c"))
+    _, drop = core.on_submit("big", SubmitTx(0, bytes(MAX_PAYLOAD + 1)), 0.0)
+    assert drop
+    core.on_bad_frame("garbage")
+    core.on_timeout("loris")
+    core.on_hello("twice", ClientHello(1, "t", "c"))
+    _, drop = core.on_hello("twice", ClientHello(1, "t", "c"))
+    assert drop
+    assert core.drops == [
+        ("lie", "bad-hello"),
+        ("early", "submit-before-hello"),
+        ("big", "bad-submit"),
+        ("garbage", "malformed-frame"),
+        ("loris", "slow-loris"),
+        ("twice", "double-hello"),
+    ]
+    # dropped sessions are gone: the next submit is submit-before-hello
+    _, drop = core.on_submit("big", SubmitTx(1, b"p"), 0.0)
+    assert drop and core.drops[-1] == ("big", "submit-before-hello")
+
+
+def test_core_reject_carries_retry_after():
+    core = GatewayCore(AdmissionQueues(per_tenant_limit=1, retry_after_ms=75))
+    core.on_hello("conn", ClientHello(1, "t", "c"))
+    core.on_submit("conn", SubmitTx(0, b"a"), 0.0)
+    replies, drop = core.on_submit("conn", SubmitTx(1, b"b"), 0.0)
+    assert not drop  # backpressure is not an offence
+    assert replies[0] == SubmitAck(1, False, 75, "tenant-full")
+    assert core.rejected == 1
+
+
+def test_core_emits_registered_obs_events(tmp_path):
+    from hbbft_tpu.obs import recorder as _obs
+    from hbbft_tpu.obs.schema import EVENTS
+
+    rec = _obs.enable(str(tmp_path / "trace.jsonl"))
+    try:
+        core = GatewayCore(AdmissionQueues(per_tenant_limit=1))
+        core.on_hello("conn", ClientHello(1, "t", "c"))
+        core.on_submit("conn", SubmitTx(0, b"a"), 0.0)
+        core.on_submit("conn", SubmitTx(1, b"b"), 0.0)
+        (tx,) = core.drain(10)
+        core.on_committed(tx, 0, 1.0)
+        events = [e for e in rec.events if isinstance(e, dict)]
+    finally:
+        _obs.disable()
+    seen = {e.get("ev") for e in events}
+    for ev in ("gateway_admit", "gateway_reject", "client_commit_latency", "queue_depth"):
+        assert ev in seen, f"missing {ev} in {seen}"
+    for e in events:
+        spec = EVENTS.get(e.get("ev"))
+        if spec is None:
+            continue
+        fields = set(e) - {"ev", "t"}
+        assert spec.required <= fields, (e.get("ev"), fields)
+        if not spec.open:
+            assert fields <= spec.allowed, (e.get("ev"), fields)
+
+
+# ---------------------------------------------------------------------------
+# TransactionQueue.remove_all: set fast path + unhashable fallback
+# ---------------------------------------------------------------------------
+
+
+def test_remove_all_set_fast_path():
+    q = TransactionQueue([b"a", b"b", b"c", b"b"])
+    q.remove_all(tx for tx in [b"b"])  # generator: must materialize once
+    assert list(q.queue) == [b"a", b"c"]
+
+
+def test_remove_all_unhashable_batch_does_not_crash():
+    q = TransactionQueue([b"a", b"b", b"c"])
+    q.remove_all([b"b", [1, 2]])  # unhashable committed tx from a peer
+    assert list(q.queue) == [b"a", b"c"]
+
+
+def test_remove_all_unhashable_queue_entry():
+    marker = [1]
+    q = TransactionQueue([b"a", marker, b"b"])
+    q.remove_all([b"a", marker])
+    assert list(q.queue) == [b"b"]
+
+
+# ---------------------------------------------------------------------------
+# GatewayAlgo: gossip intercept + attribution
+# ---------------------------------------------------------------------------
+
+
+def _new_algo_map(n=4, seed=0x6A7E):
+    from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+
+    rng = random.Random(seed)
+    netinfos = NetworkInfo.generate_map(list(range(n)), rng, mock=True)
+    algos = {}
+    for nid, ni in netinfos.items():
+        arng = random.Random(f"ga-{nid}")
+        algos[nid] = GatewayAlgo(
+            QueueingHoneyBadger(DynamicHoneyBadger(ni, rng=arng), batch_size=8, rng=arng)
+        )
+    return algos
+
+
+def test_gateway_algo_attributes_invalid_gossip():
+    algo = _new_algo_map()[0]
+    for bad in (TxGossip(b"not-a-tuple"), TxGossip(()), TxGossip(("str",))):
+        step = algo.handle_message(1, bad)
+        assert isinstance(step, Step)
+        faults = list(step.fault_log)
+        assert len(faults) == 1
+        assert faults[0].node_id == 1
+        assert faults[0].kind == FaultKind.INVALID_MESSAGE
+    assert len(algo.qhb.queue) == 0  # nothing hostile was queued
+
+
+def test_gateway_algo_queues_valid_gossip_and_relays_input():
+    algos = _new_algo_map()
+    batch = (encode_tx("t", "c", 0, b"x"), encode_tx("t", "c", 1, b"y"))
+    step = algos[0].handle_input(TxGossip(batch))
+    assert isinstance(step, Step)
+    assert len(algos[0].qhb.queue) == 2
+    relayed = [tm for tm in step.messages if isinstance(tm.message, TxGossip)]
+    assert len(relayed) == 1 and relayed[0].target.is_all
+    step = algos[1].handle_message(0, TxGossip(batch))
+    assert isinstance(step, Step) and not list(step.fault_log)
+    assert len(algos[1].qhb.queue) == 2
+
+
+def test_gateway_algo_rejects_invalid_local_input():
+    algo = _new_algo_map()[0]
+    with pytest.raises(ValueError):
+        algo.handle_input(TxGossip(b"nope"))
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: the client path against a direct-input twin
+# ---------------------------------------------------------------------------
+
+
+def _run_gossip_consensus(batch, n=4, seed=0x71D3):
+    from hbbft_tpu.harness.network import (
+        MessageScheduler,
+        SilentAdversary,
+        TestNetwork,
+    )
+    from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+
+    rng = random.Random(seed)
+
+    def new_algo(ni):
+        arng = random.Random(f"twin-{ni.our_id}")
+        return GatewayAlgo(
+            QueueingHoneyBadger(DynamicHoneyBadger(ni, rng=arng), batch_size=8, rng=arng)
+        )
+
+    net = TestNetwork(
+        n,
+        0,
+        lambda adv: SilentAdversary(MessageScheduler(MessageScheduler.RANDOM, rng)),
+        new_algo,
+        rng,
+        mock_crypto=True,
+    )
+    net.input(0, TxGossip(batch))
+    for _ in range(200_000):
+        if all(nd.outputs for nd in net.nodes.values()):
+            break
+        if net.any_busy():
+            net.step()
+            continue
+        for nid, nd in net.nodes.items():
+            step = nd.instance.propose()
+            if not step.is_empty():
+                nd._absorb(step)
+                msgs = list(nd.messages)
+                nd.messages.clear()
+                net.dispatch_messages(nid, msgs)
+        if not net.any_busy():
+            break
+    assert all(nd.outputs for nd in net.nodes.values()), "consensus stalled"
+
+    def key(b):
+        return (
+            b.epoch,
+            tuple(sorted((str(k), tuple(v)) for k, v in b.contributions.items())),
+            repr(b.change),
+        )
+
+    keys = [key(nd.outputs[0]) for _, nd in sorted(net.nodes.items())]
+    assert len(set(keys)) == 1, "validators disagree"
+    return keys[0]
+
+
+def test_client_path_bit_identical_to_direct_input_twin():
+    # leg 1: transactions enter through the full client path — framed
+    # bytes, the codec, the validators, admission, weighted drain
+    core = GatewayCore(AdmissionQueues(weights={"alpha": 2, "beta": 1}))
+    plan = [
+        ("alpha", "a0", 0, b"pay-a0"),
+        ("beta", "b0", 0, b"pay-b0"),
+        ("alpha", "a1", 0, b"pay-a1"),
+        ("alpha", "a0", 1, b"pay-a2"),
+        ("beta", "b0", 1, b"pay-b1"),
+    ]
+    for tenant, cid, _, _ in plan:
+        conn = f"{tenant}/{cid}"
+        if conn not in core.sessions:
+            buf = frame(ClientHello(PROTO_VERSION, tenant, cid))
+            core.on_hello(conn, loads(buf[LEN_BYTES:]))
+    for i, (tenant, cid, seq, payload) in enumerate(plan):
+        buf = frame(SubmitTx(seq, payload))
+        replies, drop = core.on_submit(f"{tenant}/{cid}", loads(buf[LEN_BYTES:]), float(i))
+        assert not drop and replies[0].admitted
+    gateway_batch = tuple(core.drain(64))
+
+    # leg 2: the direct-input twin — the same envelopes, no gateway
+    adm = AdmissionQueues(weights={"alpha": 2, "beta": 1})
+    for tenant, cid, seq, payload in plan:
+        adm.offer(tenant, encode_tx(tenant, cid, seq, payload))
+    direct_batch = tuple(adm.take(64))
+
+    assert gateway_batch == direct_batch  # byte-identical before consensus
+    assert len(gateway_batch) == len(plan)
+
+    # both batches drive identically-seeded networks: committed batches
+    # must be bit-identical
+    assert _run_gossip_consensus(gateway_batch) == _run_gossip_consensus(direct_batch)
+
+
+def test_hostile_clients_scenario_is_green():
+    from hbbft_tpu.harness.scenarios import ScenarioConfig, run_scenario
+
+    res = run_scenario("hostile-clients", ScenarioConfig(n=5, epochs=1, seed=0xBAD0))
+    assert res.ok, res.detail
+    assert res.faults >= 7  # 6 hostile clients + the invalid gossiper
+
+
+def test_fuzz_gateway_surface_pinned_seed():
+    from hbbft_tpu.harness.fuzz import fuzz_gateway
+
+    rep = fuzz_gateway(0xF0227 + 3, 120)
+    assert rep.ok, rep.failures[:3]
+    assert rep.cases == 120
+    assert rep.rejected > 0 and rep.decoded > 0
+
+
+# ---------------------------------------------------------------------------
+# the real thing: concurrent clients, real TCP mesh, exactly-once
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_load_exactly_once_across_tenants():
+    """Acceptance load test: 4 concurrent clients x 2 tenants through a
+    real n=4 TCP mesh; every admitted transaction is committed exactly
+    once and acked, hostile-free run attributes nobody."""
+    from hbbft_tpu.serve.loadgen import TenantSpec, run_tcp
+
+    tenants = [
+        TenantSpec("alpha", weight=2, clients=2, rate_hz=40.0, mean_payload=96),
+        TenantSpec("beta", weight=1, clients=2, rate_hz=40.0, arrival="bursty", mean_payload=96),
+    ]
+    summary = run_tcp(tenants, n_validators=4, duration_s=1.5, seed=0xACCE)
+    assert summary["errors"] == []
+    assert summary["committed"] > 0
+    assert summary["unacked"] == 0, summary
+    assert summary["duplicate_acks"] == 0
+    assert summary["gateway_drops"] == []
+    assert summary["admitted"] == summary["committed"]
+    assert summary["commit_p99_s"] >= summary["commit_p50_s"] > 0
+
+
+def test_gateway_shell_attributes_hostile_sockets():
+    """Real sockets, hostile clients only: malformed handshake and an
+    oversized header must be attributed and disconnected without
+    touching the mesh or crashing the listener."""
+    from hbbft_tpu.serve.loadgen import _free_addrs, _new_algo_factory
+    from hbbft_tpu.serve.gateway import Gateway
+    from hbbft_tpu.transport.tcp import TcpNode
+
+    async def run():
+        addrs = _free_addrs(5)
+        client_addr, mesh = addrs[0], addrs[1:]
+        new_algo = _new_algo_factory(8)
+        nodes = [TcpNode(a, [x for x in mesh if x != a], new_algo) for a in mesh]
+        core = GatewayCore()
+        gw = Gateway(nodes[0], client_addr, core=core, handshake_timeout=0.4)
+        await asyncio.gather(*(n.start() for n in nodes))
+        await gw.start()
+        run_tasks = [asyncio.ensure_future(n.run(until=lambda nd: False)) for n in nodes]
+        host, port = client_addr.rsplit(":", 1)
+
+        # malformed handshake bytes
+        r, w = await asyncio.open_connection(host, int(port))
+        garbage = b"\xde\xad\xbe\xef"
+        w.write(len(garbage).to_bytes(LEN_BYTES, "big") + garbage)
+        await w.drain()
+        assert await r.read(64) == b""  # disconnected
+        w.close()
+
+        # oversized header
+        r, w = await asyncio.open_connection(host, int(port))
+        w.write((CLIENT_MAX_FRAME + 1).to_bytes(LEN_BYTES, "big"))
+        await w.drain()
+        assert await r.read(64) == b""
+        w.close()
+
+        # slow-loris: connect and send nothing past the deadline
+        r, w = await asyncio.open_connection(host, int(port))
+        assert await asyncio.wait_for(r.read(64), 5.0) == b""
+        w.close()
+
+        # an honest client still gets served after all that
+        r, w = await asyncio.open_connection(host, int(port))
+        w.write(frame(ClientHello(PROTO_VERSION, "t", "c")))
+        await w.drain()
+        ack, _ = await asyncio.wait_for(read_frame(r), 5.0)
+        assert validate_hello_ack(ack) and ack.ok
+        w.close()
+
+        for t in run_tasks:
+            t.cancel()
+        await asyncio.gather(*run_tasks, return_exceptions=True)
+        await gw.close()
+        await asyncio.gather(*(n.close() for n in nodes[1:]))
+        return core
+
+    core = asyncio.run(run())
+    reasons = sorted(reason for _, reason in core.drops)
+    assert reasons == ["bad-handshake", "bad-handshake", "slow-loris"], core.drops

@@ -179,6 +179,13 @@ def _samples():
         ),
         # harness
         "DynContrib": cls["DynContrib"](b"user", (signed_vote,)),
+        # serve (client wire protocol)
+        "SrvHello": cls["SrvHello"](1, "tenant-0", "client-7"),
+        "SrvHelloAck": cls["SrvHelloAck"](True, "ok", 262144),
+        "SrvSubmit": cls["SrvSubmit"](42, b"tx-payload"),
+        "SrvSubmitAck": cls["SrvSubmitAck"](42, False, 50, "tenant-full"),
+        "SrvCommitAck": cls["SrvCommitAck"](42, 3),
+        "SrvGossip": cls["SrvGossip"]((b"tx-a", b"tx-b")),
     }
     return manifest, samples
 
