@@ -2089,6 +2089,151 @@ def bench_serve_vector(epochs: int = 100, nodes: int = 1024):
     )
 
 
+# ---------------------------------------------------------------------------
+# 100k-validator co-simulation sweep (--cosim)
+# ---------------------------------------------------------------------------
+
+
+def bench_cosim(ns=None, epochs: int = 3, out: str = None):
+    """The packed co-simulation scale sweep (``scripts/bench_cosim.sh``):
+    struct-of-arrays epochs at n ∈ {1k, 4k, 16k, 65k, 100k} under a
+    WAN-real delay model (5 continental zones, lognormal tails, 2%%
+    crashed), one fused device launch per epoch, O(1) Python objects.
+
+    Two legs, all rows also collected into ``BENCH_COSIM_r0.json``:
+
+    1. scale — per-n rows from ``run_epoch_packed``: cold (compile)
+       epoch, warm epochs/s (median of ``epochs``), peak RSS, packed
+       device bytes per validator, mesh device count.
+    2. equivalence — the packed queueing co-sim vs the dict-based
+       ``VectorizedQueueingSim`` from equal-seeded rngs at n=1024:
+       committed batches must be byte-identical every epoch (the same
+       gate ``tests/test_cosim.py`` holds at small n; the sweep's
+       numbers are only meaningful because this row is exact).
+
+    Sweep sizes come from ``HBBFT_TPU_COSIM_NS`` (comma-separated)
+    when set.  Mock-crypto protocol plane throughout — the co-sim's
+    own contract (real BLS belongs to the dict-based sims).
+    """
+    import os
+    import random as _r
+    import statistics as _st
+
+    from hbbft_tpu.harness.cosim import (
+        PackedHoneyBadgerCosim,
+        PackedQueueingCosim,
+    )
+    from hbbft_tpu.harness.epoch import VectorizedQueueingSim
+    from hbbft_tpu.harness.wan import (
+        DEFAULT_TOPOLOGY,
+        LatencyModel,
+        WanModel,
+    )
+
+    env_ns = os.environ.get("HBBFT_TPU_COSIM_NS")
+    if ns is None and env_ns:
+        ns = [int(x) for x in env_ns.split(",") if x]
+    ns = list(ns or (1000, 4096, 16384, 65536, 100000))
+    rows = []
+
+    # -- leg 1: the scale sweep under the WAN model --------------------
+    wan = WanModel(
+        seed=0xC052,
+        topology=DEFAULT_TOPOLOGY,
+        latency=LatencyModel("lognormal"),
+        deadline_ms=400.0,
+    )
+    for n in ns:
+        f = (n - 1) // 3
+        n_dead = min(n // 50, f)  # 2% crashed, inside the f bound
+        dead = set(range(n - n_dead, n))
+        t0 = time.perf_counter()
+        sim = PackedHoneyBadgerCosim(n, _r.Random(0xC053), wan=wan)
+        init_s = time.perf_counter() - t0
+        cold = sim.run_epoch_packed(dead=dead)  # pays the compile
+        warm = [sim.run_epoch_packed(dead=dead) for _ in range(epochs)]
+        rate = _st.median(s.epochs_per_s for s in warm)
+        last = warm[-1]
+        rows.append(
+            _emit(
+                "cosim_epochs_per_s",
+                rate,
+                "epochs/s",
+                nodes=n,
+                dead=n_dead,
+                epochs=epochs,
+                init_s=round(init_s, 2),
+                cold_epoch_s=round(cold.wall_s, 3),
+                warm_epoch_s=round(1.0 / rate, 4),
+                accepted=last.accepted,
+                coin_flips=last.coin_flips,
+                peak_rss_mb=round(last.peak_rss_bytes / 2**20, 1),
+                bytes_per_validator=round(last.bytes_per_validator, 1),
+                mesh_devices=last.mesh_devices,
+                wan_zones=len(DEFAULT_TOPOLOGY.zones),
+                wan_distribution="lognormal",
+            )
+        )
+
+    # -- leg 2: byte-identity vs the dict plane at n=1024 -------------
+    # (AFTER the sweep: the dict plane's ~1.7 GB of per-node Python
+    # objects would otherwise pollute every sweep row's RSS high-water)
+    n_twin, twin_epochs = 1024, 2
+    dead = set(range(n_twin - 30, n_twin))
+    legacy = VectorizedQueueingSim(
+        n_twin, _r.Random(0xC051), batch_size=n_twin, mock=True
+    )
+    packed = PackedQueueingCosim(
+        n_twin, _r.Random(0xC051), batch_size=n_twin
+    )
+    txs = [b"cosim-%06d" % i for i in range(2 * n_twin)]
+    legacy.input_all(txs)
+    packed.input_all(txs)
+    t0 = time.perf_counter()
+    for _ in range(twin_epochs):
+        res_l = legacy.run_epoch(dead=dead)
+        res_p = packed.run_epoch(dead=dead)
+        assert res_l.batch == res_p.batch, "packed plane diverged"
+        assert res_l.accepted == res_p.accepted
+        assert [(f.node_id, f.kind) for f in res_l.fault_log] == [
+            (f.node_id, f.kind) for f in res_p.fault_log
+        ]
+    rows.append(
+        _emit(
+            "cosim_twin_identity",
+            1.0,
+            "bool",
+            nodes=n_twin,
+            epochs=twin_epochs,
+            dead=len(dead),
+            wall_s=round(time.perf_counter() - t0, 2),
+        )
+    )
+
+    sweep = [r for r in rows if r["metric"] == "cosim_epochs_per_s"]
+    rows.append(
+        _emit(
+            "cosim_sweep",
+            max(r["nodes"] for r in sweep),
+            "validators",
+            rates={str(r["nodes"]): r["value"] for r in sweep},
+            peak_rss_mb={
+                str(r["nodes"]): r["peak_rss_mb"] for r in sweep
+            },
+            host_cores=os.cpu_count(),
+        )
+    )
+    if out:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), out
+        )
+        with open(path, "w") as fh:
+            json.dump(rows, fh, indent=2)
+            fh.write("\n")
+        print("wrote %d rows to %s" % (len(rows), path), flush=True)
+    return rows
+
+
 SUITE = {
     "sim_default": lambda: bench_sim_default(batched=False),
     "sim_batched": lambda: bench_sim_default(batched=True),
@@ -2189,6 +2334,19 @@ def main() -> None:
         "trace (see scripts/bench_cold.sh for the virgin/primed pair)",
     )
     p.add_argument(
+        "--cosim",
+        action="store_true",
+        help="100k-validator packed co-simulation sweep under a WAN "
+        "delay model + the n=1024 dict-plane byte-identity leg "
+        "(see scripts/bench_cosim.sh); sizes via HBBFT_TPU_COSIM_NS",
+    )
+    p.add_argument(
+        "--cosim-out",
+        default="BENCH_COSIM_r0.json",
+        help="JSON file for the --cosim rows (relative to the repo "
+        "root; empty string disables the file)",
+    )
+    p.add_argument(
         "--serve",
         action="store_true",
         help="serving-gateway headline: concurrent clients over the real "
@@ -2216,7 +2374,12 @@ def main() -> None:
 
         obsrec.enable(args.trace)
     try:
-        if args.serve:
+        if args.cosim:
+            bench_cosim(
+                epochs=args.epochs if args.epochs != 5 else 3,
+                out=args.cosim_out or None,
+            )
+        elif args.serve:
             bench_serve(duration=args.duration)
         elif args.serve_vector:
             bench_serve_vector(epochs=args.epochs if args.epochs != 5 else 100)

@@ -1,0 +1,515 @@
+"""Packed 100k-validator co-simulation — struct-of-arrays sim state,
+one fused device launch per epoch.
+
+``VectorizedHoneyBadgerSim`` (``harness/epoch.py``) already batches the
+crypto, but its *protocol* state is Python dicts keyed by node id —
+payload dicts, per-instance estimate dicts, per-(sender, proposer)
+share entries — O(n) to O(n²) host objects per epoch.  That tops out
+around n=1024.  This module is the other execution model the paper's
+north star names: the WHOLE network's per-epoch protocol state lives in
+packed ``[n]`` device columns (struct-of-arrays), one fused launch
+(``parallel/mesh.py::packed_cosim_step_fn``) resolves every agreement
+instance's decision, and the Python side holds O(1) objects regardless
+of n.
+
+The move that makes this exact rather than approximate: under the mock
+crypto the entire crypto plane is algebraically transparent —
+
+- encryption round-trips (``xor_stream`` twice with the same derived
+  key), so committed plaintexts ARE the proposed contributions;
+- the real common coin is subset-independent
+  (``combine_signatures`` returns the group tag), so a coin value is
+  ``sha256``-parity of ``(group seed, nonce)`` — computable per
+  instance without any share exchange;
+- decryption-share validity collapses to counting (an honest share is
+  valid by construction, a forged one invalid), so fault attribution
+  is a deterministic replay from counts
+  (``vectorized.packed_decrypt_attribution``).
+
+What remains per instance is the honest-schedule binary-agreement
+decision algebra of ``VectorizedAgreement.run`` — a closed form over
+two counts (yes-votes c1, no-votes c0 = live − c1) which the fused step
+evaluates for all n instances at once, with the n² vote relation
+factored through the WAN layer's zone product (see
+``packed_cosim_step_fn``).  Equivalence is not asymptotic:
+``tests/test_cosim.py`` pins batches, fault logs, coin flips, and
+agreement epochs byte-identical to the dict-based sim at every n where
+both run.
+
+Supported adversary surface: ``dead`` (silent nodes), ``late`` (whole
+broadcasts delayed past agreement), ``late_subset`` (per-proposer
+partial timely delivery), ``forged_dec`` (forged decryption shares),
+plus the WAN models of ``harness/wan.py`` (zone partitions, heavy-tail
+lateness, correlated failures).  Everything else the dict-based sim
+models (``corrupt_shards``, vote injection, divergent schedules,
+observers) needs per-message state the packed representation
+deliberately does not carry — those kwargs raise, use
+``VectorizedHoneyBadgerSim``.
+
+Sharding: above ``HBBFT_TPU_COSIM_MESH_MIN`` validators (default 4096)
+with more than one device visible, the instance axis shards over the
+same named-axis mesh as the verify plane and the zone histograms
+circulate on an on-device ppermute ring — byte-identical to the
+single-device launch (integer adds, exact in any order).  Force with
+``HBBFT_TPU_COSIM_MESH=1``/``0``.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.fault import FaultLog
+from ..crypto.mock import _tag as _mock_tag
+from ..obs import recorder as _obs
+from ..ops import staging
+from ..protocols.common_coin import make_nonce
+from ..protocols.honey_badger import Batch
+from .epoch import EpochResult, TransactionQueueMixin
+from .vectorized import packed_decrypt_attribution
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _peak_rss_bytes() -> int:
+    # ru_maxrss is KiB on Linux (bytes on macOS, where this is only a
+    # slight overstatement nobody benches on)
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+class CosimEpochStats:
+    """One scale-mode epoch's aggregates (``run_epoch_packed``) — no
+    per-node materialization."""
+
+    __slots__ = (
+        "epoch",
+        "n",
+        "accepted",
+        "coin_flips",
+        "wall_s",
+        "epochs_per_s",
+        "peak_rss_bytes",
+        "bytes_per_validator",
+        "mesh_devices",
+    )
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+
+class PackedHoneyBadgerCosim:
+    """HoneyBadger co-simulation with packed struct-of-arrays state.
+
+    Byte-compatible with ``VectorizedHoneyBadgerSim(n, rng, mock=True)``:
+    consumes the identical rng draw sequence (key dealing at init, one
+    encryption nonce per live proposer per epoch) and produces
+    identical ``EpochResult`` rows, so a packed sim and a dict-based
+    sim driven from equal-seeded rngs stay in lockstep for arbitrarily
+    many epochs.  Mock crypto only — the real-BLS plane needs actual
+    share exchange and belongs to the dict-based sim.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        rng,
+        mock: bool = True,
+        wan: Optional[Any] = None,
+        mesh: Optional[Any] = None,
+    ):
+        if not mock:
+            raise ValueError(
+                "the packed co-sim models the mock-crypto protocol "
+                "plane; real BLS runs use VectorizedHoneyBadgerSim"
+            )
+        self.n = int(n)
+        self.rng = rng
+        self.mock = True
+        # consume NetworkInfo.generate_map's exact draw sequence
+        # (core/network_info.py:167-174) without materializing n key
+        # objects: one group-seed draw, then one per-node secret-key
+        # draw in sorted id order.  The group seed IS the mock master
+        # public key bytes = the invocation id bound into coin nonces.
+        self._group_seed = rng.randrange(2**256).to_bytes(32, "big")
+        for _ in range(self.n):
+            rng.randrange(2**256)
+        self.num_faulty = (self.n - 1) // 3
+        self.num_correct = self.n - self.num_faulty
+        self.epoch = 0
+        # WAN model: accept a WanModel (bound here) or a pre-bound
+        # WanSchedule (shared with a legacy twin)
+        if wan is not None and hasattr(wan, "bind"):
+            wan = wan.bind(self.n)
+        self.wan = wan
+        # -- packed device state (the struct-of-arrays columns) -------
+        self._mesh = self._pick_mesh(mesh)
+        from ..parallel import mesh as PM
+
+        n_dev = self._mesh.devices.size if self._mesh is not None else 1
+        self._n_pad = PM.cosim_pad(self.n, n_dev)
+        self._Z = self.wan.Z if self.wan is not None else 1
+        self._step = PM.packed_cosim_step_fn(self._mesh, self._Z)
+        import jax.numpy as jnp
+
+        zone_h = np.zeros(self._n_pad, dtype=np.int32)
+        if self.wan is not None:
+            zone_h[: self.n] = self.wan.zone
+        self._zone = jnp.asarray(zone_h)
+        # per-instance commit counters: the persistent packed sim
+        # state, donated through every step (double-buffered)
+        self._commit = jnp.zeros((self._n_pad,), dtype=jnp.int32)
+        self._state_bytes = int(self._zone.nbytes + self._commit.nbytes)
+
+    def _pick_mesh(self, mesh):
+        if mesh is not None:
+            return mesh if mesh.devices.size > 1 else None
+        forced = os.environ.get("HBBFT_TPU_COSIM_MESH", "")
+        if forced == "0":
+            return None
+        import jax
+
+        n_dev = len(jax.devices())
+        if n_dev <= 1:
+            return None
+        if forced == "1" or self.n >= _env_int(
+            "HBBFT_TPU_COSIM_MESH_MIN", 4096
+        ):
+            from ..parallel import mesh as PM
+
+            return PM.make_mesh()
+        return None
+
+    @property
+    def mesh_devices(self) -> int:
+        return self._mesh.devices.size if self._mesh is not None else 1
+
+    def commit_counts(self) -> np.ndarray:
+        """Per-instance committed-epoch counters (the packed state)."""
+        return np.asarray(self._commit)[: self.n]
+
+    # -- mock crypto, host side -------------------------------------------
+
+    def _coin_parity(self, pid: int, agreement_epoch: int) -> int:
+        """The real mock coin for (this HB epoch, instance pid,
+        agreement epoch): parity of the combined group signature —
+        subset-independent, so no share exchange is simulated."""
+        nonce = make_nonce(
+            self._group_seed, self.epoch, pid, agreement_epoch
+        )
+        return _mock_tag(b"SIG", self._group_seed, nonce)[0] & 1
+
+    # -- one epoch ---------------------------------------------------------
+
+    _UNSUPPORTED = (
+        "corrupt_shards",
+        "observe",
+        "adv_bval",
+        "adv_aux",
+        "forged_coin",
+        "divergent",
+        "div_schedule",
+    )
+
+    def run_epoch(
+        self,
+        contributions: Dict[int, Any],
+        dead: Optional[Set[int]] = None,
+        forged_dec: Optional[Dict[int, Dict[int, Any]]] = None,
+        late: Optional[Set[int]] = None,
+        late_subset: Optional[Dict[int, Set[int]]] = None,
+        wan: Optional[Any] = None,
+        **adv,
+    ) -> EpochResult:
+        """Advance the whole network one epoch; equivalence mode.
+
+        Same contract as ``VectorizedHoneyBadgerSim.run_epoch`` over
+        the supported adversary surface; committed contributions are
+        the proposer's original objects (mock encryption round-trips
+        to identity).  ``forged_dec`` shares are bogus by definition
+        (the adversary model) — each live forger is attributed once.
+        """
+        for k in self._UNSUPPORTED:
+            if adv.get(k):
+                raise ValueError(
+                    f"packed co-sim does not model {k!r}; use "
+                    "VectorizedHoneyBadgerSim"
+                )
+        unknown = set(adv) - set(self._UNSUPPORTED)
+        if unknown:
+            raise TypeError(f"unknown adversary kwargs {sorted(unknown)}")
+        t0 = time.perf_counter()
+        dead = set(dead or set())
+        late = set(late or set())
+        forged_dec = forged_dec or {}
+        late_subset = dict(late_subset or {})
+        sched = wan if wan is not None else self.wan
+        if sched is not None and hasattr(sched, "bind"):
+            sched = sched.bind(self.n)
+        view = None
+        if sched is not None:
+            view = sched.epoch_view(self.epoch)
+            dead |= sched.crashed_set(self.epoch)
+        if len(dead) > self.num_faulty:
+            raise ValueError(
+                f"{len(dead)} dead nodes exceeds the f={self.num_faulty} bound"
+            )
+        # 1. propose: one encryption nonce per sorted live proposer —
+        # the dict-based sim's exact rng sequence (_propose_phase); the
+        # nonces themselves are dead weight because mock decryption
+        # returns the original plaintext
+        proposers: List[int] = []
+        for pid in range(self.n):
+            if pid in dead or pid not in contributions:
+                continue
+            self.rng.randrange(2**128)
+            proposers.append(pid)
+        # 2. broadcast: honest RBC always delivers; `late` proposers'
+        # waves are withheld past agreement (never delivered)
+        delivered = [pid for pid in proposers if pid not in late]
+        if len(delivered) < self.num_correct:
+            raise RuntimeError(
+                "fewer than N−f broadcasts delivered — common subset "
+                "cannot terminate on this schedule (more than f "
+                "dead/corrupt/late proposers)"
+            )
+        if set(late_subset) - set(delivered):
+            raise ValueError(
+                "late_subset proposers must have completed their "
+                "broadcast (they deliver late, not never)"
+            )
+        # 3-5. agreement + decryption: the fused packed step
+        n_live = self.n - len(dead)
+        faults = FaultLog()
+        accepted_mask, nondef_mask, fail_mask = self._run_step(
+            delivered, dead, view, late_subset, forged_dec, n_live
+        )
+        accepted = [int(p) for p in np.flatnonzero(accepted_mask[: self.n])]
+        accepted_set = set(accepted)
+        # agreement bookkeeping identical to VectorizedAgreement.run on
+        # this honest schedule: definite-1 decides at agreement epoch
+        # 0, definite-0 at 1, coin-bound instances converge to 1 and
+        # decide at 2 or 3 by the real mock coin's parity (one real
+        # flip each, at agreement epoch 2)
+        nondef = [int(p) for p in np.flatnonzero(nondef_mask[: self.n])]
+        coin_flips = len(nondef)
+        agreement_epochs: Dict[int, int] = {}
+        for pid in range(self.n):
+            if pid in accepted_set:
+                agreement_epochs[pid] = 0
+            else:
+                agreement_epochs[pid] = 1
+        for pid in nondef:
+            agreement_epochs[pid] = 2 if self._coin_parity(pid, 2) else 3
+        # decryption fault attribution (ordering contract shared with
+        # decrypt_round — see packed_decrypt_attribution)
+        packed_decrypt_attribution(
+            accepted,
+            forged_dec,
+            dead,
+            faults,
+            lambda pid: bool(fail_mask[pid]),
+        )
+        shares_verified = n_live * len(accepted)
+        # 6. batch assembly: mock round-trip identity — committed
+        # contributions are the originals
+        out_contribs: Dict[int, Any] = {}
+        for pid in accepted:
+            if fail_mask[pid]:
+                continue
+            out_contribs[pid] = contributions[pid]
+        batch = Batch(self.epoch, out_contribs)
+        wall = time.perf_counter() - t0
+        phases = {"step": wall, "commit_latency": wall}
+        self._emit_epoch(len(accepted), coin_flips, wall)
+        self.epoch += 1
+        return EpochResult(
+            batch=batch,
+            accepted=accepted,
+            fault_log=faults,
+            coin_flips=coin_flips,
+            shares_verified=shares_verified,
+            agreement_epochs=agreement_epochs,
+            observer_batch=None,
+            virtual=None,
+            phases=phases,
+        )
+
+    # -- the fused step ----------------------------------------------------
+
+    def _run_step(
+        self,
+        delivered: Sequence[int],
+        dead: Set[int],
+        view,
+        late_subset: Dict[int, Set[int]],
+        forged_dec: Dict[int, Dict[int, Any]],
+        n_live: int,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Marshal the epoch's masks into leased staging buffers, run
+        the fused launch, and return (accepted, nondef, dec_fail)
+        host masks.  The commit column is donated and double-buffered
+        through the step."""
+        import jax.numpy as jnp
+
+        np_ = self._n_pad
+        with staging.buffers().lease() as lease:
+            prop_on = lease.get((np_,), np.int8)
+            dst_on = lease.get((np_,), np.int8)
+            ovr_mask = lease.get((np_,), np.int8)
+            ovr_c1 = lease.get((np_,), np.int32)
+            forged_cnt = lease.get((np_,), np.int32)
+            live = np.ones(self.n, dtype=bool)
+            if dead:
+                live[sorted(dead)] = False
+            if view is not None:
+                prop = np.zeros(self.n, dtype=bool)
+                prop[list(delivered)] = True
+                prop_on[: self.n] = prop & view.src_ok
+                dst_on[: self.n] = live & view.dst_ok
+                reach = view.reach
+            else:
+                prop_on[list(delivered)] = 1
+                dst_on[: self.n] = live
+                reach = np.ones((1, 1), dtype=np.uint8)
+            for pid, subset in late_subset.items():
+                ovr_mask[pid] = 1
+                ovr_c1[pid] = sum(1 for nid in subset if live[nid])
+            for nid, targets in forged_dec.items():
+                if nid in dead or not (0 <= nid < self.n):
+                    continue
+                for pid in targets:
+                    if 0 <= pid < self.n:
+                        forged_cnt[pid] += 1
+            params = np.asarray([n_live, self.num_faulty], dtype=np.int32)
+            acc, nondef, dec_fail, commit = self._step(
+                prop_on,
+                dst_on,
+                self._zone,
+                np.asarray(reach, dtype=np.uint8),
+                ovr_mask,
+                ovr_c1,
+                forged_cnt,
+                self._commit,
+                params,
+            )
+            self._commit = commit
+            out = (np.asarray(acc), np.asarray(nondef), np.asarray(dec_fail))
+        return out
+
+    # -- scale mode --------------------------------------------------------
+
+    def run_epoch_packed(
+        self, dead: Optional[Set[int]] = None
+    ) -> CosimEpochStats:
+        """Scale-mode epoch: every live validator proposes, the WAN
+        model (if any) decides timeliness, and only aggregates come
+        home — no batches, no rng nonces, no per-node Python objects.
+        The 100k sweep (``bench.py --cosim``) drives this."""
+        t0 = time.perf_counter()
+        dead = set(dead or set())
+        sched = self.wan
+        view = None
+        if sched is not None:
+            view = sched.epoch_view(self.epoch)
+            dead |= sched.crashed_set(self.epoch)
+        n_live = self.n - len(dead)
+        delivered: Sequence[int]
+        if dead:
+            live = np.ones(self.n, dtype=bool)
+            live[sorted(dead)] = False
+            delivered = np.flatnonzero(live)
+        else:
+            delivered = range(self.n)
+        acc, nondef, _fail = self._run_step(
+            delivered, dead, view, {}, {}, n_live
+        )
+        accepted = int(acc[: self.n].astype(np.int64).sum())
+        coin_flips = int(nondef[: self.n].astype(np.int64).sum())
+        wall = time.perf_counter() - t0
+        stats = CosimEpochStats(
+            epoch=self.epoch,
+            n=self.n,
+            accepted=accepted,
+            coin_flips=coin_flips,
+            wall_s=wall,
+            epochs_per_s=(1.0 / wall) if wall > 0 else float("inf"),
+            peak_rss_bytes=_peak_rss_bytes(),
+            bytes_per_validator=self._state_bytes / self.n,
+            mesh_devices=self.mesh_devices,
+        )
+        self._emit_epoch(accepted, coin_flips, wall)
+        self.epoch += 1
+        return stats
+
+    def _emit_epoch(self, accepted: int, coin_flips: int, wall: float):
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "cosim_epoch",
+                n=self.n,
+                epochs_per_s=round(1.0 / wall, 3) if wall > 0 else 0.0,
+                peak_rss=_peak_rss_bytes(),
+                epoch=self.epoch,
+                accepted=accepted,
+                coin_flips=coin_flips,
+                mesh_devices=self.mesh_devices,
+            )
+
+
+class PackedQueueingCosim(TransactionQueueMixin):
+    """QueueingHoneyBadger over the packed epoch driver — transaction
+    queues, random B/N proposals, committed-transaction removal —
+    rng-lockstepped with ``VectorizedQueueingSim`` (the equivalence
+    twin) and arbitrarily large on the packed plane."""
+
+    def __init__(
+        self,
+        n: int,
+        rng,
+        batch_size: int = 100,
+        mock: bool = True,
+        wan: Optional[Any] = None,
+        mesh: Optional[Any] = None,
+    ):
+        self.sim = PackedHoneyBadgerCosim(n, rng, mock=mock, wan=wan, mesh=mesh)
+        self.rng = rng
+        self.batch_size = batch_size
+        self._init_queues()
+
+    def _queue_ids(self) -> List[int]:
+        return list(range(self.sim.n))
+
+    def arrival_factor(self) -> float:
+        """The WAN model's flash-crowd arrival multiplier for the
+        upcoming epoch (callers scale their injection by this)."""
+        if self.sim.wan is None:
+            return 1.0
+        return self.sim.wan.arrival_factor(self.sim.epoch)
+
+    def run_epoch(self, dead: Optional[Set[int]] = None, **adv) -> EpochResult:
+        dead = set(dead or set())
+        # WAN crashes must be known BEFORE queue sampling (a crashed
+        # node draws no proposal) — same merge the legacy twin does
+        if self.sim.wan is not None:
+            dead |= self.sim.wan.crashed_set(self.sim.epoch)
+        contribs = self._sample_contribs(dead)
+        result = self.sim.run_epoch(contribs, dead=dead, **adv)
+        self._drain(list(result.batch.tx_iter()))
+        return result
+
+
+__all__ = [
+    "PackedHoneyBadgerCosim",
+    "PackedQueueingCosim",
+    "CosimEpochStats",
+]

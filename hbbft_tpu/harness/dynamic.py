@@ -211,6 +211,14 @@ class VectorizedDynamicSim:
         """One epoch: wrap contributions with pending votes, run the
         vectorized epoch, count the committed votes, and switch eras if
         a change wins (f+1 committed votes)."""
+        dead = set(dead or set())
+        wan = adv.get("wan")
+        if wan is not None:
+            # WAN-correlated crashes are dead for the whole epoch —
+            # their pending votes stay queued, like any silent node
+            if hasattr(wan, "bind"):
+                adv["wan"] = wan = wan.bind(self.sim.n)
+            dead |= wan.crashed_set(self.sim.epoch)
         wrapped = {}
         for pid in sorted(self.sim.netinfos):
             if dead and pid in dead:
@@ -421,6 +429,13 @@ class VectorizedDynamicQueueingSim(TransactionQueueMixin):
         self, dead: Optional[Set[Any]] = None, **adv
     ) -> DynamicEpochResult:
         dead = set(dead or set())
+        wan = adv.get("wan")
+        if wan is not None:
+            # crashes merge BEFORE queue sampling (crashed nodes draw
+            # no proposal) — the same order the packed co-sim uses
+            if hasattr(wan, "bind"):
+                adv["wan"] = wan = wan.bind(self.dyn.sim.n)
+            dead |= wan.crashed_set(self.dyn.sim.epoch)
         contribs = self._sample_contribs(dead)
         res = self.dyn.run_epoch(contribs, dead=dead, **adv)
         self._drain(list(res.batch.tx_iter()))

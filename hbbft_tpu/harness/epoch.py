@@ -1372,6 +1372,7 @@ class VectorizedHoneyBadgerSim:
         late_subset: Optional[Dict[Any, Set[Any]]] = None,
         divergent: Optional[DivergentEpoch0] = None,
         div_schedule: Optional[DivergentSchedule] = None,
+        wan: Optional[Any] = None,
     ) -> EpochResult:
         """Advance every correct node through one complete epoch.
 
@@ -1410,8 +1411,30 @@ class VectorizedHoneyBadgerSim:
         ``divergent``: a two-class epoch-0 schedule for the agreement
         phase (``DivergentEpoch0``); its equivocators are silent in
         every other phase (decryption treats them like ``dead``).
+        ``wan``: a ``harness.wan.WanModel`` / bound ``WanSchedule`` —
+        materialized for this epoch as crashed nodes (merged into
+        ``dead``) and per-proposer timely-delivery subsets (merged
+        into ``late_subset``), the same epoch view the packed co-sim
+        (``harness/cosim.py``) consumes zone-factored; equal-seeded
+        runs of the two planes under one model are byte-identical.
         """
         dead = set(dead or set())
+        if wan is not None:
+            if hasattr(wan, "bind"):
+                wan = wan.bind(self.n)
+            wan_dead, wan_subset = wan.twin_kwargs(
+                self.epoch,
+                [
+                    pid
+                    for pid in sorted(self.netinfos)
+                    if pid in contributions
+                ],
+                dead=dead,
+            )
+            dead = wan_dead
+            merged = dict(wan_subset)
+            merged.update(late_subset or {})
+            late_subset = merged or None
         late = set(late or set())
         corrupt_shards = corrupt_shards or {}
         forged_dec = forged_dec or {}
@@ -2524,6 +2547,14 @@ class VectorizedQueueingSim(TransactionQueueMixin):
 
     def run_epoch(self, dead: Optional[Set[Any]] = None, **adv) -> EpochResult:
         dead = set(dead or set())
+        wan = adv.get("wan")
+        if wan is not None:
+            # a WAN-crashed node draws no proposal: the crash set must
+            # be merged BEFORE queue sampling so the rng sequence
+            # matches the packed co-sim's (which samples post-merge)
+            if hasattr(wan, "bind"):
+                adv["wan"] = wan = wan.bind(self.sim.n)
+            dead |= wan.crashed_set(self.sim.epoch)
         contribs = self._sample_contribs(dead)
         result = self.sim.run_epoch(contribs, dead=dead, **adv)
         self._drain(list(result.batch.tx_iter()))

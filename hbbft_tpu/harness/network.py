@@ -363,16 +363,34 @@ class SeededDelaySchedule:
     messages re-enter delivery out of their original send order.  Drain
     fully with ``network.release_held()`` once the scenario's delay
     budget is spent (delays must be finite for liveness).
+
+    The draw itself is a pluggable seam: ``sampler(rng, sender,
+    recipient, message, p_delay=...)`` returns the value compared
+    against ``p_delay`` / ``p_release``.  The default consumes exactly
+    one flat ``rng.random()`` per decision (the legacy distribution,
+    pinned byte-for-byte by ``tests/test_cosim.py``); WAN models plug
+    in via :meth:`hbbft_tpu.harness.wan.WanSchedule.delay_sampler`
+    without forking the class.
     """
 
-    def __init__(self, rng, p_delay: float = 0.25, p_release: float = 0.5):
+    def __init__(
+        self, rng, p_delay: float = 0.25, p_release: float = 0.5, sampler=None
+    ):
         self.rng = rng
         self.p_delay = p_delay
         self.p_release = p_release
+        self.sampler = sampler
         self.held_count = 0
 
+    def _draw(self, sender, recipient, message, threshold: float) -> float:
+        if self.sampler is None:
+            return self.rng.random()
+        return self.sampler(
+            self.rng, sender, recipient, message, p_delay=threshold
+        )
+
     def __call__(self, sender, recipient, message) -> bool:
-        if self.rng.random() < self.p_delay:
+        if self._draw(sender, recipient, message, self.p_delay) < self.p_delay:
             self.held_count += 1
             return False
         return True
@@ -380,7 +398,8 @@ class SeededDelaySchedule:
     def pump(self, network: "TestNetwork") -> None:
         """Release a random subset of the held backlog (reordered)."""
         network.release_held(
-            lambda s, r, m: self.rng.random() < self.p_release
+            lambda s, r, m: self._draw(s, r, m, self.p_release)
+            < self.p_release
         )
 
 

@@ -643,6 +643,167 @@ def _run_hostile_clients(cfg: ScenarioConfig) -> ScenarioResult:
     )
 
 
+# -- WAN-realism scenarios (harness/wan.py over both sim planes) -------------
+
+
+def _wan_partition_model(seed: int):
+    """Three geo-zones, tail-free intra-epoch latency (the scenario
+    isolates the partition), zones (0, 1) cut off from zone 2 during
+    epoch 0, healed from epoch 1."""
+    from .wan import GeoTopology, LatencyModel, PartitionWindow, WanModel
+
+    topo = GeoTopology(
+        zones=("us", "eu", "ap"),
+        delay_ms=((2.0, 2.0, 2.0),) * 3,
+        weights=(4.0, 3.0, 3.0),
+    )
+    return WanModel(
+        seed=seed,
+        topology=topo,
+        latency=LatencyModel("uniform"),
+        deadline_ms=400.0,
+        partitions=(PartitionWindow(0, 1, ((0, 1), (2,))),),
+    )
+
+
+def _run_geo_partition_heal(cfg: ScenarioConfig) -> ScenarioResult:
+    """A zone-level WAN partition cuts the minority zone off for epoch
+    0 and heals at epoch 1: the cut zone's proposals must be excluded
+    by the N−f rule exactly while the partition holds, readmitted the
+    epoch it heals — and the packed co-sim must stay byte-identical to
+    the dict-based sim under the same model (the honest twin is the
+    other execution plane)."""
+    from .cosim import PackedHoneyBadgerCosim
+
+    n, f = cfg.n, (cfg.n - 1) // 3
+    _check(f >= 1, f"n={cfg.n} has f=0; need n >= 4")
+    model = _wan_partition_model(cfg.seed)
+    sched = model.bind(n)
+    cut = [i for i in range(n) if sched.zone[i] == 2]
+    main = [i for i in range(n) if sched.zone[i] != 2]
+    _check(
+        len(cut) <= f and len(main) >= n - f,
+        f"zone split {len(main)}/{len(cut)} violates the f={f} "
+        "partition-survivability precondition",
+    )
+    legacy = VectorizedHoneyBadgerSim(n, random.Random(cfg.seed), mock=True)
+    packed = PackedHoneyBadgerCosim(n, random.Random(cfg.seed), wan=model)
+    # epoch 0: partition active — minority-zone proposers rejected
+    contribs = _contribs(n, b"gp0")
+    res_l = legacy.run_epoch(contribs, wan=model)
+    res_p = packed.run_epoch(contribs)
+    _check(
+        res_l.accepted == main,
+        f"partition epoch accepted {res_l.accepted}, want {main}",
+    )
+    _check(
+        sorted(res_l.batch.contributions) == main
+        and all(i not in res_l.batch.contributions for i in cut),
+        "partitioned zone leaked into the committed batch",
+    )
+    _check(len(res_l.fault_log) == 0, "honest partition attributed faults")
+    _check(
+        res_l.batch == res_p.batch
+        and res_l.accepted == res_p.accepted
+        and res_l.agreement_epochs == res_p.agreement_epochs
+        and res_l.coin_flips == res_p.coin_flips,
+        "packed plane diverged from dict plane during the partition",
+    )
+    # epoch 1: healed — everyone back in the common subset
+    contribs = _contribs(n, b"gp1")
+    res_l = legacy.run_epoch(contribs, wan=model)
+    res_p = packed.run_epoch(contribs)
+    _check(
+        res_l.accepted == list(range(n)),
+        f"heal epoch accepted {res_l.accepted}, want all {n}",
+    )
+    _check(
+        res_l.batch.contributions == contribs,
+        "healed batch does not carry every proposer",
+    )
+    _check(
+        res_l.batch == res_p.batch and res_l.accepted == res_p.accepted,
+        "packed plane diverged from dict plane after healing",
+    )
+    return ScenarioResult(
+        "geo-partition-heal", True, n, 2, cfg.seed, 0,
+        f"zone of {len(cut)} excluded while cut, readmitted on heal; "
+        "packed ≡ dict plane both epochs",
+    )
+
+
+def _run_flash_crowd(cfg: ScenarioConfig) -> ScenarioResult:
+    """A flash-crowd arrival burst (×5 for one epoch) floods the
+    transaction queues of both sim planes: commits stay byte-identical
+    between the packed and dict-based queueing sims every epoch, the
+    burst epoch commits a full batch, and the backlog drains back to
+    the pre-burst waterline afterwards."""
+    from .cosim import PackedQueueingCosim
+    from .epoch import VectorizedQueueingSim
+    from .wan import FlashCrowd, LatencyModel, WanModel
+
+    n, f = cfg.n, (cfg.n - 1) // 3
+    _check(f >= 1, f"n={cfg.n} has f=0; need n >= 4")
+    boost, flash_epoch, batch = 5.0, 1, 4 * n
+    model = WanModel(
+        seed=cfg.seed,
+        latency=LatencyModel("uniform"),
+        deadline_ms=1e9,  # tail-free: the scenario isolates arrivals
+        flash_crowds=(FlashCrowd(flash_epoch, flash_epoch + 1, boost),),
+    )
+    legacy = VectorizedQueueingSim(
+        n, random.Random(cfg.seed), batch_size=batch, mock=True
+    )
+    packed = PackedQueueingCosim(
+        n, random.Random(cfg.seed), batch_size=batch, wan=model
+    )
+    base_rate = batch // 2
+    committed: set = set()
+    seq = 0
+    epochs = 0
+
+    def _pump(e: int) -> None:
+        res_l = legacy.run_epoch(wan=model)
+        res_p = packed.run_epoch()
+        _check(
+            res_l.batch == res_p.batch,
+            f"epoch {e}: packed plane committed a different batch",
+        )
+        _check(len(res_l.fault_log) == 0, "honest flash crowd attributed faults")
+        committed.update(res_l.batch.tx_iter())
+        _check(
+            len(legacy.queue) == len(packed.queue),
+            f"epoch {e}: queue depths diverged",
+        )
+
+    for e in range(4):
+        factor = packed.arrival_factor()
+        _check(
+            factor == (boost if e == flash_epoch else 1.0),
+            f"epoch {e} arrival factor {factor}",
+        )
+        arrivals = [b"fc-%05d" % (seq + i) for i in range(int(base_rate * factor))]
+        seq += len(arrivals)
+        legacy.input_all(arrivals)
+        packed.input_all(arrivals)
+        _pump(e)
+        epochs += 1
+    burst_backlog = len(legacy.queue)
+    while len(legacy.queue) and epochs < 24:
+        _pump(epochs)
+        epochs += 1
+    _check(
+        len(legacy.queue) == 0 and len(committed) == seq,
+        f"backlog did not drain: {len(committed)}/{seq} txs committed, "
+        f"{len(legacy.queue)} still queued after {epochs} epochs",
+    )
+    return ScenarioResult(
+        "flash-crowd", True, n, epochs, cfg.seed, 0,
+        f"x{boost:g} burst absorbed: {seq} txs committed, backlog peak "
+        f"{burst_backlog} drained by epoch {epochs}, packed ≡ dict plane",
+    )
+
+
 # -- wire-format fuzzing -----------------------------------------------------
 
 
@@ -691,6 +852,8 @@ SCENARIOS: Dict[str, Callable[[ScenarioConfig], ScenarioResult]] = {
     "partition-heal": _run_partition_heal,
     "churn": _run_churn,
     "hostile-clients": _run_hostile_clients,
+    "geo-partition-heal": _run_geo_partition_heal,
+    "flash-crowd": _run_flash_crowd,
     "fuzz": _run_fuzz,
 }
 

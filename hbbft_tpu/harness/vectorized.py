@@ -704,3 +704,34 @@ def decrypt_round(
         phases=phases,
         spec=spec_stats,
     )
+
+
+def packed_decrypt_attribution(
+    accepted: List[Any],
+    forged: Dict[Any, Dict[Any, Any]],
+    dead: Set[Any],
+    faults: FaultLog,
+    failed,
+) -> None:
+    """Replay :func:`decrypt_round`'s fault attribution from aggregate
+    counts — the packed co-simulation's O(adversaries) mirror of the
+    per-share loop above, kept next to it so the two orderings can
+    never drift apart.
+
+    The per-share loop walks entries nid-major (sorted senders × sorted
+    proposers) and flags each forging sender ONCE at its first invalid
+    share, so: (1) every live forger with at least one forged share
+    aimed at an accepted ciphertext gets ``INVALID_DECRYPTION_SHARE``
+    in sorted-sender order; then (2) every accepted proposer whose
+    valid-share count collapsed to ≤ f gets ``SHARE_DECRYPTION_FAILED``
+    in sorted-proposer order (``failed(pid) -> bool``, the caller's
+    count check).  ``accepted`` must already be sorted."""
+    acc = set(accepted)
+    for nid in sorted(forged):
+        if nid in dead:
+            continue
+        if any(pid in acc for pid in forged[nid]):
+            faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
+    for pid in accepted:
+        if failed(pid):
+            faults.add(pid, FaultKind.SHARE_DECRYPTION_FAILED)

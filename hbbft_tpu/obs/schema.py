@@ -100,4 +100,11 @@ EVENTS: Dict[str, EventSpec] = {
     ),
     "client_commit_latency": _spec({"latency_s"}, {"tenant", "epoch"}),
     "queue_depth": _spec({"depth"}, {"pending"}),
+    # 100k co-simulation (additive): one row per packed-sim epoch, and
+    # one per WAN model bound to a network size
+    "cosim_epoch": _spec(
+        {"n", "epochs_per_s", "peak_rss"},
+        {"epoch", "accepted", "coin_flips", "mesh_devices", "bytes_per_validator"},
+    ),
+    "wan_model": _spec({"distribution", "seed"}, {"zones", "n"}),
 }

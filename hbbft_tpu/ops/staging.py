@@ -198,6 +198,15 @@ class Lease:
         held, self._held = self._held, []
         self._pool._give(held)
 
+    # context-manager sugar for leases whose safe-retire point is a
+    # block exit (the co-sim step: outputs are materialized before the
+    # block ends, so the device has provably consumed its inputs)
+    def __enter__(self) -> "Lease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.retire()
+
 
 class BufferPool:
     """Preallocated host staging arrays keyed by ``(shape, dtype)``.

@@ -447,3 +447,129 @@ def sharded_g1_msm(
     pts = jnp.asarray(ec_jax.g1_to_limbs(list(points)))
     bits = jnp.asarray(LB.scalars_to_bits(list(scalars)))
     return ec_jax.g1_from_limbs(run(pts, bits))
+
+
+# ---------------------------------------------------------------------------
+# Packed co-simulation step — the 100k-validator protocol plane
+# ---------------------------------------------------------------------------
+# The packed co-sim (``harness/cosim.py``) keeps the WHOLE network's
+# per-instance agreement state as [n] struct-of-arrays columns and
+# resolves one honest-Byzantine-agreement epoch in a single fused
+# launch.  The n² per-(proposer, receiver) vote relation factors
+# through the WAN layer's zone product — est(p, j) = prop_on[p] ·
+# dst_on[j] · reach[zone_p, zone_j] — so the yes-vote count per
+# instance is a zone-bucketed segment sum contracted against the
+# proposer's reach row: c1[p] = prop_on[p] · Σ_z reach[zone_p, z]·A[z],
+# A[z] = Σ_{j live, on-time} [zone_j = z].  O(n·Z) instead of O(n²);
+# arbitrary per-proposer receiver subsets (the legacy ``late_subset``
+# adversary) ride an override lane with host-precomputed counts.
+#
+# On a mesh the instance axis shards P(AXIS): each shard zone-buckets
+# its own receivers and the [Z] partial histograms — the entire
+# cross-node message exchange — circulate via an on-device ppermute
+# ring (int32 adds: exact, order-free, byte-identical to one device).
+
+
+def packed_cosim_step_fn(mesh: Optional[Mesh], n_zones: int):
+    """Build the fused per-epoch co-sim step.
+
+    Args (all device arrays; [n] axis pre-padded to the mesh):
+      prop_on    i8[n]  instance's proposal was sent on time
+      dst_on     i8[n]  node is live and receiving on time
+      zone       i32[n] node → geo-zone
+      reach      u8[Z, Z] zone-pair on-time reachability (replicated)
+      ovr_mask   i8[n]  use the override count for this instance
+      ovr_c1     i32[n] host-computed yes votes (late_subset lane)
+      forged_cnt i32[n] live forged decryption shares aimed at p
+      commit     i32[n] per-instance commit counters (DONATED — the
+                        double-buffered packed sim state)
+      params     i32[2] (n_live, f) (replicated)
+
+    Returns ``(accepted i8[n], nondef i8[n], dec_fail i8[n],
+    commit' i32[n])`` — the agreement decision mask, the
+    needs-a-real-coin mask, the share-decryption-failure mask, and the
+    advanced commit state.  The decision algebra is the closed form of
+    ``VectorizedAgreement.run`` on honest votes: support counts lift
+    past f+1, enter the bin past 2f+1, and an instance is definite-1,
+    definite-0, or coin-bound exactly as the array engine decides —
+    pinned instance-for-instance by ``tests/test_cosim.py``.
+    """
+    from ..ops import pallas_ec
+
+    n_dev = mesh.devices.size if mesh is not None else 1
+    Z = int(n_zones)
+
+    def _body(
+        prop_on, dst_on, zone, reach, ovr_mask, ovr_c1, forged_cnt, commit, params
+    ):
+        n_live = params[0]
+        f = params[1]
+        a = jnp.zeros((Z,), jnp.int32).at[zone].add(dst_on.astype(jnp.int32))
+        if n_dev > 1:
+            # ring all-reduce of the zone histograms: the only
+            # cross-shard traffic, Z int32 words per hop
+            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            acc = a
+            msg = a
+            for _ in range(n_dev - 1):
+                msg = jax.lax.ppermute(msg, AXIS, perm)
+                acc = acc + msg
+            a = acc
+        reach_rows = reach.astype(jnp.int32)[zone]  # [n_shard, Z]
+        c1_base = prop_on.astype(jnp.int32) * (reach_rows * a[None, :]).sum(-1)
+        c1 = jnp.where(ovr_mask != 0, ovr_c1, c1_base)
+        c0 = n_live - c1
+        lift1 = jnp.where(c1 >= f + 1, n_live, c1)
+        lift0 = jnp.where(c0 >= f + 1, n_live, c0)
+        bin1 = lift1 >= 2 * f + 1
+        bin0 = lift0 >= 2 * f + 1
+        pos = c1 > 0
+        neg = c0 > 0
+        has1 = (pos & bin1) | (pos & ~bin1 & ~bin0) | (neg & ~bin0)
+        has0 = (neg & bin0) | (pos & ~bin1 & bin0)
+        accepted = has1
+        nondef = has1 & has0
+        dec_fail = accepted & ((n_live - forged_cnt) <= f)
+        commit_out = commit + accepted.astype(jnp.int32)
+        return (
+            accepted.astype(jnp.int8),
+            nondef.astype(jnp.int8),
+            dec_fail.astype(jnp.int8),
+            commit_out,
+        )
+
+    if mesh is not None and n_dev > 1:
+        _step = functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS), P(AXIS), P(AXIS),
+                P(AXIS), P(),
+            ),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        )(_body)
+    else:
+        _step = _body
+    cache_name = "cosim_step_%dz_%dd" % (Z, n_dev)
+
+    def run(
+        prop_on, dst_on, zone, reach, ovr_mask, ovr_c1, forged_cnt, commit, params
+    ):
+        # the commit column is donated: each epoch consumes the old
+        # buffer and hands back the advanced one (double-buffered
+        # packed state; donation applies on TPU/GPU, CPU copies)
+        return pallas_ec.cached_compiled(
+            cache_name,
+            _step,
+            prop_on, dst_on, zone, reach, ovr_mask, ovr_c1, forged_cnt,
+            commit, params,
+            donate=(7,),
+        )
+
+    return run
+
+
+def cosim_pad(n: int, n_dev: int) -> int:
+    """Instance-axis padding for the co-sim step: zero rows are
+    absorbing (a padded instance counts no votes and is definite-0)."""
+    return -(-n // n_dev) * n_dev

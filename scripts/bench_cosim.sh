@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Co-simulation scale sweep: `bench.py --cosim` — packed
+# struct-of-arrays epochs at n ∈ {1k, 4k, 16k, 65k, 100k} under the
+# WAN-real delay model (5 continental zones, lognormal tails, 2%
+# crashed), preceded by the n=1024 byte-identity leg against the
+# dict-based VectorizedQueueingSim.  One JSON line per row; all rows
+# are also written to BENCH_COSIM_r0.json at the repo root.
+#
+# Examples:
+#   scripts/bench_cosim.sh                           # full sweep
+#   HBBFT_TPU_COSIM_NS=1000,16384 scripts/bench_cosim.sh
+#   COSIM_EPOCHS=10 scripts/bench_cosim.sh           # longer warm leg
+#   COSIM_OUT= scripts/bench_cosim.sh                # stdout only
+#   HBBFT_TPU_COSIM_MESH=1 scripts/bench_cosim.sh    # force the mesh
+#
+# The sweep runs the mock-crypto protocol plane (the co-sim contract);
+# single-host CPU numbers measure the packed engine, not a TPU pod.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+epochs="${COSIM_EPOCHS:-3}"
+out="${COSIM_OUT-BENCH_COSIM_r0.json}"
+
+exec python bench.py --cosim --epochs "$epochs" --cosim-out "$out"

@@ -8,7 +8,9 @@
 #      the checked-in golden manifest (serialization stability)
 #   4. scenarios smoke — bad-share (the speculative-combine fallback
 #      and leftover-audit attribution gate) + equivocate +
-#      hostile-clients (gateway attribution and twin bit-identity)
+#      hostile-clients (gateway attribution and twin bit-identity) +
+#      geo-partition-heal and flash-crowd (WAN models over both sim
+#      planes, packed co-sim byte-identical to the dict plane)
 #   5. gateway smoke — a real-TCP serving run (n=4 validators, 2
 #      tenants x 2 clients); every admitted tx committed exactly once
 #      and acked, zero spurious attributions
@@ -51,7 +53,8 @@ stage=${PIPESTATUS[0]}
 
 echo "== [4/5] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
-  --only bad-share --only equivocate --only hostile-clients 2>&1 | log
+  --only bad-share --only equivocate --only hostile-clients \
+  --only geo-partition-heal --only flash-crowd 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 

@@ -1,0 +1,289 @@
+"""Packed co-simulation equivalence (harness/cosim.py, harness/wan.py).
+
+The packed struct-of-arrays co-sim is only trustworthy because it is
+byte-identical to the dict-based vectorized sims — same rng draw
+sequence, same batches, same fault attribution, same agreement-epoch
+accounting — at every size the dict plane can still run.  These tests
+hold that plane-equivalence gate at small n (the 100k sweep in
+``bench.py --cosim`` rides on it), pin the WAN model's determinism,
+and pin the legacy ``SeededDelaySchedule`` draw sequence that the
+WAN sampler seam must not disturb.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.crypto.mock import MockDecryptionShare
+from hbbft_tpu.harness import wan as W
+from hbbft_tpu.harness.cosim import (
+    PackedHoneyBadgerCosim,
+    PackedQueueingCosim,
+)
+from hbbft_tpu.harness.epoch import (
+    VectorizedHoneyBadgerSim,
+    VectorizedQueueingSim,
+)
+from hbbft_tpu.harness.network import SeededDelaySchedule
+
+FORGED = MockDecryptionShare(b"\x00" * 32, b"\x00" * 32)
+
+
+def _contribs(n, e):
+    return {i: [f"tx-{e}-{i}-{j}" for j in range(3)] for i in range(n)}
+
+
+def _assert_epoch_equal(a, b, ctx):
+    assert a.batch == b.batch, ctx
+    assert a.accepted == b.accepted, ctx
+    assert [x.compact() for x in a.fault_log] == [
+        x.compact() for x in b.fault_log
+    ], ctx
+    assert a.coin_flips == b.coin_flips, ctx
+    assert a.shares_verified == b.shares_verified, ctx
+    assert a.agreement_epochs == b.agreement_epochs, ctx
+
+
+def _wan_model(seed=11, alpha=1.5):
+    topo = W.GeoTopology(
+        zones=("a", "b", "c"),
+        delay_ms=((2, 80, 250), (80, 2, 120), (250, 120, 2)),
+        weights=(6, 4, 3),
+    )
+    return W.WanModel(
+        seed=seed,
+        topology=topo,
+        latency=W.LatencyModel("pareto", alpha=alpha),
+        deadline_ms=200.0,
+        partitions=(W.PartitionWindow(1, 2, ((0, 1), (2,))),),
+        failures=(W.CorrelatedFailure(2, 3, 2),),
+        flash_crowds=(W.FlashCrowd(1, 2, 4.0),),
+    )
+
+
+class TestPackedEquivalence:
+    """packed co-sim ≡ dict-based sim, epoch by epoch, from equal seeds."""
+
+    @pytest.mark.parametrize("n", [4, 13, 64])
+    @pytest.mark.parametrize("seed", [42, 7])
+    def test_matches_dict_plane(self, n, seed):
+        r1, r2 = random.Random(seed), random.Random(seed)
+        legacy = VectorizedHoneyBadgerSim(n, r1, mock=True)
+        packed = PackedHoneyBadgerCosim(n, r2)
+        f = legacy.num_faulty
+        for e in range(4):
+            kw = {}
+            if e == 1 and f >= 1:
+                kw = dict(dead={0}, forged_dec={n - 1: {1: FORGED}})
+            if e == 2 and n >= 13:
+                kw = dict(late_subset={2: set(range(6))})
+            if e == 3 and f >= 2:
+                # enough forgers to push a proposer below t+1 valid
+                kw = dict(forged_dec={s: {1: FORGED} for s in range(f, n)})
+            a = legacy.run_epoch(_contribs(n, e), **kw)
+            b = packed.run_epoch(_contribs(n, e), **kw)
+            _assert_epoch_equal(a, b, (n, seed, e))
+        # rng lockstep held across all epochs — every draw matched
+        assert r1.random() == r2.random()
+
+    def test_nondef_coin_path(self):
+        # n=13, f=4: a 6-live late_subset gives c1=6 >= f+1 and
+        # c0=7 >= f+1 — the non-definite branch that flips a real coin
+        n = 13
+        r1, r2 = random.Random(7), random.Random(7)
+        legacy = VectorizedHoneyBadgerSim(n, r1, mock=True)
+        packed = PackedHoneyBadgerCosim(n, r2)
+        kw = dict(late_subset={2: set(range(6))})
+        a = legacy.run_epoch(_contribs(n, 0), **kw)
+        b = packed.run_epoch(_contribs(n, 0), **kw)
+        assert a.coin_flips == b.coin_flips == 1
+        assert a.agreement_epochs[2] in (2, 3)
+        _assert_epoch_equal(a, b, "nondef")
+
+    def test_decryption_collapse(self):
+        # 9 of 13 senders forge their share of proposer 1: valid =
+        # 13-9 = 4 <= f, so decryption fails and pid 1 leaves the batch
+        n = 13
+        r1, r2 = random.Random(9), random.Random(9)
+        legacy = VectorizedHoneyBadgerSim(n, r1, mock=True)
+        packed = PackedHoneyBadgerCosim(n, r2)
+        forgers = {s: {1: FORGED} for s in range(4, n)}
+        a = legacy.run_epoch(_contribs(n, 0), forged_dec=forgers)
+        b = packed.run_epoch(_contribs(n, 0), forged_dec=forgers)
+        fa = [x.compact() for x in a.fault_log]
+        assert any("SHARE_DECRYPTION_FAILED" in x for x in fa)
+        assert 1 not in a.batch.contributions
+        assert 1 not in b.batch.contributions
+        _assert_epoch_equal(a, b, "collapse")
+
+    def test_unsupported_adversaries_raise(self):
+        packed = PackedHoneyBadgerCosim(4, random.Random(0))
+        with pytest.raises(ValueError):
+            packed.run_epoch(_contribs(4, 0), corrupt_shards={0: {1}})
+        with pytest.raises(TypeError):
+            packed.run_epoch(_contribs(4, 0), bogus_adversary=1)
+        with pytest.raises(ValueError):
+            PackedHoneyBadgerCosim(4, random.Random(0), mock=False)
+
+
+class TestWanModels:
+    def test_wan_twin_byte_identity(self):
+        """The same WanModel drives both planes — partition window,
+        correlated zone failure and pareto tails included — and every
+        epoch row stays byte-identical."""
+        n = 13
+        model = _wan_model()
+        r1, r2 = random.Random(5), random.Random(5)
+        legacy = VectorizedHoneyBadgerSim(n, r1, mock=True)
+        packed = PackedHoneyBadgerCosim(n, r2, wan=model)
+        for e in range(4):
+            a = legacy.run_epoch(_contribs(n, e), wan=model)
+            b = packed.run_epoch(_contribs(n, e))
+            _assert_epoch_equal(a, b, ("wan", e))
+        assert r1.random() == r2.random()
+
+    def test_wan_bind_deterministic(self):
+        model = _wan_model()
+        s1, s2 = model.bind(13), model.bind(13)
+        for e in range(5):
+            v1, v2 = s1.epoch_view(e), s2.epoch_view(e)
+            assert (v1.reach == v2.reach).all()
+            assert (v1.crashed == v2.crashed).all()
+            assert (v1.src_ok == v2.src_ok).all()
+            assert (v1.dst_ok == v2.dst_ok).all()
+            assert v1.arrival_factor == v2.arrival_factor
+
+    def test_zone_assignment_largest_remainder(self):
+        topo = W.GeoTopology(
+            zones=("a", "b", "c"), delay_ms=((2.0,) * 3,) * 3,
+            weights=(4.0, 3.0, 3.0),
+        )
+        zone = topo.assign(10)
+        counts = np.bincount(zone, minlength=3)
+        assert counts.tolist() == [4, 3, 3]
+        assert (np.sort(zone) == zone).all()  # contiguous blocks
+
+    def test_latency_late_prob_closed_forms(self):
+        lm = W.LatencyModel("pareto", alpha=2.0)
+        assert lm.late_prob(100.0, 200.0) == pytest.approx(0.25)
+        assert lm.late_prob(100.0, 50.0) == 1.0
+        lg = W.LatencyModel("lognormal", sigma=0.6)
+        assert lg.late_prob(100.0, 100.0) == pytest.approx(0.5)
+        un = W.LatencyModel("uniform")
+        assert un.late_prob(100.0, 400.0) == 0.0
+
+
+class TestShardedAndQueueing:
+    def test_sharded_matches_single_device(self):
+        """Mesh-sharded packed state ≡ single-device packed state,
+        including the persistent commit counters (conftest forces 8
+        virtual CPU devices, so a 4-way mesh is available)."""
+        from hbbft_tpu.parallel import mesh as M
+
+        n = 64
+        r1, r2 = random.Random(3), random.Random(3)
+        single = PackedHoneyBadgerCosim(n, r1)
+        shard = PackedHoneyBadgerCosim(n, r2, mesh=M.make_mesh(4))
+        assert shard.mesh_devices == 4
+        for e in range(3):
+            kw = dict(late_subset={5: set(range(40))}) if e == 1 else {}
+            a = single.run_epoch(_contribs(n, e), **kw)
+            b = shard.run_epoch(_contribs(n, e), **kw)
+            _assert_epoch_equal(a, b, ("mesh", e))
+        assert (single.commit_counts() == shard.commit_counts()).all()
+
+    def test_queueing_lockstep(self):
+        n = 13
+        r1, r2 = random.Random(21), random.Random(21)
+        lq = VectorizedQueueingSim(n, r1, batch_size=20, mock=True)
+        pq = PackedQueueingCosim(n, r2, batch_size=20)
+        txs = [b"t%03d" % i for i in range(200)]
+        lq.input_all(txs)
+        pq.input_all(txs)
+        for e in range(4):
+            kw = dict(dead={0}) if e == 2 else {}
+            a = lq.run_epoch(**kw)
+            b = pq.run_epoch(**kw)
+            _assert_epoch_equal(a, b, ("queue", e))
+            assert len(lq.queue) == len(pq.queue)
+        assert r1.random() == r2.random()
+
+    def test_queueing_wan_twin(self):
+        n = 13
+        model = _wan_model(seed=23)
+        r1, r2 = random.Random(31), random.Random(31)
+        lq = VectorizedQueueingSim(n, r1, batch_size=20, mock=True)
+        pq = PackedQueueingCosim(n, r2, batch_size=20, wan=model)
+        txs = [b"w%03d" % i for i in range(200)]
+        lq.input_all(txs)
+        pq.input_all(txs)
+        for e in range(4):
+            a = lq.run_epoch(wan=model)
+            b = pq.run_epoch()
+            _assert_epoch_equal(a, b, ("qwan", e))
+            assert len(lq.queue) == len(pq.queue)
+        assert r1.random() == r2.random()
+
+
+class TestDelaySchedulePin:
+    """The sampler seam must not disturb the legacy draw sequence."""
+
+    def test_default_draws_pinned_byte_for_byte(self):
+        # the default sampler consumes exactly ONE flat rng.random()
+        # per decision — the distribution every pre-seam scenario and
+        # checkpoint was recorded under
+        sched = SeededDelaySchedule(random.Random(0xDE1A), p_delay=0.25)
+        ref = random.Random(0xDE1A)
+        decisions = [
+            sched(s, r, ("msg", i))
+            for i, (s, r) in enumerate((a, b) for a in range(5) for b in range(5))
+        ]
+        expected = [not (ref.random() < 0.25) for _ in range(25)]
+        assert decisions == expected
+        assert sched.held_count == expected.count(False)
+        # and the rngs are in lockstep afterwards
+        assert sched.rng.random() == ref.random()
+
+    def test_wan_sampler_one_draw_per_decision(self):
+        model = W.WanModel(
+            seed=3,
+            latency=W.LatencyModel("lognormal", sigma=0.8),
+            deadline_ms=150.0,
+        )
+        sampler = model.bind(10).delay_sampler()
+        sched = SeededDelaySchedule(
+            random.Random(4), p_delay=0.25, sampler=sampler
+        )
+        ref = random.Random(4)
+        for s in range(5):
+            for r in range(5):
+                sched(s, r, None)
+                ref.random()
+        assert sched.rng.random() == ref.random()
+
+
+class TestScaleMode:
+    def test_packed_stats_small(self):
+        sim = PackedHoneyBadgerCosim(64, random.Random(0))
+        s = sim.run_epoch_packed()
+        assert s.n == 64 and s.accepted == 64 and s.coin_flips == 0
+        assert s.bytes_per_validator > 0 and s.mesh_devices >= 1
+        s2 = sim.run_epoch_packed(dead={0})
+        assert s2.epoch == 1 and s2.accepted == 63
+        counts = sim.commit_counts()
+        assert counts[1] == 2 and counts[0] == 1
+
+    @pytest.mark.slow
+    def test_packed_smoke_16384(self):
+        model = W.WanModel(
+            seed=3,
+            latency=W.LatencyModel("lognormal", sigma=0.8),
+            deadline_ms=150.0,
+        )
+        sim = PackedHoneyBadgerCosim(16384, random.Random(0), wan=model)
+        for _ in range(3):
+            s = sim.run_epoch_packed()
+            assert 0 < s.accepted <= 16384
+            assert s.peak_rss_bytes > 0
+        assert int(sim.commit_counts().max()) <= 3
