@@ -467,6 +467,7 @@ class RaceChecker:
         from ..obs import recorder
         from ..ops import gf256_jax, packed_msm, pallas_ec, staging
         from ..parallel import mesh as _mesh
+        from ..recover import wal as _wal
         from ..transport import tcp as _tcp
 
         lock_sites = [
@@ -539,8 +540,21 @@ class RaceChecker:
             node.faults = _chk.track_list(
                 node.faults, "transport/tcp.TcpNode.faults"
             )
+            node._replay = _chk.track_dict(
+                node._replay, "transport/tcp.TcpNode._replay"
+            )
 
         self._shim(_tcp, "_TRACK_NODE", _track_tcp_node)
+
+        # WAL writers: the protocol pump appends while the
+        # ``hbbft-wal-sync`` daemon fsyncs — their shared lock is
+        # tracked per instance via the same constructor-hook pattern
+        def _track_wal(writer, _chk=self):
+            writer._lock = _chk.track_lock(
+                writer._lock, "recover/wal.WalWriter._lock"
+            )
+
+        self._shim(_wal, "_TRACK_WAL", _track_wal)
 
         rec = recorder.ACTIVE
         if rec is not None:
@@ -578,10 +592,10 @@ class RaceChecker:
                 setattr(obj, attr, list(current))
             elif isinstance(current, TrackedLock):
                 setattr(obj, attr, current._raw)
-            elif attr == "_TRACK_NODE":
-                # the tcp constructor hook is a plain callable we set —
-                # restore the original (None) so nodes built after
-                # disable() are untracked
+            elif attr in ("_TRACK_NODE", "_TRACK_WAL"):
+                # the constructor hooks are plain callables we set —
+                # restore the originals (None) so nodes/writers built
+                # after disable() are untracked
                 setattr(obj, attr, original)
             else:
                 # product code rebound the global mid-window (documented

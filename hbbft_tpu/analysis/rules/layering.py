@@ -41,6 +41,18 @@ ALLOWED: Dict[str, Set[str]] = {
         "obs",
         "transport",
         "serve",
+        "recover",
+    },
+    # crash recovery sits beside the harness: it persists harness
+    # checkpoints and drives the transport's session resumption
+    "recover": {
+        "recover",
+        "harness",
+        "transport",
+        "protocols",
+        "core",
+        "crypto",
+        "obs",
     },
     "transport": {"transport", "protocols", "core", "crypto", "obs"},
     # the serving front door sits above the mesh and the protocol stack;

@@ -245,7 +245,16 @@ def fuzz_frames(
     """Feed crafted length-prefixed streams through ``TcpNode._recv_loop``
     (a fed ``StreamReader`` — no real sockets) and check: well-formed
     frames are delivered, malformed ones dropped with stream realignment,
-    truncation/oversize terminate the loop, and nothing hangs."""
+    truncation/oversize terminate the loop, and nothing hangs.
+
+    The resume surface rides the same loop: hostile ``SeqData`` frames
+    (fresh, duplicate, and invalid sequence numbers) and mid-stream
+    resume control frames (``ResumeHello``/``ResumeWelcome``/
+    ``ResumeAck``) are interleaved, and the expected-delivery oracle
+    mirrors the transport's dedup rules — fresh seqs deliver exactly
+    once, everything else drops without killing the link.  The per-peer
+    receive counter persists across cases (one node, one peer), exactly
+    as a long-lived link would see it."""
     from ..transport import tcp as _tcp
 
     rng = random.Random(seed)
@@ -254,9 +263,39 @@ def fuzz_frames(
     report = FuzzReport(surface="frames")
 
     node = _tcp.TcpNode("127.0.0.1:1", ["127.0.0.1:1", "127.0.0.1:2"], lambda ni: None)
+    # oracle's mirror of node._recv_seq["fuzz-peer"] — persists across
+    # cases just like the node's own counter does
+    rs = {"v": 0}
 
     def frame_of(payload: bytes) -> bytes:
         return len(payload).to_bytes(_tcp._LEN_BYTES, "big") + payload
+
+    def expect_delivery(message: Any) -> int:
+        """Mirror of ``_recv_loop``'s resume semantics: how many inbox
+        entries this decoded message must produce."""
+        if isinstance(
+            message, (_tcp.ResumeAck, _tcp.ResumeHello, _tcp.ResumeWelcome)
+        ):
+            return 0  # control frames are dropped mid-stream
+        if isinstance(message, _tcp.SeqData):
+            if not _tcp._seq_ok(message.seq) or message.seq <= rs["v"]:
+                return 0  # invalid or duplicate sequence number
+            rs["v"] = message.seq
+            return 1
+        return 1  # legacy bare message
+
+    def bad_seq() -> Any:
+        return rng.choice(
+            [
+                True,
+                False,
+                -1 - rng.randrange(5),
+                _tcp._MAX_SEQ + rng.randrange(100),
+                "7",
+                None,
+                b"\x02",
+            ]
+        )
 
     async def run_stream(stream: bytes, expect_delivered: int) -> None:
         reader = asyncio.StreamReader()
@@ -285,18 +324,46 @@ def fuzz_frames(
             for _ in range(rng.randrange(1, 6)):
                 if terminated:
                     break
-                k = rng.randrange(6)
+                k = rng.randrange(10)
                 if k in (0, 1):  # valid frame
                     stream += frame_of(dumps(_random_primitive(rng)))
                     expect += 1
                 elif k == 2:  # well-formed frame, malformed payload: dropped
                     payload = _mutate(rng, _random_obj_frame(rng, manifest))
                     try:
-                        loads(payload)
-                        expect += 1  # mutation happened to stay valid
+                        decoded = loads(payload)
+                        # mutation happened to stay valid — may even be a
+                        # resume-surface object, so ask the oracle
+                        expect += expect_delivery(decoded)
                     except SerializationError:
                         pass
                     stream += frame_of(payload)
+                elif k == 6:  # fresh SeqData: delivered exactly once
+                    seq = rs["v"] + 1 + rng.randrange(3)
+                    stream += frame_of(
+                        dumps(_tcp.SeqData(seq, _random_primitive(rng)))
+                    )
+                    rs["v"] = seq
+                    expect += 1
+                elif k == 7:  # duplicate/stale SeqData: dropped
+                    seq = rng.randrange(rs["v"] + 1)
+                    stream += frame_of(
+                        dumps(_tcp.SeqData(seq, _random_primitive(rng)))
+                    )
+                elif k == 8:  # invalid sequence number: dropped
+                    stream += frame_of(
+                        dumps(_tcp.SeqData(bad_seq(), _random_primitive(rng)))
+                    )
+                elif k == 9:  # mid-stream resume control frame: dropped
+                    j = rng.randrange(3)
+                    seq = rng.choice([rs["v"], rng.randrange(2**40), bad_seq()])
+                    if j == 0:
+                        ctl: Any = _tcp.ResumeHello("127.0.0.1:9", seq)
+                    elif j == 1:
+                        ctl = _tcp.ResumeWelcome(seq)
+                    else:
+                        ctl = _tcp.ResumeAck(seq)
+                    stream += frame_of(dumps(ctl))
                 elif k == 3:  # truncated frame: loop must terminate cleanly
                     payload = dumps(_random_primitive(rng))
                     cut = frame_of(payload)[: _tcp._LEN_BYTES + rng.randrange(len(payload))]

@@ -442,6 +442,10 @@ class TestNetwork:
         # models it through adversarial scheduling of its queues.
         self.message_filter = message_filter
         self.held_messages: List[Tuple[Any, Any, Any]] = []
+        # crash plane: nodes killed via ``kill()``; messages addressed
+        # to a down node buffer here (the in-memory analogue of the TCP
+        # transport's replay buffer) and are redelivered on restart
+        self._down: Dict[Any, List[Tuple[Any, Any]]] = {}
         # batching backends get a prefetch pass every ~n steps
         self.prefetch_every = n if ops is not None and hasattr(ops, "prefetch") else 0
         self._steps = 0
@@ -496,6 +500,9 @@ class TestNetwork:
                 (held if predicate(*m) else kept).append(m)
             self.held_messages = kept
         for sender_id, recipient, message in held:
+            if recipient in self._down:
+                self._down[recipient].append((sender_id, message))
+                continue
             node = (
                 self.observer
                 if recipient == self.OBSERVER_ID
@@ -520,6 +527,9 @@ class TestNetwork:
                 for nid, node in self.nodes.items():
                     if nid != sender_id:
                         self._enqueue(nid, node, sender_id, tm.message)
+                for nid in self._down:
+                    if nid != sender_id:
+                        self._down[nid].append((sender_id, tm.message))
                 self._enqueue(
                     self.OBSERVER_ID, self.observer, sender_id, tm.message
                 )
@@ -530,6 +540,8 @@ class TestNetwork:
                     self.adversary.push_message(sender_id, tm)
                 elif to_id in self.nodes:
                     self._enqueue(to_id, self.nodes[to_id], sender_id, tm.message)
+                elif to_id in self._down:
+                    self._down[to_id].append((sender_id, tm.message))
                 elif to_id == self.OBSERVER_ID:
                     self._enqueue(
                         self.OBSERVER_ID, self.observer, sender_id, tm.message
@@ -543,6 +555,33 @@ class TestNetwork:
             # algorithm misbehaves we surface it rather than hide it
             assert not msgs_obs, "observer attempted to send messages"
 
+    # -- crash / restart ---------------------------------------------------
+
+    def kill(self, nid) -> TestNode:
+        """SIGKILL-sim: remove a node mid-run.  Its received-but-not-
+        yet-applied queue moves to the down-buffer (in a real deployment
+        those frames sit in peers' replay buffers — they were never
+        applied, so the WAL does not have them either) and every later
+        message addressed to it buffers until :meth:`restart`."""
+        node = self.nodes.pop(nid)
+        self._down[nid] = list(node.queue)
+        node.queue.clear()
+        return node
+
+    def restart(self, nid, node) -> TestNode:
+        """Rejoin a restarted node (a recovered algorithm or a
+        ``TestNode`` wrapping one): redeliver everything buffered while
+        it was down, in arrival order — the in-memory equivalent of the
+        TCP resume replay."""
+        if not isinstance(node, TestNode):
+            node = TestNode(node)
+        buffered = self._down.pop(nid, [])
+        self.nodes[nid] = node
+        for sender_id, message in buffered:
+            node.queue.append((sender_id, message))
+            self._note_obs(node, sender_id, message)
+        return node
+
     # -- checkpointing -----------------------------------------------------
     # Like NetworkInfo, the harness never serializes the ops backend;
     # restore rebinds to the backend injected via
@@ -555,6 +594,7 @@ class TestNetwork:
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self.__dict__.setdefault("_down", {})  # pre-crash-PR snapshots
         from ..crypto.backend import restore_backend
 
         self.ops = restore_backend()

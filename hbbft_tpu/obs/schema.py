@@ -107,4 +107,10 @@ EVENTS: Dict[str, EventSpec] = {
         {"epoch", "accepted", "coin_flips", "mesh_devices", "bytes_per_validator"},
     ),
     "wan_model": _spec({"distribution", "seed"}, {"zones", "n"}),
+    # crash-recovery (additive): one row per resumed TCP link (how many
+    # buffered frames were replayed vs dropped as already-delivered),
+    # and one per plane that degraded to its fallback path (stager →
+    # inline, device → host) — emitted at most once per degradation
+    "wire_resume": _spec({"peer", "replayed", "dropped"}, {"recv_seq"}),
+    "degrade": _spec({"plane", "reason"}, {"detail"}),
 }

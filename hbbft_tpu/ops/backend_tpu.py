@@ -73,6 +73,31 @@ class _DeviceMerkleTree(MerkleTree):
         self.levels = levels
 
 
+class _GuardedFinalizer:
+    """Wraps a device finalizer for the degradation ladder: an
+    exception surfacing at finalize time degrades the backend (one
+    ``degrade`` obs event, device routing off for the process) and
+    recomputes the value on the host path — byte-identical by the
+    backend contract, so callers never see the failure."""
+
+    def __init__(self, backend: "TpuBackend", fin, recompute):
+        self._backend = backend
+        self._fin = fin
+        self._recompute = recompute
+
+    def __call__(self):
+        try:
+            return self._fin()
+        except Exception as exc:
+            self._backend._degrade(f"finalize:{type(exc).__name__}")
+            return self._recompute()
+
+    def __getattr__(self, name):
+        # ready/poll/start_drain finalizer-protocol passthrough for the
+        # epoch driver's drain overlap
+        return getattr(self._fin, name)
+
+
 class TpuBackend(CpuBackend):
     """Batched JAX/TPU ops backend (bit-identical to ``CpuBackend``).
 
@@ -88,6 +113,11 @@ class TpuBackend(CpuBackend):
     def __init__(self, mesh=None):
         self.mesh = mesh if mesh is not None else _mesh_from_env()
         self._sharded_g1 = None
+        # Degradation ladder: the first device/mesh error flips this
+        # sticky flag — every later call routes host-side (identical
+        # results, the process stays alive) and the failure is
+        # attributed exactly once via the ``degrade`` obs event.
+        self._degraded = False
         # env overrides are read here (not at import) so operators and
         # tests can set them after the module loads
         # G2_DEVICE_MIN joined the tunable set with the batched coin
@@ -116,10 +146,25 @@ class TpuBackend(CpuBackend):
         except Exception:
             pass  # prewarm is an optimization; never block construction
 
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def _degrade(self, reason: str) -> None:
+        """Flip to host-only routing, attributing the failure once."""
+        if self._degraded:
+            return
+        self._degraded = True
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("degrade", plane="device", reason=reason)
+            rec.count("degrade.device")
+
     def _mesh_flush_active(self) -> bool:
         """Whether product flushes route to the sharded mesh engine:
         a >1-device mesh on a backend the engine supports (real TPU,
         or a virtual CPU mesh under ``HBBFT_TPU_MESH_CPU=1``)."""
+        if self._degraded:
+            return False
         if self.mesh is None or self.mesh.devices.size < 2:
             return False
         from . import packed_msm
@@ -139,28 +184,37 @@ class TpuBackend(CpuBackend):
     def sha256_many(self, items: Sequence[bytes]) -> List[bytes]:
         items = list(items)
         if (
-            not self._native_host()
+            not self._degraded
+            and not self._native_host()
             and len(items) >= _MIN_DEVICE_BATCH
             and len({len(i) for i in items}) == 1
         ):
-            return sha256_jax.sha256_many(items)
+            try:
+                return sha256_jax.sha256_many(items)
+            except Exception as exc:
+                self._degrade(f"sha256:{type(exc).__name__}")
         return super().sha256_many(items)
 
     def merkle_tree(self, values: List[bytes]) -> MerkleTree:
         vals = list(values)
         if (
-            self._native_host()
+            self._degraded
+            or self._native_host()
             or len(vals) < _MIN_DEVICE_BATCH
             or len({len(v) for v in vals}) != 1
         ):
             return MerkleTree(vals)
-        levels = sha256_jax.merkle_levels_device(vals)
+        try:
+            levels = sha256_jax.merkle_levels_device(vals)
+        except Exception as exc:
+            self._degrade(f"merkle:{type(exc).__name__}")
+            return MerkleTree(vals)
         return _DeviceMerkleTree(vals, levels)
 
     # -- erasure coding ---------------------------------------------------
 
     def rs_codec(self, data_shards: int, parity_shards: int):
-        if parity_shards == 0 or self._native_host():
+        if parity_shards == 0 or self._degraded or self._native_host():
             return super().rs_codec(data_shards, parity_shards)
         if data_shards + parity_shards > 256:
             return gf256_jax.ReedSolomonDevice16(data_shards, parity_shards)
@@ -235,29 +289,42 @@ class TpuBackend(CpuBackend):
         # throughput is the single-chip windowed rate and only the
         # [3, L] partial sums cross ICI, so the mesh scales it by
         # device count (ADVICE r1 item 3 / VERDICT r2 item 5).
-        if self.mesh is not None and len(points) >= self.G1_MESH_MIN:
-            from ..parallel import mesh as M
-            from . import packed_msm
+        if (
+            not self._degraded
+            and self.mesh is not None
+            and len(points) >= self.G1_MESH_MIN
+        ):
+            try:
+                from ..parallel import mesh as M
+                from . import packed_msm
 
-            if rec is not None:
-                rec.event("device_op", op="g1_msm", k=len(points), engine="mesh")
-            if self._sharded_g1 is None:
-                # r5: the mesh path ships the PACKED wire (96 B/point
-                # + scalar bytes, on-device unpack per shard) — the r4
-                # single-chip transfer win, inherited multi-chip
-                # (VERDICT r4 weak #5); the expanded limb+digit layout
-                # (~650 B/point) is gone from this branch
-                self._sharded_g1 = M.sharded_packed_msm_fn(self.mesh)
-            w = ec_jax._width(scalars, None)
-            wires = packed_msm.g1_wires_batch(points)
-            sc = packed_msm.scalar_bytes_batch(scalars, -(-w // 8))
-            return ec_jax.g1_from_limbs(self._sharded_g1(wires, sc))
+                if rec is not None:
+                    rec.event(
+                        "device_op", op="g1_msm", k=len(points), engine="mesh"
+                    )
+                if self._sharded_g1 is None:
+                    # r5: the mesh path ships the PACKED wire (96 B/point
+                    # + scalar bytes, on-device unpack per shard) — the r4
+                    # single-chip transfer win, inherited multi-chip
+                    # (VERDICT r4 weak #5); the expanded limb+digit layout
+                    # (~650 B/point) is gone from this branch
+                    self._sharded_g1 = M.sharded_packed_msm_fn(self.mesh)
+                w = ec_jax._width(scalars, None)
+                wires = packed_msm.g1_wires_batch(points)
+                sc = packed_msm.scalar_bytes_batch(scalars, -(-w // 8))
+                return ec_jax.g1_from_limbs(self._sharded_g1(wires, sc))
+            except Exception as exc:
+                self._degrade(f"mesh:{type(exc).__name__}")
         if not self._g1_in_device_band(len(points), flat=True):
             if rec is not None:
                 rec.event("device_op", op="g1_msm", k=len(points), engine="host")
             return super().g1_msm(points, scalars)
-        fin = self._device_g1_msm(points, scalars)
-        if fin is None:  # no warm executables for this shape
+        try:
+            fin = self._device_g1_msm(points, scalars)
+        except Exception as exc:
+            self._degrade(f"launch:{type(exc).__name__}")
+            fin = None
+        if fin is None:  # no warm executables for this shape (or degraded)
             if rec is not None:
                 rec.event(
                     "device_op", op="g1_msm", k=len(points), engine="host_cold"
@@ -265,14 +332,19 @@ class TpuBackend(CpuBackend):
             return super().g1_msm(points, scalars)
         if rec is not None:
             rec.event("device_op", op="g1_msm", k=len(points), engine="device")
-        return fin()
+        return _GuardedFinalizer(
+            self, fin, lambda: CpuBackend.g1_msm(self, points, scalars)
+        )()
 
     def _g1_in_device_band(self, k: int, flat: bool = False) -> bool:
         """One home for the host/device G1 routing decision (shared by
         the sync and async entries so they can never drift): the device
         takes a batch when no native host path exists, or when k falls
         inside the measured routing band.  ``flat`` applies the extra
-        upper cap of the ungrouped chunked path (``G1_FLAT_MAX``)."""
+        upper cap of the ungrouped chunked path (``G1_FLAT_MAX``).  A
+        degraded backend never routes to the device again."""
+        if self._degraded:
+            return False
         if not self._native_host():
             return True
         if flat and k > self.G1_FLAT_MAX:
@@ -323,8 +395,15 @@ class TpuBackend(CpuBackend):
             and points
             and self._g1_in_device_band(len(points), flat=True)
         ):
-            fin = self._device_g1_msm(points, scalars)
+            try:
+                fin = self._device_g1_msm(points, scalars)
+            except Exception as exc:
+                self._degrade(f"launch:{type(exc).__name__}")
+                fin = None
             if fin is not None:
+                fin = _GuardedFinalizer(
+                    self, fin, lambda: CpuBackend.g1_msm(self, points, scalars)
+                )
                 # the sync path stamps every route it takes; the async
                 # fast path was the ONE silent branch — device MSMs in
                 # flight were invisible in traces (ISSUE 4 satellite)
@@ -343,13 +422,19 @@ class TpuBackend(CpuBackend):
     def g2_msm(self, points: Sequence[G2], scalars: Sequence[int]) -> G2:
         points, scalars = list(points), list(scalars)
         rec = _obs.ACTIVE
-        if self._native_host() and len(points) < self.G2_DEVICE_MIN:
+        if self._degraded or (
+            self._native_host() and len(points) < self.G2_DEVICE_MIN
+        ):
             if rec is not None:
                 rec.event("device_op", op="g2_msm", k=len(points), engine="host")
             return super().g2_msm(points, scalars)
         if rec is not None:
             rec.event("device_op", op="g2_msm", k=len(points), engine="device")
-        return ec_jax.g2_msm(points, scalars)
+        try:
+            return ec_jax.g2_msm(points, scalars)
+        except Exception as exc:
+            self._degrade(f"g2:{type(exc).__name__}")
+            return super().g2_msm(points, scalars)
 
     # -- product-form MSM ---------------------------------------------------
 
@@ -398,14 +483,25 @@ class TpuBackend(CpuBackend):
             else list(points)
         )
         rec = _obs.ACTIVE
+
+        def _host_product():
+            # degrade recompute target: the exact host path, finalized
+            return CpuBackend.g1_msm_product_async(
+                self, pts_list, s_coeffs, t_coeffs, group_sizes
+            )()
+
         if (
             self._mesh_flush_active()
             and pts_list
             and len(pts_list) >= self.G1_MESH_MIN
         ):
-            fin = packed_msm.g1_msm_product_async(
-                points, s_coeffs, t_coeffs, group_sizes, mesh=self.mesh
-            )
+            try:
+                fin = packed_msm.g1_msm_product_async(
+                    points, s_coeffs, t_coeffs, group_sizes, mesh=self.mesh
+                )
+            except Exception as exc:
+                self._degrade(f"mesh-flush:{type(exc).__name__}")
+                fin = None
             if fin is not None:
                 if rec is not None:
                     rec.event(
@@ -414,9 +510,9 @@ class TpuBackend(CpuBackend):
                         k=len(pts_list),
                         engine="mesh",
                     )
-                return fin
+                return _GuardedFinalizer(self, fin, _host_product)
             # the mesh declined (no warm shard executable / zero device
-            # share): fall through to the host product path below
+            # share) or degraded: fall through to the host product path
         if (
             self.mesh is None
             and pts_list
@@ -430,9 +526,13 @@ class TpuBackend(CpuBackend):
                 jax.default_backend() == "tpu"
                 or pallas_ec.exec_cache_active()
             ):
-                fin = packed_msm.g1_msm_product_async(
-                    points, s_coeffs, t_coeffs, group_sizes
-                )
+                try:
+                    fin = packed_msm.g1_msm_product_async(
+                        points, s_coeffs, t_coeffs, group_sizes
+                    )
+                except Exception as exc:
+                    self._degrade(f"fused-flush:{type(exc).__name__}")
+                    fin = None
                 if fin is not None:
                     if rec is not None:
                         rec.event(
@@ -441,7 +541,7 @@ class TpuBackend(CpuBackend):
                             k=len(pts_list),
                             engine="device",
                         )
-                    return fin
+                    return _GuardedFinalizer(self, fin, _host_product)
         if rec is not None:
             rec.event(
                 "device_op", op="g1_msm_product", k=len(pts_list), engine="host"

@@ -65,6 +65,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import recorder as _obs
+
 
 def enabled() -> bool:
     """Staged transfers are on unless ``HBBFT_TPU_STAGING=0``."""
@@ -121,17 +123,54 @@ class Stager:
         self._q: "queue.SimpleQueue[Optional[StageTask]]" = queue.SimpleQueue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # Degradation ladder (crash-recovery PR): a worker thread that
+        # died unexpectedly, or a thread that cannot start, degrades
+        # this stager to inline execution permanently — attributed
+        # once via the ``degrade`` obs event, never a process death.
+        # Inline submit is bit-identical to the staged path by the
+        # module's own determinism contract (HBBFT_TPU_STAGING=0 is
+        # the same code path).
+        self._started = False
+        self._degraded = False
 
-    def _ensure_thread(self) -> None:
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def _mark_degraded(self, reason: str) -> None:
+        # callers hold self._lock
+        self._degraded = True
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("degrade", plane="stager", reason=reason)
+            rec.count("degrade.stager")
+
+    def _ensure_thread(self) -> bool:
+        """→ True when the worker is up; False degrades to inline."""
+        if self._degraded:
+            return False
         if self._thread is not None and self._thread.is_alive():
-            return
+            return True
         with self._lock:
+            if self._degraded:
+                return False
             if self._thread is not None and self._thread.is_alive():
-                return
-            self._thread = threading.Thread(
-                target=self._loop, name="hbbft-stager", daemon=True
-            )
-            self._thread.start()
+                return True
+            if self._started:
+                # the worker existed and died without being asked to —
+                # whatever killed it (device runtime fault, interpreter
+                # teardown race) would kill a respawn too; degrade
+                self._mark_degraded("worker-died")
+                return False
+            try:
+                self._thread = threading.Thread(
+                    target=self._loop, name="hbbft-stager", daemon=True
+                )
+                self._thread.start()
+            except BaseException as exc:
+                self._mark_degraded(f"thread-start:{type(exc).__name__}")
+                return False
+            self._started = True
+        return True
 
     def _loop(self) -> None:
         while True:
@@ -142,13 +181,13 @@ class Stager:
 
     def submit(self, fn: Callable[[], Any]) -> StageTask:
         """Enqueue ``fn`` on the worker (staging on) or run it inline
-        (staging off).  Either way the returned task is the caller's
-        only handle — completion, result, and errors flow through it."""
+        (staging off, or the worker degraded).  Either way the returned
+        task is the caller's only handle — completion, result, and
+        errors flow through it."""
         task = StageTask(fn)
-        if not enabled():
+        if not enabled() or not self._ensure_thread():
             task._run()
             return task
-        self._ensure_thread()
         self._q.put(task)
         return task
 

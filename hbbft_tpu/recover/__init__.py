@@ -1,0 +1,35 @@
+"""Crash recovery — durable WAL, checkpoint restore, restart drivers.
+
+The recovery stack in one sentence: every inbound event is CRC-framed
+into an append-only write-ahead log *before* it is applied
+(``wal.py``), epoch-granular ``checkpoint.save`` snapshots bound the
+replay tail (``node.py``), and the restart drivers (``driver.py``)
+rebuild a killed node whose transport sequence numbers continue the
+pre-crash stream so the TCP session-resumption layer
+(``transport/tcp.py``) neither loses nor double-applies a frame.
+"""
+
+from .driver import (
+    durable_tcp_node,
+    prime_replay,
+    restart_tcp_node,
+)
+from .node import DurableAlgo, Recovery, RecoveryError, recover
+from .wal import CHECKPOINT, INPUT, MESSAGE, Record, WalError, WalWriter, read_records
+
+__all__ = [
+    "CHECKPOINT",
+    "INPUT",
+    "MESSAGE",
+    "DurableAlgo",
+    "Record",
+    "Recovery",
+    "RecoveryError",
+    "WalError",
+    "WalWriter",
+    "durable_tcp_node",
+    "prime_replay",
+    "read_records",
+    "recover",
+    "restart_tcp_node",
+]

@@ -1,0 +1,231 @@
+"""Durable write-ahead log — CRC-framed records + epoch snapshots.
+
+One append-only file per node holds everything a crashed validator
+needs to come back: full ``checkpoint.save`` snapshots (``CHECKPOINT``
+records, written at epoch granularity) interleaved with the inbound
+event stream between snapshots (``INPUT`` / ``MESSAGE`` records, one
+per ``handle_input`` / ``handle_message`` call, written *before* the
+event is applied).  Because every algorithm is a deterministic sans-IO
+state machine (the ``determinism`` lint rule guarantees it), replaying
+the records after the last snapshot regenerates the exact pre-crash
+state *and* the exact outbound ``Step`` stream — which is what lets
+the transport's session resumption renumber and re-send only the
+frames a peer never received.
+
+File format::
+
+    magic   := b"HBWAL001"                       (8 bytes, file start)
+    record  := kind(1) || len(4, BE) || crc32(4, BE) || payload(len)
+
+A crash mid-append leaves a truncated or CRC-failing *tail*;
+:func:`read_records` stops cleanly at the first bad record and reports
+``clean=False`` — everything before the tail is intact by CRC.
+
+Payload encoding is pickle protocol 5, the same trust model as
+``harness/checkpoint.py``: the WAL is trusted local state, never
+loaded from an untrusted source (the *wire* codec remains
+``core/serialize.py``).  ``MESSAGE`` payloads are ``(sender, message)``
+pairs; ``CHECKPOINT`` payloads are ``(state_bytes, meta)`` where
+``state_bytes`` is ``checkpoint.save`` output and ``meta`` is a small
+dict the restart driver uses for transport continuity (per-peer send
+sequence numbers at snapshot time).
+
+Durability knobs: every append is written + flushed to the OS
+immediately; ``fsync`` batching is delegated to a background syncer
+thread (``hbbft-wal-sync``) so the protocol pump never blocks on disk,
+with ``fsync="always"`` available for tests and paranoid deployments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import threading
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_MAGIC = b"HBWAL001"
+_HDR = 1 + 4 + 4  # kind + length + crc32
+_PROTOCOL = 5
+
+CHECKPOINT = 1
+INPUT = 2
+MESSAGE = 3
+_KINDS = (CHECKPOINT, INPUT, MESSAGE)
+
+# Racecheck hook (analysis/racecheck.py): when the runtime lockset
+# checker is installed it replaces this with a callable that wraps each
+# new writer's lock in a tracked view, so the append path vs the
+# background syncer thread is race-checked.
+_TRACK_WAL: Optional[Callable[["WalWriter"], None]] = None
+
+
+class WalError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Record:
+    kind: int
+    payload: bytes
+
+
+def _frame_record(kind: int, payload: bytes) -> bytes:
+    return (
+        bytes([kind])
+        + len(payload).to_bytes(4, "big")
+        + (zlib.crc32(payload) & 0xFFFFFFFF).to_bytes(4, "big")
+        + payload
+    )
+
+
+def read_records(path: str) -> Tuple[List[Record], bool]:
+    """Scan a WAL file → ``(records, clean)``.
+
+    ``clean`` is False when the file ends in a truncated or
+    CRC-failing tail (the signature of a crash mid-append); the
+    records before the tail are returned and are CRC-intact.
+    """
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return [], True
+    if not data.startswith(_MAGIC):
+        return [], len(data) == 0
+    pos = len(_MAGIC)
+    records: List[Record] = []
+    while pos < len(data):
+        if pos + _HDR > len(data):
+            return records, False  # truncated header
+        kind = data[pos]
+        length = int.from_bytes(data[pos + 1 : pos + 5], "big")
+        crc = int.from_bytes(data[pos + 5 : pos + 9], "big")
+        end = pos + _HDR + length
+        if kind not in _KINDS or end > len(data):
+            return records, False  # unknown kind / truncated payload
+        payload = data[pos + _HDR : end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return records, False  # torn write
+        records.append(Record(kind, payload))
+        pos = end
+    return records, True
+
+
+def decode_checkpoint(payload: bytes) -> Tuple[bytes, Dict[str, Any]]:
+    state_bytes, meta = pickle.loads(payload)
+    return state_bytes, meta
+
+
+def decode_input(payload: bytes) -> Any:
+    return pickle.loads(payload)
+
+
+def decode_message(payload: bytes) -> Tuple[Any, Any]:
+    sender, message = pickle.loads(payload)
+    return sender, message
+
+
+class WalWriter:
+    """Append-only writer with background fsync batching.
+
+    Thread-shape: the protocol pump appends (event-loop thread); the
+    ``hbbft-wal-sync`` daemon fsyncs on an interval.  ``_lock`` guards
+    the file handle and the dirty counter — the only state both
+    threads touch."""
+
+    def __init__(
+        self,
+        path: str,
+        fsync: str = "interval",  # "always" | "interval" | "off"
+        fsync_interval_s: float = 0.05,
+    ):
+        if fsync not in ("always", "interval", "off"):
+            raise ValueError(f"bad fsync policy: {fsync!r}")
+        self.path = path
+        self._fsync = fsync
+        self._interval = fsync_interval_s
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+        self._dirty = 0
+        self._closed = False
+        self._wake = threading.Event()
+        self._syncer: Optional[threading.Thread] = None
+        if fresh:
+            self._f.write(_MAGIC)
+            self._f.flush()
+        if _TRACK_WAL is not None:
+            _TRACK_WAL(self)
+        if fsync == "interval":
+            self._syncer = threading.Thread(
+                target=self._sync_loop, name="hbbft-wal-sync", daemon=True
+            )
+            self._syncer.start()
+
+    # -- append paths --------------------------------------------------
+
+    def append(self, kind: int, payload: bytes) -> None:
+        if kind not in _KINDS:
+            raise WalError(f"bad record kind: {kind}")
+        rec = _frame_record(kind, payload)
+        with self._lock:
+            if self._closed:
+                raise WalError("append to closed WAL")
+            self._f.write(rec)
+            self._f.flush()
+            if self._fsync == "always":
+                os.fsync(self._f.fileno())
+            else:
+                self._dirty += 1
+
+    def append_checkpoint(
+        self, state_bytes: bytes, meta: Optional[Dict[str, Any]] = None
+    ) -> None:
+        self.append(
+            CHECKPOINT,
+            pickle.dumps((state_bytes, dict(meta or {})), protocol=_PROTOCOL),
+        )
+
+    def append_input(self, value: Any) -> None:
+        self.append(INPUT, pickle.dumps(value, protocol=_PROTOCOL))
+
+    def append_message(self, sender: Any, message: Any) -> None:
+        self.append(MESSAGE, pickle.dumps((sender, message), protocol=_PROTOCOL))
+
+    # -- durability ----------------------------------------------------
+
+    def sync(self) -> None:
+        """Force an fsync now (no-op when nothing is dirty)."""
+        with self._lock:
+            if self._dirty and not self._f.closed:
+                os.fsync(self._f.fileno())
+                self._dirty = 0
+
+    def _sync_loop(self) -> None:
+        while True:
+            self._wake.wait(self._interval)
+            if self._wake.is_set():
+                return  # close() requested shutdown
+            self.sync()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._wake.set()
+        if self._syncer is not None:
+            self._syncer.join(timeout=5.0)
+        with self._lock:
+            if self._dirty and not self._f.closed:
+                os.fsync(self._f.fileno())
+                self._dirty = 0
+            self._f.close()
+
+    def __enter__(self) -> "WalWriter":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

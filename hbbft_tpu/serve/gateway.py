@@ -184,6 +184,27 @@ class GatewayCore:
         self.admitted = 0
         self.rejected = 0
         self.commits = 0
+        # validator-restart window (crash-recovery PR): while a mesh
+        # member is restarting, fresh submissions are refused with an
+        # explicit retry-after instead of admitted into a queue no one
+        # is proposing from; pending/acked ledgers are untouched, so
+        # exactly-once commit acks hold across the window
+        self._restarting = False
+        self._restart_retry_ms = 0
+
+    # -- validator-restart window -------------------------------------------
+
+    def begin_restart(self, retry_after_ms: int = 250) -> None:
+        """Open the restart window: reject new submissions with
+        ``retry_after_ms`` until :meth:`end_restart`."""
+        self._restarting = True
+        self._restart_retry_ms = int(retry_after_ms)
+
+    def end_restart(self) -> None:
+        self._restarting = False
+
+    def restarting(self) -> bool:
+        return self._restarting
 
     # -- connection lifecycle ------------------------------------------------
 
@@ -213,6 +234,26 @@ class GatewayCore:
             # idempotent resubmission — already admitted; the commit
             # will still be acked exactly once
             return [SubmitAck(msg.seq, True, 0, "duplicate")], False
+        if self._restarting:
+            # explicit backpressure, no hostile attribution: the client
+            # did nothing wrong, the mesh is mid-restart
+            self.rejected += 1
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.event(
+                    "gateway_reject",
+                    tenant=tenant,
+                    reason="validator-restart",
+                    client=client_id,
+                    seq=msg.seq,
+                    retry_after_ms=self._restart_retry_ms,
+                )
+                rec.count("gateway.rejected")
+            return [
+                SubmitAck(
+                    msg.seq, False, self._restart_retry_ms, "validator-restart"
+                )
+            ], False
         ok, retry_ms, detail = self.admission.offer(tenant, tx)
         rec = _obs.ACTIVE
         if ok:
@@ -508,9 +549,14 @@ class Gateway:
     # -- mesh side -----------------------------------------------------------
 
     async def _pump(self) -> None:
-        """Flush admitted transactions into the mesh as gossip batches."""
+        """Flush admitted transactions into the mesh as gossip batches.
+        During a validator-restart window the drain pauses too —
+        already-admitted transactions stay queued rather than gossiping
+        into a mesh that is mid-rejoin."""
         while not self._closing:
             await asyncio.sleep(self.flush_interval)
+            if self.core.restarting():
+                continue
             batch = self.core.drain(self.batch_max)
             if not batch:
                 continue

@@ -24,6 +24,19 @@ Deviations (deliberate):
   crossbeam channel mesh; the algorithm remains single-threaded by
   construction, matching the library's sans-IO contract.
 
+**Session resumption** (crash-recovery PR): every data frame is wrapped
+in ``SeqData`` carrying a per-link monotonic sequence number; each link
+opens with a ``ResumeHello``/``ResumeWelcome`` handshake exchanging the
+highest sequence number either side has *consumed*.  The sender keeps a
+bounded outbound replay buffer — frames a peer never acknowledged, plus
+everything routed while the peer was down — and on (re)connect replays
+exactly the frames above the peer's reported high-water mark; the
+receiver drops duplicates by sequence number.  Combined with the
+write-ahead log (``recover/``), a validator SIGKILLed mid-epoch neither
+loses nor double-applies a frame.  A dead link is redialed forever with
+jittered exponential backoff (the dial side owns reconnection, keeping
+the one-connection-per-pair invariant).
+
 The reference example runs a single ``Broadcast`` with placeholder keys
 (``node.rs:105-118``); :func:`generate_keys_for` reproduces that spirit:
 each node independently deals the *same* deterministic (INSECURE) key
@@ -34,23 +47,80 @@ keys via the dealerless DKG (``protocols/sync_key_gen.py``).
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import random
-from typing import Any, Callable, Dict, List, Optional
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..core.fault import Fault, FaultKind
 from ..core.network_info import NetworkInfo
-from ..core.serialize import SerializationError, dumps, loads
+from ..core.serialize import SerializationError, dumps, loads, wire
 from ..core.step import Step
 from ..obs import recorder as _obs
 
 _LEN_BYTES = 4
 _MAX_FRAME = 64 * 1024 * 1024
 
+# Session-resumption bounds.  Sequence numbers are attacker-controlled
+# wire ints — every use is behind ``_seq_ok`` and they never size an
+# allocation (the replay buffer is bounded by OUR frame/byte caps, the
+# peer's number only selects a trim point).
+_MAX_SEQ = 2**63
+_REPLAY_MAX_FRAMES = 4096
+_REPLAY_MAX_BYTES = 16 * 1024 * 1024
+_ACK_EVERY = 64
+_REDIAL_BASE_S = 0.05
+_REDIAL_CAP_S = 2.0
+
 # Racecheck hook (analysis/racecheck.py): when the runtime lockset
 # checker is installed it replaces this with a callable that wraps each
-# new node's per-connection containers (_writers/outputs/faults) in
-# tracked views, so concurrent connection handling is race-checked.
+# new node's per-connection containers (_writers/outputs/faults and the
+# replay-buffer map) in tracked views, so concurrent connection
+# handling is race-checked.
 _TRACK_NODE: Optional[Callable[["TcpNode"], None]] = None
+
+
+@wire("RsHello")
+@dataclasses.dataclass(frozen=True)
+class ResumeHello:
+    """Link-opening handshake (dial side): who we are + the highest
+    sequence number we have consumed from this peer (0 = fresh)."""
+
+    addr: Any
+    recv_seq: Any
+
+
+@wire("RsWelcome")
+@dataclasses.dataclass(frozen=True)
+class ResumeWelcome:
+    """Accept side's reply: the highest sequence number *it* has
+    consumed from us, so the dialer can trim + replay its buffer."""
+
+    recv_seq: Any
+
+
+@wire("RsData")
+@dataclasses.dataclass(frozen=True)
+class SeqData:
+    """One data frame: per-link monotonic sequence number + payload."""
+
+    seq: Any
+    msg: Any
+
+
+@wire("RsAck")
+@dataclasses.dataclass(frozen=True)
+class ResumeAck:
+    """Periodic cumulative ack (every ``_ACK_EVERY`` delivered frames)
+    letting the sender trim its replay buffer in steady state."""
+
+    seq: Any
+
+
+def _seq_ok(v: Any) -> bool:
+    """Total validator for wire sequence numbers (bool is an int —
+    reject it explicitly)."""
+    return isinstance(v, int) and not isinstance(v, bool) and 0 <= v < _MAX_SEQ
 
 
 def generate_keys_for(addresses: List[str], our_addr: str) -> NetworkInfo:
@@ -103,6 +173,8 @@ class TcpNode:
         new_algo: Callable[[NetworkInfo], Any],
         netinfo: Optional[NetworkInfo] = None,
         dial_retries: int = 50,
+        resume_recv: Optional[Dict[str, int]] = None,
+        resume_send: Optional[Dict[str, int]] = None,
     ):
         self.our_addr = our_addr
         self.dial_retries = dial_retries
@@ -116,13 +188,38 @@ class TcpNode:
         # output (e.g. the serving gateway's commit-ack watcher); a
         # misbehaving hook must not take down the protocol pump.
         self.on_output: Optional[Callable[[Any], None]] = None
+        # Optional hook invoked after each pump iteration routes its
+        # step — the quiescent point where the restart driver writes
+        # epoch checkpoints (algorithm state and send seqs consistent).
+        self.on_step: Optional[Callable[["TcpNode"], None]] = None
         self._writers: Dict[str, asyncio.StreamWriter] = {}
         self._inbox: asyncio.Queue = asyncio.Queue()
         self._server: Optional[asyncio.base_events.Server] = None
         self._tasks: List[asyncio.Task] = []
         self._connected = asyncio.Event()
+        self._closing = False
+        # session-resumption state (restart: seed from checkpoint meta
+        # + WAL so numbering continues the pre-crash stream exactly)
+        self._send_seq: Dict[str, int] = dict(resume_send or {})
+        self._recv_seq: Dict[str, int] = dict(resume_recv or {})
+        self._replay: Dict[str, Deque[Tuple[int, bytes]]] = {}
+        self._replay_bytes: Dict[str, int] = {}
+        # Acks must reflect the *applied* high-water mark, not the
+        # delivered one: a durable algorithm WAL-logs a frame only when
+        # it is handled, and an ack for a delivered-but-unapplied frame
+        # would let the peer trim it — a crash before apply would then
+        # lose it forever.  The recv loop records each delivered frame's
+        # seq here; the pump acks as it consumes them (FIFO per peer).
+        self._seq_trail: Dict[str, Deque[int]] = {}
+        self._applied_since_ack: Dict[str, int] = {}
         if _TRACK_NODE is not None:
             _TRACK_NODE(self)
+
+    @property
+    def send_seqs(self) -> Dict[str, int]:
+        """Snapshot of per-peer outbound sequence numbers — stored in
+        checkpoint meta so a restarted node renumbers continuously."""
+        return dict(self._send_seq)
 
     # -- connection management --------------------------------------------
 
@@ -183,6 +280,8 @@ class TcpNode:
 
     async def _dial(self, peer: str) -> None:
         host, port = peer.rsplit(":", 1)
+        # initial connect: bounded retries so start() fails fast on an
+        # unreachable peer instead of hanging the mesh
         for attempt in range(self.dial_retries):
             try:
                 reader, writer = await asyncio.open_connection(host, int(port))
@@ -191,9 +290,57 @@ class TcpNode:
                 await asyncio.sleep(0.05 * (attempt + 1))
         else:
             raise ConnectionError(f"could not reach peer {peer}")
-        # handshake: announce our address so the acceptor learns who we are
-        writer.write(_frame(self.our_addr))
-        await writer.drain()
+        await self._run_link(peer, reader, writer)
+        # The link died.  The dial side owns reconnection: redial with
+        # jittered exponential backoff until close() — a validator
+        # restarting after a crash comes back on the same address.
+        backoff = _REDIAL_BASE_S
+        while not self._closing:
+            try:
+                reader, writer = await asyncio.open_connection(host, int(port))
+            except OSError:
+                await asyncio.sleep(backoff * (0.5 + random.random()))
+                backoff = min(backoff * 2.0, _REDIAL_CAP_S)
+                continue
+            backoff = _REDIAL_BASE_S
+            await self._run_link(peer, reader, writer)
+
+    async def _run_link(
+        self,
+        peer: str,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        """Dial-side resume handshake, then the receive loop, on one
+        connection.  Returns when the link dies."""
+        try:
+            writer.write(
+                _frame(ResumeHello(self.our_addr, self._recv_seq.get(peer, 0)))
+            )
+            await writer.drain()
+            welcome = await _read_frame(reader)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            SerializationError,
+        ):
+            writer.close()
+            return
+        if not isinstance(welcome, ResumeWelcome) or not _seq_ok(
+            welcome.recv_seq
+        ):
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("wire.bad_resume")
+            writer.close()
+            return
+        self._resume_link(peer, welcome.recv_seq, writer)
+        try:
+            await writer.drain()
+        except (ConnectionError, OSError):
+            writer.close()
+            return
         self._register(peer, writer)
         try:
             await self._recv_loop(peer, reader)
@@ -204,7 +351,7 @@ class TcpNode:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         try:
-            peer = await _read_frame(reader)
+            hello = await _read_frame(reader)
         except (
             asyncio.IncompleteReadError,
             ConnectionError,
@@ -212,6 +359,17 @@ class TcpNode:
         ):
             writer.close()
             return
+        if isinstance(hello, ResumeHello):
+            peer, peer_recv = hello.addr, hello.recv_seq
+            if not _seq_ok(peer_recv):
+                rec = _obs.ACTIVE
+                if rec is not None:
+                    rec.count("wire.bad_resume")
+                writer.close()
+                return
+        else:
+            # legacy handshake: a bare address frame, no resume state
+            peer, peer_recv = hello, None
         if (
             not isinstance(peer, (str, int))
             or peer not in self.peer_addrs
@@ -225,16 +383,66 @@ class TcpNode:
             # (Dead links are unregistered on recv-loop exit, so a
             # legitimately restarted peer can always re-handshake; a
             # peer reconnecting FASTER than its stale link's EOF is
-            # observed gets refused once and must retry — acceptable
-            # for this demo transport, a production one would probe
-            # the existing writer on a conflicting handshake.)
+            # observed gets refused once and must retry — the dial
+            # side's redial loop absorbs the refusal and retries.)
             writer.close()
             return
+        if peer_recv is not None:
+            try:
+                writer.write(
+                    _frame(ResumeWelcome(self._recv_seq.get(peer, 0)))
+                )
+                await writer.drain()
+            except (ConnectionError, OSError):
+                writer.close()
+                return
+            self._resume_link(peer, peer_recv, writer)
+            try:
+                await writer.drain()
+            except (ConnectionError, OSError):
+                writer.close()
+                return
         self._register(peer, writer)
         try:
             await self._recv_loop(peer, reader)
         finally:
             self._unregister(peer, writer)
+
+    def _resume_link(
+        self, peer: str, peer_recv: int, writer: asyncio.StreamWriter
+    ) -> None:
+        """Trim the replay buffer to the peer's consumed high-water
+        mark and queue the remainder for re-send (the frames it may
+        never have seen).  ``peer_recv`` is attacker-controlled: it is
+        bounds-checked by the caller and only *selects a trim point* —
+        it never sizes an allocation."""
+        if not isinstance(peer_recv, int) or isinstance(peer_recv, bool):
+            return
+        if peer_recv < 0 or peer_recv >= _MAX_SEQ:
+            return
+        buf = self._replay.get(peer)
+        dropped = replayed = 0
+        if buf:
+            while buf and buf[0][0] <= peer_recv:
+                _, frame = buf.popleft()
+                self._replay_bytes[peer] = (
+                    self._replay_bytes.get(peer, 0) - len(frame)
+                )
+                dropped += 1
+            for _, frame in buf:
+                writer.write(frame)
+                replayed += 1
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "wire_resume",
+                peer=peer,
+                replayed=replayed,
+                dropped=dropped,
+                recv_seq=peer_recv,
+            )
+            if replayed:
+                rec.count("wire.replayed_frames", replayed)
 
     def _register(self, peer: str, writer: asyncio.StreamWriter) -> None:
         self._writers[peer] = writer
@@ -247,6 +455,38 @@ class TcpNode:
         if self._writers.get(peer) is writer:
             del self._writers[peer]
 
+    # -- replay buffer ------------------------------------------------------
+
+    def _buffer_frame(self, peer: str, seq: int, frame: bytes) -> None:
+        """Hold an outbound frame until the peer acks past it.  Bounded
+        by our own caps; eviction severs resume-exactness for the
+        evicted frames and is therefore counted loudly."""
+        buf = self._replay.setdefault(peer, deque())
+        buf.append((seq, frame))
+        self._replay_bytes[peer] = self._replay_bytes.get(peer, 0) + len(frame)
+        evicted = 0
+        while len(buf) > _REPLAY_MAX_FRAMES or (
+            self._replay_bytes[peer] > _REPLAY_MAX_BYTES and len(buf) > 1
+        ):
+            _, old = buf.popleft()
+            self._replay_bytes[peer] -= len(old)
+            evicted += 1
+        if evicted:
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("wire.replay_evicted", evicted)
+                rec.count(f"wire.replay_evicted.{peer}", evicted)
+
+    def _trim_acked(self, peer: str, seq: int) -> None:
+        buf = self._replay.get(peer)
+        if not buf:
+            return
+        while buf and buf[0][0] <= seq:
+            _, frame = buf.popleft()
+            self._replay_bytes[peer] = (
+                self._replay_bytes.get(peer, 0) - len(frame)
+            )
+
     async def _recv_loop(self, peer: str, reader: asyncio.StreamReader) -> None:
         while True:
             try:
@@ -257,11 +497,62 @@ class TcpNode:
                 continue  # malformed frame: drop it, the length-prefixed
                 # stream stays aligned on the next frame
             rec = _obs.ACTIVE
+            if isinstance(message, ResumeAck):
+                if _seq_ok(message.seq):
+                    self._trim_acked(peer, message.seq)
+                elif rec is not None:
+                    rec.count("wire.bad_resume")
+                continue
+            if isinstance(message, (ResumeHello, ResumeWelcome)):
+                # resume control frames are only meaningful as the
+                # first exchange on a link — mid-stream ones are noise
+                if rec is not None:
+                    rec.count("wire.unexpected_resume")
+                continue
+            if isinstance(message, SeqData):
+                if not _seq_ok(message.seq):
+                    if rec is not None:
+                        rec.count("wire.bad_seq")
+                    continue
+                last = self._recv_seq.get(peer, 0)
+                if message.seq <= last:
+                    # duplicate delivery (replay overlap after resume,
+                    # or a misbehaving peer) — exactly-once by drop
+                    if rec is not None:
+                        rec.count("wire.dup_frames")
+                    continue
+                self._recv_seq[peer] = message.seq
+                self._seq_trail.setdefault(peer, deque()).append(message.seq)
+                message = message.msg
+            else:
+                # legacy bare frame (pre-resume peer): no seq to ack
+                self._seq_trail.setdefault(peer, deque()).append(0)
             if rec is not None:
                 rec.event("wire_recv", peer=peer, size=size)
                 rec.count("wire.recv_frames")
                 rec.count("wire.recv_bytes", size)
             await self._inbox.put((peer, message))
+
+    def _ack_applied(self, sender: str) -> None:
+        """Called by the pump once per consumed inbound frame: the
+        frame is now applied (and, for a durable algorithm, WAL-logged
+        *before* apply), so its seq is safe to ack — the peer may trim
+        its replay buffer up to here without a crash losing anything."""
+        if not isinstance(sender, str):
+            return
+        trail = self._seq_trail.get(sender)
+        if not trail:
+            return
+        seq = trail.popleft()
+        if not seq:
+            return  # legacy bare frame — nothing to ack
+        n = self._applied_since_ack.get(sender, 0) + 1
+        if n >= _ACK_EVERY:
+            n = 0
+            w = self._writers.get(sender)
+            if w is not None:
+                w.write(_frame(ResumeAck(seq)))
+        self._applied_since_ack[sender] = n
 
     # -- the protocol pump --------------------------------------------------
 
@@ -283,13 +574,19 @@ class TcpNode:
                 targets = self.peer_addrs
             else:
                 targets = [tm.target.node] if tm.target.node != self.our_addr else []
-            frame = _frame(tm.message)
             kind = "all" if tm.target.is_all else "node"
             for peer in targets:
+                # every data frame is sequenced + buffered, whether or
+                # not the link is currently up — a down peer's frames
+                # wait in the replay buffer for its resume handshake
+                seq = self._send_seq.get(peer, 0) + 1
+                self._send_seq[peer] = seq
+                frame = _frame(SeqData(seq, tm.message))
+                self._buffer_frame(peer, seq, frame)
                 w = self._writers.get(peer)
                 if w is not None:
                     w.write(frame)
-                    touched.append(w)
+                    touched.append((peer, w))
                     if rec is not None:
                         rec.event(
                             "wire_send",
@@ -299,11 +596,16 @@ class TcpNode:
                         )
                         rec.count("wire.sent_frames")
                         rec.count("wire.sent_bytes", len(frame) - _LEN_BYTES)
-        for w in touched:
+        for peer, w in touched:
             try:
                 await w.drain()
             except (ConnectionError, OSError):
-                pass
+                # The link died under the write.  The frame is safe in
+                # the replay buffer and will be re-sent on resume —
+                # but never swallow the drop invisibly: attribute it.
+                if rec is not None:
+                    rec.count("wire.send_drops")
+                    rec.count(f"wire.send_drops.{peer}")
 
     async def input(self, value: Any) -> None:
         await self._route(self.algo.handle_input(value))
@@ -338,11 +640,21 @@ class TcpNode:
                 rec = _obs.ACTIVE
                 if rec is not None:
                     rec.count("wire.handler_errors")
+                self._ack_applied(sender)
                 continue
             await self._route(step)
+            self._ack_applied(sender)
+            if self.on_step is not None:
+                try:
+                    self.on_step(self)
+                except Exception:
+                    rec = _obs.ACTIVE
+                    if rec is not None:
+                        rec.count("wire.output_hook_errors")
         return self.outputs
 
     async def close(self) -> None:
+        self._closing = True
         for t in self._tasks:
             t.cancel()
         for w in self._writers.values():

@@ -104,7 +104,12 @@ def test_fuzz_summary_events_emitted_when_tracing():
     finally:
         obs.disable()
     assert res.ok, res.detail
-    assert {r["surface"] for r in rows} == {"codec", "frames", "handlers"}
+    assert {r["surface"] for r in rows} == {
+        "codec",
+        "frames",
+        "handlers",
+        "gateway",
+    }
 
 
 def test_cli_list_and_run(capsys):
@@ -177,7 +182,12 @@ def test_fuzz_corpus_smoke():
     reports = fuzz.run_corpus(
         seed=0xBEE, codec_cases=80, frame_cases=12, handler_cases=40
     )
-    assert [r.surface for r in reports] == ["codec", "frames", "handlers"]
+    assert [r.surface for r in reports] == [
+        "codec",
+        "frames",
+        "handlers",
+        "gateway",
+    ]
     assert all(r.ok for r in reports), [
         f for r in reports for f in r.failures[:2]
     ]

@@ -406,6 +406,44 @@ def test_tcp_node_containers_tracked_and_restored(request):
     assert type(after.outputs) is list
 
 
+def test_wal_writer_sync_thread_tracked_and_race_free(request, tmp_path):
+    """The durable WAL's thread shape under the lockset checker:
+    concurrent appenders race the ``hbbft-wal-sync`` daemon over the
+    shared file handle — all accesses go through ``_lock``, so the
+    checker must stay silent and the log must stay intact."""
+    if request.config.getoption("--racecheck"):
+        pytest.skip("manages the global checker itself")
+    from hbbft_tpu.recover import wal as wal_mod
+
+    assert wal_mod._TRACK_WAL is None
+    path = str(tmp_path / "rc.wal")
+    racecheck.enable()
+    try:
+        w = wal_mod.WalWriter(
+            path, fsync="interval", fsync_interval_s=0.001
+        )
+        assert isinstance(w._lock, racecheck.TrackedLock)
+        assert callable(wal_mod._TRACK_WAL)
+
+        def burst():
+            for i in range(50):
+                w.append_input(i)
+
+        threads = [threading.Thread(target=burst) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        w.sync()
+        w.close()
+    finally:
+        reports = racecheck.disable()
+    assert wal_mod._TRACK_WAL is None
+    assert reports == []
+    records, clean = wal_mod.read_records(path)
+    assert clean and len(records) == 150
+
+
 @pytest.mark.slow
 def test_cli_racecheck_driver_runs_clean():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

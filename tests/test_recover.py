@@ -1,0 +1,554 @@
+"""Crash-recovery tests — durable WAL, checkpoint + replay restore,
+TCP session resumption, and the degradation ladder.
+
+The recovery contract under test, plane by plane:
+
+- **WAL** (``recover/wal.py``): CRC-framed append-only records survive
+  torn tails; ``read_records`` stops cleanly at the first bad record.
+- **Restore** (``recover/node.py``): last snapshot + deterministic
+  replay reconstructs the pre-crash state exactly, and a crash-restart
+  run commits batches identical to an uninterrupted same-seed twin —
+  at n=4 and n=13, mock and real threshold crypto.
+- **Transport** (``transport/tcp.py``): a mid-epoch SIGKILL-sim over
+  real sockets, restored via ``recover.driver``; session resumption
+  replays only the missed frames, inbound dedup drops duplicates, and
+  acks reflect the *applied* (WAL-logged) high-water mark — never the
+  merely-delivered one.
+- **Serving** (``serve/gateway.py``): every committed transaction is
+  acked exactly once across the restart, zero duplicates or losses.
+- **Degradation** (``ops/staging.py``, ``ops/backend_tpu.py``): a dead
+  stager worker or a faulting device degrades to the host path with a
+  single ``degrade`` obs event and byte-identical results — never a
+  process death.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from hbbft_tpu.harness import checkpoint as ckpt
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.harness.scenarios import _hb_batch_key, _state_eq
+from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.protocols.honey_badger import HoneyBadger
+from hbbft_tpu.recover import WalWriter, recover
+from hbbft_tpu.recover import wal as wal_mod
+from hbbft_tpu.recover.node import DurableAlgo, RecoveryError
+from hbbft_tpu.transport.tcp import TcpNode
+
+
+def _free_ports(n):
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _addrs(n):
+    return sorted(f"127.0.0.1:{p}" for p in _free_ports(n))
+
+
+# -- WAL framing ---------------------------------------------------------
+
+
+def test_wal_roundtrip(tmp_path):
+    p = str(tmp_path / "a.wal")
+    with WalWriter(p, fsync="always") as w:
+        w.append_input([b"tx-1"])
+        w.append_message("peer-0", ("m", 1))
+        w.append_checkpoint(b"state-bytes", {"send_seqs": {"p": 3}})
+    records, clean = wal_mod.read_records(p)
+    assert clean
+    assert [r.kind for r in records] == [
+        wal_mod.INPUT,
+        wal_mod.MESSAGE,
+        wal_mod.CHECKPOINT,
+    ]
+    assert wal_mod.decode_input(records[0].payload) == [b"tx-1"]
+    assert wal_mod.decode_message(records[1].payload) == ("peer-0", ("m", 1))
+    assert wal_mod.decode_checkpoint(records[2].payload) == (
+        b"state-bytes",
+        {"send_seqs": {"p": 3}},
+    )
+
+
+def test_wal_reopen_appends(tmp_path):
+    p = str(tmp_path / "a.wal")
+    with WalWriter(p, fsync="off") as w:
+        w.append_input(1)
+    with WalWriter(p, fsync="off") as w:  # reopen: no second magic
+        w.append_input(2)
+    records, clean = wal_mod.read_records(p)
+    assert clean
+    assert [wal_mod.decode_input(r.payload) for r in records] == [1, 2]
+
+
+def test_wal_truncated_tail_tolerated(tmp_path):
+    p = str(tmp_path / "a.wal")
+    with WalWriter(p, fsync="off") as w:
+        w.append_input(1)
+        w.append_input(2)
+    # crash mid-append: a torn header, then a torn payload
+    for tail in (b"\x02\x00\x00", bytes([wal_mod.INPUT]) +
+                 (100).to_bytes(4, "big") + b"\x00" * 4 + b"short"):
+        with open(p, "ab") as f:
+            f.write(tail)
+        records, clean = wal_mod.read_records(p)
+        assert not clean
+        assert [wal_mod.decode_input(r.payload) for r in records] == [1, 2]
+        with open(p, "rb") as f:
+            data = f.read()
+        with open(p, "wb") as f:
+            f.write(data[: len(data) - len(tail)])
+
+
+def test_wal_crc_corruption_stops_scan(tmp_path):
+    p = str(tmp_path / "a.wal")
+    with WalWriter(p, fsync="off") as w:
+        for i in range(3):
+            w.append_input(i)
+    with open(p, "rb") as f:
+        data = f.read()
+    with open(p, "wb") as f:  # flip the last payload byte
+        f.write(data[:-1] + bytes([data[-1] ^ 0xFF]))
+    records, clean = wal_mod.read_records(p)
+    assert not clean
+    assert [wal_mod.decode_input(r.payload) for r in records] == [0, 1]
+
+
+def test_wal_missing_empty_and_junk_files(tmp_path):
+    assert wal_mod.read_records(str(tmp_path / "nope.wal")) == ([], True)
+    empty = tmp_path / "empty.wal"
+    empty.write_bytes(b"")
+    assert wal_mod.read_records(str(empty)) == ([], True)
+    junk = tmp_path / "junk.wal"
+    junk.write_bytes(b"not a wal file")
+    assert wal_mod.read_records(str(junk)) == ([], False)
+
+
+def test_wal_guard_rails(tmp_path):
+    p = str(tmp_path / "a.wal")
+    with pytest.raises(ValueError):
+        WalWriter(p, fsync="nope")
+    w = WalWriter(p, fsync="off")
+    with pytest.raises(wal_mod.WalError):
+        w.append(7, b"")
+    w.close()
+    w.close()  # idempotent
+    with pytest.raises(wal_mod.WalError):
+        w.append_input(1)
+
+
+def test_wal_interval_fsync(tmp_path):
+    p = str(tmp_path / "a.wal")
+    w = WalWriter(p, fsync="interval", fsync_interval_s=0.01)
+    for i in range(10):
+        w.append_input(i)
+    w.sync()
+    w.close()
+    records, clean = wal_mod.read_records(p)
+    assert clean and len(records) == 10
+
+
+def test_recover_requires_checkpoint(tmp_path):
+    p = str(tmp_path / "a.wal")
+    with WalWriter(p, fsync="off") as w:
+        w.append_input(b"x")
+    with pytest.raises(RecoveryError):
+        recover(p)
+
+
+# -- checkpoint + WAL restore ≡ uninterrupted run ------------------------
+
+
+def _crash_restore_run(n, mock, seed, wal_path, kill_at):
+    """One HoneyBadger epoch in TestNetwork; when ``wal_path`` is set,
+    node 1 is durable and is SIGKILL-simmed at step ``kill_at``, then
+    restored from checkpoint + WAL and rejoined.  Returns per-node
+    batch keys (sorted by node id)."""
+    victim = 1
+    rng = random.Random(seed)
+
+    def new_algo(ni):
+        algo = HoneyBadger(ni, rng=random.Random(f"rcv-{ni.our_id}-{seed}"))
+        if wal_path is not None and ni.our_id == victim:
+            return DurableAlgo(
+                algo, WalWriter(wal_path, fsync="off"), checkpoint_every=1
+            )
+        return algo
+
+    net = TestNetwork(
+        n,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        new_algo,
+        rng,
+        mock_crypto=mock,
+    )
+    for nid in sorted(net.nodes):
+        node = net.nodes[nid]
+        node.handle_input([b"rc-%03d" % nid])
+        msgs = list(node.messages)
+        node.messages.clear()
+        net.dispatch_messages(nid, msgs)
+    steps = 0
+    resumed = None
+    try:
+        while not all(nd.outputs for nd in net.nodes.values()):
+            assert net.any_busy(), "network quiesced before batches"
+            net.step()
+            steps += 1
+            assert steps < 400_000, "crash-restore epoch stalled"
+            if wal_path is not None and steps == kill_at:
+                killed = net.kill(victim)
+                assert not killed.outputs, "victim output before the kill"
+                pre = ckpt.load(ckpt.save(killed.algo.algo))
+                killed.algo.wal.close()
+                rec = recover(wal_path)
+                assert _state_eq(rec.algo, pre), (
+                    "recovered state diverges from pre-crash state"
+                )
+                resumed = WalWriter(wal_path, fsync="off")
+                net.restart(victim, rec.resume(resumed))
+        assert all(not nd.faults for nd in net.nodes.values())
+        return [
+            _hb_batch_key(nd.outputs[0])
+            for _, nd in sorted(net.nodes.items())
+        ]
+    finally:
+        if resumed is not None:
+            resumed.close()
+
+
+@pytest.mark.parametrize(
+    "n,mock",
+    [(4, True), (13, True), (4, False)],
+    ids=["n4-mock", "n13-mock", "n4-real-bls"],
+)
+def test_restore_equals_uninterrupted(n, mock, tmp_path):
+    seed = 1000 + n + (0 if mock else 1)
+    keys = _crash_restore_run(n, mock, seed, str(tmp_path / "v.wal"), 25)
+    twin = _crash_restore_run(n, mock, seed, None, 0)
+    assert keys == twin, "batches diverge from the no-crash twin"
+    assert len(set(keys)) == 1, "validators disagree on the batch"
+
+
+@pytest.mark.slow
+def test_restore_equals_uninterrupted_n13_real_bls(tmp_path):
+    keys = _crash_restore_run(13, False, 77, str(tmp_path / "v.wal"), 25)
+    twin = _crash_restore_run(13, False, 77, None, 0)
+    assert keys == twin
+    assert len(set(keys)) == 1
+
+
+# -- TCP session resumption: dedup + applied-not-delivered acks ----------
+
+
+class _CaptureWriter:
+    def __init__(self):
+        self.buf = b""
+
+    def write(self, data):
+        self.buf += data
+
+
+def test_resume_dedup_under_duplicate_frame_replay():
+    """Feeding a resume replay stream twice delivers each frame exactly
+    once, in order, and counts the duplicates."""
+    from hbbft_tpu.core.step import Step, Target
+    from hbbft_tpu.obs import recorder as obs
+
+    async def run():
+        a, b = "127.0.0.1:1", "127.0.0.1:2"
+        sender = TcpNode(a, [b], lambda ni: Broadcast(ni, a))
+        receiver = TcpNode(b, [a], lambda ni: Broadcast(ni, b))
+        payloads = [b"seg-%d" % i for i in range(6)]
+        for p in payloads:
+            await sender._route(Step(messages=[Target.all().message(p)]))
+        w = _CaptureWriter()
+        sender._resume_link(b, 0, w)
+        reader = asyncio.StreamReader()
+        reader.feed_data(w.buf + w.buf)  # duplicated delivery
+        reader.feed_eof()
+        await receiver._recv_loop(a, reader)
+        got = []
+        while not receiver._inbox.empty():
+            got.append(receiver._inbox.get_nowait())
+        assert [m for _, m in got] == payloads
+        assert all(s == a for s, _ in got)
+        assert receiver._recv_seq[a] == len(payloads)
+
+    rec = obs.enable()
+    try:
+        asyncio.run(run())
+        assert rec.counters.get("wire.dup_frames", 0) == 6
+    finally:
+        obs.disable()
+
+
+class _NullAlgo:
+    """Minimal sans-IO algorithm: absorbs everything, never outputs."""
+
+    def __init__(self, ni):
+        pass
+
+    def handle_input(self, value):
+        from hbbft_tpu.core.step import Step
+
+        return Step()
+
+    def handle_message(self, sender, message):
+        from hbbft_tpu.core.step import Step
+
+        return Step()
+
+    def terminated(self):
+        return False
+
+
+def test_ack_reflects_applied_not_delivered():
+    """The resume ack must advance only as frames are *applied* by the
+    pump (and therefore WAL-logged by a durable algorithm) — an ack at
+    delivery time would let the peer trim frames that a crash between
+    delivery and apply would then lose forever."""
+    from hbbft_tpu.core.serialize import loads
+    from hbbft_tpu.core.step import Step, Target
+    from hbbft_tpu.transport import tcp as tcp_mod
+
+    async def run():
+        a, b = "127.0.0.1:1", "127.0.0.1:2"
+        sender = TcpNode(a, [b], _NullAlgo)
+        receiver = TcpNode(b, [a], _NullAlgo)
+        n = tcp_mod._ACK_EVERY
+        for i in range(n):
+            await sender._route(
+                Step(messages=[Target.all().message(b"m-%d" % i)])
+            )
+        w = _CaptureWriter()
+        sender._resume_link(b, 0, w)
+        back = _CaptureWriter()
+        receiver._writers[a] = back
+        reader = asyncio.StreamReader()
+        reader.feed_data(w.buf)
+        reader.feed_eof()
+        await receiver._recv_loop(a, reader)
+        # all frames delivered, none applied: no ack may have left
+        assert receiver._inbox.qsize() == n
+        assert back.buf == b""
+        calls = {"n": 0}
+
+        def done(nd):
+            calls["n"] += 1
+            return calls["n"] > n
+
+        await receiver.run(until=done)
+        acks = []
+        buf = back.buf
+        while buf:
+            ln = int.from_bytes(buf[:4], "big")
+            acks.append(loads(buf[4 : 4 + ln]))
+            buf = buf[4 + ln :]
+        assert [x.seq for x in acks] == [n]
+        assert all(isinstance(x, tcp_mod.ResumeAck) for x in acks)
+
+    asyncio.run(run())
+
+
+# -- mid-epoch kill/restart over real TCP + exactly-once gateway acks ----
+
+
+def test_tcp_kill_restart_exactly_once(tmp_path):
+    """SIGKILL-sim a durable validator mid-epoch over real sockets,
+    restore it from checkpoint + WAL, rejoin via session resumption:
+    every node commits the same batch, and the serving gateway acks
+    every committed transaction exactly once — zero duplicates, zero
+    losses."""
+    from hbbft_tpu.recover.driver import (
+        durable_tcp_node,
+        prime_replay,
+        restart_tcp_node,
+    )
+    from hbbft_tpu.serve.gateway import AdmissionQueues, GatewayCore
+    from hbbft_tpu.serve.protocol import ClientHello, SubmitTx
+
+    core = GatewayCore(
+        AdmissionQueues(per_tenant_limit=64, global_limit=128)
+    )
+    _, dropped = core.on_hello("c0", ClientHello(1, "alpha", "c0"))
+    assert not dropped
+    for s in range(4):
+        replies, dropped = core.on_submit(
+            "c0", SubmitTx(s, b"gw-tx-%d" % s), float(s)
+        )
+        assert not dropped and replies and replies[0].admitted
+    txs = list(core.drain(16))
+    assert len(txs) == 4
+    wal_path = str(tmp_path / "victim.wal")
+
+    def new_algo(ni):
+        return HoneyBadger(ni, rng=random.Random(f"tcpcr-{ni.our_id}"))
+
+    async def run():
+        addrs = _addrs(4)
+        victim_addr = addrs[0]  # smallest address: dials all peers,
+        # so the restarted process re-establishes the mesh itself
+        nodes = {}
+        for a in addrs:
+            others = [x for x in addrs if x != a]
+            if a == victim_addr:
+                nodes[a] = durable_tcp_node(
+                    a, others, new_algo, wal_path, fsync="off"
+                )
+            else:
+                nodes[a] = TcpNode(a, others, new_algo)
+        await asyncio.gather(
+            *(nd.start(mesh_timeout=15) for nd in nodes.values())
+        )
+        for i, a in enumerate(addrs):
+            await nodes[a].input([txs[i]])
+        other_tasks = [
+            asyncio.ensure_future(
+                nodes[a].run(
+                    until=lambda nd: len(nd.outputs) >= 1, timeout=120
+                )
+            )
+            for a in addrs
+            if a != victim_addr
+        ]
+        # SIGKILL-sim: stop the pump mid-epoch (12 applied messages is
+        # far short of an epoch at n=4), dropping the unapplied inbox
+        calls = {"n": 0}
+
+        def kill_when(nd):
+            calls["n"] += 1
+            return calls["n"] > 12
+
+        victim = nodes[victim_addr]
+        await victim.run(until=kill_when, timeout=60)
+        assert not victim.outputs, "victim output before the kill point"
+        await victim.close()
+        victim.algo.wal.close()
+
+        node2, recovery = restart_tcp_node(
+            victim_addr,
+            [x for x in addrs if x != victim_addr],
+            wal_path,
+            fsync="off",
+        )
+        # replay the regenerated steps into the transport so the resume
+        # handshake can re-send (identically renumbered) missed frames
+        await prime_replay(node2, recovery.steps)
+        await node2.start(mesh_timeout=15)
+        out2 = await node2.run(
+            until=lambda nd: len(nd.outputs) >= 1, timeout=120
+        )
+        await asyncio.gather(*other_tasks)
+        results = [out2[0]] + [
+            nodes[a].outputs[0] for a in addrs if a != victim_addr
+        ]
+        node2.algo.wal.close()
+        await node2.close()
+        await asyncio.gather(
+            *(nodes[a].close() for a in addrs if a != victim_addr)
+        )
+        return results
+
+    batches = asyncio.run(run())
+    keys = [_hb_batch_key(b) for b in batches]
+    assert len(set(keys)) == 1, keys
+    batch = batches[0]
+    committed = [
+        tx for _, c in sorted(batch.contributions.items()) for tx in c
+    ]
+    assert set(committed) <= set(txs)
+    assert len(committed) >= 3  # at least n - f contributions commit
+    acks = [core.on_committed(tx, batch.epoch, 10.0) for tx in committed]
+    assert all(a is not None for a in acks), "committed tx never acked"
+    # exactly-once: replaying the same committed batch acks nothing new
+    assert all(
+        core.on_committed(tx, batch.epoch, 11.0) is None
+        for tx in committed
+    )
+
+
+# -- graceful degradation ------------------------------------------------
+
+
+def test_stager_worker_death_degrades_to_inline():
+    """A dead staging worker degrades to inline execution: results stay
+    correct, one ``degrade`` event is emitted (sticky — never again),
+    and the process survives."""
+    from hbbft_tpu.obs import recorder as obs
+    from hbbft_tpu.ops import staging
+
+    st = staging.Stager()
+    assert st.submit(lambda: 7).result() == 7
+    assert not st.degraded()
+    # simulate the worker thread dying (the poison pill makes _loop
+    # return, exactly like an uncaught thread death would)
+    st._q.put(None)
+    st._thread.join(timeout=5)
+    assert not st._thread.is_alive()
+    rec = obs.enable()
+    try:
+        t = st.submit(lambda: 6 * 7)
+        assert t.done() and t.result() == 42  # ran inline
+        assert st.degraded()
+        evs = [e for e in rec.events if e["ev"] == "degrade"]
+        assert st.submit(lambda: 1).result() == 1
+        evs2 = [e for e in rec.events if e["ev"] == "degrade"]
+    finally:
+        obs.disable()
+    assert len(evs) == 1
+    assert evs[0]["plane"] == "stager"
+    assert evs[0]["reason"] == "worker-died"
+    assert len(evs2) == 1  # degrade is sticky and reported once
+
+
+def test_device_error_degrades_to_host(monkeypatch):
+    """An induced device fault mid-call falls back to the host path
+    with byte-identical results, one ``degrade`` event, and permanent
+    host routing afterwards — never a crash."""
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.obs import recorder as obs
+    from hbbft_tpu.ops import backend_tpu
+
+    be = backend_tpu.TpuBackend()
+    # the native host path would short-circuit the device path; force
+    # the device route so the injected fault is actually hit
+    monkeypatch.setattr(be, "_native_host", lambda: False)
+
+    def boom(items):
+        raise RuntimeError("injected device fault")
+
+    monkeypatch.setattr(backend_tpu.sha256_jax, "sha256_many", boom)
+    items = [bytes([i]) * 32 for i in range(16)]
+    rec = obs.enable()
+    try:
+        out = be.sha256_many(items)
+        evs = [e for e in rec.events if e["ev"] == "degrade"]
+        out2 = be.sha256_many(items)  # host-routed, no second event
+        evs2 = [e for e in rec.events if e["ev"] == "degrade"]
+    finally:
+        obs.disable()
+    expected = CpuBackend().sha256_many(items)
+    assert out == expected and out2 == expected
+    assert be.degraded()
+    assert len(evs) == 1
+    assert evs[0]["plane"] == "device"
+    assert evs[0]["reason"] == "sha256:RuntimeError"
+    assert len(evs2) == 1
