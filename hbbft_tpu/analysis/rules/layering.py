@@ -44,7 +44,10 @@ ALLOWED: Dict[str, Set[str]] = {
         "recover",
     },
     # crash recovery sits beside the harness: it persists harness
-    # checkpoints and drives the transport's session resumption
+    # checkpoints and drives the transport's session resumption; its
+    # bounded-memory bench (`python -m hbbft_tpu.recover --gc-bench`)
+    # measures the serving gateway's epoch-GC'd ack ledger, the other
+    # per-epoch accumulator the recovery plane's checkpoint hook prunes
     "recover": {
         "recover",
         "harness",
@@ -53,6 +56,7 @@ ALLOWED: Dict[str, Set[str]] = {
         "core",
         "crypto",
         "obs",
+        "serve",
     },
     "transport": {"transport", "protocols", "core", "crypto", "obs"},
     # the serving front door sits above the mesh and the protocol stack;

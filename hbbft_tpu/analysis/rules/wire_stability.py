@@ -205,6 +205,7 @@ class WireStabilityRule(Rule):
         "parallel/",
         "native/",
         "serve/",
+        "recover/",
     )
 
     def __init__(self, manifest: Optional[Dict[str, object]] = None):

@@ -83,6 +83,7 @@ class WireTaintRule(Rule):
         "harness/",
         "crypto/merkle.py",
         "serve/",
+        "recover/",
     )
     whole_project = True
 

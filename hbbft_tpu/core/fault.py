@@ -69,6 +69,7 @@ class FaultKind(enum.Enum):
     # Generic protocol violations
     INVALID_MESSAGE = "sent a malformed or undecodable message"
     EPOCH_OUT_OF_RANGE = "sent a message for an epoch out of the accepted window"
+    INVALID_SNAPSHOT = "served a forged or malformed state-transfer snapshot"
 
     def __repr__(self) -> str:  # keep logs compact
         return f"FaultKind.{self.name}"

@@ -254,7 +254,13 @@ def fuzz_frames(
     mirrors the transport's dedup rules — fresh seqs deliver exactly
     once, everything else drops without killing the link.  The per-peer
     receive counter persists across cases (one node, one peer), exactly
-    as a long-lived link would see it."""
+    as a long-lived link would see it.
+
+    The state-transfer surface rides here too: hostile ``St*`` frames
+    to a node with no transfer manager must drop cleanly, and a
+    manager pinned mid-FETCH fed type-confused / oversized /
+    out-of-order chunks must fault the provider, keep its accumulator
+    within the quorum-pinned size, and never install."""
     from ..transport import tcp as _tcp
 
     rng = random.Random(seed)
@@ -277,6 +283,8 @@ def fuzz_frames(
             message, (_tcp.ResumeAck, _tcp.ResumeHello, _tcp.ResumeWelcome)
         ):
             return 0  # control frames are dropped mid-stream
+        if isinstance(message, _tcp._ST_TYPES):
+            return 0  # no transfer manager attached: counted + dropped
         if isinstance(message, _tcp.SeqData):
             if not _tcp._seq_ok(message.seq) or message.seq <= rs["v"]:
                 return 0  # invalid or duplicate sequence number
@@ -296,6 +304,51 @@ def fuzz_frames(
                 b"\x02",
             ]
         )
+
+    def hostile_int(rng: random.Random) -> Any:
+        """Alloc-sink bait: the size/offset/index/count fields of the
+        ``St*`` types, randomized across the hostile spectrum."""
+        return rng.choice(
+            [
+                0,
+                1,
+                rng.randrange(2**20),
+                rng.randrange(2**62),
+                -1 - rng.randrange(100),
+                _tcp._ST_MAX_BYTES + rng.randrange(2**30),
+                True,
+                None,
+                "1024",
+                b"\x01",
+            ]
+        )
+
+    def random_st(rng: random.Random) -> Any:
+        """A structurally well-formed ``St*`` frame with hostile field
+        values — to a node with no transfer manager attached, every one
+        must count ``wire.st_unexpected`` and deliver nothing."""
+        j = rng.randrange(4)
+        if j == 0:
+            return _tcp.SnapReq(
+                hostile_int(rng),
+                hostile_int(rng),
+                rng.choice([True, False, 1, None, "y"]),
+            )
+        if j == 1:
+            return _tcp.SnapMeta(
+                hostile_int(rng),
+                hostile_int(rng),
+                bytes(rng.randrange(256) for _ in range(rng.choice([0, 31, 32]))),
+                hostile_int(rng),
+                hostile_int(rng),
+            )
+        if j == 2:
+            return _tcp.SnapChunk(
+                hostile_int(rng),
+                hostile_int(rng),
+                bytes(rng.randrange(256) for _ in range(rng.randrange(0, 64))),
+            )
+        return _tcp.SnapDone(hostile_int(rng), bytes(32))
 
     async def run_stream(stream: bytes, expect_delivered: int) -> None:
         reader = asyncio.StreamReader()
@@ -324,7 +377,10 @@ def fuzz_frames(
             for _ in range(rng.randrange(1, 6)):
                 if terminated:
                     break
-                k = rng.randrange(10)
+                k = rng.randrange(12)
+                if k in (10, 11):  # St* transfer frame: no manager → dropped
+                    stream += frame_of(dumps(random_st(rng)))
+                    continue
                 if k in (0, 1):  # valid frame
                     stream += frame_of(dumps(_random_primitive(rng)))
                     expect += 1
@@ -388,6 +444,81 @@ def fuzz_frames(
                     f"recv loop crashed on stream {stream[:32].hex()}…"
                     f"len={len(stream)}: {type(exc).__name__}: {exc}"
                 )
+
+        # -- the manager-attached chunk surface --------------------------
+        # A CatchupManager pinned mid-FETCH, fed hostile chunk streams:
+        # the strict in-order validator must fault the provider on the
+        # first bad chunk (oversized / overlapping / out-of-order /
+        # type-confused), never accumulate past the quorum-pinned size
+        # (the alloc-sink taint property, now runtime-checked), never
+        # install, and never surface anything to the inbox.
+        from ..recover.transfer import CatchupManager
+
+        for _ in range(max(1, cases // 4)):
+            report.cases += 1
+            mnode = _tcp.TcpNode(
+                "127.0.0.1:3",
+                ["127.0.0.1:3", "127.0.0.1:4"],
+                lambda ni: None,
+            )
+            mgr = CatchupManager(mnode, 1)
+            mnode.transfer = mgr
+            size = rng.randrange(1, 4 * _tcp._ST_CHUNK_BYTES)
+            nchunks = max(
+                1, (size + _tcp._ST_CHUNK_BYTES - 1) // _tcp._ST_CHUNK_BYTES
+            )
+            mgr.state = mgr.FETCH
+            mgr._provider = "fuzz-peer"
+            mgr._from = 0
+            mgr._target = 3
+            mgr._expect = (bytes(32), size, nchunks)
+            mgr._quorum_peers = ["fuzz-peer"]
+            stream = b""
+            for _ in range(rng.randrange(1, 6)):
+                stream += frame_of(
+                    dumps(
+                        _tcp.SnapChunk(
+                            hostile_int(rng),
+                            hostile_int(rng),
+                            bytes(
+                                rng.randrange(256)
+                                for _ in range(rng.randrange(0, 512))
+                            ),
+                        )
+                    )
+                )
+            stream += frame_of(dumps(_tcp.SnapDone(3, bytes(32))))
+            reader = asyncio.StreamReader()
+            reader.feed_data(stream)
+            reader.feed_eof()
+            try:
+                await asyncio.wait_for(
+                    mnode._recv_loop("fuzz-peer", reader), FRAME_TIMEOUT_S
+                )
+            except Exception as exc:
+                report.failures.append(
+                    f"transfer chunk surface crashed: "
+                    f"{type(exc).__name__}: {exc}"
+                )
+                continue
+            got = 0
+            while not mnode._inbox.empty():
+                mnode._inbox.get_nowait()
+                got += 1
+            if got:
+                report.failures.append(
+                    f"hostile St chunks delivered {got} inbox frames"
+                )
+            if mgr._got > size:
+                report.failures.append(
+                    f"chunk accumulator exceeded pinned size: "
+                    f"{mgr._got} > {size}"
+                )
+            if mgr.installed:
+                report.failures.append(
+                    "hostile chunk stream installed a snapshot"
+                )
+            report.faults += len(mnode.faults)
 
     asyncio.run(run_all())
     return report

@@ -47,6 +47,18 @@ module packages them as a named, seeded, CLI-drivable matrix (reference
   the TCP session-resumption plane replays exactly the frames the peer
   missed — duplicates dropped by sequence number, deliveries exactly
   once across two flap cycles.
+- **dark-peer-catchup**: a validator SIGKILL-simmed over real TCP and
+  kept dark until its peers' replay buffers evict the frames it missed
+  (``wire.replay_evicted``); on restart the resume gap escalates into
+  an f+1 digest-quorum state transfer (``recover/transfer.py``), the
+  durable algorithm fast-forwards, and the node proposes live in the
+  next epoch with every batch bit-identical to its never-crashed
+  peers.
+- **byzantine-snapshot**: a Byzantine snapshot provider forges the
+  offered digest (outvoted by the honest quorum), the payload bytes
+  (caught by the pre-decode hash check), and the chunk structure; each
+  serving attempt is attributed (``INVALID_SNAPSHOT``), retried
+  against the next quorum peer, and never corrupts the joiner.
 - **fuzz**: the wire-format fuzzer corpus (:mod:`hbbft_tpu.harness.fuzz`)
   over the codec, the TCP framing layer, the ``handle_*`` surface and
   the serving gateway — zero crashes, hangs or unlogged failures.
@@ -1183,6 +1195,411 @@ def _run_link_flap(cfg: ScenarioConfig) -> ScenarioResult:
     )
 
 
+# -- state transfer: dark peers past the replay bound ------------------------
+
+
+def _run_dark_peer_catchup(cfg: ScenarioConfig) -> ScenarioResult:
+    """A validator is SIGKILL-simmed and kept dark while its peers —
+    running with a deliberately tiny replay buffer — commit three more
+    epochs, evicting every frame the dark node missed
+    (``wire.replay_evicted``).  On restart the resume handshake lands
+    on a sequence gap (``wire.seq_gap``) and the attached
+    ``CatchupManager`` fetches an f+1 digest-quorum snapshot,
+    fast-forwards the durable algorithm through the missed epochs, and
+    the node proposes live in the next epoch.  Every batch — snapshot-
+    installed or locally committed — must be bit-identical across all
+    four nodes: the never-crashed peers ARE the no-crash twin."""
+    import asyncio
+    import os
+    import socket
+    import tempfile
+
+    from ..protocols.honey_badger import HoneyBadger
+    from ..recover.driver import (
+        durable_tcp_node,
+        prime_replay,
+        restart_tcp_node,
+    )
+    from ..recover.transfer import attach_transfer
+    from ..transport.tcp import TcpNode
+
+    def free_addrs(k):
+        socks = []
+        for _ in range(k):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        addrs = sorted(
+            "127.0.0.1:%d" % s.getsockname()[1] for s in socks
+        )
+        for s in socks:
+            s.close()
+        return addrs
+
+    def new_algo(ni):
+        return HoneyBadger(
+            ni, rng=random.Random(f"dpc-{ni.our_id}-{cfg.seed}")
+        )
+
+    rec = _obs.ACTIVE
+    owned = rec is None
+    if owned:
+        rec = _obs.enable()
+    base = dict(rec.counters)
+
+    def delta(name):
+        return rec.counters.get(name, 0) - base.get(name, 0)
+
+    dark_epochs = 3
+    cap = 32  # replay frames per link — three epochs far exceed it
+
+    async def run(wal_path):
+        addrs = free_addrs(4)
+        victim = addrs[0]  # smallest address dials every peer, so the
+        # restarted process re-establishes the whole mesh itself
+        peers = [a for a in addrs if a != victim]
+        nodes = {}
+        for a in addrs:
+            others = [x for x in addrs if x != a]
+            if a == victim:
+                nodes[a] = durable_tcp_node(
+                    a, others, new_algo, wal_path, fsync="off",
+                    transfer=True, replay_max_frames=cap,
+                )
+            else:
+                nodes[a] = TcpNode(
+                    a, others, new_algo, replay_max_frames=cap
+                )
+                attach_transfer(nodes[a])
+        await asyncio.gather(
+            *(nd.start(mesh_timeout=15) for nd in nodes.values())
+        )
+        # epoch 0: everyone contributes, everyone commits; the durable
+        # victim checkpoints at the epoch boundary
+        for i, a in enumerate(addrs):
+            await nodes[a].input([b"dpc-e0-%d" % i])
+        await asyncio.gather(
+            *(
+                nodes[a].run(
+                    until=lambda nd: len(nd.outputs) >= 1, timeout=120
+                )
+                for a in addrs
+            )
+        )
+        epoch0_key = _hb_batch_key(nodes[victim].outputs[0])
+        # SIGKILL-sim: close without any goodbye, keep it dark for
+        # three full epochs so the peers' replay buffers must evict
+        await nodes[victim].close()
+        nodes[victim].algo.wal.close()
+        for e in range(1, 1 + dark_epochs):
+            for i, a in enumerate(peers):
+                await nodes[a].input([b"dpc-e%d-%d" % (e, i)])
+            await asyncio.gather(
+                *(
+                    nodes[a].run(
+                        until=lambda nd, k=e + 1: len(nd.outputs) >= k,
+                        timeout=120,
+                    )
+                    for a in peers
+                )
+            )
+        _check(
+            delta(f"wire.replay_evicted.{victim}") >= 1,
+            "peers never evicted the dark node's frames — the replay "
+            "gap under test did not form",
+        )
+        # restart from the WAL; the resume gap must escalate into a
+        # state transfer instead of a severed stream
+        node2, recovery = restart_tcp_node(
+            victim, peers, wal_path, fsync="off",
+            transfer=True, replay_max_frames=cap,
+        )
+        await prime_replay(node2, recovery.steps)
+        await node2.start(mesh_timeout=15)
+        mgr = node2.transfer
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + 60
+        while mgr.installed == 0:
+            _check(
+                loop.time() < deadline,
+                "state transfer did not complete within 60s",
+            )
+            await asyncio.sleep(0.02)
+        # live rejoin: one more epoch with all four proposing
+        live_epoch = 1 + dark_epochs
+        for i, a in enumerate(addrs):
+            nd = node2 if a == victim else nodes[a]
+            await nd.input([b"dpc-e%d-%d" % (live_epoch, i)])
+        await asyncio.gather(
+            node2.run(
+                until=lambda nd, k=dark_epochs + 1: len(nd.outputs)
+                >= k,
+                timeout=120,
+            ),
+            *(
+                nodes[a].run(
+                    until=lambda nd, k=live_epoch + 1: len(nd.outputs)
+                    >= k,
+                    timeout=120,
+                )
+                for a in peers
+            ),
+        )
+        victim_keys = [epoch0_key] + [
+            _hb_batch_key(b) for b in node2.outputs
+        ]
+        peer_keys = {
+            a: [_hb_batch_key(b) for b in nodes[a].outputs]
+            for a in peers
+        }
+        faulted = [a for a in peers if nodes[a].faults]
+        if node2.faults:
+            faulted.append(victim)
+        installs = mgr.installed
+        node2.algo.wal.close()
+        await node2.close()
+        await asyncio.gather(*(nodes[a].close() for a in peers))
+        return victim_keys, peer_keys, faulted, installs
+
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            victim_keys, peer_keys, faulted, installs = asyncio.run(
+                run(os.path.join(td, "victim.wal"))
+            )
+        evicted = delta("wire.replay_evicted")
+        gaps = delta("wire.seq_gap")
+        st_installed = delta("st.installed")
+    finally:
+        if owned:
+            _obs.disable()
+
+    _check(
+        len(victim_keys) == dark_epochs + 2,
+        f"rejoined node committed {len(victim_keys)} epochs, expected "
+        f"{dark_epochs + 2}",
+    )
+    for a, keys in peer_keys.items():
+        _check(
+            keys == victim_keys,
+            f"rejoined node's batches diverge from never-crashed peer "
+            f"{a}",
+        )
+    _check(gaps >= 1, "rejoin never observed a sequence gap")
+    _check(
+        installs >= 1 and st_installed >= 1,
+        "the gap did not escalate into a snapshot install",
+    )
+    _check(
+        not faulted,
+        f"honest run attributed faults on {faulted}",
+    )
+    return ScenarioResult(
+        "dark-peer-catchup", True, 4, dark_epochs + 2, cfg.seed, 0,
+        f"real TCP n=4: victim dark {dark_epochs} epochs past a "
+        f"{cap}-frame replay cap ({evicted} frames evicted), rejoined "
+        f"via f+1 quorum snapshot ({installs} install(s), {gaps} seq "
+        f"gap(s)); {dark_epochs + 2} epochs bit-identical on all nodes",
+    )
+
+
+def _run_byzantine_snapshot(cfg: ScenarioConfig) -> ScenarioResult:
+    """A Byzantine snapshot provider attacks the state-transfer path
+    three ways: a forged digest offered at probe time (outvoted by the
+    f+1 honest quorum, never fetched), forged payload bytes served
+    under the honest digest (caught by the pre-decode hash check), and
+    a structurally-invalid chunk stream.  Each serving attempt is
+    attributed — ``FaultKind.INVALID_SNAPSHOT`` naming the provider —
+    the fetch retries against the next quorum peer, and every installed
+    snapshot is bit-identical to the honest payload: the forger can be
+    detected, but never corrupt the joiner."""
+    import asyncio
+
+    from ..core.fault import FaultKind
+    from ..protocols.honey_badger import Batch
+    from ..recover.transfer import (
+        CatchupManager,
+        encode_snapshot,
+        snapshot_digest,
+    )
+    from ..transport import tcp as _tcp
+    from ..transport.tcp import SnapChunk, SnapDone, SnapMeta, TcpNode
+
+    class _CaptureWriter:
+        def __init__(self):
+            self.buf = b""
+
+        def write(self, data):
+            self.buf += data
+
+    rec = _obs.ACTIVE
+    owned = rec is None
+    if owned:
+        rec = _obs.enable()
+    base = dict(rec.counters)
+
+    def delta(name):
+        return rec.counters.get(name, 0) - base.get(name, 0)
+
+    addrs = ["127.0.0.1:%d" % (9001 + i) for i in range(4)]
+    joiner_addr, byz, honest1, honest2 = addrs
+    installed: List[Any] = []
+
+    async def run():
+        joiner = TcpNode(joiner_addr, addrs[1:], lambda ni: None)
+        for p in joiner.peer_addrs:
+            joiner._writers[p] = _CaptureWriter()
+        mgr = CatchupManager(
+            joiner,
+            1,
+            install_fn=lambda upto, batches: installed.append(
+                (upto, list(batches))
+            ),
+            epoch_fn=lambda: 0,
+        )
+        joiner.transfer = mgr
+
+        honest = [
+            Batch(
+                e,
+                {a: [b"bz-%03d-%d" % (e, i)]
+                 for i, a in enumerate(addrs)},
+            )
+            for e in range(3)
+        ]
+        payload = encode_snapshot(honest)
+        digest = snapshot_digest(payload)
+        cb = _tcp._ST_CHUNK_BYTES
+        nchunks = max(1, -(-len(payload) // cb))
+        honest_meta = SnapMeta(0, 2, digest, len(payload), nchunks)
+
+        async def serve_honest(peer):
+            for i in range(nchunks):
+                await mgr.on_control(
+                    peer,
+                    SnapChunk(
+                        i, i * cb, payload[i * cb:(i + 1) * cb]
+                    ),
+                )
+            await mgr.on_control(peer, SnapDone(2, digest))
+
+        # round 1: forged digest offered at probe time — it can never
+        # assemble f+1 matching tuples, so it is simply outvoted
+        await mgr.on_gap(byz, 0, 500)
+        _check(mgr.state == mgr.PROBE, "gap did not start a probe")
+        _check(
+            all(
+                w.buf for w in joiner._writers.values()
+            ),
+            "probe not broadcast to every peer",
+        )
+        forged_digest = bytes(b ^ 0xFF for b in digest)
+        await mgr.on_control(
+            byz, SnapMeta(0, 2, forged_digest, len(payload), nchunks)
+        )
+        _check(
+            mgr.state == mgr.PROBE,
+            "a single forged offer must not reach quorum",
+        )
+        await mgr.on_control(honest1, honest_meta)
+        await mgr.on_control(honest2, honest_meta)
+        _check(
+            mgr.state == mgr.FETCH and mgr._provider == honest1,
+            "the f+1 quorum must form on the honest tuple, excluding "
+            "the forged offer",
+        )
+        await serve_honest(honest1)
+        _check(
+            mgr.installed == 1 and mgr.state == mgr.IDLE,
+            "honest quorum fetch failed",
+        )
+
+        # round 2: the forger joins the quorum with the HONEST digest,
+        # wins provider selection, then serves forged bytes — the
+        # reassembled payload is hashed before a byte is decoded
+        await mgr.on_gap(byz, 0, 600)
+        for p in (byz, honest1):
+            await mgr.on_control(p, honest_meta)
+        _check(
+            mgr._provider == byz,
+            "expected the Byzantine peer (lowest address) as provider",
+        )
+        mgr.hold(honest2, ("live", b"parked-mid-transfer"))
+        forged = bytes(b ^ 0xAA for b in payload)
+        for i in range(nchunks):
+            await mgr.on_control(
+                byz,
+                SnapChunk(i, i * cb, forged[i * cb:(i + 1) * cb]),
+            )
+        await mgr.on_control(byz, SnapDone(2, digest))
+        _check(mgr.installed == 1, "a forged payload was installed")
+        _check(
+            mgr.state == mgr.FETCH and mgr._provider == honest1,
+            "forged payload must fail over to the next quorum peer",
+        )
+        await serve_honest(honest1)
+        _check(mgr.installed == 2, "post-forgery retry failed")
+        _check(
+            not joiner._inbox.empty()
+            and joiner._inbox.get_nowait()
+            == (honest2, ("live", b"parked-mid-transfer")),
+            "frame parked mid-transfer was not flushed after install",
+        )
+
+        # round 3: a structurally-invalid chunk stream (out-of-order
+        # index) — rejected before it can touch the receive buffer
+        await mgr.on_gap(byz, 0, 700)
+        for p in (byz, honest1):
+            await mgr.on_control(p, honest_meta)
+        await mgr.on_control(byz, SnapChunk(1, cb, b"out-of-order"))
+        _check(
+            mgr.state == mgr.FETCH and mgr._provider == honest1,
+            "malformed chunk stream must fail over to the next peer",
+        )
+        await serve_honest(honest1)
+        _check(mgr.installed == 3, "post-bad-chunk retry failed")
+        honest_keys = [_hb_batch_key(b) for b in honest]
+        return joiner.faults, honest_keys
+
+    try:
+        faults, honest_keys = asyncio.run(run())
+        forged_count = delta("st.forged")
+        installed_count = delta("st.installed")
+    finally:
+        if owned:
+            _obs.disable()
+
+    snap_faults = [
+        f
+        for f in faults
+        if getattr(f, "kind", None) is FaultKind.INVALID_SNAPSHOT
+    ]
+    named = [getattr(f, "node_id", "?") for f in snap_faults]
+    _check(
+        len(snap_faults) == 2
+        and all(f.node_id == byz for f in snap_faults),
+        f"expected 2 INVALID_SNAPSHOT faults naming {byz}, got {named}",
+    )
+    _check(
+        forged_count == 2 and installed_count == 3,
+        f"counters diverge: st.forged={forged_count} (want 2), "
+        f"st.installed={installed_count} (want 3)",
+    )
+    _check(len(installed) == 3, "expected 3 installs across 3 rounds")
+    for upto, got in installed:
+        _check(
+            upto == 2
+            and [_hb_batch_key(b) for b in got] == honest_keys,
+            "an installed snapshot diverges from the honest payload",
+        )
+    return ScenarioResult(
+        "byzantine-snapshot", True, 4, 3, cfg.seed, len(snap_faults),
+        "forged digest outvoted by the f+1 quorum; forged payload and "
+        "malformed chunk stream each attributed "
+        f"(2 INVALID_SNAPSHOT faults on the provider) and retried; "
+        "all 3 installs bit-identical to the honest payload",
+    )
+
+
 # -- wire-format fuzzing -----------------------------------------------------
 
 
@@ -1235,6 +1652,8 @@ SCENARIOS: Dict[str, Callable[[ScenarioConfig], ScenarioResult]] = {
     "flash-crowd": _run_flash_crowd,
     "crash-restart": _run_crash_restart,
     "link-flap": _run_link_flap,
+    "dark-peer-catchup": _run_dark_peer_catchup,
+    "byzantine-snapshot": _run_byzantine_snapshot,
     "fuzz": _run_fuzz,
 }
 

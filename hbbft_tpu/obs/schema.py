@@ -113,4 +113,13 @@ EVENTS: Dict[str, EventSpec] = {
     # inline, device → host) — emitted at most once per degradation
     "wire_resume": _spec({"peer", "replayed", "dropped"}, {"recv_seq"}),
     "degrade": _spec({"plane", "reason"}, {"detail"}),
+    # state-transfer (additive): one row per installed snapshot, one
+    # per rejected provider/abort, one per future-epoch flood drop
+    # burst, and one per live WAL compaction
+    "st_transfer": _spec(
+        {"peer", "from_epoch", "upto_epoch", "bytes"}, {"chunks", "retries"}
+    ),
+    "st_reject": _spec({"peer", "reason"}, {"epoch"}),
+    "hb_future_drop": _spec({"node", "epoch"}, {"drops"}),
+    "wal_compact": _spec({"dropped", "kept", "bytes"}),
 }

@@ -34,6 +34,17 @@ from ..core.step import Step
 from ..obs import recorder as _obs
 from .common_subset import CommonSubset
 
+# Bounded future-message queue (state-transfer PR).  Beyond-window
+# messages used to queue without limit — a flooding peer could grow
+# ``incoming_queue`` arbitrarily with epochs far in the future.  Now
+# queueing is capped per sender and per horizon; what exceeds either
+# cap is counted (``hb.future_dropped``), emitted (``hb_future_drop``)
+# and the repeat offender attributed every ``_FUTURE_FAULT_EVERY``
+# drops, so a flooder is visible instead of invisible.
+_FUTURE_HORIZON = 64  # queue at most this many epochs past the window
+_FUTURE_MAX_PER_SENDER = 64  # queued future messages per sender
+_FUTURE_FAULT_EVERY = 32  # attribute every Nth drop per sender
+
 
 @wire("HbBatch")
 @dataclasses.dataclass(frozen=True)
@@ -107,6 +118,11 @@ class HoneyBadger(DistAlgorithm):
         self._spec_hits = 0
         self._spec_misses = 0
         self._pending_faults = FaultLog()
+        # future-queue accounting (bounded-memory long runs): how many
+        # messages each sender has queued beyond the window, and how
+        # many we have dropped on them (for periodic attribution)
+        self._future_queued: Dict[Any, int] = {}
+        self._future_drops: Dict[Any, int] = {}
         # deterministic per-node default (badgerlint: determinism) —
         # replayable and co-simulation-stable; the seed folds in our
         # secret key so the ciphertext randomness stays unpredictable
@@ -128,6 +144,13 @@ class HoneyBadger(DistAlgorithm):
         if not isinstance(epoch, int) or isinstance(epoch, bool):
             return Step.from_fault(sender_id, FaultKind.INVALID_MESSAGE)
         if epoch > self.epoch + self.max_future_epochs:
+            queued = self._future_queued.get(sender_id, 0)
+            if (
+                epoch > self.epoch + self.max_future_epochs + _FUTURE_HORIZON
+                or queued >= _FUTURE_MAX_PER_SENDER
+            ):
+                return self._drop_future(sender_id, epoch)
+            self._future_queued[sender_id] = queued + 1
             self.incoming_queue.setdefault(epoch, []).append(
                 (sender_id, message.content)
             )
@@ -135,6 +158,32 @@ class HoneyBadger(DistAlgorithm):
         if epoch < self.epoch:
             return Step()  # obsolete
         return self._handle_message_content(sender_id, epoch, message.content)
+
+    def _drop_future(self, sender_id, epoch: int) -> Step:
+        """A future-epoch message we will not queue: count it, surface
+        it, and attribute the sender on every Nth drop (one drop can be
+        clock skew; a stream of them is a flood)."""
+        drops = self._future_drops.get(sender_id, 0) + 1
+        self._future_drops[sender_id] = drops
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.count("hb.future_dropped")
+            rec.event(
+                "hb_future_drop",
+                node=str(self.netinfo.our_id),
+                epoch=epoch,
+                drops=drops,
+            )
+        if drops % _FUTURE_FAULT_EVERY == 0:
+            return Step.from_fault(sender_id, FaultKind.EPOCH_OUT_OF_RANGE)
+        return Step()
+
+    def _dec_future(self, sender_id) -> None:
+        n = self._future_queued.get(sender_id, 0)
+        if n <= 1:
+            self._future_queued.pop(sender_id, None)
+        else:
+            self._future_queued[sender_id] = n - 1
 
     def terminated(self) -> bool:
         return False  # HoneyBadger runs forever
@@ -455,6 +504,7 @@ class HoneyBadger(DistAlgorithm):
         max_epoch = self.epoch + self.max_future_epochs
         step: Step = Step()
         for sender_id, content in self.incoming_queue.pop(max_epoch, []):
+            self._dec_future(sender_id)
             step.extend(
                 self._handle_message_content(sender_id, max_epoch, content)
             )
@@ -468,6 +518,71 @@ class HoneyBadger(DistAlgorithm):
             if e < self.epoch and cs.terminated()
         ]:
             del self.common_subsets[epoch]
+
+    # -- state transfer + bounded-memory GC --------------------------------
+
+    def fast_forward(self, upto_epoch: int, batches: List[Any]) -> Step:
+        """Install a quorum-verified snapshot: output the transferred
+        batches for epochs ``[self.epoch, upto_epoch]`` and jump to
+        ``upto_epoch + 1``, exactly as if this node had decided those
+        epochs itself.  The caller (``recover/transfer.py``) has
+        already digest-verified the batches against f+1 peers.
+
+        In-flight per-epoch state for the skipped window is discarded
+        (those epochs are decided — the batch IS the decision); queued
+        future messages that land inside the new window are
+        re-dispatched, ones behind it are dropped."""
+        if upto_epoch < self.epoch:
+            return Step()
+        step: Step = Step()
+        by_epoch: Dict[int, Any] = {}
+        for b in batches:
+            ep = getattr(b, "epoch", None)
+            if (
+                isinstance(b, Batch)
+                and isinstance(ep, int)
+                and not isinstance(ep, bool)
+                and self.epoch <= ep <= upto_epoch
+            ):
+                by_epoch[ep] = b
+        for ep in sorted(by_epoch):
+            step.output.append(by_epoch[ep])
+        for d in (self.common_subsets, self.received_shares, self.ciphertexts):
+            for ep in [e for e in d if e <= upto_epoch]:
+                del d[ep]
+        self.decrypted_contributions = {}
+        self._pending_faults = FaultLog()
+        self.epoch = upto_epoch + 1
+        self.has_input_flag = False
+        # re-dispatch queued messages now inside the window; drop the
+        # ones the jump made obsolete
+        window_hi = self.epoch + self.max_future_epochs
+        for ep in sorted([e for e in self.incoming_queue if e <= window_hi]):
+            for sender_id, content in self.incoming_queue.pop(ep, []):
+                self._dec_future(sender_id)
+                if ep >= self.epoch:
+                    step.extend(
+                        self._handle_message_content(sender_id, ep, content)
+                    )
+        step.extend(self._try_output_batches())
+        return step
+
+    def gc_epochs(self) -> int:
+        """Prune per-epoch state for epochs before the current one —
+        the driver calls this after each durable checkpoint, so a
+        long-running node's dicts stay bounded by the live window.
+        (``_remove_terminated`` already drops *terminated* past subset
+        instances; this also reclaims ones wedged by a faulty peer.)"""
+        dropped = 0
+        for d in (self.common_subsets, self.received_shares, self.ciphertexts):
+            for ep in [e for e in d if e < self.epoch]:
+                del d[ep]
+                dropped += 1
+        for ep in [e for e in self.incoming_queue if e < self.epoch]:
+            for sender_id, _ in self.incoming_queue.pop(ep):
+                self._dec_future(sender_id)
+            dropped += 1
+        return dropped
 
 
 class HoneyBadgerBuilder:

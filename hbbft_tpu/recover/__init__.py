@@ -7,6 +7,11 @@ replay tail (``node.py``), and the restart drivers (``driver.py``)
 rebuild a killed node whose transport sequence numbers continue the
 pre-crash stream so the TCP session-resumption layer
 (``transport/tcp.py``) neither loses nor double-applies a frame.
+State transfer (``transfer.py``) covers the one gap frame replay
+cannot: a peer dark past the replay-buffer bound fetches a
+quorum-verified epoch snapshot and fast-forwards; WAL compaction
+(``wal.compact_wal`` / the ``HBBFT_TPU_WAL_COMPACT`` trigger) keeps
+the log bounded by dropping records before the last checkpoint.
 """
 
 from .driver import (
@@ -15,18 +20,34 @@ from .driver import (
     restart_tcp_node,
 )
 from .node import DurableAlgo, Recovery, RecoveryError, recover
-from .wal import CHECKPOINT, INPUT, MESSAGE, Record, WalError, WalWriter, read_records
+from .transfer import CatchupManager, SnapshotStore, attach_transfer
+from .wal import (
+    CHECKPOINT,
+    INPUT,
+    MESSAGE,
+    Record,
+    WalError,
+    WalWriter,
+    compact_records,
+    compact_wal,
+    read_records,
+)
 
 __all__ = [
     "CHECKPOINT",
     "INPUT",
     "MESSAGE",
+    "CatchupManager",
     "DurableAlgo",
     "Record",
     "Recovery",
     "RecoveryError",
+    "SnapshotStore",
     "WalError",
     "WalWriter",
+    "attach_transfer",
+    "compact_records",
+    "compact_wal",
     "durable_tcp_node",
     "prime_replay",
     "read_records",

@@ -33,15 +33,39 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..core.network_info import NetworkInfo
 from ..transport.tcp import TcpNode
 from .node import DurableAlgo, Recovery, recover
+from .transfer import attach_transfer
 from .wal import WalWriter
 
 
 def _meta_fn(node_ref: Dict[str, TcpNode]) -> Callable[[], Dict[str, Any]]:
     def fn() -> Dict[str, Any]:
         node = node_ref.get("node")
-        return {"send_seqs": node.send_seqs if node is not None else {}}
+        if node is None:
+            return {"send_seqs": {}, "recv_seqs": {}}
+        # recv base = applied (WAL-logged) wire-seq high-water per link,
+        # NOT the logged-message count: a state-transfer install skips
+        # wire seqs this node never saw, and the resume handshake must
+        # claim them so peers don't re-send evicted history.
+        return {"send_seqs": node.send_seqs, "recv_seqs": node.applied_seqs}
 
     return fn
+
+
+def _on_step(
+    on_checkpoint: Optional[Callable[[TcpNode], None]],
+) -> Callable[[TcpNode], None]:
+    """Quiescent-point hook: checkpoint when due, then GC per-epoch
+    state the snapshot now covers (bounded-memory long runs)."""
+
+    def hook(n: TcpNode) -> None:
+        if n.algo.maybe_checkpoint():
+            gc = getattr(n.algo, "gc_epochs", None)
+            if gc is not None:
+                gc()
+            if on_checkpoint is not None:
+                on_checkpoint(n)
+
+    return hook
 
 
 def durable_tcp_node(
@@ -52,9 +76,15 @@ def durable_tcp_node(
     checkpoint_every: int = 1,
     netinfo: Optional[NetworkInfo] = None,
     fsync: str = "interval",
+    transfer: bool = False,
+    snapshot_retain: int = 1024,
+    on_checkpoint: Optional[Callable[[TcpNode], None]] = None,
     **kw: Any,
 ) -> TcpNode:
-    """A fresh TCP node with a durable, write-ahead-logged algorithm."""
+    """A fresh TCP node with a durable, write-ahead-logged algorithm.
+    ``transfer=True`` attaches the state-transfer manager: the node
+    serves snapshots to dark peers and escalates its own replay gaps
+    into a catch-up instead of a severed link."""
     node_ref: Dict[str, TcpNode] = {}
 
     def build(ni: NetworkInfo) -> DurableAlgo:
@@ -68,7 +98,9 @@ def durable_tcp_node(
 
     node = TcpNode(our_addr, peer_addrs, build, netinfo=netinfo, **kw)
     node_ref["node"] = node
-    node.on_step = lambda n: n.algo.maybe_checkpoint()
+    node.on_step = _on_step(on_checkpoint)
+    if transfer:
+        attach_transfer(node, retain=snapshot_retain)
     return node
 
 
@@ -80,11 +112,16 @@ def restart_tcp_node(
     checkpoint_every: int = 1,
     netinfo: Optional[NetworkInfo] = None,
     fsync: str = "interval",
+    transfer: bool = False,
+    snapshot_retain: int = 1024,
+    on_checkpoint: Optional[Callable[[TcpNode], None]] = None,
     **kw: Any,
 ) -> Tuple[TcpNode, Recovery]:
     """Restore a crashed node from its WAL.  Call
     :func:`prime_replay` with the returned recovery's steps, then
-    ``await node.start()``."""
+    ``await node.start()``.  With ``transfer=True`` a node that was
+    dark past its peers' replay bound catches up via state transfer
+    instead of staying severed."""
     recovery = recover(wal_path, ops=ops)
     node_ref: Dict[str, TcpNode] = {}
 
@@ -106,7 +143,9 @@ def restart_tcp_node(
         **kw,
     )
     node_ref["node"] = node
-    node.on_step = lambda n: n.algo.maybe_checkpoint()
+    node.on_step = _on_step(on_checkpoint)
+    if transfer:
+        attach_transfer(node, retain=snapshot_retain)
     return node, recovery
 
 

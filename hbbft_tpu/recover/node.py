@@ -97,6 +97,27 @@ class DurableAlgo:
         self.wal.append_checkpoint(checkpoint.save(self.algo), meta)
         self._outputs_since_ckpt = 0
 
+    def install_snapshot(self, upto_epoch: int, batches: List[Any]) -> Any:
+        """State transfer: fast-forward the wrapped algorithm through a
+        quorum-verified batch range and pin the jump with a fresh
+        CHECKPOINT record, so a crash after install recovers *from* the
+        transferred state, never from the pre-gap log.
+
+        Returns the wrapped algorithm's fast-forward ``Step`` (the
+        skipped epochs surface as outputs).  Raises
+        :class:`RecoveryError` when the wrapped algorithm has no
+        ``fast_forward`` (the DynamicHoneyBadger family needs the
+        join-plan path instead)."""
+        ff = getattr(self.algo, "fast_forward", None)
+        if ff is None:
+            raise RecoveryError(
+                f"{type(self.algo).__name__} cannot install a snapshot "
+                "(no fast_forward)"
+            )
+        step = ff(upto_epoch, batches)
+        self.checkpoint()
+        return step
+
     # -- delegation ------------------------------------------------------
 
     def terminated(self) -> bool:
@@ -117,10 +138,14 @@ class Recovery:
       the outbound replay buffer holds the frames a peer may have
       missed; the in-process plane discards them (already delivered).
     - ``meta``: the last snapshot's driver metadata (send seqs).
-    - ``recv_seqs``: per-sender count of MESSAGE records over the whole
-      log — exactly the per-link receive sequence number the resume
-      handshake reports, because every delivered data frame is logged
-      once, in order, before it is applied.
+    - ``recv_seqs``: the per-link receive sequence numbers the resume
+      handshake reports.  When the last snapshot's meta carries a
+      ``"recv_seqs"`` base (written by the real-TCP driver, and
+      rewritten by state-transfer installs), the count is that base
+      plus the MESSAGE records *after* the snapshot; legacy logs
+      without the key fall back to counting the whole log.  Both agree
+      on gap-free logs — the base exists so a state-transfer jump
+      (which skips wire seqs the node never saw) stays accurate.
     - ``clean``: False when the log ended in a torn tail (expected
       after a crash; the tail event was never applied pre-crash
       either, so replay is still exact).
@@ -173,13 +198,17 @@ def recover(path: str, ops: Any = None) -> Recovery:
     state_bytes, meta = _wal.decode_checkpoint(records[last_idx].payload)
     algo = checkpoint.load(state_bytes, ops=ops)
     steps: List[Any] = []
-    recv_seqs: Dict[Any, int] = {}
+    base = meta.get("recv_seqs")
+    meta_based = isinstance(base, dict)
+    recv_seqs: Dict[Any, int] = dict(base) if meta_based else {}
     for i, r in enumerate(records):
         if r.kind == _wal.MESSAGE:
             sender, message = _wal.decode_message(r.payload)
-            recv_seqs[sender] = recv_seqs.get(sender, 0) + 1
             if i > last_idx:
+                recv_seqs[sender] = recv_seqs.get(sender, 0) + 1
                 steps.append(algo.handle_message(sender, message))
+            elif not meta_based:
+                recv_seqs[sender] = recv_seqs.get(sender, 0) + 1
         elif r.kind == _wal.INPUT and i > last_idx:
             steps.append(algo.handle_input(_wal.decode_input(r.payload)))
     return Recovery(algo, steps, meta, recv_seqs, clean)

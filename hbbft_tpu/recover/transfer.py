@@ -1,0 +1,580 @@
+"""Byzantine-safe state transfer — rejoining past the replay bound.
+
+A validator dark longer than the transport's replay-buffer bound
+(``transport/tcp.py``: ``_REPLAY_MAX_FRAMES`` / ``_REPLAY_MAX_BYTES``)
+can never be caught up by frame replay: its peers evicted the frames it
+missed.  Before this module that was a loud counter and a permanently
+severed stream.  Now the lagging node fetches an *epoch snapshot* — the
+committed batches it missed — from its peers and fast-forwards:
+
+::
+
+    joiner                                peers (n-1, ≤ f Byzantine)
+      |-- StReq(from, None, fetch=False) --->|   probe: what can you serve?
+      |<-- StMeta(from, upto, digest, ...) --|   one per peer
+      |          (no f+1 agreement? pin the (f+1)-th highest upto
+      |           and re-request the exact range)
+      |-- StReq(from, E, fetch=True) ------->|   to ONE quorum provider
+      |<-- StChunk(i, off, data) * k --------|   strict in-order slices
+      |<-- StDone(E, digest) ----------------|
+      verify sha256(payload) == quorum digest
+      install_snapshot(E, batches)  →  rejoin live at epoch E+1
+
+The Byzantine argument: honest HoneyBadger validators commit *identical*
+batches per epoch, and the snapshot payload is their canonical encoding
+(``core.serialize.dumps`` — deterministic, dict keys sorted), so every
+honest peer serves byte-identical payloads for the same range.  With at
+most f Byzantine peers, f+1 matching ``(range, digest, size, chunks)``
+tuples therefore include at least one honest peer — the agreed digest
+IS the honest payload's digest.  A Byzantine provider can still join
+the quorum with the honest digest and then serve forged bytes, but the
+reassembled payload is hashed before a single byte is decoded: the
+mismatch is attributed (``FaultKind.INVALID_SNAPSHOT``), the provider
+is excluded, and the fetch retries against the next quorum peer.  A
+forged snapshot is never applied.
+
+Taint discipline (the ``wire-taint`` rule covers this module): chunk
+``size``/``offset``/``index`` fields are attacker-controlled alloc-sink
+roots.  The manager bounds the accepted payload by ``_ST_MAX_BYTES``
+*before* accepting any chunk, accumulates received bytes rather than
+pre-allocating from a claimed size, and rejects out-of-order,
+overlapping, or oversized chunks with a fault — a hostile provider can
+never grow the receive buffer past the quorum-pinned size.
+
+While a transfer is in flight the transport parks inbound data frames
+(``CatchupManager.hold``) and flushes them to the inbox after install —
+late frames for snapshot-covered epochs are dropped by the algorithm's
+obsolete-epoch check, frames for live epochs apply normally, and the
+WAL sees them *after* the install checkpoint so crash recovery replays
+the exact same order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ..core.fault import FaultKind, FaultLog
+from ..core.serialize import SerializationError, dumps, loads
+from ..obs import recorder as _obs
+from ..transport import tcp as _tcp
+from ..transport.tcp import SnapChunk, SnapDone, SnapMeta, SnapReq
+
+_MAX_EPOCH = 2**62
+# full probe→pin→fetch restarts before giving up (each restart already
+# excludes every provider that served garbage)
+_MAX_RESTARTS = 3
+
+
+def _epoch_ok(v: Any) -> bool:
+    """Total validator for wire epoch numbers."""
+    return isinstance(v, int) and not isinstance(v, bool) and 0 <= v < _MAX_EPOCH
+
+
+def _int_ok(v: Any) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+
+def encode_snapshot(batches: List[Any]) -> bytes:
+    """Canonical snapshot payload: the wire codec over the batch list.
+    Deterministic (dict keys sorted), so honest providers serving the
+    same committed range produce byte-identical payloads — the basis of
+    the f+1 digest quorum."""
+    return dumps(list(batches))
+
+
+def snapshot_digest(payload: bytes) -> bytes:
+    return hashlib.sha256(payload).digest()
+
+
+class SnapshotStore:
+    """Provider-side retention of committed batches, keyed by epoch.
+
+    Bounded: at most ``retain`` epochs are kept (oldest evicted), which
+    also bounds the range any single ``StReq`` can make us encode."""
+
+    def __init__(self, retain: int = 1024):
+        self.retain = max(1, int(retain))
+        self._batches: Dict[int, Any] = {}
+        self._high = -1
+
+    def record(self, output: Any) -> None:
+        """Feed one algorithm output; non-batch outputs are ignored."""
+        epoch = getattr(output, "epoch", None)
+        if not _epoch_ok(epoch):
+            return
+        self._batches[epoch] = output
+        if epoch > self._high:
+            self._high = epoch
+        while len(self._batches) > self.retain:
+            del self._batches[min(self._batches)]
+
+    def high(self) -> int:
+        """Highest recorded epoch (-1 when empty)."""
+        return self._high
+
+    def slice(self, from_epoch: int, upto_epoch: int) -> Optional[List[Any]]:
+        """The contiguous batches for ``[from_epoch, upto_epoch]``, or
+        ``None`` when any epoch in the range is missing.  The caller
+        bounds the span (≤ ``retain``) before we iterate."""
+        out = []
+        for e in range(from_epoch, upto_epoch + 1):
+            b = self._batches.get(e)
+            if b is None:
+                return None
+            out.append(b)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._batches)
+
+
+class CatchupManager:
+    """The ``TcpNode.transfer`` hook: provider and joiner in one object.
+
+    Provider role: answers ``StReq`` from the :class:`SnapshotStore`
+    (silence when we cannot serve the range — the joiner's quorum
+    simply doesn't count us).  Joiner role: driven by the transport's
+    gap detection, runs probe → pin → fetch → verify → install and owns
+    the parked-frame buffer while the transfer is in flight."""
+
+    IDLE = "idle"
+    PROBE = "probe"
+    FETCH = "fetch"
+
+    def __init__(
+        self,
+        node: Any,
+        num_faulty: int,
+        store: Optional[SnapshotStore] = None,
+        install_fn: Optional[Callable[[int, List[Any]], Any]] = None,
+        epoch_fn: Optional[Callable[[], int]] = None,
+    ):
+        self.node = node
+        self.f = max(0, int(num_faulty))
+        self.store = store if store is not None else SnapshotStore()
+        # install defaults to the DurableAlgo surface; epoch to the
+        # wrapped algorithm's current epoch
+        self._install_fn = install_fn
+        self._epoch_fn = epoch_fn or (
+            lambda: int(getattr(self.node.algo, "epoch", 0))
+        )
+        self.state = self.IDLE
+        self.installed = 0  # completed transfers (tests/scenarios)
+        self._from = 0
+        self._target: Optional[int] = None
+        # peer -> (upto, digest, size, chunks) offers (probe + pin)
+        self._offers: Dict[str, Tuple[int, bytes, int, int]] = {}
+        # peers replying "nothing newer than your epoch" (empty offer)
+        self._empty_votes: Set[str] = set()
+        self._pinned = False
+        self._failed: Set[str] = set()
+        self._quorum_peers: List[str] = []
+        self._provider: Optional[str] = None
+        self._expect: Optional[Tuple[bytes, int, int]] = None
+        self._parts: List[bytes] = []
+        self._got = 0
+        self._next_idx = 0
+        self._restarts = 0
+        # parked inbound data frames, global arrival order
+        self._held: List[Tuple[str, Any]] = []
+        self._held_first: Dict[str, int] = {}
+
+    # -- transport-facing hooks -----------------------------------------
+
+    def holding(self) -> bool:
+        return self.state != self.IDLE
+
+    def hold(self, peer: str, message: Any) -> None:
+        """Park one delivered data frame until install flushes it."""
+        self._held_first.setdefault(peer, self.node._recv_seq.get(peer, 0))
+        self._held.append((peer, message))
+
+    async def on_gap(self, peer: str, last: int, seq: int) -> None:
+        """The transport saw seqs jump ``last → seq`` on this link —
+        the frames between were evicted from the peer's replay buffer."""
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.count("st.gap")
+        if self.state != self.IDLE:
+            # a second eviction on a link mid-transfer punches a hole
+            # in its parked stream: drop that peer's parked frames (the
+            # same loss class the snapshot already covers) and rebase
+            if peer in self._held_first:
+                self._held = [(p, m) for (p, m) in self._held if p != peer]
+                del self._held_first[peer]
+            elif self.state == self.PROBE and peer not in self._offers:
+                # a resumed link coming up AFTER the probe broadcast
+                # missed its SnapReq (send_control to a down link is
+                # lost) — its first replayed frame gaps here, so probe
+                # it directly; a slow mesh still reaches f+1 offers
+                self.node.send_control(
+                    peer,
+                    SnapReq(
+                        self._from,
+                        self._target if self._pinned else None,
+                        False,
+                    ),
+                )
+            return
+        self._restarts = 0
+        self._begin_probe()
+
+    async def on_control(self, peer: str, message: Any) -> None:
+        if isinstance(message, SnapReq):
+            self._serve(peer, message)
+        elif isinstance(message, SnapMeta):
+            self._on_meta(peer, message)
+        elif isinstance(message, SnapChunk):
+            await self._on_chunk(peer, message)
+        elif isinstance(message, SnapDone):
+            await self._on_done(peer, message)
+
+    # -- provider role ---------------------------------------------------
+
+    def _serve(self, peer: str, req: SnapReq) -> None:
+        if (
+            not _epoch_ok(req.from_epoch)
+            or not isinstance(req.fetch, bool)
+            or not (req.upto_epoch is None or _epoch_ok(req.upto_epoch))
+        ):
+            self._attribute(peer, "bad-req")
+            return
+        upto = self.store.high() if req.upto_epoch is None else req.upto_epoch
+        if upto < req.from_epoch:
+            # nothing newer than the joiner already has: answer with an
+            # explicit empty offer (sentinel digest=b"", size=chunks=0)
+            # so f+1 such votes let it conclude the gap needs no
+            # transfer, instead of staying silent and leaving it in
+            # PROBE holding frames forever
+            self.node.send_control(
+                peer, SnapMeta(req.from_epoch, req.from_epoch, b"", 0, 0)
+            )
+            return
+        if upto - req.from_epoch + 1 > self.store.retain:
+            # a hostile width would make us encode an unbounded range
+            self._attribute(peer, "range-too-wide")
+            return
+        batches = self.store.slice(req.from_epoch, upto)
+        if batches is None:
+            return  # a hole in our retention; stay silent
+        payload = encode_snapshot(batches)
+        if len(payload) > _tcp._ST_MAX_BYTES:
+            return  # we cannot serve within the wire bound
+        digest = snapshot_digest(payload)
+        chunk = _tcp._ST_CHUNK_BYTES
+        nchunks = max(1, (len(payload) + chunk - 1) // chunk)
+        self.node.send_control(
+            peer, SnapMeta(req.from_epoch, upto, digest, len(payload), nchunks)
+        )
+        if req.fetch:
+            for i in range(nchunks):
+                off = i * chunk
+                self.node.send_control(
+                    peer, SnapChunk(i, off, payload[off : off + chunk])
+                )
+            self.node.send_control(peer, SnapDone(upto, digest))
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("st.served")
+
+    # -- joiner role -----------------------------------------------------
+
+    def _begin_probe(self) -> None:
+        self.state = self.PROBE
+        self._from = int(self._epoch_fn())
+        self._target = None
+        self._offers.clear()
+        self._empty_votes.clear()
+        self._pinned = False
+        self._provider = None
+        self._expect = None
+        self._reset_fetch()
+        for p in self.node.peer_addrs:
+            self.node.send_control(p, SnapReq(self._from, None, False))
+
+    def _reset_fetch(self) -> None:
+        self._parts = []
+        self._got = 0
+        self._next_idx = 0
+
+    def _on_meta(self, peer: str, meta: SnapMeta) -> None:
+        rec = _obs.ACTIVE
+        if self.state != self.PROBE:
+            if rec is not None:
+                rec.count("st.unexpected")
+            return
+        if (
+            meta.from_epoch == self._from
+            and meta.upto_epoch == self._from
+            and meta.digest == b""
+            and meta.size == 0
+            and meta.chunks == 0
+        ):
+            # explicit "nothing newer than your epoch" vote.  f+1 of
+            # them include an honest peer at-or-behind us, so the gap
+            # needs no snapshot (e.g. a single-link eviction, or a gap
+            # that raced in right behind a completed install): stand
+            # down and release the held frames instead of holding the
+            # inbox hostage in PROBE forever.
+            self._empty_votes.add(peer)
+            if len(self._empty_votes) >= self.f + 1:
+                if rec is not None:
+                    rec.count("st.noop")
+                held = self._held
+                self._to_idle()
+                for p, m in held:
+                    self.node._inbox.put_nowait((p, m))
+            return
+        if (
+            not _epoch_ok(meta.from_epoch)
+            or not _epoch_ok(meta.upto_epoch)
+            or not isinstance(meta.digest, bytes)
+            or len(meta.digest) != 32
+            or not _int_ok(meta.size)
+            or not _int_ok(meta.chunks)
+            or meta.size > _tcp._ST_MAX_BYTES
+            or not (1 <= meta.chunks <= _tcp._ST_MAX_CHUNKS)
+        ):
+            self._attribute(peer, "bad-meta")
+            return
+        if meta.from_epoch != self._from or meta.upto_epoch < self._from:
+            if rec is not None:
+                rec.count("st.unexpected")
+            return
+        if self._pinned and meta.upto_epoch != self._target:
+            return  # stale probe reply after the range was pinned
+        self._offers[peer] = (
+            meta.upto_epoch, meta.digest, meta.size, meta.chunks
+        )
+        self._advance_probe()
+
+    def _advance_probe(self) -> None:
+        # quorum: f+1 peers offering the identical (upto, digest, size,
+        # chunks) tuple — pick the highest-epoch such tuple
+        by_tuple: Dict[Tuple[int, bytes, int, int], List[str]] = {}
+        for p, offer in self._offers.items():
+            by_tuple.setdefault(offer, []).append(p)
+        agreed = [
+            (offer, peers)
+            for offer, peers in by_tuple.items()
+            if len(peers) >= self.f + 1
+        ]
+        if agreed:
+            offer, peers = max(agreed, key=lambda op: op[0][0])
+            self._target = offer[0]
+            self._expect = (offer[1], offer[2], offer[3])
+            self._quorum_peers = sorted(peers)
+            self._fetch_from_next()
+            return
+        # no agreement yet.  Peers at different epochs legitimately
+        # offer different ranges; once ≥ 2f+1 replied (≥ f+1 honest),
+        # pin the (f+1)-th highest offered upto — at least one honest
+        # peer can serve it — and re-request that exact range.
+        if self._pinned or len(self._offers) < max(2 * self.f + 1, 1):
+            return
+        tops = sorted((u for u, _, _, _ in self._offers.values()), reverse=True)
+        if len(tops) <= self.f:
+            return
+        target = tops[self.f]
+        if target < self._from:
+            return
+        self._pinned = True
+        self._target = target
+        pin_peers = [
+            p for p, (u, _, _, _) in self._offers.items() if u >= target
+        ]
+        self._offers.clear()
+        for p in pin_peers:
+            self.node.send_control(p, SnapReq(self._from, target, False))
+
+    def _fetch_from_next(self) -> None:
+        for p in self._quorum_peers:
+            if p not in self._failed:
+                self._provider = p
+                self._reset_fetch()
+                self.state = self.FETCH
+                self.node.send_control(
+                    p, SnapReq(self._from, self._target, True)
+                )
+                return
+        self._restart_or_abort("providers-exhausted")
+
+    def _restart_or_abort(self, reason: str) -> None:
+        rec = _obs.ACTIVE
+        self._restarts += 1
+        if self._restarts < _MAX_RESTARTS:
+            if rec is not None:
+                rec.count("st.retry")
+            self._begin_probe()
+            return
+        # give up: flush the parked frames so the node is no worse off
+        # than the legacy severed-link behaviour; the next gap retries
+        if rec is not None:
+            rec.count("st.aborted")
+            rec.event("st_reject", peer=self._provider or "-", reason=reason)
+        held = self._held
+        self._to_idle()
+        for p, m in held:
+            self.node._inbox.put_nowait((p, m))
+
+    async def _provider_failed(self, reason: str) -> None:
+        """The chosen provider served garbage: attribute, exclude,
+        retry against the next quorum peer."""
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.count("st.forged")
+            rec.event(
+                "st_reject",
+                peer=self._provider or "-",
+                reason=reason,
+                epoch=self._target,
+            )
+        self._attribute(self._provider, reason, kind=FaultKind.INVALID_SNAPSHOT)
+        if self._provider is not None:
+            self._failed.add(self._provider)
+        self._provider = None
+        self._fetch_from_next()
+
+    async def _on_chunk(self, peer: str, msg: SnapChunk) -> None:
+        if self.state != self.FETCH or peer != self._provider:
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("st.unexpected")
+            return
+        digest, size, chunks = self._expect
+        data = msg.data
+        cb = _tcp._ST_CHUNK_BYTES
+        if (
+            not _int_ok(msg.index)
+            or not _int_ok(msg.offset)
+            or not isinstance(data, (bytes, bytearray))
+            or msg.index != self._next_idx
+            or msg.index >= chunks
+            or msg.offset != msg.index * cb
+            or len(data) > cb
+            or msg.offset + len(data) > size
+            or (msg.index < chunks - 1 and len(data) != cb)
+            or (msg.index == chunks - 1 and msg.offset + len(data) != size)
+        ):
+            await self._provider_failed("bad-chunk")
+            return
+        self._parts.append(bytes(data))
+        self._got += len(data)
+        self._next_idx += 1
+
+    async def _on_done(self, peer: str, msg: SnapDone) -> None:
+        if self.state != self.FETCH or peer != self._provider:
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("st.unexpected")
+            return
+        digest, size, chunks = self._expect
+        if self._next_idx != chunks or self._got != size:
+            await self._provider_failed("short-stream")
+            return
+        payload = b"".join(self._parts)
+        if msg.digest != digest or snapshot_digest(payload) != digest:
+            await self._provider_failed("forged-digest")
+            return
+        try:
+            batches = loads(payload)
+        except SerializationError:
+            await self._provider_failed("undecodable")
+            return
+        # structural belt-and-braces (an honest payload always passes):
+        # exactly one batch per epoch, contiguous over the pinned range
+        ok = isinstance(batches, list) and len(batches) == (
+            self._target - self._from + 1
+        )
+        if ok:
+            for e, b in zip(range(self._from, self._target + 1), batches):
+                if getattr(b, "epoch", None) != e:
+                    ok = False
+                    break
+        if not ok:
+            await self._provider_failed("bad-shape")
+            return
+        await self._install(batches, len(payload), chunks)
+
+    async def _install(
+        self, batches: List[Any], nbytes: int, chunks: int
+    ) -> None:
+        # Renumber per-link recv expectations BEFORE the install
+        # checkpoint: everything below the first parked frame is either
+        # applied or covered by the snapshot, so the checkpoint may
+        # claim it — and the parked frames' WAL records then count
+        # contiguously on top of this base after a crash.
+        for p, first in self._held_first.items():
+            if first > self.node._applied_seq.get(p, 0):
+                self.node._applied_seq[p] = first - 1
+        if self._install_fn is not None:
+            step = self._install_fn(self._target, batches)
+        else:
+            step = self.node.algo.install_snapshot(self._target, batches)
+        self.installed += 1
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.count("st.installed")
+            rec.event(
+                "st_transfer",
+                peer=self._provider or "-",
+                from_epoch=self._from,
+                upto_epoch=self._target,
+                bytes=nbytes,
+                chunks=chunks,
+                retries=self._restarts + len(self._failed),
+            )
+        held = self._held
+        self._to_idle()
+        if step is not None:
+            await self.node._route(step)
+        for p, m in held:
+            self.node._inbox.put_nowait((p, m))
+
+    def _to_idle(self) -> None:
+        self.state = self.IDLE
+        self._offers.clear()
+        self._empty_votes.clear()
+        self._failed.clear()
+        self._provider = None
+        self._expect = None
+        self._target = None
+        self._pinned = False
+        self._reset_fetch()
+        self._held = []
+        self._held_first = {}
+
+    def _attribute(
+        self, peer: Optional[str], reason: str,
+        kind: FaultKind = FaultKind.INVALID_MESSAGE,
+    ) -> None:
+        if peer is None:
+            return
+        # FaultLog.init routes through the shared debug-log + obs path
+        self.node.faults.extend(FaultLog.init(peer, kind))
+
+
+def attach_transfer(
+    node: Any,
+    num_faulty: Optional[int] = None,
+    retain: int = 1024,
+    install_fn: Optional[Callable[[int, List[Any]], Any]] = None,
+) -> CatchupManager:
+    """Wire a :class:`CatchupManager` onto a ``TcpNode``: sets
+    ``node.transfer`` and chains the output hook so every committed
+    batch lands in the provider-side :class:`SnapshotStore`."""
+    f = node.netinfo.num_faulty if num_faulty is None else int(num_faulty)
+    mgr = CatchupManager(
+        node, f, store=SnapshotStore(retain), install_fn=install_fn
+    )
+    node.transfer = mgr
+    prev = node.on_output
+
+    def _watch(out: Any, _prev=prev, _mgr=mgr) -> None:
+        _mgr.store.record(out)
+        if _prev is not None:
+            _prev(out)
+
+    node.on_output = _watch
+    return mgr

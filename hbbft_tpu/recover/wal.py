@@ -34,6 +34,19 @@ Durability knobs: every append is written + flushed to the OS
 immediately; ``fsync`` batching is delegated to a background syncer
 thread (``hbbft-wal-sync``) so the protocol pump never blocks on disk,
 with ``fsync="always"`` available for tests and paranoid deployments.
+
+**Compaction** (state-transfer PR): recovery only ever reads the last
+``CHECKPOINT`` and the records after it, so everything before that
+snapshot is dead weight — an indefinitely-running node would grow its
+log without bound.  :func:`compact_records` drops the dead prefix
+(injecting the counted per-sender receive seqs into the surviving
+snapshot's meta so ``recover()`` stays exact without the dropped
+``MESSAGE`` records); :func:`compact_wal` applies it to a closed log
+atomically (temp file + ``os.replace``); ``WalWriter.compact`` does
+the same on a live writer, and ``append_checkpoint`` triggers it
+automatically once the log passes a size or record-count threshold.
+The ``HBBFT_TPU_WAL_COMPACT`` env knob sets the byte threshold
+(default 4 MiB) or disables the trigger (``off``/``0``/``no``).
 """
 
 from __future__ import annotations
@@ -45,9 +58,31 @@ import threading
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..obs import recorder as _obs
+
 _MAGIC = b"HBWAL001"
 _HDR = 1 + 4 + 4  # kind + length + crc32
 _PROTOCOL = 5
+
+# Automatic compaction: fire at append_checkpoint once the log passes
+# either bound.  The byte threshold is tunable via HBBFT_TPU_WAL_COMPACT
+# ("off"/"0"/"no"/"false" disables; an integer sets the byte threshold).
+_COMPACT_ENV = "HBBFT_TPU_WAL_COMPACT"
+_COMPACT_DEFAULT_BYTES = 4 * 1024 * 1024
+_COMPACT_MIN_RECORDS = 4096
+
+
+def _compact_threshold() -> Optional[int]:
+    """The live byte threshold, or ``None`` when compaction is off."""
+    raw = os.environ.get(_COMPACT_ENV, "").strip().lower()
+    if raw in ("off", "0", "no", "false"):
+        return None
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return _COMPACT_DEFAULT_BYTES
 
 CHECKPOINT = 1
 INPUT = 2
@@ -127,6 +162,70 @@ def decode_message(payload: bytes) -> Tuple[Any, Any]:
     return sender, message
 
 
+# -- compaction --------------------------------------------------------
+
+
+def compact_records(records: List[Record]) -> Tuple[List[Record], int]:
+    """Drop every record preceding the last ``CHECKPOINT`` →
+    ``(compacted_records, dropped_count)``.
+
+    Recovery never reads the dropped prefix — except for the per-sender
+    ``MESSAGE`` counts that seed the resume handshake's receive seqs.
+    When the surviving snapshot's meta lacks a ``"recv_seqs"`` base
+    (legacy logs), the counts over the dropped-and-kept prefix are
+    injected into it, so meta-based accounting in ``recover()`` is
+    exact on the compacted log."""
+    last_idx = -1
+    for i, r in enumerate(records):
+        if r.kind == CHECKPOINT:
+            last_idx = i
+    if last_idx <= 0:
+        return list(records), 0  # nothing before the snapshot (or none)
+    ckpt = records[last_idx]
+    state_bytes, meta = decode_checkpoint(ckpt.payload)
+    if not isinstance(meta.get("recv_seqs"), dict):
+        counts: Dict[Any, int] = {}
+        for r in records[:last_idx]:
+            if r.kind == MESSAGE:
+                sender, _ = decode_message(r.payload)
+                counts[sender] = counts.get(sender, 0) + 1
+        meta = dict(meta)
+        meta["recv_seqs"] = counts
+        ckpt = Record(
+            CHECKPOINT,
+            pickle.dumps((state_bytes, meta), protocol=_PROTOCOL),
+        )
+    return [ckpt] + list(records[last_idx + 1 :]), last_idx
+
+
+def _write_wal(path: str, records: List[Record]) -> int:
+    """Atomically replace ``path`` with a log holding ``records``;
+    returns the new file size."""
+    tmp = path + ".compact.tmp"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        for r in records:
+            f.write(_frame_record(r.kind, r.payload))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return os.path.getsize(path)
+
+
+def compact_wal(path: str) -> Tuple[int, int]:
+    """Offline compaction of a closed WAL →
+    ``(dropped_records, reclaimed_bytes)``.  A torn tail is preserved
+    as-is would be wrong — it is already unreadable — so the rewritten
+    log simply ends at the last intact record."""
+    before = os.path.getsize(path)
+    records, _clean = read_records(path)
+    compacted, dropped = compact_records(records)
+    if dropped == 0:
+        return 0, 0
+    after = _write_wal(path, compacted)
+    return dropped, before - after
+
+
 class WalWriter:
     """Append-only writer with background fsync batching.
 
@@ -150,6 +249,8 @@ class WalWriter:
         self._f = open(path, "ab")
         self._lock = threading.Lock()
         self._dirty = 0
+        self._size = 0 if fresh else os.path.getsize(path)
+        self._records = 0  # appends since open (size covers resumed logs)
         self._closed = False
         self._wake = threading.Event()
         self._syncer: Optional[threading.Thread] = None
@@ -175,6 +276,8 @@ class WalWriter:
                 raise WalError("append to closed WAL")
             self._f.write(rec)
             self._f.flush()
+            self._size += len(rec)
+            self._records += 1
             if self._fsync == "always":
                 os.fsync(self._f.fileno())
             else:
@@ -187,12 +290,63 @@ class WalWriter:
             CHECKPOINT,
             pickle.dumps((state_bytes, dict(meta or {})), protocol=_PROTOCOL),
         )
+        self.maybe_compact()
 
     def append_input(self, value: Any) -> None:
         self.append(INPUT, pickle.dumps(value, protocol=_PROTOCOL))
 
     def append_message(self, sender: Any, message: Any) -> None:
         self.append(MESSAGE, pickle.dumps((sender, message), protocol=_PROTOCOL))
+
+    # -- compaction ----------------------------------------------------
+
+    def maybe_compact(self) -> bool:
+        """Compact when the log passed the size or record-count
+        threshold (called after every checkpoint append)."""
+        threshold = _compact_threshold()
+        if threshold is None:
+            return False
+        with self._lock:
+            due = (
+                self._size >= threshold
+                or self._records >= _COMPACT_MIN_RECORDS
+            )
+        if not due:
+            return False
+        return self.compact() > 0
+
+    def compact(self) -> int:
+        """Drop all records before the last checkpoint, atomically, on
+        the live log → dropped record count.  Safe against the syncer
+        thread: the rewrite happens under ``_lock`` and the handle is
+        reopened on the replacement file before the lock is released."""
+        with self._lock:
+            if self._closed:
+                raise WalError("compact of closed WAL")
+            self._f.flush()
+            if self._dirty:
+                os.fsync(self._f.fileno())
+                self._dirty = 0
+            before = os.path.getsize(self.path)
+            records, _clean = read_records(self.path)
+            compacted, dropped = compact_records(records)
+            if dropped == 0:
+                return 0
+            self._f.close()
+            after = _write_wal(self.path, compacted)
+            self._f = open(self.path, "ab")
+            self._size = after
+            self._records = len(compacted)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.count("wal.compacted")
+            rec.event(
+                "wal_compact",
+                dropped=dropped,
+                kept=len(compacted),
+                bytes=before - after,
+            )
+        return dropped
 
     # -- durability ----------------------------------------------------
 

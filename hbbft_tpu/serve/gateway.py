@@ -30,7 +30,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import dataclasses
-from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..core.algorithm import DistAlgorithm
 from ..core.fault import FaultKind
@@ -167,8 +167,10 @@ class GatewayCore:
     transaction admitted once is acked on its *first* appearance in a
     committed batch; duplicates across proposer samples (expected —
     proposers draw overlapping random samples) are ignored.  ``acked``
-    retains envelope hashes for the life of the core (bench/test scale;
-    a long-lived deployment would age it out by epoch)."""
+    maps envelope hash → commit epoch so :meth:`gc_epochs` can age the
+    ledger out once an epoch is durably checkpointed — the piece that
+    turns "runs 100 epochs" into "runs indefinitely in bounded
+    memory"."""
 
     def __init__(
         self,
@@ -179,7 +181,10 @@ class GatewayCore:
         self.max_payload = int(max_payload)
         self.sessions: Dict[str, Tuple[str, str]] = {}
         self.pending: Dict[bytes, _Pending] = {}
-        self.acked: Set[bytes] = set()
+        # tx → epoch it committed in (epoch-less commits land at the
+        # current high-water so GC still ages them out eventually)
+        self.acked: Dict[bytes, int] = {}
+        self._max_epoch = -1
         self.drops: List[Tuple[str, str]] = []
         self.admitted = 0
         self.rejected = 0
@@ -330,10 +335,12 @@ class GatewayCore:
         p = self.pending.pop(tx, None)
         if p is None:
             return None
-        self.acked.add(tx)
         self.commits += 1
         latency = max(0.0, now - p.t_admit)
         ep = epoch if type(epoch) is int else -1
+        if ep > self._max_epoch:
+            self._max_epoch = ep
+        self.acked[tx] = ep if ep >= 0 else self._max_epoch
         rec = _obs.ACTIVE
         if rec is not None:
             rec.event(
@@ -344,6 +351,25 @@ class GatewayCore:
             )
             rec.observe("gateway.commit_latency_s", latency)
         return p.conn_id, CommitAck(p.seq, ep), latency
+
+    def gc_epochs(self, upto_epoch: int, keep: int = 8) -> int:
+        """Age the exactly-once ledger: drop acked entries whose commit
+        epoch is at least ``keep`` epochs behind ``upto_epoch`` →
+        count dropped.  Call once an epoch is durably checkpointed;
+        ``keep`` covers the client-resubmission window (a resubmit of a
+        GC'd tx is re-admitted and re-acked — it committed so long ago
+        that the ack it chases is dead anyway)."""
+        if type(upto_epoch) is not int:
+            return 0
+        cut = upto_epoch - max(0, int(keep))
+        stale = [tx for tx, ep in self.acked.items() if ep <= cut]
+        for tx in stale:
+            del self.acked[tx]
+        if stale:
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("gateway.gc_acked", len(stale))
+        return len(stale)
 
 
 # -- the mesh-side algorithm wrapper ----------------------------------------
@@ -581,3 +607,5 @@ class Gateway:
                     w.write(frame(ack))
                 except (ConnectionError, OSError):
                     pass
+        if type(epoch) is int:
+            self.core.gc_epochs(epoch)

@@ -37,6 +37,16 @@ loses nor double-applies a frame.  A dead link is redialed forever with
 jittered exponential backoff (the dial side owns reconnection, keeping
 the one-connection-per-pair invariant).
 
+**State transfer** (dark-peer catch-up PR): a peer dark longer than the
+replay-buffer bound cannot be caught up by replay — the evicted frames
+are gone.  The receiver detects the hole as a sequence *gap* on the
+first replayed frame and, when a ``recover.transfer.CatchupManager`` is
+attached, escalates into a Byzantine-safe snapshot fetch over the
+``St*`` control frames below (request → f+1 digest quorum → chunked
+payload → verify → install) instead of severing the stream.  The
+transport owns only the frame vocabulary and the gap/hold hooks; the
+protocol lives in ``recover/transfer.py``.
+
 The reference example runs a single ``Broadcast`` with placeholder keys
 (``node.rs:105-118``); :func:`generate_keys_for` reproduces that spirit:
 each node independently deals the *same* deterministic (INSECURE) key
@@ -71,6 +81,17 @@ _REPLAY_MAX_BYTES = 16 * 1024 * 1024
 _ACK_EVERY = 64
 _REDIAL_BASE_S = 0.05
 _REDIAL_CAP_S = 2.0
+
+# State-transfer bounds (the ``St*`` frames below; recover/transfer.py
+# drives the protocol).  A snapshot payload is chunked so no single
+# frame nears ``_MAX_FRAME``, and every size/offset/index field is an
+# attacker-controlled wire int: the receiving side accepts a payload
+# only up to ``_ST_MAX_BYTES``, accumulates received bytes instead of
+# pre-allocating from a claimed size, and rejects any chunk whose
+# offset/length stray from the strict in-order layout.
+_ST_CHUNK_BYTES = 256 * 1024
+_ST_MAX_BYTES = 32 * 1024 * 1024
+_ST_MAX_CHUNKS = _ST_MAX_BYTES // _ST_CHUNK_BYTES
 
 # Racecheck hook (analysis/racecheck.py): when the runtime lockset
 # checker is installed it replaces this with a callable that wraps each
@@ -115,6 +136,60 @@ class ResumeAck:
     letting the sender trim its replay buffer in steady state."""
 
     seq: Any
+
+
+@wire("StReq")
+@dataclasses.dataclass(frozen=True)
+class SnapReq:
+    """Joiner → peers: request state-transfer metadata for epochs
+    ``[from_epoch, upto_epoch]`` (``upto_epoch=None`` in the probe
+    round means "up to whatever you have committed"), or — with
+    ``fetch=True``, sent to exactly one quorum-agreeing provider — the
+    chunk stream itself."""
+
+    from_epoch: Any
+    upto_epoch: Any
+    fetch: Any
+
+
+@wire("StMeta")
+@dataclasses.dataclass(frozen=True)
+class SnapMeta:
+    """Provider → joiner: the snapshot it can serve for the requested
+    range — payload digest, total size, and chunk count.  The joiner
+    installs a payload only when f+1 peers agree on this tuple."""
+
+    from_epoch: Any
+    upto_epoch: Any
+    digest: Any
+    size: Any
+    chunks: Any
+
+
+@wire("StChunk")
+@dataclasses.dataclass(frozen=True)
+class SnapChunk:
+    """One in-order slice of the snapshot payload.  ``index`` and
+    ``offset`` are attacker-controlled and strictly validated against
+    the quorum-pinned meta — out-of-order, overlapping or oversized
+    chunks fault the provider and never grow the receive buffer."""
+
+    index: Any
+    offset: Any
+    data: Any
+
+
+@wire("StDone")
+@dataclasses.dataclass(frozen=True)
+class SnapDone:
+    """End of the chunk stream; the joiner verifies the reassembled
+    payload's digest against the f+1 quorum before decoding a byte."""
+
+    upto_epoch: Any
+    digest: Any
+
+
+_ST_TYPES = (SnapReq, SnapMeta, SnapChunk, SnapDone)
 
 
 def _seq_ok(v: Any) -> bool:
@@ -175,6 +250,8 @@ class TcpNode:
         dial_retries: int = 50,
         resume_recv: Optional[Dict[str, int]] = None,
         resume_send: Optional[Dict[str, int]] = None,
+        replay_max_frames: Optional[int] = None,
+        replay_max_bytes: Optional[int] = None,
     ):
         self.our_addr = our_addr
         self.dial_retries = dial_retries
@@ -212,6 +289,28 @@ class TcpNode:
         # seq here; the pump acks as it consumes them (FIFO per peer).
         self._seq_trail: Dict[str, Deque[int]] = {}
         self._applied_since_ack: Dict[str, int] = {}
+        # Applied (not merely delivered) inbound high-water mark per
+        # peer — what a durable checkpoint may claim as its resume
+        # seqs.  Starts at the resume point (everything recovered from
+        # the WAL is applied by definition) and advances as the pump
+        # consumes frames; a state-transfer install jumps it over the
+        # evicted range.
+        self._applied_seq: Dict[str, int] = dict(resume_recv or {})
+        # replay-buffer bounds: per-node overrides let tests and the
+        # dark-peer scenarios force eviction without routing 4096 frames
+        self._replay_max_frames = (
+            _REPLAY_MAX_FRAMES if replay_max_frames is None
+            else max(1, int(replay_max_frames))
+        )
+        self._replay_max_bytes = (
+            _REPLAY_MAX_BYTES if replay_max_bytes is None
+            else max(1, int(replay_max_bytes))
+        )
+        # State-transfer hook (``recover/transfer.py``): the restart
+        # driver attaches a CatchupManager here.  None keeps the legacy
+        # behaviour — an evicted replay range is a loudly-counted,
+        # permanently severed stream.
+        self.transfer: Optional[Any] = None
         if _TRACK_NODE is not None:
             _TRACK_NODE(self)
 
@@ -220,6 +319,24 @@ class TcpNode:
         """Snapshot of per-peer outbound sequence numbers — stored in
         checkpoint meta so a restarted node renumbers continuously."""
         return dict(self._send_seq)
+
+    @property
+    def applied_seqs(self) -> Dict[str, int]:
+        """Snapshot of per-peer *applied* inbound sequence numbers —
+        the resume high-water mark a checkpoint may safely claim (a
+        delivered-but-unapplied frame is never included)."""
+        return dict(self._applied_seq)
+
+    def send_control(self, peer: str, message: Any) -> bool:
+        """Write an unsequenced control frame (the state-transfer
+        plane) to a live link.  Control frames are never buffered or
+        replayed — the transfer layer owns retries.  Returns ``False``
+        when the link is down."""
+        w = self._writers.get(peer)
+        if w is None:
+            return False
+        w.write(_frame(message))
+        return True
 
     # -- connection management --------------------------------------------
 
@@ -422,6 +539,7 @@ class TcpNode:
             return
         buf = self._replay.get(peer)
         dropped = replayed = 0
+        rec = _obs.ACTIVE
         if buf:
             while buf and buf[0][0] <= peer_recv:
                 _, frame = buf.popleft()
@@ -429,10 +547,16 @@ class TcpNode:
                     self._replay_bytes.get(peer, 0) - len(frame)
                 )
                 dropped += 1
+            if buf and buf[0][0] > peer_recv + 1 and rec is not None:
+                # the peer fell behind our replay buffer: the frames
+                # below buf[0] were evicted and are gone — it will see
+                # the gap on the first replayed frame and must
+                # state-transfer to catch up
+                rec.count("wire.resume_gap")
+                rec.count(f"wire.resume_gap.{peer}")
             for _, frame in buf:
                 writer.write(frame)
                 replayed += 1
-        rec = _obs.ACTIVE
         if rec is not None:
             rec.event(
                 "wire_resume",
@@ -465,8 +589,8 @@ class TcpNode:
         buf.append((seq, frame))
         self._replay_bytes[peer] = self._replay_bytes.get(peer, 0) + len(frame)
         evicted = 0
-        while len(buf) > _REPLAY_MAX_FRAMES or (
-            self._replay_bytes[peer] > _REPLAY_MAX_BYTES and len(buf) > 1
+        while len(buf) > self._replay_max_frames or (
+            self._replay_bytes[peer] > self._replay_max_bytes and len(buf) > 1
         ):
             _, old = buf.popleft()
             self._replay_bytes[peer] -= len(old)
@@ -509,6 +633,20 @@ class TcpNode:
                 if rec is not None:
                     rec.count("wire.unexpected_resume")
                 continue
+            if isinstance(message, _ST_TYPES):
+                # state-transfer control plane: unsequenced, handled by
+                # the attached CatchupManager.  A node without one (or
+                # a manager error) drops the frame — never the loop.
+                if self.transfer is None:
+                    if rec is not None:
+                        rec.count("wire.st_unexpected")
+                    continue
+                try:
+                    await self.transfer.on_control(peer, message)
+                except Exception:
+                    if rec is not None:
+                        rec.count("wire.st_errors")
+                continue
             if isinstance(message, SeqData):
                 if not _seq_ok(message.seq):
                     if rec is not None:
@@ -521,6 +659,21 @@ class TcpNode:
                     if rec is not None:
                         rec.count("wire.dup_frames")
                     continue
+                if message.seq > last + 1:
+                    # frames [last+1, seq-1] were evicted from the
+                    # peer's replay buffer while we were dark.  With a
+                    # CatchupManager attached this escalates into a
+                    # state transfer instead of a severed stream.
+                    if rec is not None:
+                        rec.count("wire.seq_gap")
+                    if self.transfer is not None:
+                        try:
+                            await self.transfer.on_gap(
+                                peer, last, message.seq
+                            )
+                        except Exception:
+                            if rec is not None:
+                                rec.count("wire.st_errors")
                 self._recv_seq[peer] = message.seq
                 self._seq_trail.setdefault(peer, deque()).append(message.seq)
                 message = message.msg
@@ -531,6 +684,13 @@ class TcpNode:
                 rec.event("wire_recv", peer=peer, size=size)
                 rec.count("wire.recv_frames")
                 rec.count("wire.recv_bytes", size)
+            if self.transfer is not None and self.transfer.holding():
+                # a state transfer is in flight: data frames delivered
+                # now refer to epochs the snapshot supersedes or to
+                # live epochs we cannot process yet — parked in arrival
+                # order and flushed to the inbox at install time
+                self.transfer.hold(peer, message)
+                continue
             await self._inbox.put((peer, message))
 
     def _ack_applied(self, sender: str) -> None:
@@ -546,6 +706,7 @@ class TcpNode:
         seq = trail.popleft()
         if not seq:
             return  # legacy bare frame — nothing to ack
+        self._applied_seq[sender] = seq
         n = self._applied_since_ack.get(sender, 0) + 1
         if n >= _ACK_EVERY:
             n = 0

@@ -12,7 +12,10 @@
 #      geo-partition-heal and flash-crowd (WAN models over both sim
 #      planes, packed co-sim byte-identical to the dict plane) +
 #      crash-restart and link-flap (durable WAL recovery, the gateway
-#      restart window, and TCP session-resumption replay/dedup)
+#      restart window, and TCP session-resumption replay/dedup) +
+#      dark-peer-catchup and byzantine-snapshot (rejoin past the
+#      replay bound via f+1 quorum state transfer; forged snapshots
+#      attributed, never installed)
 #   5. gateway smoke — a real-TCP serving run (n=4 validators, 2
 #      tenants x 2 clients); every admitted tx committed exactly once
 #      and acked, zero spurious attributions
@@ -57,7 +60,8 @@ echo "== [4/5] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
   --only bad-share --only equivocate --only hostile-clients \
   --only geo-partition-heal --only flash-crowd \
-  --only crash-restart --only link-flap 2>&1 | log
+  --only crash-restart --only link-flap \
+  --only dark-peer-catchup --only byzantine-snapshot 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 

@@ -1,0 +1,459 @@
+"""State-transfer tests — replay-bound eviction, quorum catch-up,
+WAL compaction, and the bounded-memory GC paths.
+
+The contract under test, plane by plane:
+
+- **Replay bounds** (``transport/tcp.py``): the outbound replay buffer
+  is capped by frames *and* bytes; eviction is counted loudly
+  (``wire.replay_evicted``) because it severs resume-exactness.
+- **Escalation** (``recover/transfer.py``): a receive-side seq gap —
+  the signature of eviction on the peer — escalates into a probe →
+  quorum → fetch → verify → install state transfer instead of a
+  permanently severed stream; inbound data frames are parked during
+  the transfer and flushed after install, and the per-link applied
+  seq is renumbered so acks/checkpoints continue contiguously.
+- **WAL compaction** (``recover/wal.py``): dropping everything before
+  the last checkpoint is invisible to recovery — the compacted log
+  replays to a structurally identical state with identical resume
+  seqs — and the ``HBBFT_TPU_WAL_COMPACT`` trigger + offline CLI both
+  drive it.
+- **Bounded memory** (``serve/gateway.py``, ``protocols/
+  honey_badger.py``): the gateway's exactly-once ack ledger is aged by
+  epoch GC without reopening the dedup window, and HoneyBadger's
+  future-epoch queue is bounded per sender with drops counted and
+  repeat offenders attributed.
+"""
+
+import asyncio
+import random
+import shutil
+
+import pytest
+
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.harness.scenarios import _state_eq
+from hbbft_tpu.obs import recorder as obs
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    HoneyBadger,
+    HoneyBadgerMessage,
+)
+from hbbft_tpu.recover import WalWriter, recover
+from hbbft_tpu.recover import wal as wal_mod
+from hbbft_tpu.recover.node import DurableAlgo
+from hbbft_tpu.recover.transfer import (
+    CatchupManager,
+    SnapshotStore,
+    encode_snapshot,
+    snapshot_digest,
+)
+from hbbft_tpu.transport.tcp import SnapChunk, SnapDone, SnapMeta, TcpNode
+
+
+class _NullAlgo:
+    """Minimal sans-IO algorithm: absorbs everything, never outputs."""
+
+    def __init__(self, ni):
+        pass
+
+    def handle_input(self, value):
+        from hbbft_tpu.core.step import Step
+
+        return Step()
+
+    def handle_message(self, sender, message):
+        from hbbft_tpu.core.step import Step
+
+        return Step()
+
+    def terminated(self):
+        return False
+
+
+class _CaptureWriter:
+    def __init__(self):
+        self.buf = b""
+
+    def write(self, data):
+        self.buf += data
+
+
+def _route_n(node, n, size=64):
+    """Route ``n`` broadcast frames of ``size``-byte payloads."""
+    from hbbft_tpu.core.step import Step, Target
+
+    async def run():
+        for i in range(n):
+            await node._route(
+                Step(messages=[Target.all().message(b"%03d" % i + b"x" * size)])
+            )
+
+    asyncio.run(run())
+
+
+# -- replay-buffer bounds ------------------------------------------------
+
+
+def test_replay_byte_cap_evicts_and_counts():
+    """The byte cap bounds the replay buffer independently of the frame
+    cap, evicts oldest-first keeping a contiguous tail, and counts the
+    evictions both globally and per-peer."""
+    a, b = "127.0.0.1:1", "127.0.0.1:2"
+    cap = 600
+    sender = TcpNode(a, [b], _NullAlgo, replay_max_bytes=cap)
+    rec = obs.enable()
+    try:
+        _route_n(sender, 20)
+        evicted = rec.counters.get("wire.replay_evicted", 0)
+        assert evicted == rec.counters.get(f"wire.replay_evicted.{b}", 0)
+    finally:
+        obs.disable()
+    buf = sender._replay[b]
+    assert sender._replay_bytes[b] <= cap
+    assert sender._replay_bytes[b] == sum(len(f) for _, f in buf)
+    # oldest-first eviction: what survives is the contiguous tail
+    assert [s for s, _ in buf] == list(range(21 - len(buf), 21))
+    assert evicted == 20 - len(buf) > 0
+
+
+def test_replay_frame_cap_still_applies():
+    a, b = "127.0.0.1:1", "127.0.0.1:2"
+    sender = TcpNode(a, [b], _NullAlgo, replay_max_frames=4)
+    _route_n(sender, 20)
+    assert [s for s, _ in sender._replay[b]] == [17, 18, 19, 20]
+
+
+# -- eviction escalates into a state transfer ----------------------------
+
+
+def test_seq_gap_escalates_into_transfer_and_flushes_held():
+    """A resume replay that starts past the receiver's high-water mark
+    (the peer evicted the frames between) must escalate into a state
+    transfer: the gap starts a probe, data frames delivered meanwhile
+    are parked, a quorum-verified snapshot installs, the applied seq is
+    renumbered under the first parked frame, and the parked frames are
+    flushed to the inbox in arrival order."""
+    a, b = "127.0.0.1:1", "127.0.0.1:2"
+    installed = []
+
+    async def run():
+        sender = TcpNode(a, [b], _NullAlgo, replay_max_frames=4)
+        receiver = TcpNode(b, [a], _NullAlgo)
+        mgr = CatchupManager(
+            receiver,
+            0,  # n=2 toy mesh: f=0, a single offer is a quorum
+            install_fn=lambda upto, batches: installed.append(
+                (upto, list(batches))
+            )
+            or None,
+            epoch_fn=lambda: 0,
+        )
+        receiver.transfer = mgr
+        from hbbft_tpu.core.step import Step, Target
+
+        payloads = [b"live-%02d" % i for i in range(20)]
+        for p in payloads:
+            await sender._route(Step(messages=[Target.all().message(p)]))
+        # the receiver was dark for all 20; only 17..20 survive eviction
+        w = _CaptureWriter()
+        sender._resume_link(b, 0, w)
+        reader = asyncio.StreamReader()
+        reader.feed_data(w.buf)
+        reader.feed_eof()
+        await receiver._recv_loop(a, reader)
+        # gap detected → probe in flight, every delivered frame parked
+        assert mgr.state == mgr.PROBE
+        assert receiver._inbox.empty()
+        assert [m for _, m in mgr._held] == payloads[16:]
+        # a peer answers the probe with a 2-epoch snapshot
+        batches = [Batch(e, {0: [b"snap-%d" % e]}) for e in (0, 1)]
+        payload = encode_snapshot(batches)
+        digest = snapshot_digest(payload)
+        await mgr.on_control(a, SnapMeta(0, 1, digest, len(payload), 1))
+        assert mgr.state == mgr.FETCH
+        await mgr.on_control(a, SnapChunk(0, 0, payload))
+        await mgr.on_control(a, SnapDone(1, digest))
+        assert mgr.state == mgr.IDLE
+        # applied seq renumbered to just under the first parked frame:
+        # everything below is covered by the snapshot, so acks and
+        # checkpoints continue contiguously from the parked stream
+        assert receiver._applied_seq[a] == 16
+        flushed = []
+        while not receiver._inbox.empty():
+            flushed.append(receiver._inbox.get_nowait())
+        assert flushed == [(a, m) for m in payloads[16:]]
+        assert not receiver.faults
+
+    rec = obs.enable()
+    try:
+        asyncio.run(run())
+        assert rec.counters.get("wire.seq_gap", 0) >= 1
+        assert rec.counters.get("wire.replay_evicted", 0) == 16
+        assert rec.counters.get("st.installed", 0) == 1
+    finally:
+        obs.disable()
+    assert len(installed) == 1
+    upto, batches = installed[0]
+    assert upto == 1 and [bt.epoch for bt in batches] == [0, 1]
+
+
+def test_empty_offer_quorum_stands_down():
+    """f+1 explicit "nothing newer" votes resolve a probe without a
+    snapshot: the manager returns to idle and releases the parked
+    frames instead of holding the inbox hostage."""
+
+    async def run():
+        a, b = "127.0.0.1:1", "127.0.0.1:2"
+        receiver = TcpNode(b, [a], _NullAlgo)
+        mgr = CatchupManager(receiver, 0, epoch_fn=lambda: 5)
+        receiver.transfer = mgr
+        await mgr.on_gap(a, 0, 40)
+        assert mgr.state == mgr.PROBE
+        mgr.hold(a, b"parked")
+        await mgr.on_control(a, SnapMeta(5, 5, b"", 0, 0))
+        assert mgr.state == mgr.IDLE
+        assert receiver._inbox.get_nowait() == (a, b"parked")
+        assert not receiver.faults
+
+    rec = obs.enable()
+    try:
+        asyncio.run(run())
+        assert rec.counters.get("st.noop", 0) == 1
+    finally:
+        obs.disable()
+
+
+def test_snapshot_store_retention_bound():
+    store = SnapshotStore(retain=3)
+    for e in range(10):
+        store.record(Batch(e, {0: [b"b%d" % e]}))
+    assert len(store) == 3 and store.high() == 9
+    assert store.slice(7, 9) is not None
+    assert store.slice(5, 9) is None  # evicted epoch ⇒ refuse the range
+
+
+# -- WAL compaction ------------------------------------------------------
+
+
+def _durable_epoch_run(wal_path, seed):
+    """One HoneyBadger epoch in TestNetwork with node 1 durable
+    (checkpoint_every=1), so its WAL holds records both before and
+    after the final checkpoint."""
+    victim = 1
+    rng = random.Random(seed)
+
+    def new_algo(ni):
+        algo = HoneyBadger(ni, rng=random.Random(f"cw-{ni.our_id}-{seed}"))
+        if ni.our_id == victim:
+            return DurableAlgo(
+                algo, WalWriter(wal_path, fsync="off"), checkpoint_every=1
+            )
+        return algo
+
+    net = TestNetwork(
+        4,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        new_algo,
+        rng,
+        mock_crypto=True,
+    )
+    for nid in sorted(net.nodes):
+        node = net.nodes[nid]
+        node.handle_input([b"cw-%03d" % nid])
+        msgs = list(node.messages)
+        node.messages.clear()
+        net.dispatch_messages(nid, msgs)
+    steps = 0
+    while not all(nd.outputs for nd in net.nodes.values()):
+        assert net.any_busy(), "network quiesced before batches"
+        net.step()
+        steps += 1
+        assert steps < 400_000, "epoch stalled"
+    net.nodes[victim].algo.wal.close()
+
+
+def test_compacted_wal_replay_equals_full_replay(tmp_path, monkeypatch):
+    """Satellite invariant: recovery from a compacted WAL reaches a
+    state structurally equal to full-log replay, with identical resume
+    receive seqs (compaction injects the dropped-prefix message counts
+    into the surviving snapshot's meta)."""
+    monkeypatch.delenv(wal_mod._COMPACT_ENV, raising=False)
+    full = str(tmp_path / "full.wal")
+    _durable_epoch_run(full, seed=4242)
+    compacted = str(tmp_path / "compacted.wal")
+    shutil.copyfile(full, compacted)
+    dropped, reclaimed = wal_mod.compact_wal(compacted)
+    assert dropped > 0 and reclaimed > 0
+    a = recover(full)
+    b = recover(compacted)
+    assert _state_eq(a.algo, b.algo), "compacted replay diverges"
+    assert a.recv_seqs == b.recv_seqs
+    assert a.meta.get("send_seqs") == b.meta.get("send_seqs")
+    # compaction is idempotent: nothing left before the snapshot
+    assert wal_mod.compact_wal(compacted) == (0, 0)
+
+
+def test_wal_compaction_trigger_env(tmp_path, monkeypatch):
+    """``HBBFT_TPU_WAL_COMPACT`` arms the checkpoint-time trigger: a
+    1-byte threshold compacts on every checkpoint append, ``off``
+    disables the trigger entirely."""
+    monkeypatch.setenv(wal_mod._COMPACT_ENV, "1")
+    p = str(tmp_path / "auto.wal")
+    rec = obs.enable()
+    try:
+        with WalWriter(p, fsync="off") as w:
+            for i in range(3):
+                w.append_input(i)
+            w.append_checkpoint(b"state", {"send_seqs": {}})
+        assert rec.counters.get("wal.compacted", 0) == 1
+    finally:
+        obs.disable()
+    records, clean = wal_mod.read_records(p)
+    assert clean and [r.kind for r in records] == [wal_mod.CHECKPOINT]
+
+    monkeypatch.setenv(wal_mod._COMPACT_ENV, "off")
+    p2 = str(tmp_path / "manual.wal")
+    with WalWriter(p2, fsync="off") as w:
+        for i in range(3):
+            w.append_input(i)
+        w.append_checkpoint(b"state", {})
+    records, clean = wal_mod.read_records(p2)
+    assert clean and len(records) == 4  # trigger disarmed
+
+
+def test_wal_compaction_preserves_tail_records(tmp_path, monkeypatch):
+    """Records *after* the last checkpoint survive compaction byte-for-
+    byte — they are exactly what recovery replays."""
+    monkeypatch.delenv(wal_mod._COMPACT_ENV, raising=False)
+    p = str(tmp_path / "tail.wal")
+    with WalWriter(p, fsync="off") as w:
+        w.append_message("p0", ("pre", 1))
+        w.append_checkpoint(b"s", {})
+        w.append_message("p1", ("post", 2))
+        w.append_input([b"post-input"])
+    dropped, _ = wal_mod.compact_wal(p)
+    assert dropped == 1
+    records, clean = wal_mod.read_records(p)
+    assert clean
+    assert [r.kind for r in records] == [
+        wal_mod.CHECKPOINT,
+        wal_mod.MESSAGE,
+        wal_mod.INPUT,
+    ]
+    assert wal_mod.decode_message(records[1].payload) == ("p1", ("post", 2))
+    # the dropped prefix's per-sender counts moved into the meta
+    _, meta = wal_mod.decode_checkpoint(records[0].payload)
+    assert meta["recv_seqs"] == {"p0": 1}
+
+
+def test_compact_cli(tmp_path, capsys):
+    from hbbft_tpu.recover.__main__ import main
+
+    p = str(tmp_path / "cli.wal")
+    with WalWriter(p, fsync="off") as w:
+        w.append_input(1)
+        w.append_checkpoint(b"s", {})
+    assert main(["--compact", p]) == 0
+    out = capsys.readouterr().out
+    assert "compacted" in out and "dropped 1 record" in out
+    assert main(["--compact", str(tmp_path / "missing.wal")]) == 1
+
+
+# -- bounded memory: gateway ack-ledger GC -------------------------------
+
+
+def test_gateway_gc_ages_ack_ledger_without_reopening_dedup():
+    from hbbft_tpu.serve.gateway import GatewayCore
+    from hbbft_tpu.serve.protocol import ClientHello, SubmitTx
+
+    core = GatewayCore()
+    _, dropped = core.on_hello("c0", ClientHello(1, "alpha", "c0"))
+    assert not dropped
+    for s in range(5):
+        replies, dropped = core.on_submit(
+            "c0", SubmitTx(s, b"gc-tx-%d" % s), float(s)
+        )
+        assert not dropped and replies[0].admitted
+    txs = core.drain(16)
+    assert len(txs) == 5
+    for ep, tx in enumerate(txs):
+        assert core.on_committed(tx, ep, 10.0) is not None
+    assert len(core.acked) == 5
+    # a resubmission inside the keep window is deduped, not re-admitted
+    replies, _ = core.on_submit("c0", SubmitTx(4, b"gc-tx-4"), 11.0)
+    assert replies[0].admitted and not core.pending
+    rec = obs.enable()
+    try:
+        assert core.gc_epochs(4, keep=2) == 3  # epochs 0..2 aged out
+        assert rec.counters.get("gateway.gc_acked", 0) == 3
+    finally:
+        obs.disable()
+    assert len(core.acked) == 2  # epochs 3, 4 still inside the window
+    # past the window the tx is re-admitted (its old ack is long dead)
+    replies, _ = core.on_submit("c0", SubmitTx(0, b"gc-tx-0"), 12.0)
+    assert replies[0].admitted and len(core.pending) == 1
+    assert core.gc_epochs("nonsense") == 0  # total on junk input
+
+
+# -- bounded memory: HoneyBadger future-epoch queue ----------------------
+
+
+def test_hb_future_drops_counted_and_attributed():
+    """Messages beyond the queueing horizon are dropped with a counter
+    and a schema row, and a flood from one sender is attributed on the
+    32nd drop — memory stays bounded no matter what arrives."""
+    from hbbft_tpu.core.fault import FaultKind
+    from hbbft_tpu.core.network_info import NetworkInfo
+    from hbbft_tpu.protocols import honey_badger as hb_mod
+
+    nis = NetworkInfo.generate_map(
+        list(range(4)), random.Random(7), mock=True
+    )
+    hb = HoneyBadger(nis[0])
+    horizon = hb.max_future_epochs + hb_mod._FUTURE_HORIZON  # 3 + 64
+    rec = obs.enable()
+    try:
+        faults = []
+        for i in range(hb_mod._FUTURE_FAULT_EVERY):
+            step = hb.handle_message(
+                1, HoneyBadgerMessage(horizon + 1 + i, None)
+            )
+            faults.extend(step.fault_log)
+        assert rec.counters.get("hb.future_dropped", 0) == 32
+        rows = [e for e in rec.events if e["ev"] == "hb_future_drop"]
+        assert len(rows) == 32 and rows[0]["node"] == "0"
+        assert rows[-1]["drops"] == 32
+    finally:
+        obs.disable()
+    # one drop is clock skew; the 32nd is a flood — exactly one fault
+    assert [f.kind for f in faults] == [FaultKind.EPOCH_OUT_OF_RANGE]
+    assert all(f.node_id == 1 for f in faults)
+    assert not hb.incoming_queue  # nothing beyond the horizon queued
+
+
+def test_hb_future_queue_bounded_per_sender():
+    from hbbft_tpu.core.network_info import NetworkInfo
+    from hbbft_tpu.protocols import honey_badger as hb_mod
+
+    nis = NetworkInfo.generate_map(
+        list(range(4)), random.Random(8), mock=True
+    )
+    hb = HoneyBadger(nis[0])
+    cap = hb_mod._FUTURE_MAX_PER_SENDER
+    rec = obs.enable()
+    try:
+        for i in range(cap + 5):
+            hb.handle_message(2, HoneyBadgerMessage(10, ("q", i)))
+        assert rec.counters.get("hb.future_dropped", 0) == 5
+    finally:
+        obs.disable()
+    # exactly `cap` queued for the sender, the overflow dropped
+    assert hb._future_queued[2] == cap
+    assert len(hb.incoming_queue[10]) == cap
