@@ -2079,6 +2079,17 @@ def bench_serve_vector(epochs: int = 100, nodes: int = 1024):
         reject_rate=summary["reject_rate"],
         duration_s=summary["duration_s"],
     )
+    for hop, dist in sorted(summary.get("hop_walls_s", {}).items()):
+        _emit(
+            "serve_vector_hop_wall",
+            dist["p50"],
+            "s",
+            hop=hop,
+            p50_s=dist["p50"],
+            p90_s=dist["p90"],
+            max_s=dist["max"],
+            nodes=nodes,
+        )
     return _emit(
         "serve_vector_commit_latency",
         summary["commit_p50_s"],
@@ -2086,6 +2097,85 @@ def bench_serve_vector(epochs: int = 100, nodes: int = 1024):
         p50_s=summary["commit_p50_s"],
         p99_s=summary["commit_p99_s"],
         nodes=nodes,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Recorder overhead with the export plane live (--obs-bench)
+# ---------------------------------------------------------------------------
+
+
+def bench_obs_overhead(events: int = 200_000, reps: int = 3):
+    """A/B overhead of the fleet telemetry plane on the recorder hot
+    path.  Leg A is the PR-1 recorder: JSONL sink, counters, hists —
+    nothing else.  Leg B is the same workload with export enabled:
+    every row mirrored into the flight ring AND the Prometheus
+    exposition rendered every 10k events (a scrape rate well above any
+    real fleet poller).  Best-of-``reps`` wall per leg; the acceptance
+    bar is B within 5%% of A."""
+    import os
+    import tempfile
+
+    from hbbft_tpu.obs.flight import FlightRecorder
+    from hbbft_tpu.obs.metrics import MetricsCore
+    from hbbft_tpu.obs.recorder import Recorder
+
+    def drive(rec, core=None, every=10_000):
+        t0 = time.perf_counter()
+        for i in range(events):
+            rec.event(
+                "wire_send",
+                kind="SeqData",
+                peer="127.0.0.1:1",
+                size=i & 1023,
+                node="127.0.0.1:2",
+                seq=i,
+            )
+            if i & 7 == 0:
+                rec.count("wire.frames")
+            if i & 1023 == 0:
+                rec.observe("gateway.commit_latency_s", 0.001 * (i & 63))
+            if core is not None and i % every == 0:
+                core.render()
+        return time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as td:
+        base_walls, export_walls = [], []
+        for r in range(reps):
+            rec_a = Recorder(os.path.join(td, f"a{r}.jsonl"), node="bench")
+            base_walls.append(drive(rec_a))
+            rec_a.close()
+
+            rec_b = Recorder(os.path.join(td, f"b{r}.jsonl"), node="bench")
+            flight = FlightRecorder(
+                os.path.join(td, f"flight{r}.jsonl"), capacity=512,
+                node="bench",
+            )
+            rec_b.attach_flight(flight)
+            core = MetricsCore(node="bench", recorder=rec_b)
+            export_walls.append(drive(rec_b, core=core))
+            rec_b.close()
+            flight.close()
+
+    base, export = min(base_walls), min(export_walls)
+    overhead = export / base - 1.0
+    _emit(
+        "obs_recorder_events_per_s",
+        events / base,
+        "events/s",
+        events=events,
+        reps=reps,
+        wall_s=round(base, 4),
+    )
+    return _emit(
+        "obs_export_overhead",
+        100.0 * overhead,
+        "%",
+        vs_baseline=export / base,
+        events=events,
+        base_wall_s=round(base, 4),
+        export_wall_s=round(export, 4),
+        within_5pct=bool(overhead <= 0.05),
     )
 
 
@@ -2359,6 +2449,13 @@ def main() -> None:
         "behind the gateway with synthetic million-client tenants",
     )
     p.add_argument(
+        "--obs-bench",
+        action="store_true",
+        help="A/B recorder overhead with the export plane live (flight "
+        "ring mirror + periodic exposition render) vs the bare "
+        "recorder; the acceptance bar is within 5%%",
+    )
+    p.add_argument(
         "--duration", type=float, default=5.0, help="seconds (--serve)"
     )
     p.add_argument(
@@ -2383,6 +2480,8 @@ def main() -> None:
             bench_serve(duration=args.duration)
         elif args.serve_vector:
             bench_serve_vector(epochs=args.epochs if args.epochs != 5 else 100)
+        elif args.obs_bench:
+            bench_obs_overhead()
         elif args.latency:
             bench_latency(nodes=args.k or 13, epochs=args.epochs)
         elif args.cold:

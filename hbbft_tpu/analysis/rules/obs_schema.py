@@ -1,4 +1,4 @@
-"""Rule ``obs-schema`` — every emitted event matches schema v1.
+"""Rule ``obs-schema`` — every emitted event matches the schema.
 
 The JSONL trace is a stable interface: the report CLI, tests, and any
 downstream dashboards key on the field sets documented in
@@ -12,7 +12,11 @@ call site in the tree against the authoritative table:
 - keyword fields must be in the type's allowed set (``t`` — an
   explicit timestamp override — is always allowed);
 - required fields must all be present, unless the call uses a ``**``
-  splat (then only the named subset is checked).
+  splat (then only the named subset is checked);
+- the trace-context fields (``tn``/``ts``/``te`` —
+  :data:`hbbft_tpu.obs.schema.TRACE_FIELDS`) are stamped by the
+  Recorder itself and are *reserved*: an emit site passing one
+  explicitly would collide with (or spoof) the stamp.
 
 Method name + string-literal first argument is the match heuristic;
 no other ``.event(...)`` API exists in the tree.
@@ -58,6 +62,16 @@ class ObsSchemaRule(Rule):
                 continue
             names = {kw.arg for kw in node.keywords if kw.arg is not None}
             has_splat = any(kw.arg is None for kw in node.keywords)
+            for field in sorted(names & _schema.TRACE_FIELDS):
+                out.append(
+                    self.violation(
+                        ctx,
+                        node,
+                        f"event {ev!r}: field {field!r} is a reserved "
+                        "trace-context field — the Recorder stamps it",
+                    )
+                )
+            names -= _schema.TRACE_FIELDS
             if not spec.open:
                 for field in sorted(names - spec.allowed - {"t"}):
                     out.append(

@@ -285,6 +285,8 @@ def fuzz_frames(
             return 0  # control frames are dropped mid-stream
         if isinstance(message, _tcp._ST_TYPES):
             return 0  # no transfer manager attached: counted + dropped
+        if isinstance(message, _tcp.ObTrace):
+            return 0  # trace piggyback: validated/attributed, never delivered
         if isinstance(message, _tcp.SeqData):
             if not _tcp._seq_ok(message.seq) or message.seq <= rs["v"]:
                 return 0  # invalid or duplicate sequence number
@@ -377,9 +379,29 @@ def fuzz_frames(
             for _ in range(rng.randrange(1, 6)):
                 if terminated:
                     break
-                k = rng.randrange(12)
+                k = rng.randrange(13)
                 if k in (10, 11):  # St* transfer frame: no manager → dropped
                     stream += frame_of(dumps(random_st(rng)))
+                    continue
+                if k == 12:
+                    # ObTrace piggyback, valid or malformed: a bad
+                    # trace context is attributed (INVALID_MESSAGE +
+                    # wire.bad_obtrace), a good one may emit a
+                    # trace_link row — neither reaches the inbox and
+                    # neither may kill the pump
+                    stream += frame_of(
+                        dumps(
+                            _tcp.ObTrace(
+                                rng.choice(
+                                    ["127.0.0.1:9", 7, True, None, b"n", "n0"]
+                                ),
+                                rng.choice(
+                                    [rs["v"] + 1, rng.randrange(2**40), bad_seq()]
+                                ),
+                                rng.choice([None, 0, 3, bad_seq()]),
+                            )
+                        )
+                    )
                     continue
                 if k in (0, 1):  # valid frame
                     stream += frame_of(dumps(_random_primitive(rng)))
@@ -444,6 +466,8 @@ def fuzz_frames(
                     f"recv loop crashed on stream {stream[:32].hex()}…"
                     f"len={len(stream)}: {type(exc).__name__}: {exc}"
                 )
+        # malformed ObTrace contexts land here as attributed faults
+        report.faults += len(node.faults)
 
         # -- the manager-attached chunk surface --------------------------
         # A CatchupManager pinned mid-FETCH, fed hostile chunk streams:

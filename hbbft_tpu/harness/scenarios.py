@@ -59,6 +59,15 @@ module packages them as a named, seeded, CLI-drivable matrix (reference
   (caught by the pre-decode hash check), and the chunk structure; each
   serving attempt is attributed (``INVALID_SNAPSHOT``), retried
   against the next quorum peer, and never corrupts the joiner.
+- **fleet-telemetry**: the fleet telemetry plane end to end over a
+  real-TCP serving run under client load: a trace-stamped recorder
+  with ``ObTrace`` piggybacks on the mesh, per-node metrics exporters
+  scraped mid-run by the fleet poller, a forced flight-recorder dump,
+  and the post-mortem timeline (``hbbft_tpu.obs.timeline``) over
+  every artifact — health rules green, ≥99% of wire sends joined,
+  ≥99% of committed txs with a complete admit→ack chain.  Artifacts
+  land in ``$HBBFT_FLEET_DIR`` when set (the ``check.sh`` telemetry
+  stage re-runs the timeline CLI over them), else a temp dir.
 - **fuzz**: the wire-format fuzzer corpus (:mod:`hbbft_tpu.harness.fuzz`)
   over the codec, the TCP framing layer, the ``handle_*`` surface and
   the serving gateway — zero crashes, hangs or unlogged failures.
@@ -1600,6 +1609,143 @@ def _run_byzantine_snapshot(cfg: ScenarioConfig) -> ScenarioResult:
     )
 
 
+# -- fleet telemetry ---------------------------------------------------------
+
+
+def _run_fleet_telemetry(cfg: ScenarioConfig) -> ScenarioResult:
+    """The observability plane exercised end to end over a real-TCP
+    n=4 serving run: the recorder stamps trace context and mirrors
+    into a flight ring, the mesh piggybacks ``ObTrace`` frames, every
+    node exposes a Prometheus endpoint scraped mid-run by the fleet
+    poller, and the merged artifacts (trace + fleet JSONL + flight
+    dump) must yield a post-mortem timeline with all health rules
+    green, ≥99% wire-send joins and ≥99% complete admit→ack chains.
+
+    The SIGKILL crash path for the flight recorder is
+    ``tests/test_telemetry.py``'s job; here the dump is forced on the
+    way out so the timeline always merges a flight artifact."""
+    import asyncio
+    import os
+    import tempfile
+
+    from ..obs import fleet as _fleet_mod
+    from ..obs import flight as _flight_mod
+    from ..obs import metrics as _metrics
+    from ..obs import timeline as _timeline
+    from ..serve.loadgen import _run_tcp_async, default_tenants
+
+    out_dir = os.environ.get("HBBFT_FLEET_DIR")
+    tmp = None
+    if out_dir is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_dir = tmp.name
+    os.makedirs(out_dir, exist_ok=True)
+    trace_path = os.path.join(out_dir, "trace.jsonl")
+    fleet_path = os.path.join(out_dir, "fleet.jsonl")
+    flight_path = os.path.join(out_dir, "flight.jsonl")
+    for p in (trace_path, fleet_path, flight_path):
+        if os.path.exists(p):
+            os.unlink(p)
+
+    # own recorder with a sink at a known path; restore any outer one
+    # (un-closed) afterwards so a traced matrix run keeps recording
+    prev = _obs.ACTIVE
+    with _obs._SWITCH_LOCK:
+        rec = _obs.Recorder(trace_path, node="fleet")
+        _obs.ACTIVE = rec
+    flight = _flight_mod.FlightRecorder(flight_path, capacity=256, node="fleet")
+    rec.attach_flight(flight)
+
+    scraped: Dict[str, Any] = {}
+
+    async def mid_run(gateway, nodes):
+        # the gateway's exporter came up with it (metrics_addr); give
+        # every other mesh node its own endpoint, then scrape the
+        # whole fleet in one poller round while the load is live
+        extras = []
+        targets = {gateway.node.our_addr: gateway.metrics.addr}
+        for node in nodes[1:]:
+            exp = _metrics.MetricsExporter(
+                _metrics.MetricsCore(node=node.our_addr)
+            )
+            await exp.start()
+            extras.append(exp)
+            targets[node.our_addr] = exp.addr
+        poller = _fleet_mod.FleetPoller(targets, fleet_path)
+        rows = await poller.poll_once()
+        scraped["rows"] = rows
+        scraped["agg"] = _fleet_mod.aggregate(rows)
+        for exp in extras:
+            await exp.stop()
+
+    try:
+        tenants = default_tenants(2, 2, rate_hz=30.0, mean_payload=96)
+        summary = asyncio.run(
+            _run_tcp_async(
+                tenants,
+                4,
+                2.0,
+                cfg.seed,
+                metrics_addr="127.0.0.1:0",
+                mid_run=mid_run,
+            )
+        )
+        # dump BEFORE close(): close emits counter/hist rows into the
+        # trace, and a dump taken after would mirror them — the merge
+        # would then double-count every counter
+        flight.dump("scenario-end")
+    finally:
+        with _obs._SWITCH_LOCK:
+            _obs.ACTIVE = prev
+        rec.close()
+        flight.close()
+
+    _check(
+        summary["committed"] > 0 and not summary["errors"],
+        f"serving run unhealthy: committed={summary['committed']} "
+        f"errors={summary['errors']}",
+    )
+    rows = scraped.get("rows") or []
+    down = [r["node"] for r in rows if not r.get("up")]
+    _check(
+        len(rows) == 4 and not down,
+        f"fleet scrape: {len(rows)} targets, down={down}",
+    )
+    agg = scraped["agg"]
+    _check(
+        agg["totals"].get("hbbft_gateway_admitted_total", 0) > 0,
+        "mid-run scrape saw no admitted transactions",
+    )
+    tl = _timeline.build([trace_path, fleet_path, flight_path])
+    joins, chains = tl["joins"], tl["chains"]
+    _check(
+        joins["frac"] is not None and joins["frac"] >= 0.99,
+        f"wire joins below bar: {joins}",
+    )
+    _check(
+        chains["complete_frac"] is not None
+        and chains["complete_frac"] >= 0.99,
+        f"tx chains below bar: {chains}",
+    )
+    failed = [r["rule"] for r in tl["health"] if r["status"] == "FAIL"]
+    _check(not failed, f"health rules violated: {failed}")
+    if tmp is not None:
+        tmp.cleanup()
+    return ScenarioResult(
+        "fleet-telemetry",
+        True,
+        4,
+        len(tl["epochs"]),
+        cfg.seed,
+        0,
+        f"real TCP n=4 under load: {summary['committed']} txs committed, "
+        f"{joins['joined']}/{joins['sends']} wire sends joined, "
+        f"{chains['complete']}/{chains['committed']} admit->ack chains "
+        f"complete, 4/4 endpoints scraped mid-run, "
+        f"{len(tl['health'])} health rules green",
+    )
+
+
 # -- wire-format fuzzing -----------------------------------------------------
 
 
@@ -1654,6 +1800,7 @@ SCENARIOS: Dict[str, Callable[[ScenarioConfig], ScenarioResult]] = {
     "link-flap": _run_link_flap,
     "dark-peer-catchup": _run_dark_peer_catchup,
     "byzantine-snapshot": _run_byzantine_snapshot,
+    "fleet-telemetry": _run_fleet_telemetry,
     "fuzz": _run_fuzz,
 }
 

@@ -1,22 +1,39 @@
-"""Structured tracing + metrics for the hbbft_tpu stack.
+"""Structured tracing + metrics for the hbbft_tpu stack — the fleet
+telemetry plane.
 
-The observability layer has three parts:
+The observability layer, per node:
 
 - :mod:`hbbft_tpu.obs.recorder` — a near-zero-overhead recorder with
   span timers (context manager + decorator), counters and histograms.
   No-op by default: instrumented hot paths pay exactly one module
   attribute check (``recorder.ACTIVE is None``) when tracing is off.
-- Structured JSONL trace export with a stable event schema (epoch
-  start/decide, message send/deliver, crypto flush spans with batch
-  occupancy, fault telemetry, device-op routing decisions).
-- :mod:`hbbft_tpu.obs.report` — the trace summarizer CLI::
+  Schema v2 stamps every row with the cross-node trace context
+  (``tn``/``ts``/``te``) when a node identity is set.
+- :mod:`hbbft_tpu.obs.flight` — the bounded black box: a ring of the
+  last K event rows, force-dumped (atomic, crash-safe) on faults,
+  degrades and SIGTERM; persist mode survives SIGKILL.
+- :mod:`hbbft_tpu.obs.metrics` — sans-IO Prometheus-style text
+  exposition of the live counters/hists + the tiny asyncio endpoint.
 
-      python -m hbbft_tpu.obs.report trace.jsonl
+And across the fleet:
+
+- :mod:`hbbft_tpu.obs.fleet` — the poller scraping N exporters into
+  one fleet JSONL.
+- :mod:`hbbft_tpu.obs.report` — the single-summary CLI::
+
+      python -m hbbft_tpu.obs.report n0.jsonl n1.jsonl
+
+- :mod:`hbbft_tpu.obs.timeline` — the post-mortem: merges multi-node
+  traces by trace context into a per-epoch commit timeline with
+  admit→gossip→ACS→decrypt→ack hop walls and a declarative SLO/health
+  pass::
+
+      python -m hbbft_tpu.obs.timeline run/*.jsonl
 
 Enable tracing programmatically::
 
     from hbbft_tpu import obs
-    obs.enable("trace.jsonl")
+    obs.enable("trace.jsonl", node="n0")
     ...   # run simulations / flushes / epochs
     obs.disable()
 

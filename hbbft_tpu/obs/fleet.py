@@ -1,0 +1,160 @@
+"""The fleet poller — scrape N per-node exporters into one JSONL.
+
+:class:`FleetPoller` periodically GETs every node's ``/metrics``
+endpoint (:mod:`hbbft_tpu.obs.metrics`), parses the exposition back
+into series, and appends one scrape row per node per round to a
+single fleet JSONL file::
+
+    {"ev": "metrics_scrape", "node": "n0", "up": true,
+     "wall": 1754650000.123, "families": {"hbbft_wire_seq_gap_total": 0.0, ...}}
+
+Rows use the schema-v2 ``metrics_scrape`` event shape (plus the
+``families`` payload), so the fleet file feeds straight into
+``obs.timeline`` / ``obs.report`` alongside per-node traces.  A node
+that refuses connections or times out produces an ``up: false`` row —
+the fleet file records outages, it doesn't skip them.
+
+CLI::
+
+    python -m hbbft_tpu.obs.fleet --target n0=127.0.0.1:9100 \
+        --target n1=127.0.0.1:9101 --out fleet.jsonl --rounds 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time as _time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import recorder as _obs
+from .metrics import parse, scrape
+
+
+class FleetPoller:
+    """Scrapes ``targets`` (``{node_name: (host, port)}``) into
+    ``out_path`` (append-mode JSONL; ``None`` keeps rows in memory
+    only — they're always available via :attr:`rows`)."""
+
+    def __init__(
+        self,
+        targets: Dict[str, Tuple[str, int]],
+        out_path: Optional[str] = None,
+        *,
+        interval_s: float = 1.0,
+        timeout_s: float = 5.0,
+    ):
+        self.targets = dict(targets)
+        self.out_path = out_path
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.rows: List[Dict[str, Any]] = []
+
+    async def _scrape_one(self, name: str, host: str, port: int) -> Dict[str, Any]:
+        t0 = _time.perf_counter()
+        row: Dict[str, Any] = {
+            "ev": "metrics_scrape",
+            "node": name,
+            "wall": round(_time.time(), 3),
+        }
+        try:
+            body = await scrape(host, port, timeout=self.timeout_s)
+            row["up"] = True
+            row["families"] = parse(body)
+        except (OSError, asyncio.TimeoutError, ConnectionError) as exc:
+            row["up"] = False
+            row["error"] = type(exc).__name__
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "metrics_scrape",
+                node=name,
+                up=row["up"],
+                families=len(row.get("families", ())),
+                wall=round(_time.perf_counter() - t0, 6),
+            )
+        return row
+
+    async def poll_once(self) -> List[Dict[str, Any]]:
+        """One scrape round across every target, concurrently."""
+        rows = await asyncio.gather(
+            *(
+                self._scrape_one(name, host, port)
+                for name, (host, port) in sorted(self.targets.items())
+            )
+        )
+        self.rows.extend(rows)
+        if self.out_path is not None:
+            with open(self.out_path, "a") as fh:
+                for row in rows:
+                    fh.write(json.dumps(row, separators=(",", ":")) + "\n")
+        return list(rows)
+
+    async def run(self, rounds: int) -> List[Dict[str, Any]]:
+        """``rounds`` scrape rounds, ``interval_s`` apart."""
+        for i in range(rounds):
+            await self.poll_once()
+            if i + 1 < rounds:
+                await asyncio.sleep(self.interval_s)
+        return list(self.rows)
+
+
+def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fleet summary over scrape rows: the latest ``up`` state per
+    node and, over each node's *latest* successful scrape, the
+    fleet-wide sum per counter series (label sets stripped)."""
+    latest: Dict[str, Dict[str, Any]] = {}
+    for row in rows:
+        latest[row["node"]] = row
+    totals: Dict[str, float] = {}
+    for row in latest.values():
+        for series, value in (row.get("families") or {}).items():
+            name = series.split("{", 1)[0]
+            if name.endswith("_total"):
+                totals[name] = totals.get(name, 0.0) + value
+    return {
+        "nodes": len(latest),
+        "up": sum(1 for r in latest.values() if r.get("up")),
+        "totals": {k: totals[k] for k in sorted(totals)},
+    }
+
+
+def _parse_target(spec: str) -> Tuple[str, Tuple[str, int]]:
+    name, _, addr = spec.partition("=")
+    if not addr:
+        name, addr = addr or spec, spec
+    host, _, port = addr.rpartition(":")
+    return name, (host or "127.0.0.1", int(port))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hbbft_tpu.obs.fleet",
+        description="Scrape per-node metrics endpoints into one fleet JSONL.",
+    )
+    ap.add_argument(
+        "--target",
+        action="append",
+        required=True,
+        metavar="NAME=HOST:PORT",
+        help="one exporter endpoint (repeatable)",
+    )
+    ap.add_argument("--out", default=None, help="fleet JSONL path (append)")
+    ap.add_argument("--rounds", type=int, default=1)
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    targets = dict(_parse_target(s) for s in args.target)
+    poller = FleetPoller(
+        targets, args.out, interval_s=args.interval, timeout_s=args.timeout
+    )
+    rows = asyncio.run(poller.run(args.rounds))
+    summary = aggregate(rows)
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 0 if summary["up"] == summary["nodes"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

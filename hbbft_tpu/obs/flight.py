@@ -1,0 +1,226 @@
+"""The flight recorder — a bounded per-node black box.
+
+A :class:`FlightRecorder` keeps the last ``capacity`` event rows in a
+ring and force-dumps them to disk when something goes wrong: the
+recorder mirror (:meth:`Recorder.attach_flight`) triggers a dump on
+every ``fault`` and ``degrade`` event, and :func:`install_sigterm`
+hooks process termination.  Dumps are crash-safe the same way the WAL
+is — written to a temp file, fsynced, then atomically renamed — so a
+reader never sees a torn dump.
+
+For crashes that never reach a dump trigger (SIGKILL, power loss) the
+ring can run in *persist* mode: every row is written through to an
+append-only JSONL file as it is recorded, line-buffered, so the file
+on disk always holds the tail of the event stream up to the last
+completed write.  The file is compacted back down to the ring bound
+with the same atomic temp+rename dance once it grows past a few times
+``capacity``, keeping long runs at bounded disk cost.
+
+Dump files are plain JSONL in the schema-v2 row format, prefixed by
+one ``flight_dump`` meta row, so ``obs.timeline`` and ``obs.report``
+ingest them exactly like live traces.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import threading
+import time as _time
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+DEFAULT_CAPACITY = 512
+
+#: Minimum seconds between trigger-driven dumps (a fault storm must
+#: not turn the black box into an fsync storm).
+DUMP_INTERVAL_S = 1.0
+
+
+class FlightRecorder:
+    """Bounded ring of the last ``capacity`` event rows.
+
+    Thread-safe; never raises out of :meth:`record`/:meth:`maybe_dump`
+    (a broken black box must not take the node down with it).
+
+    :param path: where :meth:`dump` writes (atomic temp+rename).
+    :param capacity: ring bound (rows).
+    :param node: node identity stamped on the ``flight_dump`` meta row.
+    :param persist: optional append-only JSONL path written through on
+        every :meth:`record` — the SIGKILL-survivable mode.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        capacity: int = DEFAULT_CAPACITY,
+        node: Optional[str] = None,
+        persist: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.path = path
+        self.capacity = max(1, int(capacity))
+        self.node = None if node is None else str(node)
+        self._clock = clock or _time.monotonic
+        self._lock = threading.Lock()
+        self._ring: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=self.capacity
+        )
+        self._total = 0
+        self._last_dump = float("-inf")
+        self.dumps = 0
+        self._persist_path = persist
+        self._persist_rows = 0
+        self._persist_fh = None
+        if persist is not None:
+            self._persist_fh = open(persist, "a", buffering=1)
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, row: Dict[str, Any]) -> None:
+        """Append one event row to the ring (and the persist file when
+        enabled).  Swallows I/O errors — see class docstring."""
+        try:
+            with self._lock:
+                self._ring.append(row)
+                self._total += 1
+                if self._persist_fh is not None:
+                    self._persist_fh.write(
+                        json.dumps(row, separators=(",", ":")) + "\n"
+                    )
+                    self._persist_rows += 1
+                    if self._persist_rows > 4 * self.capacity:
+                        self._compact_persist_locked()
+        except Exception:
+            pass
+
+    def _compact_persist_locked(self) -> None:
+        """Rewrite the persist file down to the current ring contents
+        (atomic temp+rename), then reopen the append handle.  Called
+        with ``_lock`` held."""
+        path = self._persist_path
+        self._persist_fh.close()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            for row in self._ring:
+                fh.write(json.dumps(row, separators=(",", ":")) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        self._persist_fh = open(path, "a", buffering=1)
+        self._persist_rows = len(self._ring)
+
+    # -- dumping ------------------------------------------------------------
+
+    def maybe_dump(self, reason: str) -> Optional[str]:
+        """Trigger-driven dump, rate-limited to one per
+        :data:`DUMP_INTERVAL_S`.  Returns the dump path or ``None``."""
+        with self._lock:
+            now = self._clock()
+            if now - self._last_dump < DUMP_INTERVAL_S:
+                return None
+            self._last_dump = now
+        return self.dump(reason)
+
+    def dump(self, reason: str) -> Optional[str]:
+        """Force-dump the ring to ``self.path``: one ``flight_dump``
+        meta row, then the buffered rows, via atomic temp+rename with
+        an fsync before the rename (torn dumps are impossible; a crash
+        mid-dump leaves the previous dump intact)."""
+        try:
+            with self._lock:
+                rows = list(self._ring)
+                dropped = self._total - len(rows)
+            meta = {
+                "ev": "flight_dump",
+                "t": round(_time.time(), 3),
+                "reason": reason,
+                "events": len(rows),
+                "dropped": dropped,
+                "path": self.path,
+            }
+            if self.node is not None:
+                meta["node"] = self.node
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(json.dumps(meta, separators=(",", ":")) + "\n")
+                for row in rows:
+                    fh.write(json.dumps(row, separators=(",", ":")) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+            self.dumps += 1
+            # a marker row in the *live* trace too, so a merged
+            # timeline shows when/why each black box fired.  No locks
+            # are held here, and "flight_dump" is not a dump trigger,
+            # so the mirror back through event() cannot recurse.
+            from . import recorder as _obs
+
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.event(
+                    "flight_dump",
+                    reason=reason,
+                    events=len(rows),
+                    dropped=dropped,
+                    path=self.path,
+                    node=self.node,
+                )
+            return self.path
+        except Exception:
+            return None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._persist_fh is not None:
+                try:
+                    self._persist_fh.close()
+                except Exception:
+                    pass
+                self._persist_fh = None
+
+
+def load(path: str) -> Tuple[List[Dict[str, Any]], Optional[Dict[str, Any]]]:
+    """Read a dump (or persist) file: ``(rows, meta)`` where ``meta``
+    is the leading ``flight_dump`` row when present (dumps have one,
+    persist files don't).  Torn trailing lines — expected after a hard
+    kill mid-write — are silently dropped, like ``report.load_events``."""
+    rows: List[Dict[str, Any]] = []
+    meta: Optional[Dict[str, Any]] = None
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if i == 0 and row.get("ev") == "flight_dump":
+                meta = row
+            else:
+                rows.append(row)
+    return rows, meta
+
+
+def install_sigterm(flight: FlightRecorder) -> None:
+    """Dump ``flight`` on SIGTERM, chaining any previously installed
+    handler (and the default terminate behaviour).  Main-thread only —
+    signal handlers can't be set elsewhere; callers off the main
+    thread get a no-op."""
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            flight.dump("sigterm")
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass

@@ -1,0 +1,191 @@
+"""Live metrics export — Prometheus-style text exposition per node.
+
+Split sans-IO, like every protocol core in this codebase:
+
+- :class:`MetricsCore` holds no sockets.  It snapshots a recorder's
+  live counters and histogram summaries (thread-safe reads — see
+  :meth:`Recorder.counters_snapshot`) and renders them as text
+  exposition format 0.0.4.  Dotted internal names map to Prometheus
+  conventions: counter ``wire.seq_gap`` becomes
+  ``hbbft_wire_seq_gap_total``, histogram ``gateway.commit_latency_s``
+  becomes ``hbbft_gateway_commit_latency_s{stat="p50"}`` summary
+  series.  A ``node`` label carries the trace-context node id.
+- :class:`MetricsExporter` is the tiny asyncio shell beside the
+  gateway: a one-request HTTP/1.0 server answering ``GET /metrics``
+  with the core's rendering (and ``/healthz`` with ``ok``).  One
+  read, one write, close — no keep-alive, no framing edge cases.
+
+:func:`parse` is the matching reader used by the fleet poller
+(:mod:`hbbft_tpu.obs.fleet`) and tests: exposition text back into a
+``{series: value}`` dict.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from typing import Any, Dict, Optional, Tuple
+
+from . import recorder as _obs
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: Histogram summary statistics exported per hist, in exposition order.
+HIST_STATS = ("count", "min", "p50", "p90", "max", "sum")
+
+
+def _metric_name(name: str) -> str:
+    return "hbbft_" + _NAME_RE.sub("_", name)
+
+
+class MetricsCore:
+    """Sans-IO renderer of one recorder's live counters/hists.
+
+    :param node: node label on every series (defaults to the
+        recorder's trace-context node at render time).
+    :param recorder: pin a specific recorder; defaults to the
+        process-wide active one at each render.
+    """
+
+    def __init__(
+        self,
+        node: Optional[str] = None,
+        recorder: Optional["_obs.Recorder"] = None,
+    ):
+        self.node = None if node is None else str(node)
+        self._recorder = recorder
+
+    def _rec(self) -> Optional["_obs.Recorder"]:
+        return self._recorder if self._recorder is not None else _obs.ACTIVE
+
+    def render(self) -> str:
+        """The exposition body.  Always valid (possibly empty of
+        samples) even with tracing off."""
+        rec = self._rec()
+        lines = []
+        node = self.node
+        if node is None and rec is not None:
+            node = rec.node
+        label = "" if node is None else '{node="%s"}' % node
+        if rec is None:
+            lines.append("# hbbft-tpu metrics: tracing off")
+            return "\n".join(lines) + "\n"
+        counters = rec.counters_snapshot()
+        hists = rec.hists_summary()
+        lines.append("hbbft_obs_events_total%s %d" % (label, len(rec.events)))
+        for name in sorted(counters):
+            metric = _metric_name(name) + "_total"
+            lines.append("# TYPE %s counter" % metric)
+            lines.append("%s%s %d" % (metric, label, counters[name]))
+        for name in sorted(hists):
+            metric = _metric_name(name)
+            lines.append("# TYPE %s summary" % metric)
+            stats = hists[name]
+            for stat in HIST_STATS:
+                if node is None:
+                    slabel = '{stat="%s"}' % stat
+                else:
+                    slabel = '{node="%s",stat="%s"}' % (node, stat)
+                lines.append("%s%s %.9g" % (metric, slabel, stats[stat]))
+        return "\n".join(lines) + "\n"
+
+
+def parse(text: str) -> Dict[str, float]:
+    """Exposition text → ``{series: value}`` (series includes its
+    label set verbatim).  Comment and blank lines are skipped;
+    malformed lines are dropped, not raised — the poller must survive
+    a half-written or newer-format body."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            continue
+        try:
+            out[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+class MetricsExporter:
+    """The asyncio endpoint serving one :class:`MetricsCore`."""
+
+    def __init__(self, core: MetricsCore, host: str = "127.0.0.1", port: int = 0):
+        self.core = core
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        """The bound (host, port) — meaningful after :meth:`start`
+        (port 0 binds an ephemeral port)."""
+        return (self.host, self.port)
+
+    async def start(self) -> "MetricsExporter":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            try:
+                req = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            except asyncio.TimeoutError:
+                return
+            parts = req.decode("latin-1", "replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            if path.startswith("/healthz"):
+                status, body = "200 OK", "ok\n"
+            elif path.startswith("/metrics"):
+                status, body = "200 OK", self.core.render()
+            else:
+                status, body = "404 Not Found", "not found\n"
+            payload = body.encode()
+            writer.write(
+                (
+                    "HTTP/1.0 %s\r\n"
+                    "Content-Type: text/plain; version=0.0.4\r\n"
+                    "Content-Length: %d\r\n"
+                    "Connection: close\r\n\r\n" % (status, len(payload))
+                ).encode()
+                + payload
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+async def scrape(host: str, port: int, timeout: float = 5.0) -> str:
+    """One GET /metrics against an exporter; returns the raw body."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout=timeout)
+    finally:
+        writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    if b" 200 " not in head.split(b"\r\n", 1)[0]:
+        raise ConnectionError(
+            "scrape %s:%d: %s" % (host, port, head.split(b"\r\n", 1)[0].decode())
+        )
+    return body.decode()

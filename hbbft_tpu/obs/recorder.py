@@ -37,12 +37,26 @@ Design constraints (ISSUE 1 tentpole):
                       stable compact form ``<node!r>:<KIND>``), ``node,
                       kind``
   ``wire_send``       one frame written to a TCP peer link: ``peer,
-                      size`` (+ ``kind``: ``all``/``node``)
+                      size`` (+ ``kind``: ``all``/``node``; v2 adds
+                      ``node, seq`` for the cross-node causal join)
   ``wire_recv``       one frame read off a TCP peer link: ``peer, size``
+                      (+ ``node, seq``)
   ``counter``         final counter values (emitted on close)
   ``hist``            histogram summaries (emitted on close)
   ``trace_end``       total event count + duration
   ==================  =====================================================
+
+- **Cross-node trace context (schema v2).**  A recorder given a node
+  identity (``enable(..., node=...)`` or :meth:`Recorder.set_node`)
+  stamps every row with ``tn`` (node id), ``ts`` (a per-recorder
+  monotonic event sequence number) and — once :meth:`set_epoch` has
+  been called — ``te`` (the current consensus epoch).  The triple is
+  the compact trace context ``obs.timeline`` merges multi-node traces
+  by; it is stamped by :meth:`event` itself, never by call sites.
+- **Flight recorder.**  :meth:`attach_flight` mirrors every event row
+  into a bounded :class:`~hbbft_tpu.obs.flight.FlightRecorder` ring
+  and force-dumps it on any ``fault`` or ``degrade`` event — the
+  built-in black box for crashes and attributions.
 
 - **Streaming JSONL.**  With a ``path``, events are written as they
   happen (line-buffered), so a crashed run still leaves a readable
@@ -169,6 +183,7 @@ class Recorder:
         *,
         jax_annotations: bool = False,
         clock: Optional[Callable[[], float]] = None,
+        node: Optional[str] = None,
     ):
         self._clock = clock or _time.perf_counter
         self._t0 = self._clock()
@@ -185,6 +200,14 @@ class Recorder:
             os.environ.get("HBBFT_TPU_TRACE_JAX")
         )
         self._closed = False
+        # cross-node trace context (schema v2): stamped on every row
+        # when a node identity is set — tn/ts/te are reserved fields
+        self._node: Optional[str] = None if node is None else str(node)
+        self._trace_seq = 0
+        self._epoch: Optional[int] = None
+        # flight-recorder mirror (attach_flight): every row is echoed
+        # into the ring; fault/degrade events trigger a forced dump
+        self._flight: Optional[Any] = None
         self.event(
             "trace_start", schema=SCHEMA_VERSION, wall_unix=round(_time.time(), 3)
         )
@@ -204,10 +227,54 @@ class Recorder:
         for k, v in fields.items():
             row[k] = _jsonable(v)
         with self._lock:
+            # the trace-context stamp lives under the lock so ts is a
+            # strictly monotonic per-recorder sequence even with
+            # waiter/stager threads emitting concurrently
+            if self._node is not None:
+                self._trace_seq += 1
+                row["tn"] = self._node
+                row["ts"] = self._trace_seq
+                if self._epoch is not None:
+                    row["te"] = self._epoch
             self.events.append(row)
             if self._sink is not None:
                 self._sink.write(json.dumps(row, separators=(",", ":")) + "\n")
+            flight = self._flight
+        # the flight mirror runs OUTSIDE _lock: dumps do file I/O and
+        # may emit a flight_dump marker row back through event(), so
+        # holding the non-reentrant lock here would self-deadlock (the
+        # lock-order rule)
+        if flight is not None:
+            flight.record(row)
+            if ev in ("fault", "degrade"):
+                flight.maybe_dump(ev)
         return row
+
+    # -- trace context (schema v2) ------------------------------------------
+
+    def set_node(self, node: Any) -> None:
+        """Bind this recorder to a node identity: every subsequent row
+        is stamped with the ``tn``/``ts`` (/``te``) trace context."""
+        with self._lock:
+            self._node = str(node)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Update the epoch component of the trace context (stamped as
+        ``te`` on subsequent rows; ignored until :meth:`set_node`)."""
+        if type(epoch) is int:
+            with self._lock:
+                self._epoch = epoch
+
+    @property
+    def node(self) -> Optional[str]:
+        return self._node
+
+    def attach_flight(self, flight: Any) -> None:
+        """Mirror every event row into ``flight`` (a
+        :class:`~hbbft_tpu.obs.flight.FlightRecorder`); ``fault`` and
+        ``degrade`` events force a dump.  Pass ``None`` to detach."""
+        with self._lock:
+            self._flight = flight
 
     # -- counters / histograms ---------------------------------------------
 
@@ -219,6 +286,30 @@ class Recorder:
         """Record one histogram sample (summarized on :meth:`close`)."""
         with self._lock:
             self._hists.setdefault(name, []).append(float(value))
+
+    def counters_snapshot(self) -> Dict[str, int]:
+        """A consistent copy of the live counters (metrics export reads
+        this mid-run; :meth:`close` emits the final values as rows)."""
+        with self._lock:
+            return dict(self.counters)
+
+    def hists_summary(self) -> Dict[str, Dict[str, float]]:
+        """Live histogram summaries keyed by name — same statistics the
+        ``hist`` close-time rows carry (count/min/p50/p90/max/sum)."""
+        with self._lock:
+            hists = {k: list(v) for k, v in self._hists.items()}
+        out: Dict[str, Dict[str, float]] = {}
+        for name, vals in hists.items():
+            vals.sort()
+            out[name] = {
+                "count": len(vals),
+                "min": vals[0],
+                "p50": _pct(vals, 0.50),
+                "p90": _pct(vals, 0.90),
+                "max": vals[-1],
+                "sum": sum(vals),
+            }
+        return out
 
     # -- spans --------------------------------------------------------------
 
@@ -306,14 +397,18 @@ def enable(
     *,
     jax_annotations: bool = False,
     clock: Optional[Callable[[], float]] = None,
+    node: Optional[str] = None,
 ) -> Recorder:
     """Install a recorder as the process-wide trace sink.  A previously
-    installed recorder is closed first."""
+    installed recorder is closed first.  With ``node``, every row is
+    stamped with the cross-node trace context (schema v2)."""
     global ACTIVE
     with _SWITCH_LOCK:
         if ACTIVE is not None:
             ACTIVE.close()
-        ACTIVE = Recorder(path, jax_annotations=jax_annotations, clock=clock)
+        ACTIVE = Recorder(
+            path, jax_annotations=jax_annotations, clock=clock, node=node
+        )
         return ACTIVE
 
 

@@ -1,7 +1,12 @@
-"""Trace report CLI — summarize a JSONL trace written by the recorder.
+"""Trace report CLI — summarize JSONL traces written by the recorder.
 
     python -m hbbft_tpu.obs.report trace.jsonl
-    python -m hbbft_tpu.obs.report trace.jsonl --json
+    python -m hbbft_tpu.obs.report node0.jsonl node1.jsonl --json
+
+Multiple trace files (one per node, flight dumps, fleet JSONL) are
+merged into one summary.  Unknown event types — traces from a newer
+schema minor — are tolerated and surfaced under ``unknown_events``,
+never raised on.
 
 Prints, from the stable event schema (:mod:`hbbft_tpu.obs.recorder`):
 
@@ -52,8 +57,21 @@ def load_events(path: str) -> List[Dict[str, Any]]:
     return events
 
 
+def load_many(paths: List[str]) -> List[Dict[str, Any]]:
+    """Concatenate several traces (per-node files, flight dumps) into
+    one event list for :func:`summarize`."""
+    events: List[Dict[str, Any]] = []
+    for path in paths:
+        events.extend(load_events(path))
+    return events
+
+
 def _dist(vals: List[float]) -> Dict[str, float]:
     vals = sorted(vals)
+    if not vals:
+        # a trace can legitimately carry rows missing an optional
+        # field — an empty distribution must summarize, not raise
+        return {"count": 0, "min": 0.0, "p50": 0.0, "p90": 0.0, "max": 0.0, "mean": 0.0}
     return {
         "count": len(vals),
         "min": vals[0],
@@ -75,6 +93,19 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "events": len(events),
         "duration_s": (by_ev["trace_end"][-1].get("dur") if by_ev["trace_end"] else None),
     }
+
+    # -- forward compatibility ---------------------------------------------
+    # schema minors are additive: a newer trace may carry event types
+    # this reader doesn't know — count them, don't choke on them
+    from .schema import EVENTS as _KNOWN
+
+    unknown = {
+        ev: len(rows)
+        for ev, rows in by_ev.items()
+        if ev not in _KNOWN and not ev.startswith("_")
+    }
+    if unknown:
+        out["unknown_events"] = dict(sorted(unknown.items()))
 
     # -- epochs -------------------------------------------------------------
     rows = by_ev["epoch"]
@@ -229,6 +260,13 @@ def render(s: Dict[str, Any]) -> str:
             s.get("schema"),
         )
     )
+    if s.get("unknown_events"):
+        add(
+            "  unknown event types (newer schema minor): "
+            + ", ".join(
+                "%s x%d" % (ev, n) for ev, n in s["unknown_events"].items()
+            )
+        )
 
     ep = s.get("epochs")
     if ep:
@@ -339,12 +377,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m hbbft_tpu.obs.report", description=__doc__
     )
-    p.add_argument("trace", help="JSONL trace file written by the recorder")
+    p.add_argument(
+        "trace",
+        nargs="+",
+        help="JSONL trace file(s) written by the recorder (merged)",
+    )
     p.add_argument(
         "--json", action="store_true", help="emit the summary as one JSON object"
     )
     args = p.parse_args(argv)
-    events = load_events(args.trace)
+    events = load_many(args.trace)
     summary = summarize(events)
     try:
         if args.json:

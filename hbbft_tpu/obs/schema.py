@@ -1,16 +1,25 @@
 """The stable JSONL event schema — one authoritative field table.
 
-Schema v1 (PR 1) with the additive v1 extensions from the static
--analysis PR (``wire_send`` / ``wire_recv`` for the real TCP mesh).
-Consumed by :mod:`hbbft_tpu.obs.report` (field access), by
-:mod:`hbbft_tpu.analysis.rules.obs_schema` (call-site lint), and by
-tests.
+Schema v2: v1 (PR 1) plus the additive fleet-telemetry extensions —
+cross-node trace context, the causal wire-join fields, the flight
+recorder, and the per-hop commit-timeline events.  Consumed by
+:mod:`hbbft_tpu.obs.report` and :mod:`hbbft_tpu.obs.timeline` (field
+access), by :mod:`hbbft_tpu.analysis.rules.obs_schema` (call-site
+lint), and by tests.
 
 Every event row carries ``ev`` (the type) and ``t`` (seconds since
 trace start) — those are added by :meth:`Recorder.event` itself and
-are not listed per type.  ``required`` fields must appear at every
-emit site; ``optional`` fields may.  Event types marked ``open``
-accept arbitrary extra attributes (spans carry caller attrs).
+are not listed per type.  A recorder with a node context additionally
+stamps the trace-context triple on every row (:data:`TRACE_FIELDS`):
+``tn`` (node id), ``ts`` (per-recorder monotonic event seq), ``te``
+(current epoch, when known).  Those are reserved — emit sites must
+never pass them explicitly (the ``obs-schema`` lint enforces it).
+
+``required`` fields must appear at every emit site; ``optional``
+fields may.  Event types marked ``open`` accept arbitrary extra
+attributes (spans carry caller attrs).  Schema *minors* are additive:
+consumers must tolerate unknown event types and unknown optional
+fields from newer traces.
 """
 
 from __future__ import annotations
@@ -18,7 +27,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, FrozenSet
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+#: Trace-context fields stamped by the Recorder itself (never by emit
+#: sites): node id, monotonic event seq, current epoch.
+TRACE_FIELDS: FrozenSet[str] = frozenset({"tn", "ts", "te"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,9 +86,12 @@ EVENTS: Dict[str, EventSpec] = {
     "compile": _spec({"name", "key", "wall"}),
     # fault attribution
     "fault": _spec({"fault", "node", "kind"}),
-    # real TCP mesh wire plane (additive)
-    "wire_send": _spec({"peer", "size"}, {"kind"}),
-    "wire_recv": _spec({"peer", "size"}),
+    # real TCP mesh wire plane (additive).  v2: ``node`` (the emitting
+    # endpoint) + ``seq`` (the link sequence number) make a send on
+    # node A joinable to the matching recv on node B even when both
+    # stamp rows into one in-process recorder.
+    "wire_send": _spec({"peer", "size"}, {"kind", "node", "seq"}),
+    "wire_recv": _spec({"peer", "size"}, {"node", "seq"}),
     # adversarial scenario matrix (additive): one row per scenario run,
     # and one per completed fuzz surface
     "scenario": _spec(
@@ -98,7 +114,9 @@ EVENTS: Dict[str, EventSpec] = {
     "gateway_reject": _spec(
         {"tenant", "reason"}, {"client", "seq", "retry_after_ms"}
     ),
-    "client_commit_latency": _spec({"latency_s"}, {"tenant", "epoch"}),
+    "client_commit_latency": _spec(
+        {"latency_s"}, {"tenant", "epoch", "client", "seq"}
+    ),
     "queue_depth": _spec({"depth"}, {"pending"}),
     # 100k co-simulation (additive): one row per packed-sim epoch, and
     # one per WAN model bound to a network size
@@ -122,4 +140,23 @@ EVENTS: Dict[str, EventSpec] = {
     "st_reject": _spec({"peer", "reason"}, {"epoch"}),
     "hb_future_drop": _spec({"node", "epoch"}, {"drops"}),
     "wal_compact": _spec({"dropped", "kept", "bytes"}),
+    # fleet telemetry plane (schema v2, all additive) ------------------
+    # one row per WAL record append — ``records`` is the log's
+    # high-water mark, which the flight-recorder crash test joins
+    # against the on-disk WAL after a SIGKILL
+    "wal_append": _spec({"records"}, {"kind", "path"}),
+    # one row per validated ObTrace piggyback received: the local
+    # node's view of the peer's trace context (peer node id, peer
+    # trace seq, peer epoch) — the cross-process causal join points
+    "trace_link": _spec({"node", "peer", "seq"}, {"epoch"}),
+    # per-hop commit timeline: gossip relay into the mesh, ACS
+    # completion (decryption begins), and one node's committed batch
+    "gossip_relay": _spec({"txs"}, {"depth", "node"}),
+    "acs_done": _spec({"node", "epoch"}, {"proposers"}),
+    "node_commit": _spec({"node", "epoch"}, {"txs"}),
+    # flight recorder: one marker row per forced dump (written into
+    # the dump file AND the live trace)
+    "flight_dump": _spec({"reason", "events"}, {"node", "path", "dropped"}),
+    # fleet metrics poller: one row per scrape attempt per node
+    "metrics_scrape": _spec({"node", "up"}, {"families", "wall"}),
 }

@@ -1,0 +1,504 @@
+"""Post-mortem timeline — merge multi-node traces into one causally
+ordered per-epoch commit timeline, then run the SLO/health rules.
+
+    python -m hbbft_tpu.obs.timeline n0.jsonl n1.jsonl flight-n2.jsonl
+    python -m hbbft_tpu.obs.timeline run/*.jsonl --json --rules slo.rules
+
+Inputs are any mix of per-node recorder traces, flight-recorder dumps
+and fleet scrape JSONLs (all the same schema-v2 row format).  Each
+file's rows are aligned onto one wall clock via its ``trace_start``
+``wall_unix`` anchor, then joined three ways:
+
+- **wire joins** — a ``wire_send`` on node A (``node``, ``peer``,
+  ``seq``) joins the matching ``wire_recv`` on node B; the join
+  fraction is a health signal (un-joinable contexts mean a node's
+  trace is missing or its clock anchor is lying).
+- **tx chains** — ``gateway_admit`` (client, seq) → committed epoch
+  (``client_commit_latency``) → ``node_commit`` rows for that epoch:
+  a *complete* chain shows the tx entering the gateway, the fleet
+  committing its epoch, and the ack leaving — the admit→ack arc.
+- **per-epoch hops** — admit → gossip (``gossip_relay``) → ACS
+  (``acs_done``) → decrypt/commit (``node_commit``) → ack walls, one
+  line per epoch.
+
+Alert rules are declarative ``name selector op threshold`` tuples
+(see :data:`DEFAULT_RULES`); selectors address merged counters
+(``counter:wire.seq_gap``), event-field sums
+(``event_sum:spec_combine:misses``), histogram summary stats
+(``hist:gateway.commit_latency_s:p90``), and the derived chain/join
+fractions (``chain:complete_frac``, ``join:frac``).  A selector whose
+subject never appears in the traces *passes* (absent ≠ violated —
+rules are forward declarations over future planes too).  Any violated
+rule makes the CLI exit non-zero: CI runs this over scenario traces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from .report import _dist, load_events
+
+#: ``(name, selector, op, threshold)`` — the built-in SLO/health pass.
+#: ``reveal.lag_s`` is a forward declaration for the order-then-reveal
+#: arc; it passes while the histogram doesn't exist.
+DEFAULT_RULES: List[Tuple[str, str, str, float]] = [
+    ("wire-seq-gap", "counter:wire.seq_gap", "<=", 0),
+    ("wire-replay-evicted", "counter:wire.replay_evicted", "<=", 0),
+    ("wire-bad-obtrace", "counter:wire.bad_obtrace", "<=", 0),
+    ("wire-handler-errors", "counter:wire.handler_errors", "<=", 0),
+    ("spec-combine-misses", "event_sum:spec_combine:misses", "<=", 0),
+    ("gateway-rejects", "counter:gateway.rejected", "<=", 0),
+    ("reveal-lag-p90", "hist:reveal.lag_s:p90", "<=", 1.0),
+    ("chain-complete", "chain:complete_frac", ">=", 0.99),
+    ("trace-joins", "join:frac", ">=", 0.99),
+]
+
+_OPS = {
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+    "==": lambda a, b: a == b,
+}
+
+
+# ---------------------------------------------------------------------------
+# merge + align
+# ---------------------------------------------------------------------------
+
+
+def merge(paths: List[str]) -> List[Dict[str, Any]]:
+    """Load every file, stamp each row with ``_wall`` (its file's
+    ``trace_start`` wall anchor + ``t``) and ``_src``, and return all
+    rows sorted by wall time.
+
+    Flight dumps have no ``trace_start`` row: the ring mirrors a live
+    recorder, so its rows reuse the recorder's relative ``t`` but the
+    dump itself carries no wall anchor.  A file without an anchor
+    borrows the anchor of any anchored file holding the same
+    ``(tn, ts)`` row — mixing raw and anchored clocks in one hop would
+    otherwise put ~the unix epoch into a wall diff.  The same
+    ``(tn, ts)`` identity dedupes mirrored copies, so a row present in
+    both a node's trace and its flight dump is counted once.
+    """
+    files = []
+    for path in paths:
+        events = load_events(path)
+        anchor: Any = None
+        for e in events:
+            if e.get("ev") == "trace_start" and "wall_unix" in e:
+                anchor = float(e["wall_unix"])
+                break
+        files.append((path, events, anchor))
+    anchored_keys: Dict[Any, float] = {}
+    for path, events, anchor in files:
+        if anchor is None:
+            continue
+        for e in events:
+            if "tn" in e and "ts" in e:
+                anchored_keys[(e["tn"], e["ts"], e.get("ev"))] = anchor
+    rows: List[Dict[str, Any]] = []
+    seen = set()
+    for path, events, anchor in files:
+        if anchor is None:
+            for e in events:
+                key = (e["tn"], e["ts"], e.get("ev")) if "tn" in e and "ts" in e else None
+                if key is not None and key in anchored_keys:
+                    anchor = anchored_keys[key]
+                    break
+        base = 0.0 if anchor is None else anchor
+        for e in events:
+            key = (e["tn"], e["ts"], e.get("ev")) if "tn" in e and "ts" in e else None
+            if key is not None:
+                if key in seen:
+                    continue
+                seen.add(key)
+            e["_wall"] = base + float(e.get("t", 0.0))
+            e["_src"] = path
+            rows.append(e)
+    rows.sort(key=lambda e: e["_wall"])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+
+def wire_joins(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Join v2 ``wire_send`` rows to their ``wire_recv`` on the far
+    node.  Only sends carrying the causal fields participate (v1 rows
+    have no ``node``/``seq``)."""
+    recvs = set()
+    for e in rows:
+        if e.get("ev") == "wire_recv" and "node" in e and "seq" in e:
+            recvs.add((str(e["node"]), str(e["peer"]), int(e["seq"])))
+    sends = 0
+    joined = 0
+    for e in rows:
+        if e.get("ev") == "wire_send" and "node" in e and "seq" in e:
+            sends += 1
+            if (str(e["peer"]), str(e["node"]), int(e["seq"])) in recvs:
+                joined += 1
+    links = sum(1 for e in rows if e.get("ev") == "trace_link")
+    return {
+        "sends": sends,
+        "joined": joined,
+        "frac": (joined / sends) if sends else None,
+        "trace_links": links,
+    }
+
+
+def tx_chains(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The admit→ack chain per committed tx.  A chain is *complete*
+    when its commit ack (``client_commit_latency`` with client+seq)
+    joins back to a ``gateway_admit`` AND its epoch shows at least one
+    ``node_commit`` — i.e. the tx is traceable across the gateway, the
+    mesh, and back out."""
+    admits: Dict[Tuple[str, int], Dict[str, Any]] = {}
+    committed_epochs = set()
+    for e in rows:
+        ev = e.get("ev")
+        if ev == "gateway_admit" and "client" in e and "seq" in e:
+            admits.setdefault((str(e["client"]), int(e["seq"])), e)
+        elif ev == "node_commit":
+            committed_epochs.add(e.get("epoch"))
+    total = complete = 0
+    missing: List[Dict[str, Any]] = []
+    for e in rows:
+        if e.get("ev") != "client_commit_latency":
+            continue
+        total += 1
+        key = (str(e.get("client")), int(e.get("seq", -1)))
+        has_admit = key in admits
+        has_commit = e.get("epoch") in committed_epochs
+        if has_admit and has_commit:
+            complete += 1
+        elif len(missing) < 16:
+            missing.append(
+                {
+                    "client": e.get("client"),
+                    "seq": e.get("seq"),
+                    "epoch": e.get("epoch"),
+                    "admit": has_admit,
+                    "node_commit": has_commit,
+                }
+            )
+    return {
+        "committed": total,
+        "complete": complete,
+        "complete_frac": (complete / total) if total else None,
+        "incomplete_sample": missing,
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-epoch hop walls
+# ---------------------------------------------------------------------------
+
+
+def epoch_timeline(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One entry per committed epoch, causally ordered, with the
+    admit→gossip→ACS→decrypt→ack hop walls that can be established
+    from the merged rows (a hop whose endpoints are missing is simply
+    omitted — partial traces still produce a timeline)."""
+    admits: Dict[Tuple[str, int], float] = {}
+    gossip_walls: List[float] = []
+    acs: Dict[int, List[float]] = defaultdict(list)
+    commits: Dict[int, List[Dict[str, Any]]] = defaultdict(list)
+    acks: Dict[int, List[Dict[str, Any]]] = defaultdict(list)
+    for e in rows:
+        ev = e.get("ev")
+        if ev == "gateway_admit" and "client" in e and "seq" in e:
+            admits.setdefault((str(e["client"]), int(e["seq"])), e["_wall"])
+        elif ev == "gossip_relay":
+            gossip_walls.append(e["_wall"])
+        elif ev == "acs_done" and isinstance(e.get("epoch"), int):
+            acs[e["epoch"]].append(e["_wall"])
+        elif ev == "node_commit" and isinstance(e.get("epoch"), int):
+            commits[e["epoch"]].append(e)
+        elif ev == "client_commit_latency" and isinstance(e.get("epoch"), int):
+            acks[e["epoch"]].append(e)
+    gossip_walls.sort()
+
+    out: List[Dict[str, Any]] = []
+    epochs = sorted(set(commits) | set(acks) | set(acs))
+    for epoch in epochs:
+        entry: Dict[str, Any] = {"epoch": epoch}
+        ack_rows = acks.get(epoch, [])
+        commit_rows = commits.get(epoch, [])
+        entry["commit_nodes"] = len({str(c.get("node")) for c in commit_rows})
+        entry["txs"] = max(
+            [int(c["txs"]) for c in commit_rows if "txs" in c], default=len(ack_rows)
+        )
+        admit_walls = sorted(
+            admits[(str(a.get("client")), int(a.get("seq", -1)))]
+            for a in ack_rows
+            if (str(a.get("client")), int(a.get("seq", -1))) in admits
+        )
+        hops: Dict[str, float] = {}
+        t_admit = admit_walls[0] if admit_walls else None
+        t_gossip = None
+        if t_admit is not None:
+            later = [w for w in gossip_walls if w >= t_admit]
+            if later:
+                t_gossip = later[0]
+                hops["admit_to_gossip"] = t_gossip - t_admit
+        t_acs = min(acs[epoch]) if acs.get(epoch) else None
+        if t_acs is not None and t_gossip is not None:
+            hops["gossip_to_acs"] = max(0.0, t_acs - t_gossip)
+        t_commit = (
+            max(c["_wall"] for c in commit_rows) if commit_rows else None
+        )
+        if t_commit is not None and t_acs is not None:
+            hops["acs_to_commit"] = max(0.0, t_commit - t_acs)
+        if ack_rows and t_commit is not None:
+            hops["commit_to_ack"] = max(
+                0.0, max(a["_wall"] for a in ack_rows) - t_commit
+            )
+        if ack_rows:
+            entry["admit_to_ack"] = _dist(
+                [float(a.get("latency_s", 0.0)) for a in ack_rows]
+            )
+        entry["hops"] = hops
+        out.append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# health rules
+# ---------------------------------------------------------------------------
+
+
+def _merged_counters(rows: List[Dict[str, Any]]) -> Dict[str, float]:
+    out: Dict[str, float] = defaultdict(float)
+    for e in rows:
+        if e.get("ev") == "counter":
+            out[str(e.get("name"))] += float(e.get("value", 0))
+    return dict(out)
+
+
+def _merged_hists(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Per-name worst-case merge of ``hist`` summary rows (max for
+    order stats, sum for count/sum) — the conservative view for SLOs."""
+    out: Dict[str, Dict[str, float]] = {}
+    for e in rows:
+        if e.get("ev") != "hist":
+            continue
+        name = str(e.get("name"))
+        cur = out.setdefault(name, defaultdict(float))
+        for stat in ("min", "p50", "p90", "max"):
+            if stat in e:
+                cur[stat] = max(cur.get(stat, float("-inf")), float(e[stat]))
+        for stat in ("count", "sum"):
+            if stat in e:
+                cur[stat] += float(e[stat])
+    return {k: dict(v) for k, v in out.items()}
+
+
+def select(
+    selector: str,
+    rows: List[Dict[str, Any]],
+    derived: Dict[str, Any],
+) -> Optional[float]:
+    """Resolve one rule selector against the merged rows; ``None``
+    means the subject is absent from these traces."""
+    kind, _, rest = selector.partition(":")
+    if kind == "counter":
+        return _merged_counters(rows).get(rest)
+    if kind == "event_sum":
+        ev, _, field = rest.partition(":")
+        vals = [
+            float(e[field])
+            for e in rows
+            if e.get("ev") == ev and isinstance(e.get(field), (int, float))
+        ]
+        return sum(vals) if vals else None
+    if kind == "event_count":
+        n = sum(1 for e in rows if e.get("ev") == rest)
+        return float(n) if n else None
+    if kind == "hist":
+        name, _, stat = rest.rpartition(":")
+        h = _merged_hists(rows).get(name)
+        return None if h is None else h.get(stat)
+    if kind == "chain":
+        return derived["chains"].get(rest)
+    if kind == "join":
+        return derived["joins"].get(rest)
+    raise ValueError("unknown selector kind: %r" % selector)
+
+
+def evaluate_rules(
+    rules: List[Tuple[str, str, str, float]],
+    rows: List[Dict[str, Any]],
+    derived: Dict[str, Any],
+) -> List[Dict[str, Any]]:
+    results = []
+    for name, selector, op, threshold in rules:
+        value = select(selector, rows, derived)
+        if value is None:
+            status = "absent"
+        elif _OPS[op](value, threshold):
+            status = "pass"
+        else:
+            status = "FAIL"
+        results.append(
+            {
+                "rule": name,
+                "selector": selector,
+                "op": op,
+                "threshold": threshold,
+                "value": value,
+                "status": status,
+            }
+        )
+    return results
+
+
+def parse_rules(path: str) -> List[Tuple[str, str, str, float]]:
+    """One rule per line: ``name selector op threshold`` (``#``
+    comments and blank lines skipped)."""
+    rules = []
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 4 or parts[2] not in _OPS:
+                raise ValueError("%s:%d: bad rule line: %r" % (path, ln, line))
+            rules.append((parts[0], parts[1], parts[2], float(parts[3])))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# top level
+# ---------------------------------------------------------------------------
+
+
+def build(
+    paths: List[str],
+    rules: Optional[List[Tuple[str, str, str, float]]] = None,
+) -> Dict[str, Any]:
+    """The full post-mortem: merged rows → joins, chains, per-epoch
+    timeline, health results."""
+    rows = merge(paths)
+    nodes = sorted(
+        {str(e["tn"]) for e in rows if "tn" in e}
+        | {str(e["node"]) for e in rows if e.get("ev") == "node_commit"}
+    )
+    derived = {"joins": wire_joins(rows), "chains": tx_chains(rows)}
+    health = evaluate_rules(
+        DEFAULT_RULES if rules is None else rules, rows, derived
+    )
+    return {
+        "files": len(paths),
+        "events": len(rows),
+        "nodes": nodes,
+        "joins": derived["joins"],
+        "chains": derived["chains"],
+        "epochs": epoch_timeline(rows),
+        "health": health,
+        "ok": all(r["status"] != "FAIL" for r in health),
+    }
+
+
+def render(tl: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    add = lines.append
+    add(
+        "timeline: %d events from %d file(s), nodes: %s"
+        % (tl["events"], tl["files"], ", ".join(tl["nodes"]) or "(none)")
+    )
+    j = tl["joins"]
+    if j["sends"]:
+        add(
+            "wire joins: %d/%d sends joined (%.2f%%), %d trace_link rows"
+            % (j["joined"], j["sends"], 100.0 * j["frac"], j["trace_links"])
+        )
+    c = tl["chains"]
+    if c["committed"]:
+        add(
+            "tx chains: %d/%d committed txs with complete admit->ack chain (%.2f%%)"
+            % (c["complete"], c["committed"], 100.0 * c["complete_frac"])
+        )
+    if tl["epochs"]:
+        add("")
+        add("epoch  nodes  txs  hop walls (ms)")
+        for e in tl["epochs"]:
+            hops = "  ".join(
+                "%s %.1f" % (k.replace("_to_", ">"), v * 1000.0)
+                for k, v in e["hops"].items()
+            )
+            a2a = e.get("admit_to_ack")
+            if a2a:
+                hops += "  admit>ack p50 %.1f max %.1f" % (
+                    a2a["p50"] * 1000.0,
+                    a2a["max"] * 1000.0,
+                )
+            add(
+                "%5d  %5d  %3d  %s"
+                % (e["epoch"], e["commit_nodes"], e["txs"], hops or "(no hops)")
+            )
+    add("")
+    add("health:")
+    for r in tl["health"]:
+        val = "absent" if r["value"] is None else "%g" % r["value"]
+        add(
+            "  [%-6s] %-22s %s %s %g (value: %s)"
+            % (r["status"], r["rule"], r["selector"], r["op"], r["threshold"], val)
+        )
+    add("overall: %s" % ("OK" if tl["ok"] else "VIOLATIONS"))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m hbbft_tpu.obs.timeline", description=__doc__
+    )
+    p.add_argument("trace", nargs="+", help="trace/flight/fleet JSONL files")
+    p.add_argument("--json", action="store_true")
+    p.add_argument(
+        "--rules", default=None, help="rule file (default: built-in SLO set)"
+    )
+    p.add_argument(
+        "--min-join",
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="fail unless the wire-join fraction reaches FRAC "
+        "(unlike the rule, absent joins also fail)",
+    )
+    args = p.parse_args(argv)
+    rules = parse_rules(args.rules) if args.rules else None
+    tl = build(args.trace, rules)
+    if args.min_join is not None:
+        frac = tl["joins"]["frac"]
+        if frac is None or frac < args.min_join:
+            tl["ok"] = False
+            tl["health"].append(
+                {
+                    "rule": "min-join(cli)",
+                    "selector": "join:frac",
+                    "op": ">=",
+                    "threshold": args.min_join,
+                    "value": frac,
+                    "status": "FAIL",
+                }
+            )
+    try:
+        if args.json:
+            print(json.dumps(tl, indent=2, sort_keys=True))
+        else:
+            print(render(tl))
+    except BrokenPipeError:
+        sys.stderr.close()
+    return 0 if tl["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

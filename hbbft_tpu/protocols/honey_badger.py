@@ -322,6 +322,16 @@ class HoneyBadger(DistAlgorithm):
                 )
             ciphertexts[proposer_id] = ciphertext
         self.ciphertexts[epoch] = ciphertexts
+        rec = _obs.ACTIVE
+        if rec is not None:
+            # the ACS→decrypt boundary of the fleet commit timeline:
+            # the subset is agreed, decryption shares go out now
+            rec.event(
+                "acs_done",
+                node=str(self.netinfo.our_id),
+                epoch=epoch,
+                proposers=len(ciphertexts),
+            )
         if epoch == self.epoch:
             step.extend(self._try_output_batches())
         return step

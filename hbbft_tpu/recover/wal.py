@@ -278,10 +278,20 @@ class WalWriter:
             self._f.flush()
             self._size += len(rec)
             self._records += 1
+            records = self._records
             if self._fsync == "always":
                 os.fsync(self._f.fileno())
             else:
                 self._dirty += 1
+        # emitted AFTER releasing _lock (the compact() pattern): the
+        # recorder takes its own lock and may mirror into a flight
+        # ring.  ``records`` is the log's high-water mark — what the
+        # flight-recorder crash test joins against the on-disk WAL.
+        obs_rec = _obs.ACTIVE
+        if obs_rec is not None:
+            obs_rec.event(
+                "wal_append", records=records, kind=kind, path=self.path
+            )
 
     def append_checkpoint(
         self, state_bytes: bytes, meta: Optional[Dict[str, Any]] = None

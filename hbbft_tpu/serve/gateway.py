@@ -313,7 +313,9 @@ class GatewayCore:
 
     def drain(self, max_n: int) -> List[bytes]:
         """Admitted transactions for the next gossip relay, weighted
-        fairly across tenants; emits the queue-depth timeline row."""
+        fairly across tenants; emits the queue-depth timeline row and
+        — when the drain is non-empty — the ``gossip_relay`` hop of
+        the fleet commit timeline (admit → gossip)."""
         batch = self.admission.take(max_n)
         rec = _obs.ACTIVE
         if rec is not None:
@@ -322,6 +324,12 @@ class GatewayCore:
                 depth=self.admission.total_depth(),
                 pending=len(self.pending),
             )
+            if batch:
+                rec.event(
+                    "gossip_relay",
+                    txs=len(batch),
+                    depth=self.admission.total_depth(),
+                )
         return batch
 
     def on_committed(
@@ -348,6 +356,8 @@ class GatewayCore:
                 latency_s=latency,
                 tenant=p.tenant,
                 epoch=ep,
+                client=p.client_id,
+                seq=p.seq,
             )
             rec.observe("gateway.commit_latency_s", latency)
         return p.conn_id, CommitAck(p.seq, ep), latency
@@ -453,6 +463,7 @@ class Gateway:
         flush_interval: float = 0.005,
         max_frame: int = CLIENT_MAX_FRAME,
         clock: Optional[Callable[[], float]] = None,
+        metrics_addr: Optional[str] = None,
     ):
         self.node = node
         self.core = core if core is not None else GatewayCore()
@@ -467,6 +478,11 @@ class Gateway:
         self._server: Optional[asyncio.base_events.Server] = None
         self._pump_task: Optional[asyncio.Task] = None
         self._closing = False
+        # live metrics exposition beside the client listener
+        # (``host:port``; port 0 binds ephemerally — read the bound
+        # address off ``self.metrics`` after start())
+        self.metrics_addr = metrics_addr
+        self.metrics: Optional[Any] = None
         node.on_output = self._on_batch
 
     def _now(self) -> float:
@@ -479,6 +495,14 @@ class Gateway:
         self._server = await asyncio.start_server(
             self._serve_client, host, int(port)
         )
+        if self.metrics_addr is not None:
+            from ..obs.metrics import MetricsCore, MetricsExporter
+
+            mhost, mport = self.metrics_addr.rsplit(":", 1)
+            self.metrics = MetricsExporter(
+                MetricsCore(node=self.node.our_addr), mhost, int(mport)
+            )
+            await self.metrics.start()
         self._pump_task = asyncio.ensure_future(self._pump())
 
     async def run(self, until=None, timeout: Optional[float] = None) -> List[Any]:
@@ -494,6 +518,9 @@ class Gateway:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self.metrics is not None:
+            await self.metrics.stop()
+            self.metrics = None
         await self.node.close()
 
     # -- client side ---------------------------------------------------------

@@ -280,6 +280,8 @@ async def _run_tcp_async(
     grace_s: float = 20.0,
     flush_interval: float = 0.005,
     idle_timeout: float = 30.0,
+    metrics_addr: Optional[str] = None,
+    mid_run: Optional[Any] = None,
 ) -> Dict[str, Any]:
     from ..transport.tcp import TcpNode
 
@@ -303,6 +305,7 @@ async def _run_tcp_async(
         core=core,
         idle_timeout=idle_timeout,
         flush_interval=flush_interval,
+        metrics_addr=metrics_addr,
     )
     await asyncio.gather(*(node.start() for node in nodes))
     await gateway.start()
@@ -357,7 +360,19 @@ async def _run_tcp_async(
                     )
                 )
             )
+    mid_task = None
+    if mid_run is not None:
+        # fleet-telemetry hook: awaited while the load is live (half
+        # way through the run), with the serving pieces in hand — the
+        # scenario scrapes metrics endpoints here
+        async def _mid() -> None:
+            await asyncio.sleep(duration_s * 0.5)
+            await mid_run(gateway, nodes)
+
+        mid_task = asyncio.ensure_future(_mid())
     await asyncio.gather(*client_tasks)
+    if mid_task is not None:
+        await mid_task
     wall = loop.time() - t0
     sampler.cancel()
     for rt in run_tasks:
@@ -471,8 +486,12 @@ def run_vector(
         core.on_submit(conn, loads(buf[LEN_BYTES:]), now)
         submitted += 1
 
+    hop_gossip: List[float] = []
+    hop_commit: List[float] = []
+    hop_ack: List[float] = []
     for e in range(epochs):
-        now = time.perf_counter() - t0
+        t_admit = time.perf_counter()
+        now = t_admit - t0
         for t in tenants:
             lam = arrivals_per_epoch * t.weight
             if t.arrival == "bursty":
@@ -483,12 +502,22 @@ def run_vector(
                 _push(t, now)
         batch = core.drain(batch_size)
         sim.input_all(batch)
+        t_gossip = time.perf_counter()
         res = sim.run_epoch(dead=dead)
-        now = time.perf_counter() - t0
+        t_commit = time.perf_counter()
+        now = t_commit - t0
         for tx in res.batch.tx_iter():
             r = core.on_committed(tx, res.batch.epoch, now)
             if r is not None:
                 latencies.append(r[2])
+        t_ack = time.perf_counter()
+        # the per-hop walls of the fleet commit timeline, measured at
+        # the epoch driver's own boundaries (admit→gossip = arrivals +
+        # drain, gossip→commit = the consensus epoch, commit→ack = the
+        # ack fan-out)
+        hop_gossip.append(t_gossip - t_admit)
+        hop_commit.append(t_commit - t_gossip)
+        hop_ack.append(t_ack - t_commit)
         timeline.append(
             (e, core.admission.total_depth(), len(core.pending))
         )
@@ -514,6 +543,18 @@ def run_vector(
         "reject_rate": round(core.rejected / max(1, submitted), 4),
         "gateway_drops": core.drops,
         "queue_depth_timeline": timeline[:: max(1, len(timeline) // 50)],
+        "hop_walls_s": {
+            name: {
+                "p50": round(_pct(sorted(vals), 0.50), 6),
+                "p90": round(_pct(sorted(vals), 0.90), 6),
+                "max": round(max(vals), 6) if vals else 0.0,
+            }
+            for name, vals in (
+                ("admit_to_gossip", hop_gossip),
+                ("gossip_to_commit", hop_commit),
+                ("commit_to_ack", hop_ack),
+            )
+        },
     }
 
 

@@ -189,6 +189,26 @@ class SnapDone:
     digest: Any
 
 
+@wire("ObTrace")
+@dataclasses.dataclass(frozen=True)
+class ObTrace:
+    """Observability piggyback (fleet-telemetry PR): the sender's
+    trace context — node id, its outbound trace sequence number, and
+    the highest epoch it has committed — carried as an unsequenced
+    control frame in the existing control plane (additive and
+    manifest-append-only; data frames are unchanged).  The receiver
+    emits a ``trace_link`` row, giving ``obs.timeline`` an explicit
+    cross-process causal edge even when the two nodes' traces live in
+    separate files.  Every field is attacker-controlled: malformed
+    contexts are attributed (``FaultKind.INVALID_MESSAGE`` +
+    ``wire.bad_obtrace``), never crash the pump, and never reach the
+    algorithm."""
+
+    node: Any
+    seq: Any
+    epoch: Any
+
+
 _ST_TYPES = (SnapReq, SnapMeta, SnapChunk, SnapDone)
 
 
@@ -311,6 +331,11 @@ class TcpNode:
         # behaviour — an evicted replay range is a loudly-counted,
         # permanently severed stream.
         self.transfer: Optional[Any] = None
+        # fleet-telemetry trace piggyback: our outbound ObTrace
+        # counter and the highest epoch this node has committed (what
+        # the piggyback advertises to peers)
+        self._ob_seq = 0
+        self._ob_epoch: Optional[int] = None
         if _TRACK_NODE is not None:
             _TRACK_NODE(self)
 
@@ -633,6 +658,41 @@ class TcpNode:
                 if rec is not None:
                     rec.count("wire.unexpected_resume")
                 continue
+            if isinstance(message, ObTrace):
+                # trace piggyback: every field is attacker-controlled.
+                # A malformed context is attributed, never fatal; a
+                # valid one becomes the cross-process causal edge.
+                ep = message.epoch
+                if (
+                    isinstance(message.node, (str, int))
+                    and not isinstance(message.node, bool)
+                    and _seq_ok(message.seq)
+                    and (ep is None or _seq_ok(ep))
+                ):
+                    if rec is not None:
+                        rec.count("wire.obtrace")
+                        if ep is None:
+                            rec.event(
+                                "trace_link",
+                                node=self.our_addr,
+                                peer=message.node,
+                                seq=message.seq,
+                            )
+                        else:
+                            rec.event(
+                                "trace_link",
+                                node=self.our_addr,
+                                peer=message.node,
+                                seq=message.seq,
+                                epoch=ep,
+                            )
+                else:
+                    self.faults.append(
+                        Fault(peer, FaultKind.INVALID_MESSAGE)
+                    )
+                    if rec is not None:
+                        rec.count("wire.bad_obtrace")
+                continue
             if isinstance(message, _ST_TYPES):
                 # state-transfer control plane: unsequenced, handled by
                 # the attached CatchupManager.  A node without one (or
@@ -676,12 +736,27 @@ class TcpNode:
                                 rec.count("wire.st_errors")
                 self._recv_seq[peer] = message.seq
                 self._seq_trail.setdefault(peer, deque()).append(message.seq)
+                recv_seq: Optional[int] = message.seq
                 message = message.msg
             else:
                 # legacy bare frame (pre-resume peer): no seq to ack
                 self._seq_trail.setdefault(peer, deque()).append(0)
+                recv_seq = None
             if rec is not None:
-                rec.event("wire_recv", peer=peer, size=size)
+                # v2 causal-join fields: the receiving endpoint + the
+                # link seq, matching the sender's wire_send row
+                if recv_seq is None:
+                    rec.event(
+                        "wire_recv", peer=peer, size=size, node=self.our_addr
+                    )
+                else:
+                    rec.event(
+                        "wire_recv",
+                        peer=peer,
+                        size=size,
+                        node=self.our_addr,
+                        seq=recv_seq,
+                    )
                 rec.count("wire.recv_frames")
                 rec.count("wire.recv_bytes", size)
             if self.transfer is not None and self.transfer.holding():
@@ -718,17 +793,32 @@ class TcpNode:
     # -- the protocol pump --------------------------------------------------
 
     async def _route(self, step: Step) -> None:
+        rec = _obs.ACTIVE
         for out in step.output:
             self.outputs.append(out)
+            ep = getattr(out, "epoch", None)
+            if type(ep) is int:
+                # one committed batch on this node — the decrypt→commit
+                # hop of the fleet timeline, and the epoch the ObTrace
+                # piggyback advertises from here on
+                self._ob_epoch = ep
+                if rec is not None:
+                    txs = 0
+                    contrib = getattr(out, "contributions", None)
+                    if isinstance(contrib, dict):
+                        for c in contrib.values():
+                            txs += len(c) if isinstance(c, (list, tuple)) else 1
+                    rec.event(
+                        "node_commit", node=self.our_addr, epoch=ep, txs=txs
+                    )
+                    rec.set_epoch(ep)
             if self.on_output is not None:
                 try:
                     self.on_output(out)
                 except Exception:
-                    rec = _obs.ACTIVE
                     if rec is not None:
                         rec.count("wire.output_hook_errors")
         self.faults.extend(step.fault_log)
-        rec = _obs.ACTIVE
         touched = []
         for tm in step.messages:
             if tm.target.is_all:
@@ -754,9 +844,19 @@ class TcpNode:
                             peer=peer,
                             size=len(frame) - _LEN_BYTES,
                             kind=kind,
+                            node=self.our_addr,
+                            seq=seq,
                         )
                         rec.count("wire.sent_frames")
                         rec.count("wire.sent_bytes", len(frame) - _LEN_BYTES)
+        if rec is not None and touched:
+            # piggyback our trace context once per touched peer per
+            # routing round — an unsequenced control frame, so it is
+            # never buffered/replayed and costs nothing when idle
+            self._ob_seq += 1
+            ob = ObTrace(self.our_addr, self._ob_seq, self._ob_epoch)
+            for peer in {p for p, _ in touched}:
+                self.send_control(peer, ob)
         for peer, w in touched:
             try:
                 await w.drain()

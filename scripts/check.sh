@@ -19,6 +19,11 @@
 #   5. gateway smoke — a real-TCP serving run (n=4 validators, 2
 #      tenants x 2 clients); every admitted tx committed exactly once
 #      and acked, zero spurious attributions
+#   6. fleet telemetry — the fleet-telemetry scenario produces trace +
+#      fleet + flight artifacts from a real-TCP run under load, then
+#      the post-mortem timeline CLI re-merges them: exit non-zero on
+#      any health-rule violation or if <99% of the wire-send trace
+#      contexts join to their receive on the far node
 #
 # Each stage runs even if an earlier one failed (you want the full
 # report, not the first stopper), but the exit code is non-zero if ANY
@@ -40,23 +45,23 @@ log() {
 
 rc=0
 
-echo "== [1/5] badgerlint (all rules) ==" | log
+echo "== [1/6] badgerlint (all rules) ==" | log
 python -m hbbft_tpu.analysis 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [2/5] racecheck smoke ==" | log
+echo "== [2/6] racecheck smoke ==" | log
 env JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
   -p no:cacheprovider --racecheck 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [3/5] wire manifest ==" | log
+echo "== [3/6] wire manifest ==" | log
 python -m hbbft_tpu.analysis --select wire-stability 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [4/5] scenarios smoke ==" | log
+echo "== [4/6] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
   --only bad-share --only equivocate --only hostile-clients \
   --only geo-partition-heal --only flash-crowd \
@@ -65,10 +70,23 @@ env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [5/5] gateway smoke ==" | log
+echo "== [5/6] gateway smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.serve.loadgen --smoke 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
+
+echo "== [6/6] fleet telemetry (timeline + health rules) ==" | log
+fleet_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu HBBFT_FLEET_DIR="$fleet_dir" \
+  python -m hbbft_tpu.harness.scenarios --only fleet-telemetry 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.obs.timeline \
+  "$fleet_dir/trace.jsonl" "$fleet_dir/fleet.jsonl" \
+  "$fleet_dir/flight.jsonl" --min-join 0.99 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+rm -rf "$fleet_dir"
 
 if [ "$rc" -eq 0 ]; then
   echo "check: all gates clean" | log

@@ -473,6 +473,22 @@ def test_obs_schema_flags_unknown_event_and_fields():
     assert "missing required field(s) vt" in msgs
 
 
+def test_obs_schema_flags_reserved_trace_fields():
+    """``tn``/``ts``/``te`` are stamped by the Recorder itself — an
+    emit site passing one explicitly would collide with (or spoof) the
+    trace context."""
+    src = """
+        def f(rec):
+            rec.event("epoch_start", epoch=1, vt=0.5, tn="spoof")
+            rec.event("span", name="x", dur=0.1, depth=0, ts=9, te=2)
+    """
+    vs = _lint(src, "harness/fixture.py", select="obs-schema")
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 3
+    for field in ("tn", "ts", "te"):
+        assert f"field '{field}' is a reserved trace-context field" in msgs
+
+
 def test_obs_schema_accepts_valid_and_open_events():
     src = """
         def f(rec, extra):

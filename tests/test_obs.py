@@ -134,6 +134,14 @@ def test_jsonl_roundtrip_every_event_type(tmp_path):
     )
     rec.event("device_op", op="g1_msm", k=4096, engine="device")
     rec.event("fault", fault="1:INVALID_PROOF", node=1, kind="INVALID_PROOF")
+    # fleet-telemetry plane (schema v2)
+    rec.event("wal_append", records=7, kind=1, path="/tmp/x.wal")
+    rec.event("trace_link", node="127.0.0.1:2", peer="127.0.0.1:1", seq=9, epoch=1)
+    rec.event("gossip_relay", txs=3, depth=12)
+    rec.event("acs_done", node="127.0.0.1:2", epoch=1, proposers=4)
+    rec.event("node_commit", node="127.0.0.1:2", epoch=1, txs=3)
+    rec.event("flight_dump", reason="fault", events=64, dropped=0, path="/tmp/f")
+    rec.event("metrics_scrape", node="n0", up=True, families=12, wall=0.004)
     # non-JSON-native values are coerced, not fatal
     rec.event("weird", blob=b"\x00\x01", obj=object(), seq=(1, 2))
     rec.count("c")
@@ -155,6 +163,13 @@ def test_jsonl_roundtrip_every_event_type(tmp_path):
         "flush",
         "device_op",
         "fault",
+        "wal_append",
+        "trace_link",
+        "gossip_relay",
+        "acs_done",
+        "node_commit",
+        "flight_dump",
+        "metrics_scrape",
         "weird",
         "counter",
         "hist",

@@ -1,0 +1,621 @@
+"""Tests for the fleet telemetry plane: trace-context stamping, the
+``ObTrace`` wire piggyback (valid → ``trace_link``, malformed →
+attributed fault), the Prometheus exporter + fleet poller, the flight
+recorder (ring bounds, forced dumps, crash-safe persist mode, a real
+SIGKILL mid-run), the post-mortem timeline (joins, chains, hop walls,
+declarative SLO rules), and the real-TCP n=4 acceptance run — fleet
+scrape + ≥99% complete admit→ack chains + health rules green."""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from hbbft_tpu.obs import fleet as fleet_mod
+from hbbft_tpu.obs import flight as flight_mod
+from hbbft_tpu.obs import metrics as metrics_mod
+from hbbft_tpu.obs import recorder as obs
+from hbbft_tpu.obs import report, timeline
+from hbbft_tpu.recover.wal import read_records
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# trace-context stamping
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_stamped_on_every_row():
+    rec = obs.enable(node="n0")
+    rec.event("epoch_start", epoch=0, vt=0.1)
+    rec.set_epoch(3)
+    rec.event("epoch_decide", epoch=3, node=1, vt=0.9)
+    rows = rec.events
+    assert all(r["tn"] == "n0" for r in rows)
+    seqs = [r["ts"] for r in rows]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert "te" not in rows[1]  # before set_epoch
+    assert rows[-1]["te"] == 3
+    obs.disable()
+
+
+def test_trace_context_absent_without_node():
+    rec = obs.enable()
+    rec.event("epoch_start", epoch=0, vt=0.1)
+    assert "tn" not in rec.events[-1] and "ts" not in rec.events[-1]
+    rec.set_node("late")
+    rec.event("epoch_start", epoch=1, vt=0.2)
+    assert rec.events[-1]["tn"] == "late"
+    obs.disable()
+
+
+def test_set_epoch_rejects_non_int():
+    rec = obs.enable(node="n0")
+    rec.set_epoch(True)
+    rec.set_epoch("7")
+    rec.event("epoch_start", epoch=0, vt=0.1)
+    assert "te" not in rec.events[-1]
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# ObTrace piggyback over the real recv loop
+# ---------------------------------------------------------------------------
+
+
+def _frame(payload: bytes) -> bytes:
+    from hbbft_tpu.transport import tcp as _tcp
+
+    return len(payload).to_bytes(_tcp._LEN_BYTES, "big") + payload
+
+
+def _pump(node, *messages):
+    from hbbft_tpu.core.serialize import dumps
+
+    async def run():
+        reader = asyncio.StreamReader()
+        for m in messages:
+            reader.feed_data(_frame(dumps(m)))
+        reader.feed_eof()
+        await node._recv_loop("peer-under-test", reader)
+
+    asyncio.run(run())
+
+
+def test_obtrace_valid_emits_trace_link():
+    from hbbft_tpu.transport import tcp as _tcp
+
+    rec = obs.enable(node="b")
+    node = _tcp.TcpNode("127.0.0.1:2", ["127.0.0.1:1"], lambda ni: None)
+    _pump(node, _tcp.ObTrace("127.0.0.1:1", 7, 3), _tcp.ObTrace("127.0.0.1:1", 8, None))
+    links = [e for e in rec.events if e["ev"] == "trace_link"]
+    assert len(links) == 2
+    assert links[0]["node"] == "127.0.0.1:2"
+    assert links[0]["peer"] == "127.0.0.1:1"
+    assert links[0]["seq"] == 7 and links[0]["epoch"] == 3
+    assert "epoch" not in links[1]
+    assert rec.counters.get("wire.obtrace") == 2
+    assert node.faults == []
+    obs.disable()
+
+
+def test_obtrace_malformed_attributed_never_fatal():
+    from hbbft_tpu.core.fault import FaultKind
+    from hbbft_tpu.transport import tcp as _tcp
+
+    rec = obs.enable(node="b")
+    node = _tcp.TcpNode("127.0.0.1:2", ["127.0.0.1:1"], lambda ni: None)
+    bad = [
+        _tcp.ObTrace(True, 1, None),  # bool node id
+        _tcp.ObTrace(None, 1, None),  # missing node id
+        _tcp.ObTrace("n", -1, None),  # negative seq
+        _tcp.ObTrace("n", 1, "x"),  # non-int epoch
+        _tcp.ObTrace("n", 2**80, None),  # seq out of range
+    ]
+    _pump(node, *bad, _tcp.ObTrace("n", 5, 0))
+    assert rec.counters.get("wire.bad_obtrace") == len(bad)
+    assert len(node.faults) == len(bad)
+    assert all(f.kind is FaultKind.INVALID_MESSAGE for f in node.faults)
+    # the pump survived all of them and still linked the valid one
+    assert rec.counters.get("wire.obtrace") == 1
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# metrics: render/parse, exporter, fleet poller
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_render_parse_roundtrip():
+    rec = obs.enable(node="n3")
+    rec.count("wire.seq_gap", 2)
+    rec.count("gateway.admitted", 41)
+    for v in (0.1, 0.2, 0.3, 0.4):
+        rec.observe("gateway.commit_latency_s", v)
+    body = metrics_mod.MetricsCore().render()
+    series = metrics_mod.parse(body)
+    assert series['hbbft_wire_seq_gap_total{node="n3"}'] == 2.0
+    assert series['hbbft_gateway_admitted_total{node="n3"}'] == 41.0
+    assert series['hbbft_gateway_commit_latency_s{node="n3",stat="count"}'] == 4.0
+    assert series['hbbft_gateway_commit_latency_s{node="n3",stat="max"}'] == pytest.approx(0.4)
+    assert series['hbbft_obs_events_total{node="n3"}'] >= 1.0
+    obs.disable()
+
+
+def test_metrics_render_with_tracing_off_is_valid():
+    body = metrics_mod.MetricsCore(node="nx").render()
+    assert body.endswith("\n")
+    assert metrics_mod.parse(body) == {}
+
+
+def test_parse_drops_malformed_lines():
+    got = metrics_mod.parse("a 1\nbroken\n# c 2\nd nan-ish-not\ne 2.5\n")
+    assert got == {"a": 1.0, "e": 2.5}
+
+
+def test_exporter_and_fleet_poller(tmp_path):
+    rec = obs.enable(node="n0")
+    rec.count("gateway.admitted", 5)
+    out = tmp_path / "fleet.jsonl"
+
+    async def run():
+        exp_a = metrics_mod.MetricsExporter(metrics_mod.MetricsCore(node="n0"))
+        exp_b = metrics_mod.MetricsExporter(metrics_mod.MetricsCore(node="n1"))
+        await exp_a.start()
+        await exp_b.start()
+        # a dead target: bind a port and close it so nothing listens
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        poller = fleet_mod.FleetPoller(
+            {
+                "n0": exp_a.addr,
+                "n1": exp_b.addr,
+                "dead": ("127.0.0.1", dead_port),
+            },
+            str(out),
+            timeout_s=2.0,
+        )
+        rows = await poller.poll_once()
+        await exp_a.stop()
+        await exp_b.stop()
+        return rows
+
+    rows = asyncio.run(run())
+    by_node = {r["node"]: r for r in rows}
+    assert by_node["n0"]["up"] and by_node["n1"]["up"]
+    assert not by_node["dead"]["up"]
+    agg = fleet_mod.aggregate(rows)
+    assert agg["up"] == 2 and agg["nodes"] == 3
+    # both live nodes exported the shared counter: the sum sees 2x
+    assert agg["totals"]["hbbft_gateway_admitted_total"] == 10.0
+    # the JSONL artifact round-trips through the report loader
+    disk = report.load_events(str(out))
+    assert len(disk) == 3 and all(r["ev"] == "metrics_scrape" for r in disk)
+    # live metrics_scrape rows were emitted into the active trace too
+    scraped = [e for e in rec.events if e["ev"] == "metrics_scrape"]
+    assert len(scraped) == 3
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounds_and_dump(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    fl = flight_mod.FlightRecorder(str(path), capacity=8, node="n0")
+    for i in range(30):
+        fl.record({"ev": "x", "t": i * 0.1, "i": i})
+    fl.dump("test")
+    rows, meta = flight_mod.load(str(path))
+    assert meta["reason"] == "test"
+    assert meta["events"] == 8 and meta["dropped"] == 22
+    assert [r["i"] for r in rows] == list(range(22, 30))
+    fl.close()
+
+
+def test_fault_event_triggers_flight_dump(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    rec = obs.enable(node="n0")
+    fl = flight_mod.FlightRecorder(str(path), capacity=16, node="n0")
+    rec.attach_flight(fl)
+    rec.event("epoch_start", epoch=0, vt=0.1)
+    assert not path.exists()
+    rec.event("fault", fault="1:INVALID_MESSAGE", node=1, kind="INVALID_MESSAGE")
+    assert path.exists()
+    rows, meta = flight_mod.load(str(path))
+    assert meta["reason"] == "fault"
+    assert any(r["ev"] == "fault" for r in rows)
+    # the dump itself is announced in the live trace
+    assert any(e["ev"] == "flight_dump" for e in rec.events)
+    fl.close()
+    obs.disable()
+
+
+def test_flight_persist_write_through(tmp_path):
+    dump = tmp_path / "flight.jsonl"
+    persist = tmp_path / "flight.persist.jsonl"
+    fl = flight_mod.FlightRecorder(
+        str(dump), capacity=8, node="n0", persist=str(persist)
+    )
+    for i in range(5):
+        fl.record({"ev": "x", "t": float(i), "i": i})
+    # NO dump, NO close — the persist file must already hold every row
+    rows, meta = flight_mod.load(str(persist))
+    assert meta is None
+    assert [r["i"] for r in rows] == list(range(5))
+    fl.close()
+
+
+def test_flight_persist_compacts_to_ring_bound(tmp_path):
+    persist = tmp_path / "p.jsonl"
+    fl = flight_mod.FlightRecorder(
+        str(tmp_path / "d.jsonl"), capacity=10, node="n0", persist=str(persist)
+    )
+    for i in range(200):
+        fl.record({"ev": "x", "t": float(i), "i": i})
+    rows, _ = flight_mod.load(str(persist))
+    # bounded: compaction keeps the file within 4x the ring capacity
+    assert len(rows) <= 40
+    assert rows[-1]["i"] == 199
+    fl.close()
+
+
+_SIGKILL_CHILD = r"""
+import asyncio, random, sys
+from hbbft_tpu.obs import flight as flight_mod
+from hbbft_tpu.obs import recorder as obs
+from hbbft_tpu.protocols.honey_badger import HoneyBadger
+from hbbft_tpu.recover.driver import durable_tcp_node
+
+our, wal_path, persist_path = sys.argv[1], sys.argv[2], sys.argv[3]
+peers = sys.argv[4:]
+rec = obs.enable(node=our)
+fl = flight_mod.FlightRecorder(
+    persist_path + ".dump", capacity=256, node=our, persist=persist_path
+)
+rec.attach_flight(fl)
+node = durable_tcp_node(
+    our, peers, lambda ni: HoneyBadger(ni, rng=random.Random("sk-%s" % ni.our_id)),
+    wal_path, fsync="off",
+)
+
+async def main():
+    await node.start(mesh_timeout=15)
+    await node.input([b"victim-e0"])
+    await node.run(until=lambda nd: len(nd.outputs) >= 1, timeout=60)
+    print("EPOCH0-COMMITTED", flush=True)
+    # keep serving until the parent SIGKILLs us
+    await node.run(until=lambda nd: len(nd.outputs) >= 10**6, timeout=600)
+
+asyncio.run(main())
+"""
+
+
+def _free_addrs(k):
+    socks = []
+    for _ in range(k):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    addrs = sorted("127.0.0.1:%d" % s.getsockname()[1] for s in socks)
+    for s in socks:
+        s.close()
+    return addrs
+
+
+def test_flight_survives_sigkill(tmp_path):
+    """A real-TCP node in a separate process is SIGKILLed mid-run; its
+    write-through flight file must be complete and parseable, and its
+    last ``wal_append`` row must match the WAL's on-disk high-water
+    mark — the flight recorder is trustworthy evidence after a crash
+    the process never saw coming."""
+    import random
+
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger
+    from hbbft_tpu.transport.tcp import TcpNode
+
+    addrs = _free_addrs(4)
+    victim = addrs[0]  # smallest addr dials every peer itself
+    peers = [a for a in addrs if a != victim]
+    wal_path = str(tmp_path / "victim.wal")
+    persist_path = str(tmp_path / "victim.flight.jsonl")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _SIGKILL_CHILD, victim, wal_path, persist_path]
+        + peers,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+    )
+
+    def new_algo(ni):
+        return HoneyBadger(ni, rng=random.Random("sk-%s" % ni.our_id))
+
+    async def run():
+        nodes = {
+            a: TcpNode(a, [x for x in addrs if x != a], new_algo)
+            for a in peers
+        }
+        await asyncio.gather(
+            *(nd.start(mesh_timeout=30) for nd in nodes.values())
+        )
+        for i, a in enumerate(peers):
+            await nodes[a].input([b"peer-e0-%d" % i])
+        await asyncio.gather(
+            *(
+                nodes[a].run(until=lambda nd: len(nd.outputs) >= 1, timeout=120)
+                for a in peers
+            )
+        )
+        await asyncio.gather(*(nd.close() for nd in nodes.values()))
+
+    try:
+        asyncio.run(run())
+        # wait for the victim to report its commit, let its tail settle,
+        # then kill it with no warning whatsoever
+        line = child.stdout.readline()
+        assert "EPOCH0-COMMITTED" in line, (
+            line + (child.stderr.read() or "")
+        )
+        time.sleep(0.5)
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)
+    assert child.returncode == -signal.SIGKILL
+
+    rows, _meta = flight_mod.load(persist_path)
+    assert rows, "flight persist file is empty"
+    # complete & parseable: every row is a dict with the stamp
+    assert all(r.get("tn") == victim for r in rows)
+    seqs = [r["ts"] for r in rows]
+    assert seqs == sorted(seqs)
+    wal_rows = [r for r in rows if r.get("ev") == "wal_append"]
+    assert wal_rows, "no wal_append rows reached the flight recorder"
+    on_disk, _clean = read_records(wal_path)
+    assert wal_rows[-1]["records"] == len(on_disk)
+    # the victim's trace rows include real wire traffic with the causal
+    # join fields — the post-mortem can splice this node back in
+    sends = [r for r in rows if r.get("ev") == "wire_send"]
+    assert sends and all("seq" in r and r["node"] == victim for r in sends)
+
+
+# ---------------------------------------------------------------------------
+# timeline: joins, chains, rules
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def test_timeline_wire_joins_and_chains(tmp_path):
+    a = _write_jsonl(
+        tmp_path / "a.jsonl",
+        [
+            {"ev": "trace_start", "t": 0.0, "wall_unix": 100.0, "schema": 2},
+            {"ev": "gateway_admit", "t": 0.01, "client": "c0", "seq": 1,
+             "tenant": "t", "depth": 1},
+            {"ev": "wire_send", "t": 0.02, "node": "A", "peer": "B", "seq": 1,
+             "size": 10, "kind": "SeqData"},
+            {"ev": "wire_send", "t": 0.03, "node": "A", "peer": "B", "seq": 2,
+             "size": 10, "kind": "SeqData"},
+            {"ev": "gossip_relay", "t": 0.025, "txs": 1},
+            {"ev": "client_commit_latency", "t": 0.30, "latency_s": 0.29,
+             "client": "c0", "seq": 1, "epoch": 0, "tenant": "t"},
+            {"ev": "client_commit_latency", "t": 0.31, "latency_s": 0.30,
+             "client": "c9", "seq": 4, "epoch": 0, "tenant": "t"},
+        ],
+    )
+    b = _write_jsonl(
+        tmp_path / "b.jsonl",
+        [
+            {"ev": "trace_start", "t": 0.0, "wall_unix": 100.05, "schema": 2},
+            {"ev": "wire_recv", "t": 0.0, "node": "B", "peer": "A", "seq": 1,
+             "size": 10},
+            {"ev": "acs_done", "t": 0.1, "node": "B", "epoch": 0},
+            {"ev": "node_commit", "t": 0.2, "node": "B", "epoch": 0, "txs": 2},
+        ],
+    )
+    tl = timeline.build([a, b])
+    assert tl["joins"]["sends"] == 2 and tl["joins"]["joined"] == 1
+    # chain c0/1 is complete; c9/4 has no admit → incomplete
+    assert tl["chains"]["committed"] == 2 and tl["chains"]["complete"] == 1
+    assert tl["chains"]["incomplete_sample"][0]["client"] == "c9"
+    assert tl["nodes"] == ["B"]
+    (epoch,) = tl["epochs"]
+    assert epoch["epoch"] == 0 and epoch["commit_nodes"] == 1
+    assert epoch["txs"] == 2
+    # hop walls exist and respect the wall-clock anchors
+    assert epoch["hops"]["admit_to_gossip"] == pytest.approx(0.015)
+    assert "gossip_to_acs" in epoch["hops"]
+    assert "acs_to_commit" in epoch["hops"]
+    assert "commit_to_ack" in epoch["hops"]
+    # the 50% join rate and 50% chain rate trip the default rules
+    failed = {r["rule"] for r in tl["health"] if r["status"] == "FAIL"}
+    assert {"chain-complete", "trace-joins"} <= failed
+    assert not tl["ok"]
+
+
+def test_timeline_flight_dump_borrows_anchor_and_dedupes(tmp_path):
+    # A flight dump has no trace_start row: its rows reuse the live
+    # recorder's relative t.  The merge must borrow the trace file's
+    # wall anchor via the shared (tn, ts) identity and collapse the
+    # mirrored copies — otherwise a hop pairing a raw-clock row with an
+    # anchored one puts ~the unix epoch into the wall diff.
+    trace = _write_jsonl(
+        tmp_path / "trace.jsonl",
+        [
+            {"ev": "trace_start", "t": 0.0, "wall_unix": 1.7e9, "schema": 2},
+            {"ev": "acs_done", "t": 5.0, "node": "n0", "epoch": 0,
+             "tn": "n0", "ts": 1, "te": 0},
+            {"ev": "node_commit", "t": 5.5, "node": "n0", "epoch": 0,
+             "txs": 1, "tn": "n0", "ts": 2, "te": 0},
+        ],
+    )
+    flight = _write_jsonl(
+        tmp_path / "flight.jsonl",
+        [
+            # mirrored copy of ts=2 plus a ring-only row the trace lacks
+            {"ev": "node_commit", "t": 5.5, "node": "n0", "epoch": 1,
+             "txs": 1, "tn": "n0", "ts": 2, "te": 0},
+            {"ev": "acs_done", "t": 6.0, "node": "n0", "epoch": 1,
+             "tn": "n0", "ts": 3, "te": 1},
+        ],
+    )
+    rows = timeline.merge([trace, flight])
+    commits = [r for r in rows if r["ev"] == "node_commit"]
+    assert len(commits) == 1  # mirrored copy deduped by (tn, ts)
+    by_ts = {r["ts"]: r for r in rows if "ts" in r}
+    # flight-only row sits on the borrowed anchor, not raw t
+    assert by_ts[3]["_wall"] == pytest.approx(1.7e9 + 6.0)
+    tl = timeline.build([trace, flight])
+    (epoch0,) = [e for e in tl["epochs"] if e["epoch"] == 0]
+    assert epoch0["hops"]["acs_to_commit"] == pytest.approx(0.5)
+
+
+def test_timeline_rules_counters_and_absent(tmp_path):
+    p = _write_jsonl(
+        tmp_path / "t.jsonl",
+        [
+            {"ev": "trace_start", "t": 0.0, "wall_unix": 1.0, "schema": 2},
+            {"ev": "counter", "t": 1.0, "name": "wire.seq_gap", "value": 3},
+            {"ev": "hist", "t": 1.0, "name": "reveal.lag_s", "count": 2,
+             "min": 0.1, "p50": 0.5, "p90": 2.0, "max": 2.0, "sum": 2.1},
+        ],
+    )
+    tl = timeline.build([p])
+    by_rule = {r["rule"]: r for r in tl["health"]}
+    assert by_rule["wire-seq-gap"]["status"] == "FAIL"
+    assert by_rule["wire-seq-gap"]["value"] == 3.0
+    assert by_rule["reveal-lag-p90"]["status"] == "FAIL"  # p90=2.0 > 1.0
+    assert by_rule["wire-replay-evicted"]["status"] == "absent"
+    assert by_rule["spec-combine-misses"]["status"] == "absent"
+    assert not tl["ok"]
+
+
+def test_timeline_custom_rules_and_cli(tmp_path):
+    p = _write_jsonl(
+        tmp_path / "t.jsonl",
+        [
+            {"ev": "trace_start", "t": 0.0, "wall_unix": 1.0, "schema": 2},
+            {"ev": "counter", "t": 1.0, "name": "gateway.admitted", "value": 7},
+        ],
+    )
+    rules = tmp_path / "slo.rules"
+    rules.write_text(
+        "# comment\n"
+        "admitted counter:gateway.admitted >= 5\n"
+        "scrapes event_count:metrics_scrape <= 0\n"
+    )
+    parsed = timeline.parse_rules(str(rules))
+    assert parsed == [
+        ("admitted", "counter:gateway.admitted", ">=", 5.0),
+        ("scrapes", "event_count:metrics_scrape", "<=", 0.0),
+    ]
+    assert timeline.main([p, "--rules", str(rules)]) == 0
+    # default rules also pass on this quiet trace...
+    assert timeline.main([p]) == 0
+    # ...but --min-join fails it: there are no joinable sends at all
+    assert timeline.main([p, "--min-join", "0.99"]) == 1
+    bad = tmp_path / "bad.rules"
+    bad.write_text("just two\n")
+    with pytest.raises(ValueError):
+        timeline.parse_rules(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# report: multi-file + unknown event tolerance (schema minors)
+# ---------------------------------------------------------------------------
+
+
+def test_report_merges_multiple_traces_and_tolerates_unknown(tmp_path):
+    a = _write_jsonl(
+        tmp_path / "a.jsonl",
+        [
+            {"ev": "trace_start", "t": 0.0, "wall_unix": 1.0, "schema": 2},
+            {"ev": "epoch", "t": 1.0, "epoch": 0, "min_time": 0.1,
+             "max_time": 0.2, "txs": 4, "msgs_per_node": 2,
+             "bytes_per_node": 64},
+        ],
+    )
+    b = _write_jsonl(
+        tmp_path / "b.jsonl",
+        [
+            {"ev": "trace_start", "t": 0.0, "wall_unix": 2.0, "schema": 2},
+            {"ev": "from_the_future", "t": 0.5, "payload": 1},
+            {"ev": "epoch", "t": 1.0, "epoch": 1, "min_time": 0.1,
+             "max_time": 0.3, "txs": 2, "msgs_per_node": 2,
+             "bytes_per_node": 32},
+        ],
+    )
+    events = report.load_many([a, b])
+    s = report.summarize(events)
+    assert s["epochs"]["count"] == 2
+    assert s["unknown_events"] == {"from_the_future": 1}
+    text = report.render(s)
+    assert "from_the_future" in text
+    # the CLI accepts multiple positional traces
+    assert report.main([a, b]) == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the fleet-telemetry scenario (real TCP, n=4, under load)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_telemetry_scenario_end_to_end(tmp_path, monkeypatch):
+    """The acceptance gate: a real-TCP n=4 run under client load must
+    produce a scraped fleet metrics snapshot, a merged timeline where
+    ≥99% of committed txs have a complete admit→ack hop chain, and a
+    flight artifact — all re-verified here over the on-disk files."""
+    from hbbft_tpu.harness.scenarios import ScenarioConfig, run_scenario
+
+    out = tmp_path / "fleet"
+    monkeypatch.setenv("HBBFT_FLEET_DIR", str(out))
+    res = run_scenario("fleet-telemetry", ScenarioConfig(seed=0xFEE7))
+    assert res.ok, res.detail
+
+    trace = str(out / "trace.jsonl")
+    fleet = str(out / "fleet.jsonl")
+    flight = str(out / "flight.jsonl")
+    for p in (trace, fleet, flight):
+        assert os.path.exists(p), p
+
+    scrapes = report.load_events(fleet)
+    assert len(scrapes) == 4 and all(r["up"] for r in scrapes)
+
+    _rows, meta = flight_mod.load(flight)
+    assert meta is not None and meta["reason"] == "scenario-end"
+
+    tl = timeline.build([trace, fleet, flight])
+    assert tl["ok"], [r for r in tl["health"] if r["status"] == "FAIL"]
+    assert tl["chains"]["complete_frac"] >= 0.99
+    assert tl["joins"]["frac"] >= 0.99
+    assert tl["chains"]["committed"] > 0
+    assert tl["epochs"], "no committed epochs in the timeline"
+    # every epoch entry carries at least one established hop wall
+    assert any(e["hops"] for e in tl["epochs"])

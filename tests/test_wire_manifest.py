@@ -186,6 +186,16 @@ def _samples():
         "SrvSubmitAck": cls["SrvSubmitAck"](42, False, 50, "tenant-full"),
         "SrvCommitAck": cls["SrvCommitAck"](42, 3),
         "SrvGossip": cls["SrvGossip"]((b"tx-a", b"tx-b")),
+        # transport (session resumption + state transfer + telemetry)
+        "RsHello": cls["RsHello"]("127.0.0.1:7001", 5),
+        "RsWelcome": cls["RsWelcome"](5),
+        "RsData": cls["RsData"](7, b"payload"),
+        "RsAck": cls["RsAck"](7),
+        "StReq": cls["StReq"](0, 3, False),
+        "StMeta": cls["StMeta"](0, 3, b"\x11" * 32, 1024, 1),
+        "StChunk": cls["StChunk"](0, 0, b"chunk-bytes"),
+        "StDone": cls["StDone"](3, b"\x11" * 32),
+        "ObTrace": cls["ObTrace"]("127.0.0.1:7001", 42, 3),
     }
     return manifest, samples
 
