@@ -9,6 +9,8 @@
     python -m hbbft_tpu.analysis --write-wire-manifest  # pin @wire registry
     python -m hbbft_tpu.analysis --racecheck tests/test_racecheck.py
                                   # runtime lockset checker over pytest
+    python -m hbbft_tpu.analysis --stallcheck tests/test_stallcheck.py
+                                  # event-loop stall sanitizer over pytest
 
 Exit codes: 0 clean (baselined violations allowed), 1 new violations
 or parse errors, 2 usage error.
@@ -106,11 +108,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         "(hbbft_tpu.analysis.racecheck) and render its candidate races "
         "like lint violations",
     )
+    parser.add_argument(
+        "--stallcheck",
+        metavar="TEST_EXPR",
+        help="run `pytest --stallcheck TEST_EXPR` in a subprocess under "
+        "the event-loop stall sanitizer (hbbft_tpu.analysis.stallcheck) "
+        "and render its stall reports like lint violations",
+    )
+    parser.add_argument(
+        "--stall-budget",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="stallcheck budget in seconds (default: "
+        "$HBBFT_TPU_STALLCHECK_BUDGET or 0.25)",
+    )
     args = parser.parse_args(argv)
     fmt = args.format or ("json" if args.json else "human")
 
     if args.racecheck is not None:
         return _run_racecheck(args.racecheck, fmt)
+    if args.stallcheck is not None:
+        return _run_stallcheck(args.stallcheck, fmt, args.stall_budget)
 
     rules = all_rules()
     if args.list_rules:
@@ -339,6 +358,74 @@ def _run_racecheck(test_expr: str, fmt: str) -> int:
             print(f"\n{len(violations)} candidate race(s)")
         else:
             print("racecheck clean")
+    return 1 if (violations or proc.returncode) else 0
+
+
+def _run_stallcheck(
+    test_expr: str, fmt: str, budget_s: Optional[float] = None
+) -> int:
+    """Drive ``pytest --stallcheck`` in a subprocess (the
+    ``Handle._run`` patch must live in the process that runs the
+    tests), collect the JSONL report and render the stalls with the
+    usual formatters."""
+    import shlex
+    import subprocess
+    import tempfile
+
+    from . import stallcheck as _sc
+
+    repo_root = os.path.dirname(os.path.dirname(_HERE))
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "stallcheck.jsonl")
+        env = dict(os.environ)
+        env[_sc.OUT_ENV] = out
+        if budget_s is not None:
+            env[_sc.BUDGET_ENV] = str(budget_s)
+        cmd = [
+            sys.executable,
+            "-m",
+            "pytest",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            "--stallcheck",
+            *shlex.split(test_expr),
+        ]
+        proc = subprocess.run(cmd, env=env, cwd=repo_root)
+        reports = _sc.load_reports(out)
+
+    violations = [r.as_violation() for r in reports]
+    if fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "violations": [v.as_dict() for v in violations],
+                    "pytest_exit": proc.returncode,
+                    "ok": not violations and proc.returncode == 0,
+                },
+                indent=2,
+            )
+        )
+    elif fmt == "sarif":
+
+        class _ScRule:
+            name = "stallcheck"
+            description = (
+                "event-loop stall sanitizer: no callback blocks the "
+                "loop past the budget"
+            )
+
+        print(json.dumps(_sarif(violations, [], [_ScRule()]), indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+            for hop_path, hop_line, note in v.flow or ():
+                print(f"    flow: {hop_path}:{hop_line}: {note}")
+        if violations:
+            print(f"\n{len(violations)} stall(s)")
+        else:
+            print("stallcheck clean")
     return 1 if (violations or proc.returncode) else 0
 
 

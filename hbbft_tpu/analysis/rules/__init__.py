@@ -33,6 +33,18 @@
                           passes a dominating validator before keying
                           state, entering crypto, sizing allocations,
                           or recursing
+``async-blocking``        no blocking call (sync IO/sleep, fsync,
+                          subprocess, threshold crypto, WAL appends,
+                          device fetches) reachable from a serving-plane
+                          coroutine without a ``run_in_executor``/
+                          ``to_thread`` hop
+``task-leak``             ``create_task``/``ensure_future`` results are
+                          retained and awaited, gathered, or cancelled
+                          on the shutdown path
+``await-holding-lock``    no ``await`` while holding a threading lock;
+                          no blocking call while holding an asyncio lock
+``cancellation-safety``   ``CancelledError`` is never swallowed and
+                          ``finally``-block awaits are ``shield()``\\ ed
 ========================  ==================================================
 """
 
@@ -41,7 +53,10 @@ from __future__ import annotations
 from typing import List
 
 from ..core import Rule
+from .async_blocking import AsyncBlockingRule
 from .atomic_cache import AtomicCacheRule
+from .await_holding_lock import AwaitHoldingLockRule
+from .cancellation_safety import CancellationSafetyRule
 from .determinism import DeterminismRule
 from .device_sync import DeviceSyncRule
 from .dtype_width import DtypeWidthRule
@@ -51,6 +66,7 @@ from .obs_schema import ObsSchemaRule
 from .ordering import OrderedIterRule
 from .pallas_shape import PallasShapeRule
 from .step_purity import StepPurityRule
+from .task_leak import TaskLeakRule
 from .thread_shared_state import ThreadSharedStateRule
 from .wire_stability import WireStabilityRule
 from .wire_taint import WireTaintRule
@@ -72,4 +88,8 @@ def all_rules() -> List[Rule]:
         LockOrderRule(),
         AtomicCacheRule(),
         WireTaintRule(),
+        AsyncBlockingRule(),
+        TaskLeakRule(),
+        AwaitHoldingLockRule(),
+        CancellationSafetyRule(),
     ]

@@ -1,0 +1,300 @@
+"""Shared async-safety inventory for the badgerlint v4 rules.
+
+The four async rules (``async-blocking``, ``task-leak``,
+``await-holding-lock``, ``cancellation-safety``) reason about the same
+two artifacts:
+
+- a **blocking-call table** — the calls that park the OS thread (and
+  therefore the event loop, when issued from a coroutine without an
+  executor hop): sync sleeps and file/socket IO, ``os.fsync``,
+  subprocess spawns, threshold-crypto combine/verify/encrypt (CPU-bound
+  EC math), WAL appends (write+flush+fsync under a ``threading.Lock``),
+  and device fetches;
+- a **coroutine call graph** — edges from every function to every
+  callee that is statically resolvable through
+  :class:`~._dataflow.ProjectIndex` (imports, ``self`` methods, typed
+  ``self.attr`` receivers), plus a deliberately small class-hierarchy
+  fallback for the protocol dispatch seams (``handle_message`` & co.)
+  where the receiver is an untypable ``new_algo(...)`` product.
+
+An executor hop breaks a chain *by construction*: in
+``loop.run_in_executor(None, f, *a)`` / ``asyncio.to_thread(f, *a)``
+the callee ``f`` appears as a plain argument, not a call expression, so
+the graph walk sees no edge into it and anything blocking beneath it is
+sanctioned (it runs on a worker thread).  The taint engine makes the
+*opposite* choice for the same syntax — see
+:func:`~._dataflow.unwrap_executor_call` — because taint crosses
+threads while loop-blocking does not.
+
+Nested ``def``/``lambda`` bodies are never attributed to the enclosing
+function: a closure only blocks whichever thread eventually calls it,
+which the enclosing coroutine's facts cannot know.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ._ast_util import dotted_name
+from . import _dataflow as df
+
+# A flow hop, matching the Violation.flow shape: (relpath, line, note).
+Hop = Tuple[str, int, str]
+
+# -- blocking-call tables -----------------------------------------------------
+
+# Matched on the full dotted name (module-qualified calls whose bare
+# tail would be too generic to trust).
+BLOCKING_FULL: Dict[str, str] = {
+    "time.sleep": "time.sleep() [sync sleep]",
+    "os.fsync": "os.fsync() [disk barrier]",
+    "os.fdatasync": "os.fdatasync() [disk barrier]",
+    "socket.socket": "socket.socket() [sync socket]",
+    "socket.create_connection": "socket.create_connection() [sync connect]",
+    "subprocess.run": "subprocess.run() [child process]",
+    "subprocess.call": "subprocess.call() [child process]",
+    "subprocess.check_call": "subprocess.check_call() [child process]",
+    "subprocess.check_output": "subprocess.check_output() [child process]",
+    "subprocess.Popen": "subprocess.Popen() [child process]",
+}
+
+# Matched on the attribute/call tail regardless of receiver: these
+# names are project-specific enough that any call IS the blocking
+# operation (CPU-bound threshold crypto, WAL appends, device fetches).
+BLOCKING_TAILS: Dict[str, str] = {
+    # threshold crypto: pairing/EC math, milliseconds-to-seconds of CPU
+    "combine_signatures": "threshold combine_signatures() [CPU-bound crypto]",
+    "combine_decryption_shares": (
+        "threshold combine_decryption_shares() [CPU-bound crypto]"
+    ),
+    "combine_decryption_shares_many": (
+        "threshold combine_decryption_shares_many() [CPU-bound crypto]"
+    ),
+    "combine_and_check_decryption_shares": (
+        "threshold combine_and_check_decryption_shares() [CPU-bound crypto]"
+    ),
+    "combine_and_check_decryption_shares_many": (
+        "threshold combine_and_check_decryption_shares_many() "
+        "[CPU-bound crypto]"
+    ),
+    "verify_signature_share": (
+        "threshold verify_signature_share() [CPU-bound crypto]"
+    ),
+    "verify_decryption_share": (
+        "threshold verify_decryption_share() [CPU-bound crypto]"
+    ),
+    "verify_signature": "threshold verify_signature() [CPU-bound crypto]",
+    "encrypt": "threshold encrypt() [CPU-bound crypto]",
+    "decrypt": "threshold decrypt() [CPU-bound crypto]",
+    "decrypt_share": "threshold decrypt_share() [CPU-bound crypto]",
+    "decrypt_share_no_verify": (
+        "threshold decrypt_share_no_verify() [CPU-bound crypto]"
+    ),
+    "decrypt_shares_no_verify_batch": (
+        "threshold decrypt_shares_no_verify_batch() [CPU-bound crypto]"
+    ),
+    # WAL appends: write+flush (+fsync) under a threading.Lock
+    "append_message": "WAL append_message() [disk write under lock]",
+    "append_input": "WAL append_input() [disk write under lock]",
+    "append_checkpoint": (
+        "WAL append_checkpoint() [disk write + possible compaction]"
+    ),
+    # host-device sync
+    "device_get": "jax.device_get() [device fetch]",
+    "block_until_ready": "block_until_ready() [device fetch]",
+}
+
+# The sanctioned offload forms.  ``run_in_executor``/``to_thread`` pass
+# their callee as an argument, so the graph builder naturally creates
+# no edge through them — listed here for the rules/tests that need to
+# name them.
+EXECUTOR_HOPS = ("run_in_executor", "to_thread")
+
+# Dynamic-dispatch seams: call tails that resolve to *every* same-named
+# method in the project when the receiver is untypable (the transport
+# pump's ``self.algo`` is whatever ``new_algo(...)`` returned).  Kept
+# deliberately small and protocol-specific — generic names (``run``,
+# ``close``, ``get``) would manufacture unfixable false chains.
+DYNAMIC_SEAMS = (
+    "handle_message",
+    "handle_input",
+    "propose",
+    "maybe_checkpoint",
+    "install_snapshot",
+    "on_control",
+    "on_gap",
+)
+
+
+def blocking_label(node: ast.Call) -> Optional[str]:
+    """The blocking-table label for a call, or None."""
+    name = dotted_name(node.func)
+    if name is not None and name in BLOCKING_FULL:
+        return BLOCKING_FULL[name]
+    if name == "open":
+        return "open() [sync file IO]"
+    tail = None
+    if name is not None:
+        tail = name.split(".")[-1]
+    elif isinstance(node.func, ast.Attribute):
+        tail = node.func.attr
+    if tail is not None and tail in BLOCKING_TAILS:
+        return BLOCKING_TAILS[tail]
+    return None
+
+
+def own_body_nodes(func_node: ast.AST) -> Iterator[ast.AST]:
+    """Every AST node in the function's own body, nested
+    ``def``/``lambda`` bodies excluded (a closure blocks whoever calls
+    it, not the function that defined it)."""
+    stack: List[ast.AST] = list(func_node.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@dataclasses.dataclass
+class FuncFacts:
+    """Per-function async-safety facts."""
+
+    fi: df.FuncInfo
+    is_coro: bool
+    # direct blocking calls in the own body: (call node, table label)
+    blocking: List[Tuple[ast.Call, str]]
+    # statically-resolved call edges: (call node, callee qualname)
+    edges: List[Tuple[ast.Call, str]]
+
+    def label(self) -> str:
+        return self.fi.qualname.split("::", 1)[1]
+
+
+@dataclasses.dataclass
+class Chain:
+    """One witness path from a coroutine root to a blocking call."""
+
+    root: str  # root qualname
+    # the node in the ROOT function the chain leaves through (the sink
+    # itself when direct) — where the violation anchors
+    anchor: ast.Call
+    hops: Tuple[Hop, ...]
+    sink_label: str
+    sink_relpath: str
+    sink_line: int
+    sink_func: str  # label of the function containing the sink
+
+
+class AsyncGraph:
+    """The whole-project coroutine call graph + blocking facts."""
+
+    def __init__(self, modules: Dict[str, ast.Module]):
+        self.index = df.ProjectIndex(modules)
+        self._seams: Dict[str, List[str]] = {}
+        for qualname in sorted(self.index.functions):
+            fi = self.index.functions[qualname]
+            if fi.node.name in DYNAMIC_SEAMS:
+                self._seams.setdefault(fi.node.name, []).append(qualname)
+        self.facts: Dict[str, FuncFacts] = {}
+        for qualname in sorted(self.index.functions):
+            self.facts[qualname] = self._extract(self.index.functions[qualname])
+
+    def _extract(self, fi: df.FuncInfo) -> FuncFacts:
+        blocking: List[Tuple[ast.Call, str]] = []
+        edges: List[Tuple[ast.Call, str]] = []
+        for n in own_body_nodes(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            label = blocking_label(n)
+            if label is not None:
+                blocking.append((n, label))
+                continue
+            callee = self.index.resolve_call(n.func, fi.relpath, fi.cls, {})
+            if callee is not None:
+                if callee.qualname != fi.qualname:
+                    edges.append((n, callee.qualname))
+                continue
+            name = dotted_name(n.func)
+            tail = (
+                name.split(".")[-1]
+                if name is not None
+                else (n.func.attr if isinstance(n.func, ast.Attribute) else None)
+            )
+            if tail in DYNAMIC_SEAMS:
+                for q in self._seams.get(tail, ()):
+                    if q != fi.qualname:
+                        edges.append((n, q))
+        blocking.sort(key=lambda t: (t[0].lineno, t[0].col_offset))
+        edges.sort(key=lambda t: (t[0].lineno, t[0].col_offset, t[1]))
+        return FuncFacts(
+            fi, isinstance(fi.node, ast.AsyncFunctionDef), blocking, edges
+        )
+
+    def coroutines(self, prefixes: Tuple[str, ...]) -> List[str]:
+        """Qualnames of every coroutine whose module matches a prefix."""
+        return [
+            q
+            for q in sorted(self.facts)
+            if self.facts[q].is_coro
+            and any(self.facts[q].fi.relpath.startswith(p) for p in prefixes)
+        ]
+
+    def blocking_chains(self, root: str, max_depth: int = 40) -> List[Chain]:
+        """Witness paths from ``root`` to every reachable blocking
+        call, one per sink site.  A function already visited on some
+        path is not re-explored (any witness suffices)."""
+        chains: List[Chain] = []
+        visited = {root}
+        rf = self.facts[root]
+        root_hop: Hop = (
+            rf.fi.relpath,
+            rf.fi.node.lineno,
+            f"coroutine {rf.label()}() runs on the event loop",
+        )
+
+        def walk(
+            q: str,
+            anchor: Optional[ast.Call],
+            hops: Tuple[Hop, ...],
+            depth: int,
+        ) -> None:
+            f = self.facts[q]
+            for node, label in f.blocking:
+                chains.append(
+                    Chain(
+                        root=root,
+                        anchor=anchor if anchor is not None else node,
+                        hops=hops
+                        + (
+                            (
+                                f.fi.relpath,
+                                node.lineno,
+                                f"blocking: {label} in {f.label()}()",
+                            ),
+                        ),
+                        sink_label=label,
+                        sink_relpath=f.fi.relpath,
+                        sink_line=node.lineno,
+                        sink_func=f.label(),
+                    )
+                )
+            if depth >= max_depth:
+                return
+            for node, callee in f.edges:
+                if callee in visited:
+                    continue
+                visited.add(callee)
+                cf = self.facts[callee]
+                walk(
+                    callee,
+                    anchor if anchor is not None else node,
+                    hops
+                    + ((f.fi.relpath, node.lineno, f"calls {cf.label()}()"),),
+                    depth + 1,
+                )
+
+        walk(root, None, (root_hop,), 0)
+        return chains

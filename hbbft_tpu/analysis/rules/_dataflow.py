@@ -477,6 +477,25 @@ def _sink_tail(name: Optional[str]) -> Optional[str]:
     return name.split(".")[-1] if name else None
 
 
+def unwrap_executor_call(node: ast.Call) -> Optional[ast.Call]:
+    """``loop.run_in_executor(exec, f, *a)`` / ``asyncio.to_thread(f, *a)``
+    rewritten as the underlying call ``f(*a)`` (same source location), or
+    None when the node is not an executor hop or the callee is not a
+    plain name/attribute expression.  Shared with the async-safety pass
+    so both engines agree on what an offload means."""
+    tail = _sink_tail(dotted_name(node.func))
+    if tail == "run_in_executor" and len(node.args) >= 2:
+        fn, rest = node.args[1], node.args[2:]
+    elif tail == "to_thread" and len(node.args) >= 1:
+        fn, rest = node.args[0], node.args[1:]
+    else:
+        return None
+    if not isinstance(fn, (ast.Name, ast.Attribute)):
+        return None
+    call = ast.Call(func=fn, args=list(rest), keywords=[])
+    return ast.copy_location(call, node)
+
+
 # ---------------------------------------------------------------------------
 # The analyzer
 # ---------------------------------------------------------------------------
@@ -848,6 +867,16 @@ class _FunctionWalker:
     # -- calls ---------------------------------------------------------------
 
     def _eval_Call(self, node: ast.Call) -> Entry:
+        # Executor hops pass the callee as a plain argument:
+        # ``loop.run_in_executor(None, f, *a)`` / ``asyncio.to_thread(f, *a)``
+        # IS a call of ``f(*a)`` on a worker thread.  Rewriting it as that
+        # call keeps every taint sink visible through an offload (the
+        # handler still crashes on a hostile payload whichever thread runs
+        # it) — only the *loop-blocking* property changes, which is the
+        # async-safety pass's concern, not this engine's.
+        unwrapped = unwrap_executor_call(node)
+        if unwrapped is not None:
+            return self._eval_Call(unwrapped)
         name = dotted_name(node.func)
         tail = _sink_tail(name)
         if tail is None and isinstance(node.func, ast.Attribute):

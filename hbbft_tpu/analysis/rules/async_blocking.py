@@ -1,0 +1,113 @@
+"""Rule ``async-blocking`` — no blocking call reachable from a
+serving-plane coroutine without an executor hop.
+
+The serving planes (TCP mesh, gateway front door, metrics exporter,
+fleet poller, restart driver) are single-threaded asyncio: one
+callback that parks the thread — a WAL ``os.fsync``, a threshold-crypto
+combine, a sync ``open()`` — stalls *every* socket, timer, and peer
+link on that node until it returns.  The HoneyBadger liveness argument
+(asynchronous network, f faulty nodes) assumes honest nodes keep
+making progress; a self-inflicted loop stall is indistinguishable from
+a crash to the rest of the mesh.
+
+This is the interprocedural dual of the runtime ``stallcheck``
+sanitizer: a whole-project walk over the coroutine call graph
+(:mod:`._asyncgraph`), flagging every chain
+
+    coroutine root → resolvable/seam call edges → blocking-table call
+
+with no ``run_in_executor``/``asyncio.to_thread`` hop in between.  The
+hop breaks the chain by construction — the offloaded callee appears as
+an argument, not a call — so the sanctioned form needs no special
+casing and no suppression.
+
+Roots are coroutines in the serving planes (``transport/``, ``serve/``,
+``obs/fleet.py``, ``obs/metrics.py``, ``recover/driver.py``); the
+*graph* spans the whole package (the blocking WAL and crypto bodies
+live in ``recover/`` and ``crypto/``), which is why the rule's scope is
+empty — every file feeds the index, and ``--changed`` runs widen on any
+package edit.
+
+Findings anchor at the call in the root coroutine the chain leaves
+through and carry the full root→sink hop path (SARIF ``codeFlows``).
+Being ``finish_run`` findings on real lines, the rule applies
+``# lint: ok(async-blocking)`` suppression itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from ..core import FileContext, Rule, Violation
+from . import _asyncgraph as ag
+
+ROOT_SCOPE = (
+    "transport/",
+    "serve/",
+    "obs/fleet.py",
+    "obs/metrics.py",
+    "recover/driver.py",
+)
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    description = (
+        "no blocking call (sync IO/sleep, os.fsync, subprocess, "
+        "threshold crypto, WAL appends, device fetches) is reachable "
+        "from a serving-plane coroutine without a "
+        "run_in_executor/to_thread hop"
+    )
+    # Empty scope on purpose: roots live in the serving planes, but the
+    # call graph (and therefore the rule's domain) spans the package —
+    # the blocking bodies are in recover/ and crypto/.
+    scope = ()
+    whole_project = True
+
+    def __init__(self) -> None:
+        self._files: Dict[str, FileContext] = {}
+
+    def begin_run(self) -> None:
+        self._files = {}
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        self._files[ctx.relpath] = ctx
+        return ()
+
+    def finish_run(self) -> Iterable[Violation]:
+        if not self._files:
+            return ()
+        modules = {rp: ctx.tree for rp, ctx in self._files.items()}
+        graph = ag.AsyncGraph(modules)
+        out: List[Violation] = []
+        for root in graph.coroutines(ROOT_SCOPE):
+            rf = graph.facts[root]
+            for chain in graph.blocking_chains(root):
+                ctx = self._files.get(rf.fi.relpath)
+                line = chain.anchor.lineno
+                if ctx is not None and ctx.suppressed(self.name, line):
+                    continue
+                via = (
+                    ""
+                    if chain.sink_relpath == rf.fi.relpath
+                    and chain.sink_func == rf.label()
+                    else f" via {chain.sink_func}() ({chain.sink_relpath})"
+                )
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=rf.fi.relpath,
+                        line=line,
+                        col=chain.anchor.col_offset,
+                        message=(
+                            f"coroutine {rf.label()}() reaches blocking "
+                            f"{chain.sink_label}{via} with no "
+                            "run_in_executor/asyncio.to_thread hop — one "
+                            "blocked callback stalls every socket on the "
+                            "node"
+                        ),
+                        flow=chain.hops,
+                    )
+                )
+        out.sort(key=lambda v: (v.path, v.line, v.col, v.message))
+        return out

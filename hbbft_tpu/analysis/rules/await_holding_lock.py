@@ -1,0 +1,124 @@
+"""Rule ``await-holding-lock`` — no suspension while holding the wrong
+kind of lock.
+
+Two dual hazards at the thread/coroutine seam:
+
+- ``with <threading lock>:`` around an ``await`` — the coroutine
+  suspends with the OS lock held.  Every *thread* that wants the lock
+  (the WAL fsync daemon, an executor worker) blocks until the event
+  loop happens to resume this coroutine; if one of those threads is
+  the one the loop is waiting on, that's a deadlock.
+- ``async with <asyncio lock>:`` around a call from the blocking table
+  (:mod:`._asyncgraph`) — the loop itself stalls inside the critical
+  section, so every queued waiter of the lock *and* every other
+  callback stalls with it.  The sanctioned form — holding the asyncio
+  lock across an ``await loop.run_in_executor(...)`` hop — is fine and
+  not flagged: the loop keeps running while the worker thread does the
+  blocking work.
+
+Lock detection is by name: a context expression whose final component
+contains ``lock`` or ``mutex`` (``self._lock``, ``self._algo_lock``,
+``wal_lock.acquire()``…).  The sync/async distinction comes from the
+``with`` vs ``async with`` syntax itself — a ``threading.Lock`` in an
+``async with`` (or vice versa) is a ``TypeError`` at runtime, so the
+statement form is the ground truth for which world the lock lives in.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name, walk_functions
+from ._asyncgraph import blocking_label, own_body_nodes
+
+
+def _lock_name(item: ast.withitem) -> Optional[str]:
+    expr = item.context_expr
+    # `with self._lock.acquire():` style — name the receiver
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    tail = name.split(".")[-1].lower()
+    if "lock" in tail or "mutex" in tail:
+        return name
+    return None
+
+
+def _own_with_body(stmt: ast.AST) -> Iterable[ast.AST]:
+    """Nodes under a with-statement body, nested defs/lambdas excluded."""
+    stack: List[ast.AST] = list(stmt.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class AwaitHoldingLockRule(Rule):
+    name = "await-holding-lock"
+    description = (
+        "no await while holding a threading lock, and no blocking call "
+        "while holding an asyncio lock"
+    )
+    scope = (
+        "transport/",
+        "serve/",
+        "obs/fleet.py",
+        "obs/metrics.py",
+        "recover/driver.py",
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for func in walk_functions(ctx.tree):
+            for stmt in own_body_nodes(func):
+                if isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        lock = _lock_name(item)
+                        if lock is None:
+                            continue
+                        for n in _own_with_body(stmt):
+                            if isinstance(n, ast.Await):
+                                out.append(
+                                    self.violation(
+                                        ctx,
+                                        n,
+                                        f"await while holding threading "
+                                        f"lock '{lock}' in {func.name}() — "
+                                        "the coroutine suspends with the "
+                                        "OS lock held; every thread "
+                                        "wanting it blocks until the loop "
+                                        "resumes this coroutine (deadlock "
+                                        "if the loop is waiting on one of "
+                                        "them)",
+                                    )
+                                )
+                elif isinstance(stmt, ast.AsyncWith):
+                    for item in stmt.items:
+                        lock = _lock_name(item)
+                        if lock is None:
+                            continue
+                        for n in _own_with_body(stmt):
+                            if not isinstance(n, ast.Call):
+                                continue
+                            label = blocking_label(n)
+                            if label is not None:
+                                out.append(
+                                    self.violation(
+                                        ctx,
+                                        n,
+                                        f"blocking {label} while holding "
+                                        f"asyncio lock '{lock}' in "
+                                        f"{func.name}() — the loop stalls "
+                                        "inside the critical section; "
+                                        "offload with run_in_executor/"
+                                        "to_thread (holding the lock "
+                                        "across the hop is fine)",
+                                    )
+                                )
+        return out

@@ -1,0 +1,142 @@
+"""Rule ``cancellation-safety`` — cancellation must propagate, and
+``finally`` cleanup must survive it.
+
+``Task.cancel()`` is the serving planes' only shutdown mechanism:
+``TcpNode.close()`` and ``Gateway.close()`` cancel the recv loops and
+the pump and rely on ``CancelledError`` unwinding each coroutine.  Two
+patterns break that contract:
+
+- **Swallowed cancellation.**  A handler that catches the error class
+  ``CancelledError`` belongs to and does not re-raise turns ``cancel()``
+  into a no-op — the "cancelled" coroutine keeps running and ``close()``
+  hangs or leaks it.  Since Python 3.8 ``CancelledError`` derives from
+  ``BaseException``, so plain ``except Exception`` does NOT swallow it
+  and is deliberately not flagged (the belt-and-braces handlers around
+  client serving are fine); flagged are bare ``except:``,
+  ``except BaseException``, and an explicit ``CancelledError`` catch
+  without a bare ``raise`` — each only when the ``try`` body actually
+  awaits (a sync body cannot observe cancellation).
+- **Un-shielded await in finally.**  While a ``CancelledError`` is
+  unwinding, the next ``await`` in a ``finally`` block raises
+  ``CancelledError`` *again* immediately — the rest of the cleanup
+  never runs (half-closed sockets, unreleased locks).  Cleanup that
+  must complete wraps the await in ``asyncio.shield(...)``; everything
+  else should be synchronous (``writer.close()``, not
+  ``await writer.wait_closed()``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name, walk_functions
+from ._asyncgraph import own_body_nodes
+
+
+def _subtree_own(nodes: List[ast.stmt]) -> Iterable[ast.AST]:
+    stack: List[ast.AST] = list(nodes)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _has_await(nodes: List[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Await) for n in _subtree_own(nodes))
+
+
+def _swallows_cancelled(handler: ast.ExceptHandler) -> bool:
+    """True when the handler's class set includes CancelledError:
+    bare ``except:``, ``BaseException``, or CancelledError itself
+    (possibly inside a tuple).  ``Exception`` does NOT (py3.8+)."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in types:
+        name = dotted_name(e)
+        tail = name.split(".")[-1] if name else None
+        if tail in ("BaseException", "CancelledError"):
+            return True
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    for n in _subtree_own(handler.body):
+        if isinstance(n, ast.Raise) and n.exc is None:
+            return True
+    return False
+
+
+def _shielded(await_node: ast.Await) -> bool:
+    for n in ast.walk(await_node.value):
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if name and name.split(".")[-1] == "shield":
+                return True
+    return False
+
+
+class CancellationSafetyRule(Rule):
+    name = "cancellation-safety"
+    description = (
+        "CancelledError is never swallowed (bare except/BaseException/"
+        "explicit catch without re-raise around an awaiting body) and "
+        "finally-block awaits are shield()ed"
+    )
+    scope = (
+        "transport/",
+        "serve/",
+        "obs/fleet.py",
+        "obs/metrics.py",
+        "recover/driver.py",
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for func in walk_functions(ctx.tree):
+            for stmt in own_body_nodes(func):
+                if not isinstance(stmt, ast.Try):
+                    continue
+                if _has_await(stmt.body):
+                    for handler in stmt.handlers:
+                        if _swallows_cancelled(handler) and not _reraises(
+                            handler
+                        ):
+                            what = (
+                                "bare except"
+                                if handler.type is None
+                                else dotted_name(handler.type)
+                                or "the caught classes"
+                            )
+                            out.append(
+                                self.violation(
+                                    ctx,
+                                    handler,
+                                    f"{what} around an awaiting body in "
+                                    f"{func.name}() swallows "
+                                    "CancelledError — Task.cancel() "
+                                    "becomes a no-op and shutdown hangs; "
+                                    "catch narrower classes or re-raise "
+                                    "with a bare 'raise'",
+                                )
+                            )
+                for n in _subtree_own(stmt.finalbody):
+                    if isinstance(n, ast.Await) and not _shielded(n):
+                        out.append(
+                            self.violation(
+                                ctx,
+                                n,
+                                f"un-shielded await in a finally block in "
+                                f"{func.name}() — during cancellation "
+                                "this await raises CancelledError "
+                                "immediately and the cleanup after it "
+                                "never runs; wrap in asyncio.shield() or "
+                                "keep finally synchronous",
+                            )
+                        )
+        return out

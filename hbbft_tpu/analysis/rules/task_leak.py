@@ -1,0 +1,184 @@
+"""Rule ``task-leak`` — every spawned task is retained and settled.
+
+``asyncio.create_task`` / ``ensure_future`` return the only strong
+reference the caller gets.  CPython's loop keeps only a *weak* set of
+pending tasks: a fire-and-forget task can be garbage-collected
+mid-flight (vanishing silently, work half-done), and even when it
+survives, nothing awaits its exception — the failure surfaces as an
+"exception was never retrieved" log line after the fact, or never.
+On the serving planes that means a dead redial loop or pump with every
+socket still nominally open.
+
+Flagged:
+
+- a spawn expression used as a bare statement (the reference is
+  dropped on the spot);
+- a spawn assigned to a local name that is never read again in the
+  function (assigned-then-forgotten is the same leak one line later);
+- a spawn stored to a ``self`` attribute that no method of the class
+  ever reads — stored but neither awaited, gathered, nor ``.cancel()``\\ ed
+  on any shutdown path.
+
+Not flagged: spawns nested in a wider expression (``gather(...)``,
+``self._tasks.append(...)``, a dict/list literal) — the reference is
+retained by construction, and whether the *container* is settled is a
+shutdown-protocol question this rule cannot answer per-file.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from ..core import FileContext, Rule, Violation
+from ._ast_util import dotted_name
+from ._asyncgraph import own_body_nodes
+
+SPAWN_TAILS = ("create_task", "ensure_future")
+
+
+def _spawn_call(expr: ast.AST) -> Optional[ast.Call]:
+    if not isinstance(expr, ast.Call):
+        return None
+    name = dotted_name(expr.func)
+    tail = (
+        name.split(".")[-1]
+        if name is not None
+        else (expr.func.attr if isinstance(expr.func, ast.Attribute) else None)
+    )
+    return expr if tail in SPAWN_TAILS else None
+
+
+def _self_attr(target: ast.AST) -> Optional[str]:
+    if (
+        isinstance(target, ast.Attribute)
+        and isinstance(target.value, ast.Name)
+        and target.value.id == "self"
+    ):
+        return target.attr
+    return None
+
+
+class TaskLeakRule(Rule):
+    name = "task-leak"
+    description = (
+        "create_task/ensure_future results are retained and settled — "
+        "a dropped task reference can be GC-collected mid-flight and "
+        "its exception is never retrieved"
+    )
+    scope = (
+        "transport/",
+        "serve/",
+        "obs/fleet.py",
+        "obs/metrics.py",
+        "recover/driver.py",
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        classes: List[Tuple[Optional[ast.ClassDef], ast.AST]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        classes.append((node, sub))
+        # nested + module-level functions carry no enclosing class
+        class_funcs = {id(f) for _, f in classes}
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and id(node) not in class_funcs
+            ):
+                classes.append((None, node))
+        for cls, func in classes:
+            out.extend(self._check_func(ctx, cls, func))
+        return out
+
+    def _check_func(
+        self, ctx: FileContext, cls: Optional[ast.ClassDef], func: ast.AST
+    ) -> Iterable[Violation]:
+        out: List[Violation] = []
+        local_spawns: List[Tuple[str, ast.Call]] = []
+        for n in own_body_nodes(func):
+            if isinstance(n, ast.Expr):
+                call = _spawn_call(n.value)
+                if call is not None:
+                    out.append(
+                        self.violation(
+                            ctx,
+                            call,
+                            f"fire-and-forget {self._tail(call)}() in "
+                            f"{func.name}() — the only strong reference is "
+                            "dropped; the task may be GC-collected "
+                            "mid-flight and its exception is never "
+                            "retrieved; retain it and await/cancel it on "
+                            "shutdown",
+                        )
+                    )
+            elif isinstance(n, ast.Assign) and len(n.targets) == 1:
+                call = _spawn_call(n.value)
+                if call is None:
+                    continue
+                tgt = n.targets[0]
+                if isinstance(tgt, ast.Name):
+                    local_spawns.append((tgt.id, call))
+                else:
+                    attr = _self_attr(tgt)
+                    if attr is not None and cls is not None:
+                        if not self._attr_read_anywhere(cls, attr):
+                            out.append(
+                                self.violation(
+                                    ctx,
+                                    call,
+                                    f"task stored to self.{attr} in "
+                                    f"{func.name}() is never read by any "
+                                    f"method of {cls.name} — neither "
+                                    "awaited, gathered, nor cancelled on "
+                                    "the shutdown path",
+                                )
+                            )
+        for name, call in local_spawns:
+            if not self._name_read_later(func, name, call):
+                out.append(
+                    self.violation(
+                        ctx,
+                        call,
+                        f"task assigned to '{name}' in {func.name}() is "
+                        "never read again — assigned-then-forgotten is "
+                        "still a leak; await, gather, or cancel it",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _tail(call: ast.Call) -> str:
+        name = dotted_name(call.func)
+        if name is not None:
+            return name.split(".")[-1]
+        return call.func.attr if isinstance(call.func, ast.Attribute) else "?"
+
+    @staticmethod
+    def _name_read_later(func: ast.AST, name: str, spawn: ast.Call) -> bool:
+        """Any Load of ``name`` in the function (nested defs included —
+        a closure cancelling the task counts)."""
+        for n in ast.walk(func):
+            if (
+                isinstance(n, ast.Name)
+                and n.id == name
+                and isinstance(n.ctx, ast.Load)
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _attr_read_anywhere(cls: ast.ClassDef, attr: str) -> bool:
+        for n in ast.walk(cls):
+            if (
+                isinstance(n, ast.Attribute)
+                and n.attr == attr
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+                and isinstance(n.ctx, ast.Load)
+            ):
+                return True
+        return False

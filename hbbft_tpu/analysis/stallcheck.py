@@ -1,0 +1,386 @@
+"""Event-loop stall sanitizer — the runtime half of the async-safety
+pass.
+
+The static ``async-blocking`` rule proves that no *statically visible*
+call chain parks the event loop; this module catches what the call
+graph cannot see (dynamic dispatch through untyped hooks, C extensions,
+a cold JIT compile, plain CPU loops) by timing every callback the loop
+runs.  The mechanism:
+
+- **Timed callbacks.**  :func:`enable` patches
+  ``asyncio.events.Handle._run`` (``TimerHandle`` inherits it) with a
+  wrapper that stamps a per-thread *slot* — ``thread id → (t0,
+  handle)`` — around the original dispatch.  Any callback whose wall
+  time exceeds the **budget** (default 0.25 s; ``--stall-budget`` /
+  ``$HBBFT_TPU_STALLCHECK_BUDGET``) becomes a :class:`StallReport`.
+- **Mid-stall stack capture.**  A blocked loop cannot report on
+  itself, so a watchdog daemon thread samples
+  ``sys._current_frames()`` at budget/4 cadence; when a slot has been
+  occupied past the budget it snapshots that thread's Python stack.
+  The report therefore shows *where inside the callback* the time went
+  (the ``os.fsync``, the pairing loop), not just which callback was
+  slow — rendered as the violation's flow, like a lint rule's
+  source→sink hops.
+- **Attribution.**  The callback is named via its ``Task`` when the
+  handle is a coroutine step (``Task.get_coro().__qualname__``) and
+  via the callback's code object otherwise; the violation anchors at
+  the innermost package frame of the captured stack (racecheck-style),
+  falling back to the callback's definition site when the watchdog
+  never got a sample.
+
+Two front doors, mirroring :mod:`.racecheck`:
+
+- ``pytest --stallcheck`` (``tests/conftest.py``): every test runs
+  between :func:`enable` / :func:`disable`; reports accumulate into
+  ``$HBBFT_TPU_STALLCHECK_OUT`` (JSONL) and fail the test.
+- ``python -m hbbft_tpu.analysis --stallcheck <test-expr>``: runs the
+  pytest expression in a subprocess and renders the collected reports
+  like any other lint violation (rule ``stallcheck``).
+
+The checker never changes scheduling: the wrapper delegates to the
+original ``_run`` and only ever *observes*.  Known gaps, by design:
+a callback that blocks for less than the budget is invisible (tune the
+budget down for latency hunting); a stall inside a C extension that
+never releases the GIL pins the watchdog too, so the sample lands as
+soon as the GIL frees — elapsed time is still measured correctly from
+the slot's ``t0``.
+"""
+
+from __future__ import annotations
+
+import asyncio.events
+import json
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import Violation
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_PKG_ROOT = os.path.join(_REPO_ROOT, "hbbft_tpu")
+_SELF = os.path.abspath(__file__)
+
+OUT_ENV = "HBBFT_TPU_STALLCHECK_OUT"
+BUDGET_ENV = "HBBFT_TPU_STALLCHECK_BUDGET"
+DEFAULT_BUDGET_S = 0.25
+
+# captured stacks keep at most this many frames (innermost last)
+_MAX_FRAMES = 25
+
+
+def _relpath(filename: str) -> str:
+    path = os.path.abspath(filename)
+    if path.startswith(_PKG_ROOT + os.sep):
+        return os.path.relpath(path, _PKG_ROOT)
+    if path.startswith(_REPO_ROOT + os.sep):
+        return os.path.relpath(path, _REPO_ROOT)
+    return os.path.basename(path)
+
+
+def _in_package(filename: str) -> bool:
+    path = os.path.abspath(filename)
+    return path.startswith(_PKG_ROOT + os.sep) and path != _SELF
+
+
+@dataclass
+class StallReport:
+    """One event-loop stall: a callback that held the loop past the
+    budget."""
+
+    callback: str
+    path: str
+    line: int
+    elapsed_ms: float
+    budget_ms: float
+    # outermost-first (relpath, line, qualname) hops from the watchdog's
+    # mid-stall sample; empty when the stall finished between samples
+    stack: Tuple[Tuple[str, int, str], ...] = ()
+
+    def message(self) -> str:
+        where = (
+            " (stack sampled mid-stall below)"
+            if self.stack
+            else " (finished between watchdog samples; anchor is the "
+            "callback's definition)"
+        )
+        return (
+            f"event-loop callback {self.callback} blocked the loop for "
+            f"{self.elapsed_ms:.1f} ms (budget {self.budget_ms:.0f} ms) — "
+            "every socket, timer, and peer link on this loop stalled with "
+            f"it; offload the slow work with run_in_executor/to_thread"
+            f"{where}"
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "callback": self.callback,
+            "path": self.path,
+            "line": self.line,
+            "elapsed_ms": self.elapsed_ms,
+            "budget_ms": self.budget_ms,
+            "stack": [list(h) for h in self.stack],
+            "message": self.message(),
+        }
+
+    def as_violation(self) -> Violation:
+        return Violation(
+            rule="stallcheck",
+            path=self.path,
+            line=self.line,
+            col=0,
+            message=self.message(),
+            flow=tuple(
+                (p, ln, f"in {qual}()") for p, ln, qual in self.stack
+            ),
+        )
+
+
+def _describe_callback(handle: Any) -> Tuple[str, str, int]:
+    """(label, relpath, line) for a handle's callback — the coroutine's
+    qualname when this is a Task step, the function's otherwise."""
+    cb = getattr(handle, "_callback", None)
+    owner = getattr(cb, "__self__", None)
+    if isinstance(owner, asyncio.Task):
+        try:
+            coro = owner.get_coro()
+            code = getattr(coro, "cr_code", None)
+            qual = getattr(coro, "__qualname__", None) or "<coroutine>"
+            if code is not None:
+                return (
+                    f"Task step {qual}()",
+                    _relpath(code.co_filename),
+                    code.co_firstlineno,
+                )
+            return f"Task step {qual}()", "<unknown>", 0
+        except Exception:
+            return "Task step <coroutine>", "<unknown>", 0
+    func = cb
+    while hasattr(func, "func"):  # functools.partial chains
+        func = func.func
+    code = getattr(func, "__code__", None)
+    qual = getattr(func, "__qualname__", None) or repr(cb)
+    if code is not None:
+        return (
+            f"{qual}()",
+            _relpath(code.co_filename),
+            code.co_firstlineno,
+        )
+    return f"{qual}()", "<unknown>", 0
+
+
+def _snapshot(frame: Any) -> Tuple[Tuple[str, int, str], ...]:
+    """Outermost-first (relpath, line, qualname) hops of a live frame
+    stack, this module's own frames excluded."""
+    hops: List[Tuple[str, int, str]] = []
+    f = frame
+    while f is not None and len(hops) < _MAX_FRAMES:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) != _SELF:
+            hops.append((_relpath(fn), f.f_lineno, f.f_code.co_name))
+        f = f.f_back
+    hops.reverse()
+    return tuple(hops)
+
+
+class StallChecker:
+    """The slot bookkeeping + the ``Handle._run`` patch + the watchdog.
+
+    Usable standalone (``chk = StallChecker(0.05); chk.install()``) or
+    process-wide via the module-level :func:`enable`/:func:`disable`
+    pair."""
+
+    def __init__(self, budget_s: Optional[float] = None) -> None:
+        if budget_s is None:
+            budget_s = float(os.environ.get(BUDGET_ENV, DEFAULT_BUDGET_S))
+        self.budget_s = max(1e-4, float(budget_s))
+        self.reports: List[StallReport] = []
+        self._mu = threading.Lock()
+        self._seen: set = set()  # (path, line) dedupe
+        # thread id -> (t0, handle) while that thread runs a callback
+        self._slots: Dict[int, Tuple[float, Any]] = {}
+        # thread id -> (handle, sampled stack) from the watchdog
+        self._stacks: Dict[int, Tuple[Any, Tuple[Tuple[str, int, str], ...]]] = {}
+        self._orig_run: Optional[Any] = None
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+
+    # -- the Handle._run patch ------------------------------------------------
+
+    def install(self) -> None:
+        assert self._orig_run is None
+        orig = asyncio.events.Handle._run
+        self._orig_run = orig
+        checker = self
+
+        def _timed_run(handle: Any) -> Any:
+            tid = threading.get_ident()
+            t0 = time.perf_counter()
+            checker._slots[tid] = (t0, handle)
+            try:
+                return orig(handle)
+            finally:
+                checker._slots.pop(tid, None)
+                elapsed = time.perf_counter() - t0
+                stack = checker._take_stack(tid, handle)
+                if elapsed >= checker.budget_s:
+                    checker._report(handle, elapsed, stack)
+
+        asyncio.events.Handle._run = _timed_run
+        self._stop.clear()
+        self._watchdog = threading.Thread(
+            target=self._watch, name="hbbft-stallcheck", daemon=True
+        )
+        self._watchdog.start()
+
+    def uninstall(self) -> None:
+        if self._orig_run is not None:
+            asyncio.events.Handle._run = self._orig_run
+            self._orig_run = None
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+        self._slots.clear()
+        self._stacks.clear()
+
+    # -- the watchdog -----------------------------------------------------------
+
+    def _watch(self) -> None:
+        period = self.budget_s / 4.0
+        while not self._stop.wait(period):
+            if not self._slots:
+                continue
+            now = time.perf_counter()
+            frames = sys._current_frames()
+            for tid, (t0, handle) in list(self._slots.items()):
+                if now - t0 < self.budget_s:
+                    continue
+                f = frames.get(tid)
+                if f is not None:
+                    stack = _snapshot(f)
+                    with self._mu:
+                        self._stacks[tid] = (handle, stack)
+
+    def _take_stack(
+        self, tid: int, handle: Any
+    ) -> Tuple[Tuple[str, int, str], ...]:
+        with self._mu:
+            stashed = self._stacks.pop(tid, None)
+        if stashed is not None and stashed[0] is handle:
+            return stashed[1]
+        return ()
+
+    # -- reporting ----------------------------------------------------------------
+
+    def _report(
+        self,
+        handle: Any,
+        elapsed: float,
+        stack: Tuple[Tuple[str, int, str], ...],
+    ) -> None:
+        label, path, line = _describe_callback(handle)
+        # anchor at the innermost package frame of the sampled stack —
+        # the actual blocking site — when we have one
+        for p, ln, _qual in reversed(stack):
+            cand = os.path.join(_PKG_ROOT, p)
+            if os.path.isfile(cand) and _in_package(cand):
+                path, line = p, ln
+                break
+        with self._mu:
+            key = (path, line)
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self.reports.append(
+                StallReport(
+                    callback=label,
+                    path=path,
+                    line=line,
+                    elapsed_ms=elapsed * 1000.0,
+                    budget_ms=self.budget_s * 1000.0,
+                    stack=stack,
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+# Process-wide switchboard (refcounted: nested enables share one checker)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[StallChecker] = None
+_DEPTH = 0
+_SWITCH_LOCK = threading.Lock()
+
+
+def active() -> Optional[StallChecker]:
+    return _ACTIVE
+
+
+def enable(budget_s: Optional[float] = None) -> StallChecker:
+    """Install the process-wide checker (idempotent/refcounted).  The
+    first enable's budget wins for the whole window."""
+    global _ACTIVE, _DEPTH
+    with _SWITCH_LOCK:
+        if _ACTIVE is None:
+            chk = StallChecker(budget_s)
+            chk.install()
+            _ACTIVE = chk
+            _DEPTH = 0
+        _DEPTH += 1
+        return _ACTIVE
+
+
+def disable() -> List[StallReport]:
+    """Drop one enable; on the last one, restore ``Handle._run``, stop
+    the watchdog, append the collected reports to
+    ``$HBBFT_TPU_STALLCHECK_OUT`` (JSONL) when set, and return them."""
+    global _ACTIVE, _DEPTH
+    with _SWITCH_LOCK:
+        if _ACTIVE is None:
+            return []
+        _DEPTH -= 1
+        if _DEPTH > 0:
+            return list(_ACTIVE.reports)
+        chk = _ACTIVE
+        _ACTIVE = None
+    chk.uninstall()
+    out = os.environ.get(OUT_ENV)
+    if out and chk.reports:
+        with open(out, "a") as fh:
+            for r in chk.reports:
+                fh.write(json.dumps(r.as_dict(), sort_keys=True) + "\n")
+    return list(chk.reports)
+
+
+def load_reports(path: str) -> List[StallReport]:
+    """Parse a ``$HBBFT_TPU_STALLCHECK_OUT`` JSONL file back into
+    reports (the CLI renders them as violations)."""
+    reports: List[StallReport] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                reports.append(
+                    StallReport(
+                        callback=d["callback"],
+                        path=d["path"],
+                        line=int(d["line"]),
+                        elapsed_ms=float(d["elapsed_ms"]),
+                        budget_ms=float(d["budget_ms"]),
+                        stack=tuple(
+                            (h[0], int(h[1]), h[2])
+                            for h in d.get("stack", ())
+                        ),
+                    )
+                )
+    except FileNotFoundError:
+        pass
+    return reports

@@ -1875,6 +1875,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="emit one JSON row per scenario"
     )
+    parser.add_argument(
+        "--stallcheck",
+        action="store_true",
+        help="run the matrix under the event-loop stall sanitizer "
+        "(hbbft_tpu.analysis.stallcheck); any stall fails the run",
+    )
+    parser.add_argument(
+        "--stall-budget",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="stallcheck budget in seconds (default: "
+        "$HBBFT_TPU_STALLCHECK_BUDGET or 0.25)",
+    )
     args = parser.parse_args(argv)
 
     if args.list:
@@ -1886,11 +1900,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         n=args.n, epochs=args.epochs, seed=args.seed,
         fuzz_cases=args.fuzz_cases,
     )
+    stalls = []
     try:
-        results = run_matrix(cfg, only=args.only)
+        if args.stallcheck:
+            # dev-tool hook, CLI main() only: the runtime sanitizer
+            # brackets the run exactly like the pytest --stallcheck
+            # conftest guard does from outside the package; the harness
+            # proper never depends on the analysis layer
+            from ..analysis import stallcheck as _sc  # lint: ok(layering)
+
+            _sc.enable(args.stall_budget)
+            try:
+                results = run_matrix(cfg, only=args.only)
+            finally:
+                stalls = _sc.disable()
+        else:
+            results = run_matrix(cfg, only=args.only)
     except KeyError as exc:
         print(exc.args[0], file=sys.stderr)
         return 2
+    for r in stalls:
+        print(f"STALL  {r.path}:{r.line}: {r.message()}", file=sys.stderr)
     for res in results:
         if args.json:
             print(json.dumps(res.as_dict(), sort_keys=True))
@@ -1901,8 +1931,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.json:
         print(
             f"{len(results) - len(failed)}/{len(results)} scenarios green"
+            + (f", {len(stalls)} event-loop stall(s)" if stalls else "")
         )
-    return 1 if failed else 0
+    return 1 if (failed or stalls) else 0
 
 
 if __name__ == "__main__":

@@ -76,6 +76,15 @@ class FleetPoller:
             )
         return row
 
+    def _append_rows(self, rows: List[Dict[str, Any]]) -> None:
+        """Sync JSONL append — runs on an executor thread, never on the
+        event loop (the poller often shares its loop with the nodes it
+        scrapes; a slow disk must not stall their sockets)."""
+        assert self.out_path is not None
+        with open(self.out_path, "a") as fh:
+            for row in rows:
+                fh.write(json.dumps(row, separators=(",", ":")) + "\n")
+
     async def poll_once(self) -> List[Dict[str, Any]]:
         """One scrape round across every target, concurrently."""
         rows = await asyncio.gather(
@@ -86,9 +95,8 @@ class FleetPoller:
         )
         self.rows.extend(rows)
         if self.out_path is not None:
-            with open(self.out_path, "a") as fh:
-                for row in rows:
-                    fh.write(json.dumps(row, separators=(",", ":")) + "\n")
+            loop = asyncio.get_event_loop()
+            await loop.run_in_executor(None, self._append_rows, rows)
         return list(rows)
 
     async def run(self, rounds: int) -> List[Dict[str, Any]]:

@@ -165,7 +165,11 @@ class MetricsExporter:
                 + payload
             )
             await writer.drain()
-        except (ConnectionError, asyncio.CancelledError):
+        except ConnectionError:
+            # A client that hangs up mid-response is routine.  But
+            # CancelledError must propagate: the server's close() path
+            # cancels these handler tasks and relies on the unwind —
+            # swallowing it would turn shutdown into a hang.
             pass
         finally:
             try:

@@ -28,6 +28,7 @@ crash raced the original send.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core.network_info import NetworkInfo
@@ -154,5 +155,11 @@ async def prime_replay(node: TcpNode, steps: List[Any]) -> None:
     outbound frames renumber identically to the pre-crash stream and
     land in the replay buffer (no link is up yet), ready for the
     resume handshakes to trim + re-send."""
-    for step in steps:
+    for i, step in enumerate(steps):
         await node._route(step)
+        # With no link up, _route never actually awaits — a long WAL
+        # tail would monopolize the loop for its whole replay.  Yield
+        # periodically so concurrent servers (metrics, peers already
+        # running in this process) keep breathing.
+        if i % 64 == 63:
+            await asyncio.sleep(0)

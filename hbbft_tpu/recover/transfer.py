@@ -51,6 +51,7 @@ the exact same order.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -159,6 +160,11 @@ class CatchupManager:
         self._epoch_fn = epoch_fn or (
             lambda: int(getattr(self.node.algo, "epoch", 0))
         )
+        # Install runs a durable checkpoint (WAL append + fsync) — it
+        # is offloaded to an executor thread under the node's algorithm
+        # lock so it serializes with the pump.  Tests drive this class
+        # with bare fakes, hence the fallback lock.
+        self._lock = getattr(node, "_algo_lock", None) or asyncio.Lock()
         self.state = self.IDLE
         self.installed = 0  # completed transfers (tests/scenarios)
         self._from = 0
@@ -508,29 +514,41 @@ class CatchupManager:
         for p, first in self._held_first.items():
             if first > self.node._applied_seq.get(p, 0):
                 self.node._applied_seq[p] = first - 1
-        if self._install_fn is not None:
-            step = self._install_fn(self._target, batches)
-        else:
-            step = self.node.algo.install_snapshot(self._target, batches)
-        self.installed += 1
-        rec = _obs.ACTIVE
-        if rec is not None:
-            rec.count("st.installed")
-            rec.event(
-                "st_transfer",
-                peer=self._provider or "-",
-                from_epoch=self._from,
-                upto_epoch=self._target,
-                bytes=nbytes,
-                chunks=chunks,
-                retries=self._restarts + len(self._failed),
-            )
-        held = self._held
-        self._to_idle()
-        if step is not None:
-            await self.node._route(step)
-        for p, m in held:
-            self.node._inbox.put_nowait((p, m))
+        # The install writes a durable checkpoint (WAL append + fsync +
+        # possible compaction) — run it on an executor thread so the
+        # loop keeps serving, under the algorithm lock so it serializes
+        # with the pump.  Routing the produced step and re-injecting
+        # the parked frames stay inside the lock: the pump must not see
+        # the parked frames before the step's messages are numbered.
+        loop = asyncio.get_event_loop()
+        async with self._lock:
+            if self._install_fn is not None:
+                step = await loop.run_in_executor(
+                    None, self._install_fn, self._target, batches
+                )
+            else:
+                step = await loop.run_in_executor(
+                    None, self.node.algo.install_snapshot, self._target, batches
+                )
+            self.installed += 1
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("st.installed")
+                rec.event(
+                    "st_transfer",
+                    peer=self._provider or "-",
+                    from_epoch=self._from,
+                    upto_epoch=self._target,
+                    bytes=nbytes,
+                    chunks=chunks,
+                    retries=self._restarts + len(self._failed),
+                )
+            held = self._held
+            self._to_idle()
+            if step is not None:
+                await self.node._route(step)
+            for p, m in held:
+                self.node._inbox.put_nowait((p, m))
 
     def _to_idle(self) -> None:
         self.state = self.IDLE

@@ -285,7 +285,9 @@ async def _run_tcp_async(
 ) -> Dict[str, Any]:
     from ..transport.tcp import TcpNode
 
-    addrs = _free_addrs(n_validators + 1)
+    # _free_addrs binds real sockets — sync syscalls, off the loop
+    loop = asyncio.get_event_loop()
+    addrs = await loop.run_in_executor(None, _free_addrs, n_validators + 1)
     client_addr, mesh_addrs = addrs[0], addrs[1:]
     new_algo = _new_algo_factory(batch_size)
     nodes = [

@@ -291,6 +291,13 @@ class TcpNode:
         self.on_step: Optional[Callable[["TcpNode"], None]] = None
         self._writers: Dict[str, asyncio.StreamWriter] = {}
         self._inbox: asyncio.Queue = asyncio.Queue()
+        # Serializes algorithm access across the pump, input(), and the
+        # catch-up installer now that handler calls run on executor
+        # threads: the lock is held across a whole handle+route+ack
+        # iteration, preserving the atomicity the single-threaded loop
+        # used to provide (e.g. _send_seq mutation in _route vs. the
+        # on_step hook's read of it).
+        self._algo_lock = asyncio.Lock()
         self._server: Optional[asyncio.base_events.Server] = None
         self._tasks: List[asyncio.Task] = []
         self._connected = asyncio.Event()
@@ -869,7 +876,16 @@ class TcpNode:
                     rec.count(f"wire.send_drops.{peer}")
 
     async def input(self, value: Any) -> None:
-        await self._route(self.algo.handle_input(value))
+        # handle_input runs threshold crypto (batch encryption) and,
+        # for durable nodes, a WAL fsync — offload it so the event loop
+        # keeps serving sockets.  The lock keeps the handle+route pair
+        # atomic with respect to the pump.
+        loop = asyncio.get_event_loop()
+        async with self._algo_lock:
+            step = await loop.run_in_executor(
+                None, self.algo.handle_input, value
+            )
+            await self._route(step)
 
     async def run(
         self,
@@ -890,28 +906,45 @@ class TcpNode:
                 sender, message = await asyncio.wait_for(get, remaining)
             else:
                 sender, message = await get
-            try:
-                step = self.algo.handle_message(sender, message)
-            except Exception:
-                # A deserializable-but-malformed message slipped past the
-                # handler's own guards.  Never crash the pump on remote
-                # input — but never drop it silently either: attribute
-                # it so the failure is visible in faults + obs counters.
-                self.faults.append(Fault(sender, FaultKind.INVALID_MESSAGE))
-                rec = _obs.ACTIVE
-                if rec is not None:
-                    rec.count("wire.handler_errors")
-                self._ack_applied(sender)
-                continue
-            await self._route(step)
-            self._ack_applied(sender)
-            if self.on_step is not None:
+            # The handler runs threshold crypto (combine/verify) and,
+            # for durable nodes, a WAL fsync — park it on an executor
+            # thread so one slow message never stalls the recv loops.
+            # The lock spans the whole handle+route+ack iteration: the
+            # single-threaded loop used to make _route's _send_seq
+            # writes atomic w.r.t. the on_step checkpoint hook, and the
+            # offload must not reintroduce that race.
+            async with self._algo_lock:
                 try:
-                    self.on_step(self)
+                    step = await loop.run_in_executor(
+                        None, self.algo.handle_message, sender, message
+                    )
                 except Exception:
+                    # A deserializable-but-malformed message slipped
+                    # past the handler's own guards.  Never crash the
+                    # pump on remote input — but never drop it silently
+                    # either: attribute it so the failure is visible in
+                    # faults + obs counters.
+                    self.faults.append(
+                        Fault(sender, FaultKind.INVALID_MESSAGE)
+                    )
                     rec = _obs.ACTIVE
                     if rec is not None:
-                        rec.count("wire.output_hook_errors")
+                        rec.count("wire.handler_errors")
+                    self._ack_applied(sender)
+                    continue
+                await self._route(step)
+                self._ack_applied(sender)
+                if self.on_step is not None:
+                    # The restart driver's hook writes epoch
+                    # checkpoints (WAL append + fsync) — same offload.
+                    # Still inside the lock, so its view of _send_seq
+                    # is quiescent.
+                    try:
+                        await loop.run_in_executor(None, self.on_step, self)
+                    except Exception:
+                        rec = _obs.ACTIVE
+                        if rec is not None:
+                            rec.count("wire.output_hook_errors")
         return self.outputs
 
     async def close(self) -> None:

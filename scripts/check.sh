@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One-shot verification gate, in dependency order:
 #
-#   1. badgerlint — all 13 static rules over the package tree
+#   1. badgerlint — all 17 static rules over the package tree
 #   2. racecheck smoke — the lockset-checker test module under
 #      `pytest --racecheck` (runtime thread-safety)
 #   3. wire-manifest verification — the @wire registry still matches
@@ -24,6 +24,10 @@
 #      the post-mortem timeline CLI re-merges them: exit non-zero on
 #      any health-rule violation or if <99% of the wire-send trace
 #      contexts join to their receive on the far node
+#   7. stallcheck smoke — the same fleet-telemetry scenario re-run
+#      under the event-loop stall sanitizer with a pinned 0.5 s
+#      budget: no callback on any serving loop may park the thread
+#      (the runtime dual of the static async-blocking rule)
 #
 # Each stage runs even if an earlier one failed (you want the full
 # report, not the first stopper), but the exit code is non-zero if ANY
@@ -45,23 +49,23 @@ log() {
 
 rc=0
 
-echo "== [1/6] badgerlint (all rules) ==" | log
+echo "== [1/7] badgerlint (all rules) ==" | log
 python -m hbbft_tpu.analysis 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [2/6] racecheck smoke ==" | log
+echo "== [2/7] racecheck smoke ==" | log
 env JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
   -p no:cacheprovider --racecheck 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [3/6] wire manifest ==" | log
+echo "== [3/7] wire manifest ==" | log
 python -m hbbft_tpu.analysis --select wire-stability 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [4/6] scenarios smoke ==" | log
+echo "== [4/7] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
   --only bad-share --only equivocate --only hostile-clients \
   --only geo-partition-heal --only flash-crowd \
@@ -70,12 +74,12 @@ env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [5/6] gateway smoke ==" | log
+echo "== [5/7] gateway smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.serve.loadgen --smoke 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [6/6] fleet telemetry (timeline + health rules) ==" | log
+echo "== [6/7] fleet telemetry (timeline + health rules) ==" | log
 fleet_dir=$(mktemp -d)
 env JAX_PLATFORMS=cpu HBBFT_FLEET_DIR="$fleet_dir" \
   python -m hbbft_tpu.harness.scenarios --only fleet-telemetry 2>&1 | log
@@ -87,6 +91,12 @@ env JAX_PLATFORMS=cpu python -m hbbft_tpu.obs.timeline \
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 rm -rf "$fleet_dir"
+
+echo "== [7/7] stallcheck smoke (fleet-telemetry under the sanitizer) ==" | log
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
+  --only fleet-telemetry --stallcheck --stall-budget 0.5 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
 
 if [ "$rc" -eq 0 ]; then
   echo "check: all gates clean" | log

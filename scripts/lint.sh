@@ -5,7 +5,9 @@
 #   scripts/lint.sh --json
 #   scripts/lint.sh --select determinism,layering hbbft_tpu/protocols
 #   scripts/lint.sh --select thread-shared-state,lock-order,atomic-cache
+#   scripts/lint.sh --select async-blocking,task-leak,await-holding-lock,cancellation-safety
 #   scripts/lint.sh --racecheck tests/test_racecheck.py   # runtime lockset checker
+#   scripts/lint.sh --stallcheck tests/ --stall-budget 0.25   # event-loop stall sanitizer
 #   scripts/lint.sh --changed            # git-diff scope (pre-commit);
 #                                        # the CLI widens to a full run when
 #                                        # a changed file is in a

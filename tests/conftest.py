@@ -52,6 +52,15 @@ def pytest_addoption(parser):
         "(hbbft_tpu.analysis.racecheck); candidate races fail the test "
         "and append to $HBBFT_TPU_RACECHECK_OUT when set",
     )
+    parser.addoption(
+        "--stallcheck",
+        action="store_true",
+        default=False,
+        help="run every test under the event-loop stall sanitizer "
+        "(hbbft_tpu.analysis.stallcheck); callbacks blocking the loop "
+        "past $HBBFT_TPU_STALLCHECK_BUDGET seconds fail the test and "
+        "append to $HBBFT_TPU_STALLCHECK_OUT when set",
+    )
 
 
 @pytest.fixture(autouse=True)
@@ -71,6 +80,30 @@ def _racecheck_guard(request):
     if reports:
         pytest.fail(
             "racecheck: "
+            + "; ".join(
+                f"{r.path}:{r.line}: {r.message()}" for r in reports
+            ),
+            pytrace=False,
+        )
+
+
+@pytest.fixture(autouse=True)
+def _stallcheck_guard(request):
+    """With ``--stallcheck``, bracket every test with the event-loop
+    stall sanitizer.  Reports surface twice: as a test failure here and
+    as JSONL in ``$HBBFT_TPU_STALLCHECK_OUT`` for the
+    ``python -m hbbft_tpu.analysis --stallcheck`` driver."""
+    if not request.config.getoption("--stallcheck"):
+        yield
+        return
+    from hbbft_tpu.analysis import stallcheck
+
+    stallcheck.enable()
+    yield
+    reports = stallcheck.disable()
+    if reports:
+        pytest.fail(
+            "stallcheck: "
             + "; ".join(
                 f"{r.path}:{r.line}: {r.message()}" for r in reports
             ),

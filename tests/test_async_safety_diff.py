@@ -1,0 +1,341 @@
+"""Fix↔lint differential suite for the badgerlint v4 async rules.
+
+This PR's async-safety pass flagged every event-loop hazard on the
+serving planes and each got a fix (executor offloads in the TCP pump
+and input path, the fleet poller and the load generator, a cooperative
+yield in replay, a narrowed catch in the metrics exporter).  These
+tests pin that the *static* pass keeps covering every one of them:
+each test copies the serving planes into a fixture, reverts exactly
+one fix by text substitution, runs the async rules over the reverted
+tree, and asserts the right rule reports the right root→sink chain —
+file, coroutine, and sink class.
+
+The unreverted copy is asserted clean once up front, so a failure
+here means the revert (and only the revert) re-opened the hole.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from hbbft_tpu.analysis import all_rules, lint_paths
+from hbbft_tpu.analysis.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "hbbft_tpu")
+
+# the serving planes plus everything their coroutine chains reach: the
+# WAL/checkpoint bodies (recover/) are the blocking sinks the rules
+# must see, and obs/ carries the poller and the exporter
+_SCOPE_DIRS = ("transport", "serve", "obs", "recover")
+
+ASYNC_RULES = (
+    "async-blocking",
+    "task-leak",
+    "await-holding-lock",
+    "cancellation-safety",
+)
+
+
+def _rules():
+    return [r for r in all_rules() if r.name in ASYNC_RULES]
+
+
+def _copy_scope(tmp_path):
+    dst = tmp_path / "hbbft_tpu"
+    for d in _SCOPE_DIRS:
+        shutil.copytree(
+            os.path.join(PKG, d),
+            dst / d,
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+    return dst
+
+
+def _revert_and_lint(tmp_path, relpath, old, new):
+    """Apply one textual fix-revert and run the async rules over the
+    tree."""
+    root = _copy_scope(tmp_path)
+    target = root / relpath
+    text = target.read_text()
+    assert old in text, (
+        f"fix text not found in {relpath} — the differential revert "
+        "needs updating alongside the fix"
+    )
+    target.write_text(text.replace(old, new))
+    violations, errors = lint_paths([str(root)], _rules())
+    assert not errors
+    return violations
+
+
+def test_unreverted_scope_copy_is_clean(tmp_path):
+    root = _copy_scope(tmp_path)
+    violations, errors = lint_paths([str(root)], _rules())
+    assert not errors
+    assert violations == []
+
+
+# ---------------------------------------------------------------------------
+# async-blocking: the executor offloads
+# ---------------------------------------------------------------------------
+
+_TCP_INPUT_FIXED = """\
+        loop = asyncio.get_event_loop()
+        async with self._algo_lock:
+            step = await loop.run_in_executor(
+                None, self.algo.handle_input, value
+            )
+            await self._route(step)
+"""
+
+_TCP_INPUT_REVERTED = """\
+        async with self._algo_lock:
+            step = self.algo.handle_input(value)
+            await self._route(step)
+"""
+
+
+def test_tcp_input_offload_revert_redetects(tmp_path):
+    # pre-fix: handle_input (threshold encryption + WAL fsync) ran
+    # inline on the loop
+    violations = _revert_and_lint(
+        tmp_path, "transport/tcp.py", _TCP_INPUT_FIXED, _TCP_INPUT_REVERTED
+    )
+    hits = [
+        v
+        for v in violations
+        if v.rule == "async-blocking"
+        and v.path == "transport/tcp.py"
+        and "input()" in v.message
+    ]
+    assert hits, violations
+    # the seam bridges self.algo.handle_input to the WAL body and the
+    # flow walks root → seam → sink
+    assert any("append_input" in v.message for v in hits)
+    flagged = next(v for v in hits if "append_input" in v.message)
+    notes = " | ".join(note for _, _, note in flagged.flow)
+    assert "event loop" in notes
+    assert "handle_input" in notes
+    assert "blocking" in notes
+
+
+def test_tcp_pump_offload_revert_redetects(tmp_path):
+    # pre-fix: the pump dispatched handle_message (combine/verify
+    # crypto + WAL append) inline
+    violations = _revert_and_lint(
+        tmp_path,
+        "transport/tcp.py",
+        "                try:\n"
+        "                    step = await loop.run_in_executor(\n"
+        "                        None, self.algo.handle_message, sender, message\n"
+        "                    )\n"
+        "                except Exception:",
+        "                try:\n"
+        "                    step = self.algo.handle_message(sender, message)\n"
+        "                except Exception:",
+    )
+    hits = [
+        v
+        for v in violations
+        if v.rule == "async-blocking"
+        and v.path == "transport/tcp.py"
+        and "run()" in v.message
+        and "handle_message" in v.message
+    ]
+    assert hits, violations
+    assert any("append_message" in v.message for v in hits)
+
+
+def test_fleet_poller_offload_revert_redetects(tmp_path):
+    # pre-fix: poll_once appended JSONL rows with a sync open() on the
+    # loop it shares with the nodes it scrapes
+    violations = _revert_and_lint(
+        tmp_path,
+        "obs/fleet.py",
+        "        if self.out_path is not None:\n"
+        "            loop = asyncio.get_event_loop()\n"
+        "            await loop.run_in_executor(None, self._append_rows, rows)\n",
+        "        if self.out_path is not None:\n"
+        "            self._append_rows(rows)\n",
+    )
+    hits = [
+        v
+        for v in violations
+        if v.rule == "async-blocking"
+        and v.path == "obs/fleet.py"
+        and "poll_once()" in v.message
+    ]
+    assert hits, violations
+    assert any("open()" in v.message for v in hits)
+    assert any(
+        "_append_rows" in note for v in hits for _, _, note in v.flow
+    )
+
+
+def test_loadgen_free_addrs_offload_revert_redetects(tmp_path):
+    # pre-fix: the TCP load generator bound real sockets inline
+    violations = _revert_and_lint(
+        tmp_path,
+        "serve/loadgen.py",
+        "    # _free_addrs binds real sockets — sync syscalls, off the loop\n"
+        "    loop = asyncio.get_event_loop()\n"
+        "    addrs = await loop.run_in_executor(None, _free_addrs, n_validators + 1)\n",
+        "    addrs = _free_addrs(n_validators + 1)\n",
+    )
+    hits = [
+        v
+        for v in violations
+        if v.rule == "async-blocking"
+        and v.path == "serve/loadgen.py"
+        and "socket.socket" in v.message
+    ]
+    assert hits, violations
+    assert any("via _free_addrs()" in v.message for v in hits)
+
+
+def test_transfer_install_offload_revert_redetects(tmp_path):
+    # pre-fix: the snapshot installer ran install_snapshot (WAL
+    # checkpoint + fsync) inline.  The coroutine lives in
+    # recover/transfer.py but the *root* is the transport recv loop —
+    # every state-transfer control frame funnels through it — so the
+    # finding anchors in transport/tcp.py with an interprocedural flow.
+    violations = _revert_and_lint(
+        tmp_path,
+        "recover/transfer.py",
+        "            if self._install_fn is not None:\n"
+        "                step = await loop.run_in_executor(\n"
+        "                    None, self._install_fn, self._target, batches\n"
+        "                )\n"
+        "            else:\n"
+        "                step = await loop.run_in_executor(\n"
+        "                    None, self.node.algo.install_snapshot, self._target, batches\n"
+        "                )\n",
+        "            if self._install_fn is not None:\n"
+        "                step = self._install_fn(self._target, batches)\n"
+        "            else:\n"
+        "                step = self.node.algo.install_snapshot(self._target, batches)\n",
+    )
+    hits = [
+        v
+        for v in violations
+        if v.rule == "async-blocking"
+        and v.path == "transport/tcp.py"
+        and "append_checkpoint" in v.message
+    ]
+    assert hits, violations
+    notes = [note for v in hits for _, _, note in v.flow]
+    assert any("_install" in n for n in notes)
+    assert any("install_snapshot" in n for n in notes)
+
+
+# ---------------------------------------------------------------------------
+# task-leak: the dial tasks stay retained
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_dial_retention_revert_redetects(tmp_path):
+    violations = _revert_and_lint(
+        tmp_path,
+        "transport/tcp.py",
+        "                self._tasks.append(\n"
+        "                    asyncio.ensure_future(self._dial(peer))\n"
+        "                )\n",
+        "                asyncio.ensure_future(self._dial(peer))\n",
+    )
+    hits = [
+        v
+        for v in violations
+        if v.rule == "task-leak" and v.path == "transport/tcp.py"
+    ]
+    assert hits, violations
+    assert "fire-and-forget ensure_future()" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# await-holding-lock: the hazard the _algo_lock design explicitly avoids
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_under_algo_lock_redetects(tmp_path):
+    # not a revert of a shipped fix but of the design rule the fix
+    # established: the lock may be held across the executor hop, never
+    # across an inline WAL append
+    violations = _revert_and_lint(
+        tmp_path,
+        "transport/tcp.py",
+        "        async with self._algo_lock:\n"
+        "            step = await loop.run_in_executor(\n"
+        "                None, self.algo.handle_input, value\n"
+        "            )\n",
+        "        async with self._algo_lock:\n"
+        "            self.algo.wal.append_input(value)\n"
+        "            step = await loop.run_in_executor(\n"
+        "                None, self.algo.handle_input, value\n"
+        "            )\n",
+    )
+    hits = [
+        v for v in violations if v.rule == "await-holding-lock"
+    ]
+    assert hits, violations
+    assert "append_input" in hits[0].message
+    assert "asyncio lock 'self._algo_lock'" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# cancellation-safety: the metrics exporter's narrowed catch
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_cancelled_catch_revert_redetects(tmp_path):
+    # pre-fix: the handler caught CancelledError alongside
+    # ConnectionError, turning close()'s task cancellation into a no-op
+    violations = _revert_and_lint(
+        tmp_path,
+        "obs/metrics.py",
+        "        except ConnectionError:\n",
+        "        except (ConnectionError, asyncio.CancelledError):\n",
+    )
+    hits = [
+        v
+        for v in violations
+        if v.rule == "cancellation-safety" and v.path == "obs/metrics.py"
+    ]
+    assert hits, violations
+    assert "swallows" in hits[0].message
+    assert "_handle()" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# the CLI surface: a reverted chain renders as SARIF codeFlows
+# ---------------------------------------------------------------------------
+
+
+def test_reverted_chain_renders_sarif_code_flows(tmp_path, capsys):
+    root = _copy_scope(tmp_path)
+    target = root / "transport/tcp.py"
+    text = target.read_text()
+    assert _TCP_INPUT_FIXED in text
+    target.write_text(text.replace(_TCP_INPUT_FIXED, _TCP_INPUT_REVERTED))
+    rc = cli_main(
+        ["--format", "sarif", "--no-baseline", "--select", "async-blocking",
+         str(root)]
+    )
+    sarif = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    results = sarif["runs"][0]["results"]
+    flagged = [
+        r
+        for r in results
+        if r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+        == "transport/tcp.py"
+        and "append_input" in r["message"]["text"]
+    ]
+    assert flagged, results
+    (thread_flow,) = flagged[0]["codeFlows"][0]["threadFlows"]
+    locs = thread_flow["locations"]
+    assert len(locs) >= 2
+    notes = " | ".join(l["location"]["message"]["text"] for l in locs)
+    assert "event loop" in notes
+    assert "blocking" in notes

@@ -1957,3 +1957,450 @@ def test_cli_trace_emits_lint_run_event(tmp_path, capsys):
     assert run["wall"] > 0
     assert run["counts"] == {"wire-taint": 1}
     assert run["changed"] is False
+
+
+# ---------------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------------
+
+
+def _ab(source, relpath="transport/fixfile.py"):
+    return _lint(source, relpath, select="async-blocking")
+
+
+def test_async_blocking_flags_direct_blocking_call():
+    out = _ab(
+        """
+        import time
+
+        async def pump():
+            time.sleep(0.1)
+        """
+    )
+    (v,) = out
+    assert "pump()" in v.message
+    assert "time.sleep" in v.message
+    assert "stalls every socket" in v.message
+    # the flow walks root coroutine → blocking sink
+    assert v.flow is not None and len(v.flow) == 2
+    assert "event loop" in v.flow[0][2]
+    assert "blocking" in v.flow[-1][2]
+
+
+def test_async_blocking_executor_hop_is_clean():
+    # the sanctioned form: the offloaded callee is an *argument*, not a
+    # call, so the chain is broken by construction
+    out = _ab(
+        """
+        import asyncio
+        import time
+
+        async def pump(loop):
+            await loop.run_in_executor(None, time.sleep, 0.1)
+            await asyncio.to_thread(time.sleep, 0.1)
+        """
+    )
+    assert out == []
+
+
+def test_async_blocking_interprocedural_chain():
+    out = _ab(
+        """
+        import os
+
+        def flush(fd):
+            os.fsync(fd)
+
+        def persist(fd):
+            flush(fd)
+
+        async def run(fd):
+            persist(fd)
+        """
+    )
+    (v,) = out
+    assert "os.fsync" in v.message
+    assert "via flush()" in v.message
+    notes = [note for _, _, note in v.flow]
+    assert any("calls persist()" in n for n in notes)
+    assert any("calls flush()" in n for n in notes)
+    assert "blocking" in notes[-1]
+    # the finding anchors at the call the chain leaves the root through
+    assert v.line == v.flow[1][1]
+
+
+def test_async_blocking_dynamic_seam_bridges_unresolvable_call():
+    # `self.algo.handle_message(...)` cannot be resolved statically; the
+    # seam table bridges it to every `handle_message` in the index
+    out = _ab(
+        """
+        import os
+
+        class Algo:
+            def handle_message(self, sender, msg):
+                os.fsync(3)
+
+        class Node:
+            async def pump(self):
+                step = self.algo.handle_message(1, 2)
+        """
+    )
+    (v,) = out
+    assert "pump()" in v.message
+    assert "os.fsync" in v.message
+    assert "handle_message" in v.message
+
+
+def test_async_blocking_roots_only_in_serving_planes():
+    # a blocking coroutine in protocols/ is not a *root*; it only
+    # matters if a serving-plane coroutine reaches it
+    out = _lint(
+        """
+        import time
+
+        async def helper():
+            time.sleep(0.1)
+        """,
+        "protocols/fixfile.py",
+        select="async-blocking",
+    )
+    assert out == []
+
+
+def test_async_blocking_suppression_at_anchor():
+    out = _ab(
+        """
+        import time
+
+        async def pump():
+            time.sleep(0.1)  # lint: ok(async-blocking)
+        """
+    )
+    assert out == []
+
+
+def test_async_blocking_baseline_identity_ignores_flow_and_line():
+    src = """
+        import time
+
+        async def pump():
+            time.sleep(0.1)
+    """
+    (v,) = _ab(src)
+    bl = Baseline.from_violations([v], "legacy stall, tracked")
+    # same chain shifted down a line: line and flow move, the
+    # (rule, path, message) identity — and so baseline coverage — holds
+    (v2,) = _ab("\n" + src)
+    assert v2.line != v.line or v2.flow != v.flow
+    assert bl.covers(v2)
+
+
+# ---------------------------------------------------------------------------
+# task-leak
+# ---------------------------------------------------------------------------
+
+
+def _tl(source, relpath="serve/fixfile.py"):
+    return _lint(source, relpath, select="task-leak")
+
+
+def test_task_leak_flags_fire_and_forget():
+    out = _tl(
+        """
+        import asyncio
+
+        async def serve(conn):
+            asyncio.create_task(handle(conn))
+        """
+    )
+    (v,) = out
+    assert "fire-and-forget create_task()" in v.message
+    assert "serve()" in v.message
+
+
+def test_task_leak_flags_local_assigned_never_read():
+    out = _tl(
+        """
+        import asyncio
+
+        async def serve(conn):
+            t = asyncio.ensure_future(handle(conn))
+            await drain(conn)
+        """
+    )
+    (v,) = out
+    assert "assigned to 't'" in v.message
+    assert "never read again" in v.message
+
+
+def test_task_leak_flags_self_attr_never_read():
+    out = _tl(
+        """
+        import asyncio
+
+        class Node:
+            def start(self):
+                self._pump = asyncio.create_task(self.pump())
+        """
+    )
+    (v,) = out
+    assert "self._pump" in v.message
+    assert "Node" in v.message
+
+
+def test_task_leak_clean_when_retained_and_settled():
+    out = _tl(
+        """
+        import asyncio
+
+        class Node:
+            def start(self):
+                self._pump = asyncio.create_task(self.pump())
+
+            async def close(self):
+                self._pump.cancel()
+
+        async def once():
+            t = asyncio.create_task(work())
+            await t
+
+        async def grouped(conns):
+            # nested in a wider expression: the reference is retained
+            # by construction
+            await asyncio.gather(*[asyncio.create_task(h(c)) for c in conns])
+        """
+    )
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# await-holding-lock
+# ---------------------------------------------------------------------------
+
+
+def _ahl(source, relpath="transport/fixfile.py"):
+    return _lint(source, relpath, select="await-holding-lock")
+
+
+def test_await_holding_lock_flags_await_under_threading_lock():
+    out = _ahl(
+        """
+        class Node:
+            async def flush(self):
+                with self._lock:
+                    await self._drain()
+        """
+    )
+    (v,) = out
+    assert "await while holding threading lock 'self._lock'" in v.message
+    assert "flush()" in v.message
+
+
+def test_await_holding_lock_flags_blocking_under_asyncio_lock():
+    out = _ahl(
+        """
+        import os
+
+        class Node:
+            async def flush(self):
+                async with self._algo_lock:
+                    os.fsync(self.fd)
+        """
+    )
+    (v,) = out
+    assert "blocking os.fsync" in v.message
+    assert "asyncio lock 'self._algo_lock'" in v.message
+
+
+def test_await_holding_lock_executor_hop_under_asyncio_lock_is_clean():
+    # the sanctioned form the serving planes use: hold the asyncio lock
+    # across the run_in_executor hop — the loop keeps running
+    out = _ahl(
+        """
+        class Node:
+            async def flush(self, loop):
+                async with self._algo_lock:
+                    step = await loop.run_in_executor(None, self._sync_flush)
+        """
+    )
+    assert out == []
+
+
+def test_await_holding_lock_ignores_non_lock_contexts():
+    out = _ahl(
+        """
+        class Node:
+            async def flush(self):
+                with self._session:
+                    await self._drain()
+        """
+    )
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# cancellation-safety
+# ---------------------------------------------------------------------------
+
+
+def _cs(source, relpath="transport/fixfile.py"):
+    return _lint(source, relpath, select="cancellation-safety")
+
+
+def test_cancellation_safety_flags_bare_except_around_await():
+    out = _cs(
+        """
+        class Node:
+            async def pump(self):
+                try:
+                    await self._inbox.get()
+                except:
+                    pass
+        """
+    )
+    (v,) = out
+    assert "bare except" in v.message
+    assert "swallows" in v.message
+
+
+def test_cancellation_safety_flags_base_exception_and_explicit_catch():
+    out = _cs(
+        """
+        import asyncio
+
+        async def pump(q):
+            try:
+                await q.get()
+            except BaseException:
+                log()
+
+        async def drain(q):
+            try:
+                await q.get()
+            except asyncio.CancelledError:
+                log()
+        """
+    )
+    assert len(out) == 2
+    msgs = "\n".join(v.message for v in out)
+    assert "BaseException" in msgs
+    assert "CancelledError" in msgs
+
+
+def test_cancellation_safety_allows_exception_and_reraise():
+    # CancelledError derives from BaseException since py3.8, so plain
+    # `except Exception` does not swallow it; an explicit catch with a
+    # bare `raise` propagates
+    out = _cs(
+        """
+        import asyncio
+
+        async def pump(q):
+            try:
+                await q.get()
+            except Exception:
+                log()
+
+        async def drain(q):
+            try:
+                await q.get()
+            except asyncio.CancelledError:
+                cleanup()
+                raise
+        """
+    )
+    assert out == []
+
+
+def test_cancellation_safety_sync_try_body_not_flagged():
+    # a body that never awaits cannot observe cancellation
+    out = _cs(
+        """
+        async def pump(q):
+            try:
+                q.get_nowait()
+            except:
+                pass
+        """
+    )
+    assert out == []
+
+
+def test_cancellation_safety_flags_unshielded_await_in_finally():
+    out = _cs(
+        """
+        async def serve(writer):
+            try:
+                await handle(writer)
+            finally:
+                await writer.wait_closed()
+        """
+    )
+    (v,) = out
+    assert "un-shielded await in a finally block" in v.message
+    assert "serve()" in v.message
+
+
+def test_cancellation_safety_shielded_finally_is_clean():
+    out = _cs(
+        """
+        import asyncio
+
+        async def serve(writer):
+            try:
+                await handle(writer)
+            finally:
+                await asyncio.shield(writer.wait_closed())
+        """
+    )
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# async rules on the CLI surface: --changed widening, lint_run counts
+# ---------------------------------------------------------------------------
+
+
+def test_changed_widening_includes_async_blocking_everywhere():
+    from hbbft_tpu.analysis.cli import _widening_rules
+
+    # async-blocking's scope is empty on purpose — the call graph spans
+    # the package, so any package edit widens it (the blocking bodies
+    # live in recover/ and crypto/, far from the coroutine roots)
+    widened = _widening_rules(["/x/hbbft_tpu/ops/pallas_ec.py"], RULES)
+    assert "async-blocking" in widened
+    widened = _widening_rules(["/x/hbbft_tpu/transport/tcp.py"], RULES)
+    assert "async-blocking" in widened
+    # but not for files outside the package
+    assert "async-blocking" not in _widening_rules(
+        ["/x/tests/test_foo.py"], RULES
+    )
+
+
+def test_cli_trace_counts_async_rules(tmp_path, capsys):
+    f = _write_pkg_file(
+        tmp_path,
+        "transport/fixfile.py",
+        "import time\n\n\nasync def pump():\n    time.sleep(0.1)\n",
+    )
+    trace = tmp_path / "trace.jsonl"
+    rc = cli_main(
+        ["--no-baseline", "--select", "async-blocking", "--trace",
+         str(trace), str(f)]
+    )
+    capsys.readouterr()
+    assert rc == 1
+    events = [json.loads(l) for l in trace.read_text().splitlines()]
+    (run,) = [e for e in events if e.get("ev") == "lint_run"]
+    assert run["counts"] == {"async-blocking": 1}
+
+
+def test_async_rules_registered():
+    names = {r.name for r in RULES}
+    assert {
+        "async-blocking",
+        "task-leak",
+        "await-holding-lock",
+        "cancellation-safety",
+    } <= names
+    assert len(RULES) == 17

@@ -197,15 +197,22 @@ def test_merkle_type_guard_revert_redetects(tmp_path):
 def test_tcp_handler_catch_revert_redetects_dispatch(tmp_path):
     # PR 6: the TcpNode pump stopped crashing on handler exceptions —
     # malformed-but-deserializable messages become attributed faults
+    # (the handler call is offloaded through run_in_executor — the
+    # taint engine unwraps the hop, so the guard credit still comes
+    # from the try/except around it)
     violations = _revert_and_lint(
         tmp_path,
         "transport/tcp.py",
-        "            try:\n"
-        "                step = self.algo.handle_message(sender, message)\n"
-        "            except Exception:",
-        "            if True:\n"
-        "                step = self.algo.handle_message(sender, message)\n"
-        "            if False:",
+        "                try:\n"
+        "                    step = await loop.run_in_executor(\n"
+        "                        None, self.algo.handle_message, sender, message\n"
+        "                    )\n"
+        "                except Exception:",
+        "                if True:\n"
+        "                    step = await loop.run_in_executor(\n"
+        "                        None, self.algo.handle_message, sender, message\n"
+        "                    )\n"
+        "                if False:",
     )
     hits = [
         v
