@@ -7,10 +7,13 @@
     python -m hbbft_tpu.analysis --format sarif      # PR annotations
     python -m hbbft_tpu.analysis --write-baseline    # re-baseline (reviewed!)
     python -m hbbft_tpu.analysis --write-wire-manifest  # pin @wire registry
+    python -m hbbft_tpu.analysis --write-range-manifest # pin limbprove peaks
     python -m hbbft_tpu.analysis --racecheck tests/test_racecheck.py
                                   # runtime lockset checker over pytest
     python -m hbbft_tpu.analysis --stallcheck tests/test_stallcheck.py
                                   # event-loop stall sanitizer over pytest
+    python -m hbbft_tpu.analysis --rangecheck tests/test_fused_flush.py
+                                  # exact-shadow overflow sanitizer over pytest
 
 Exit codes: 0 clean (baselined violations allowed), 1 new violations
 or parse errors, 2 usage error.
@@ -65,6 +68,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "paths and exit 0",
     )
     parser.add_argument(
+        "--write-range-manifest",
+        action="store_true",
+        help="re-verify every registered kernel with limbprove "
+        "(analysis.rangecheck) and pin the proof-obligation peaks to "
+        "range_manifest.json, then exit 0",
+    )
+    parser.add_argument(
         "--baseline",
         default=DEFAULT_BASELINE,
         help="baseline file (default: the checked-in one)",
@@ -116,6 +126,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "and render its stall reports like lint violations",
     )
     parser.add_argument(
+        "--rangecheck",
+        metavar="TEST_EXPR",
+        help="run `pytest --rangecheck TEST_EXPR` in a subprocess under "
+        "the arbitrary-precision shadow sanitizer "
+        "(hbbft_tpu.analysis.rangeshadow) and render its overflow "
+        "witnesses like lint violations",
+    )
+    parser.add_argument(
         "--stall-budget",
         type=float,
         default=None,
@@ -130,6 +148,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _run_racecheck(args.racecheck, fmt)
     if args.stallcheck is not None:
         return _run_stallcheck(args.stallcheck, fmt, args.stall_budget)
+    if args.rangecheck is not None:
+        return _run_rangecheck(args.rangecheck, fmt)
+
+    if args.write_range_manifest:
+        from . import rangecheck as _rk
+
+        result = _rk.verify_all()
+        path = _rk.write_manifest()
+        obligations = [o for r in result.reports for o in r.obligations]
+        print(
+            f"wrote {len(obligations)} obligation(s) "
+            f"({sum(1 for o in obligations if o.proved)} proved) to {path}"
+        )
+        if args.trace:
+            _emit_range_event(args.trace, result)
+        return 0 if result.proved else 1
 
     rules = all_rules()
     if args.list_rules:
@@ -211,6 +245,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             paths=len(paths),
             changed=bool(args.changed),
         )
+        _range_event_if_ran(rec)
         obs.disable()
 
     if fmt == "json":
@@ -246,6 +281,34 @@ def main(argv: Optional[List[str]] = None) -> int:
             suffix = f" ({len(baselined)} baselined)" if baselined else ""
             print(f"clean{suffix}")
     return 1 if (new or errors) else 0
+
+
+def _range_event_if_ran(rec) -> None:
+    """Emit a ``range_check`` obs event when limbprove verified kernels
+    during this run (the ``limb-range`` rule memoizes its RunResult)."""
+    mod = sys.modules.get(__package__ + ".rangecheck")
+    result = getattr(mod, "_VERIFY_CACHE", None) if mod else None
+    if result is None:
+        return
+    rec.event(
+        "range_check",
+        obligations=len(result.obligations),
+        proved=sum(1 for o in result.obligations if o.proved),
+        wall=round(result.wall, 6),
+    )
+
+
+def _emit_range_event(trace_path: str, result) -> None:
+    from .. import obs
+
+    rec = obs.enable(trace_path)
+    rec.event(
+        "range_check",
+        obligations=len(result.obligations),
+        proved=sum(1 for o in result.obligations if o.proved),
+        wall=round(result.wall, 6),
+    )
+    obs.disable()
 
 
 def _git_changed_files() -> List[str]:
@@ -358,6 +421,67 @@ def _run_racecheck(test_expr: str, fmt: str) -> int:
             print(f"\n{len(violations)} candidate race(s)")
         else:
             print("racecheck clean")
+    return 1 if (violations or proc.returncode) else 0
+
+
+def _run_rangecheck(test_expr: str, fmt: str) -> int:
+    """Drive ``pytest --rangecheck`` in a subprocess (the kernel shims
+    must live in the process that runs the tests), collect the JSONL
+    overflow witnesses and render them with the usual formatters."""
+    import shlex
+    import subprocess
+    import tempfile
+
+    from . import rangeshadow as _rs
+
+    repo_root = os.path.dirname(os.path.dirname(_HERE))
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "rangecheck.jsonl")
+        env = dict(os.environ)
+        env[_rs.OUT_ENV] = out
+        cmd = [
+            sys.executable,
+            "-m",
+            "pytest",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            "--rangecheck",
+            *shlex.split(test_expr),
+        ]
+        proc = subprocess.run(cmd, env=env, cwd=repo_root)
+        reports = _rs.load_reports(out)
+
+    violations = [r.as_violation() for r in reports]
+    if fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "violations": [v.as_dict() for v in violations],
+                    "pytest_exit": proc.returncode,
+                    "ok": not violations and proc.returncode == 0,
+                },
+                indent=2,
+            )
+        )
+    elif fmt == "sarif":
+
+        class _RkRule:
+            name = "rangecheck"
+            description = (
+                "exact-shadow overflow sanitizer: sampled device kernel "
+                "calls match their arbitrary-precision recomputation"
+            )
+
+        print(json.dumps(_sarif(violations, [], [_RkRule()]), indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+        if violations:
+            print(f"\n{len(violations)} overflow witness(es)")
+        else:
+            print("rangecheck clean")
     return 1 if (violations or proc.returncode) else 0
 
 

@@ -1,0 +1,1999 @@
+"""limbprove: jaxpr-level integer range verification for the crypto kernels.
+
+Every BLS12-381 kernel in ``ops/`` rests on overflow invariants that
+historically lived only in comments (``38 * (2**12)**2 < 2**31`` in
+``limbs.py``, the "~2.6% under the ceiling" carry-sweep bound in
+``fr_jax.py``).  This module turns those comments into checked proofs:
+it traces each registered kernel to a jaxpr with ``jax.make_jaxpr`` and
+propagates integer *value intervals* through the primitive graph,
+deriving a sound bound for every intermediate tensor.
+
+The abstract domain
+-------------------
+An abstract value (:class:`AVal`) carries, per jaxpr variable:
+
+* ``iv`` — a single interval ``[lo, hi]`` over arbitrary-precision
+  Python ints covering every element (``None`` for non-integer dtypes,
+  which the engine does not track);
+* optionally ``pos`` — per-index intervals along ONE tracked axis
+  (``pos_axis``), which is what lets the fold/slice proofs in
+  ``fr_jax`` distinguish "digit 33 is provably zero after three folds"
+  from "some digit somewhere is zero";
+* optionally ``const`` — the exact element values (object-dtype numpy
+  array) for small literal/constant tensors such as fold tables, which
+  feeds the positional ``dot_general`` refinement.
+
+Overflow policy
+---------------
+Signed dtypes: an interval escaping the dtype's range is a *failed
+proof obligation* (the analyzer clamps and keeps going so one overflow
+does not hide others).  Unsigned dtypes: wraparound is defined
+behaviour in XLA and is *deliberate* in ``sha256_jax``, so the interval
+is widened to the full unsigned range instead — the ``(tot & 0xFF)
+.astype(uint8)`` idiom stays silent, as it should.
+
+Proof obligations
+-----------------
+Per kernel the engine emits keyed obligations (``kernel:kind``):
+
+* ``cap-int8/16/32/64`` — the peak signed magnitude observed for that
+  dtype must fit the dtype (one obligation per signed dtype seen);
+* ``out-invariant`` — declared output bound (the redundant-limb
+  invariant, e.g. ``|limb| <= 2**(LIMB_BITS+1)-1`` after normalize);
+* ``slice-exact`` — the final narrowing slice of a kernel drops only
+  provably-zero positions (the ``fr_jax`` fold fixed-point);
+* ``unhandled-primitive`` / ``trace-error`` — the engine refused to
+  guess; always unproved.
+
+Obligations are pinned append-only in ``range_manifest.json`` (the
+wire-manifest mold): a kernel edit that weakens a pinned peak is a loud
+diff, not a latent wrap.  ``--write-range-manifest`` regenerates it.
+
+The runtime dual (shadow sanitizer) lives in ``rangeshadow.py``.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MANIFEST_NAME = "range_manifest.json"
+DEFAULT_MANIFEST = os.path.join(os.path.dirname(__file__), MANIFEST_NAME)
+
+# Exact-value tracking is capped so a stray megabyte constant cannot
+# turn interval analysis into concrete interpretation.
+_CONST_CAP = 4096
+
+# Fixpoint iteration for scan/while bodies: join carries until stable,
+# widen any still-moving carry to the full dtype range at _WIDEN_AT so
+# termination never depends on the loop's numeric behaviour.
+_MAX_ITERS = 8
+_WIDEN_AT = 5
+
+_FLOW_DEPTH = 12
+
+
+# --------------------------------------------------------------------------
+# intervals
+
+
+@dataclass(frozen=True)
+class Interval:
+    lo: int
+    hi: int
+
+    def __post_init__(self) -> None:
+        if self.lo > self.hi:  # pragma: no cover - constructor misuse
+            raise ValueError(f"empty interval [{self.lo}, {self.hi}]")
+
+    @property
+    def mag(self) -> int:
+        return max(self.hi, -self.lo, 0)
+
+
+def iv_point(v: int) -> Interval:
+    return Interval(int(v), int(v))
+
+
+def iv_join(a: Interval, b: Interval) -> Interval:
+    return Interval(min(a.lo, b.lo), max(a.hi, b.hi))
+
+
+def iv_union(ivs: Sequence[Interval]) -> Interval:
+    out = ivs[0]
+    for x in ivs[1:]:
+        out = iv_join(out, x)
+    return out
+
+
+def iv_add(a: Interval, b: Interval) -> Interval:
+    return Interval(a.lo + b.lo, a.hi + b.hi)
+
+
+def iv_sub(a: Interval, b: Interval) -> Interval:
+    return Interval(a.lo - b.hi, a.hi - b.lo)
+
+
+def iv_mul(a: Interval, b: Interval) -> Interval:
+    c = (a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi)
+    return Interval(min(c), max(c))
+
+
+def iv_neg(a: Interval) -> Interval:
+    return Interval(-a.hi, -a.lo)
+
+
+def iv_min(a: Interval, b: Interval) -> Interval:
+    return Interval(min(a.lo, b.lo), min(a.hi, b.hi))
+
+
+def iv_max(a: Interval, b: Interval) -> Interval:
+    return Interval(max(a.lo, b.lo), max(a.hi, b.hi))
+
+
+def iv_abs(a: Interval) -> Interval:
+    if a.lo >= 0:
+        return a
+    if a.hi <= 0:
+        return Interval(-a.hi, -a.lo)
+    return Interval(0, max(-a.lo, a.hi))
+
+
+def iv_scale(a: Interval, k: int) -> Interval:
+    if k >= 0:
+        return Interval(a.lo * k, a.hi * k)
+    return Interval(a.hi * k, a.lo * k)
+
+
+def iv_shr(a: Interval, s: Interval) -> Interval:
+    """Arithmetic right shift (Python ``>>`` is already arithmetic)."""
+    s_lo, s_hi = max(s.lo, 0), max(s.hi, 0)
+    cands = (a.lo >> s_lo, a.lo >> s_hi, a.hi >> s_lo, a.hi >> s_hi)
+    return Interval(min(cands), max(cands))
+
+
+def iv_shl(a: Interval, s: Interval) -> Interval:
+    s_lo, s_hi = max(s.lo, 0), max(s.hi, 0)
+    cands = (a.lo << s_lo, a.lo << s_hi, a.hi << s_lo, a.hi << s_hi)
+    return Interval(min(cands), max(cands))
+
+
+def _tdiv(x: int, y: int) -> int:
+    q = abs(x) // abs(y)
+    return q if (x < 0) == (y < 0) else -q
+
+
+def iv_div(a: Interval, b: Interval) -> Optional[Interval]:
+    """C-style truncating division; None when the divisor spans zero."""
+    if b.lo <= 0 <= b.hi:
+        return None
+    c = (_tdiv(a.lo, b.lo), _tdiv(a.lo, b.hi), _tdiv(a.hi, b.lo), _tdiv(a.hi, b.hi))
+    return Interval(min(c), max(c))
+
+
+def iv_rem(a: Interval, b: Interval) -> Interval:
+    """C-style remainder: sign follows the dividend, |r| < max|b|."""
+    m = max(abs(b.lo), abs(b.hi), 1) - 1
+    lo = 0 if a.lo >= 0 else -m
+    hi = 0 if a.hi <= 0 else m
+    return Interval(max(lo, -iv_abs(a).hi if a.lo < 0 else 0), min(hi, iv_abs(a).hi))
+
+
+def iv_pow(a: Interval, y: int) -> Interval:
+    c = [a.lo**y, a.hi**y]
+    if y % 2 == 0 and a.lo <= 0 <= a.hi:
+        c.append(0)
+    return Interval(min(c), max(c))
+
+
+# --------------------------------------------------------------------------
+# dtypes
+
+
+def _dtype_kind(dtype: Any) -> Tuple[bool, bool, int]:
+    """(is_tracked_integer, is_signed, bits) for a numpy dtype."""
+    try:
+        d = np.dtype(dtype)
+    except TypeError:
+        # jax extended dtypes (PRNG key arrays) are opaque: untracked
+        return False, False, 0
+    if d == np.bool_:
+        return True, False, 1
+    if np.issubdtype(d, np.signedinteger):
+        return True, True, d.itemsize * 8
+    if np.issubdtype(d, np.unsignedinteger):
+        return True, False, d.itemsize * 8
+    return False, False, 0
+
+
+def dtype_range(dtype: Any) -> Interval:
+    tracked, signed, bits = _dtype_kind(dtype)
+    if not tracked:  # pragma: no cover - callers guard on tracked
+        raise ValueError(f"untracked dtype {dtype}")
+    if np.dtype(dtype) == np.bool_:
+        return Interval(0, 1)
+    if signed:
+        return Interval(-(1 << (bits - 1)), (1 << (bits - 1)) - 1)
+    return Interval(0, (1 << bits) - 1)
+
+
+# --------------------------------------------------------------------------
+# abstract values
+
+
+@dataclass(frozen=True)
+class AVal:
+    """Abstract value for one jaxpr variable.
+
+    ``iv`` is None for untracked (float) dtypes.  ``pos`` holds
+    per-index intervals along ``pos_axis`` only; ``const`` holds exact
+    values for small constants.  ``iv`` always covers both.
+    """
+
+    shape: Tuple[int, ...]
+    dtype: Any
+    iv: Optional[Interval]
+    pos: Optional[Tuple[Interval, ...]] = None
+    pos_axis: Optional[int] = None
+    const: Optional[np.ndarray] = None
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def pos_along(self, axis: int) -> Optional[Tuple[Interval, ...]]:
+        """Real per-index intervals along ``axis``, or None."""
+        if axis < 0:
+            axis += self.ndim
+        if self.const is not None:
+            n = self.shape[axis]
+            moved = np.moveaxis(self.const, axis, 0).reshape(n, -1)
+            return tuple(
+                Interval(int(min(row, default=0)), int(max(row, default=0)))
+                if row.size
+                else Interval(0, 0)
+                for row in (moved[i] for i in range(n))
+            )
+        if self.pos is not None and self.pos_axis == axis:
+            return self.pos
+        return None
+
+    def uniform(self, axis: int) -> Tuple[Interval, ...]:
+        if axis < 0:
+            axis += self.ndim
+        assert self.iv is not None
+        return (self.iv,) * self.shape[axis]
+
+    def scalar_const(self) -> Optional[int]:
+        """The exact value when every element is the same constant."""
+        if self.const is None or self.const.size == 0:
+            return None
+        flat = self.const.ravel()
+        v = flat[0]
+        return int(v) if all(x == v for x in flat) else None
+
+
+def _const_array(val: Any) -> Optional[np.ndarray]:
+    arr = np.asarray(val)
+    if arr.size > _CONST_CAP or not (
+        np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_
+    ):
+        return None
+    return arr.astype(object)
+
+
+def make_aval(
+    shape: Sequence[int],
+    dtype: Any,
+    iv: Optional[Interval] = None,
+    pos: Optional[Sequence[Interval]] = None,
+    pos_axis: Optional[int] = None,
+    const: Optional[np.ndarray] = None,
+) -> AVal:
+    """Normalizing constructor: derives ``iv`` from const/pos if absent."""
+    shape = tuple(int(s) for s in shape)
+    tracked, _, _ = _dtype_kind(dtype)
+    if not tracked:
+        return AVal(shape, dtype, None)
+    if const is not None:
+        flat = const.ravel()
+        if flat.size:
+            iv = Interval(int(min(flat)), int(max(flat)))
+        else:
+            iv = Interval(0, 0)
+    if iv is None and pos:
+        iv = iv_union(list(pos))
+    if iv is None:
+        iv = dtype_range(dtype)
+    if pos is not None:
+        if pos_axis is None or not (0 <= pos_axis < len(shape)):
+            pos, pos_axis = None, None
+        elif len(pos) != shape[pos_axis]:
+            pos, pos_axis = None, None
+        else:
+            pos = tuple(pos)
+    if pos is None:
+        pos_axis = None
+    return AVal(shape, np.dtype(dtype), iv, pos, pos_axis, const)
+
+
+# --------------------------------------------------------------------------
+# obligations
+
+
+@dataclass(frozen=True)
+class Obligation:
+    """One keyed proof obligation: ``peak`` must stay within ``capacity``."""
+
+    kernel: str
+    kind: str
+    peak: int
+    capacity: int
+    proved: bool
+    site: Optional[Tuple[str, int, str]] = None
+    flow: Optional[Tuple[Tuple[str, int, str], ...]] = None
+    message: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.kernel}:{self.kind}"
+
+
+@dataclass
+class KernelReport:
+    kernel: str
+    obligations: List[Obligation] = field(default_factory=list)
+    n_eqns: int = 0
+
+    @property
+    def proved(self) -> bool:
+        return all(o.proved for o in self.obligations)
+
+
+@dataclass(frozen=True)
+class ArgSpec:
+    """Declared bound for one kernel argument."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    lo: int = 0
+    hi: int = 0
+    const: Optional[Tuple[Tuple[int, ...], ...]] = None  # or raw ndarray via make
+
+    def aval(self) -> AVal:
+        const = None
+        if self.const is not None:
+            const = _const_array(np.asarray(self.const).reshape(self.shape))
+        return make_aval(
+            self.shape, self.dtype, Interval(int(self.lo), int(self.hi)), const=const
+        )
+
+
+def arg(shape: Sequence[int], dtype: str, lo: int, hi: int) -> ArgSpec:
+    return ArgSpec(tuple(int(s) for s in shape), dtype, int(lo), int(hi))
+
+
+def const_arg(value: np.ndarray) -> ArgSpec:
+    """Argument whose exact value is known (fold tables, sub_pad rows)."""
+    a = np.asarray(value)
+    return ArgSpec(
+        tuple(a.shape),
+        str(a.dtype),
+        int(a.min()) if a.size else 0,
+        int(a.max()) if a.size else 0,
+        const=tuple(map(tuple, a.reshape(a.shape[0], -1))) if a.ndim > 1 else tuple(a),
+    )
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One registered kernel: how to trace it and what it must satisfy."""
+
+    name: str
+    fn: Callable[..., Any]
+    args: Tuple[ArgSpec, ...]
+    out_lo: Optional[int] = None
+    out_hi: Optional[int] = None
+    final_slice_exact: bool = False
+
+
+# --------------------------------------------------------------------------
+# the interpreter
+
+
+def _iv_clamp(a: Interval, rng: Interval) -> Interval:
+    lo = min(max(a.lo, rng.lo), rng.hi)
+    hi = max(min(a.hi, rng.hi), rng.lo)
+    return Interval(lo, hi)
+
+
+_PKG_MARK = os.sep + "hbbft_tpu" + os.sep
+
+
+def _eqn_site(eqn: Any) -> Optional[Tuple[str, int, str]]:
+    """Innermost package-relative (path, line, function) for an eqn."""
+    si = getattr(eqn, "source_info", None)
+    tb = getattr(si, "traceback", None)
+    if tb is None:
+        return None
+    for fr in tb.frames:
+        fn = fr.file_name
+        i = fn.rfind(_PKG_MARK)
+        if i >= 0:
+            rel = fn[i + len(_PKG_MARK) :].replace(os.sep, "/")
+            return (rel, int(fr.line_num), fr.function_name)
+    return None
+
+
+def _prod(xs: Iterable[int]) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+# Ops whose output is element-for-element one input (for the
+# final-slice provenance walk).
+_IDENTITY_PRIMS = {
+    "convert_element_type",
+    "reshape",
+    "squeeze",
+    "broadcast_in_dim",
+    "device_put",
+    "copy",
+    "transpose",
+    "stop_gradient",
+    "sharding_constraint",
+}
+
+
+class Analyzer:
+    """Abstract interpreter over one kernel's jaxpr."""
+
+    def __init__(self, kernel: str, record: bool = True) -> None:
+        self.kernel = kernel
+        self.record = record
+        self.env: Dict[Any, AVal] = {}
+        self.prov: Dict[Any, Any] = {}
+        # dtype name -> (peak signed magnitude, eqn where attained)
+        self.peaks: Dict[str, Tuple[int, Any]] = {}
+        # primitive name -> first eqn it appeared in
+        self.unhandled: Dict[str, Any] = {}
+        self.n_eqns = 0
+
+    # -- environment ------------------------------------------------------
+
+    def read(self, atom: Any) -> AVal:
+        from jax import core as jcore
+
+        if isinstance(atom, jcore.Literal):
+            arr = np.asarray(atom.val)
+            tracked, _, _ = _dtype_kind(arr.dtype)
+            if not tracked:
+                return AVal(tuple(arr.shape), arr.dtype, None)
+            iv = Interval(int(arr.min()), int(arr.max())) if arr.size else Interval(0, 0)
+            return make_aval(arr.shape, arr.dtype, iv, const=_const_array(arr))
+        av = self.env.get(atom)
+        if av is None:
+            shape = tuple(atom.aval.shape)
+            dtype = atom.aval.dtype
+            tracked, _, _ = _dtype_kind(dtype)
+            return make_aval(shape, dtype) if tracked else AVal(shape, dtype, None)
+        return av
+
+    def _set(
+        self,
+        eqn: Any,
+        var: Any,
+        iv: Optional[Interval] = None,
+        pos: Optional[Sequence[Interval]] = None,
+        pos_axis: Optional[int] = None,
+        const: Optional[np.ndarray] = None,
+    ) -> None:
+        shape = tuple(var.aval.shape)
+        tracked, signed, _bits = _dtype_kind(var.aval.dtype)
+        if not tracked:
+            self.env[var] = AVal(shape, var.aval.dtype, None)
+            if self.record:
+                self.prov[var] = eqn
+            return
+        dtype = np.dtype(var.aval.dtype)
+        av = make_aval(shape, dtype, iv, pos, pos_axis, const)
+        rng = dtype_range(dtype)
+        if signed and self.record:
+            m = av.iv.mag
+            cur = self.peaks.get(dtype.name)
+            if cur is None or m > cur[0]:
+                self.peaks[dtype.name] = (m, eqn)
+        if av.iv.lo < rng.lo or av.iv.hi > rng.hi:
+            if signed:
+                cpos = tuple(_iv_clamp(p, rng) for p in av.pos) if av.pos else None
+                av = AVal(
+                    shape,
+                    dtype,
+                    _iv_clamp(av.iv, rng),
+                    cpos,
+                    av.pos_axis if cpos else None,
+                    None,
+                )
+            else:
+                # Unsigned wraparound is defined (and deliberate in
+                # sha256_jax): widen, do not flag.
+                av = AVal(shape, dtype, rng)
+        self.env[var] = av
+        if self.record:
+            self.prov[var] = eqn
+
+    def _copy_out(self, eqn: Any, var: Any, av: AVal) -> None:
+        self._set(eqn, var, av.iv, av.pos, av.pos_axis, av.const)
+
+    def _note_peak(self, dtype: Any, mag: int, eqn: Any) -> None:
+        dtype = np.dtype(dtype)
+        _tracked, signed, _ = _dtype_kind(dtype)
+        if signed and self.record:
+            cur = self.peaks.get(dtype.name)
+            if cur is None or mag > cur[0]:
+                self.peaks[dtype.name] = (mag, eqn)
+
+    # -- driving ----------------------------------------------------------
+
+    def interpret(self, closed: Any, in_avals: Sequence[AVal]) -> List[AVal]:
+        jaxpr = closed.jaxpr
+        for v, c in zip(jaxpr.constvars, closed.consts):
+            arr = np.asarray(c)
+            tracked, _, _ = _dtype_kind(arr.dtype)
+            if tracked:
+                iv = (
+                    Interval(int(arr.min()), int(arr.max()))
+                    if arr.size
+                    else Interval(0, 0)
+                )
+                self.env[v] = make_aval(arr.shape, arr.dtype, iv, const=_const_array(arr))
+            else:
+                self.env[v] = AVal(tuple(arr.shape), arr.dtype, None)
+        for v, av in zip(jaxpr.invars, in_avals):
+            self.env[v] = av
+        self.run_eqns(jaxpr.eqns)
+        return [self.read(v) for v in jaxpr.outvars]
+
+    def run_eqns(self, eqns: Sequence[Any]) -> None:
+        for eqn in eqns:
+            self.n_eqns += 1
+            name = eqn.primitive.name
+            h = _HANDLERS.get(name)
+            if h is None:
+                self._unknown(eqn)
+            else:
+                getattr(self, h)(eqn)
+
+    def _unknown(self, eqn: Any) -> None:
+        flagged = False
+        for ov in eqn.outvars:
+            tracked, _, bits = _dtype_kind(ov.aval.dtype)
+            if tracked and bits > 1:
+                flagged = True
+            self._set(eqn, ov)
+        if flagged and self.record and eqn.primitive.name not in self.unhandled:
+            self.unhandled[eqn.primitive.name] = eqn
+
+    # -- flow chains ------------------------------------------------------
+
+    def flow(self, eqn: Any) -> Optional[Tuple[Tuple[str, int, str], ...]]:
+        """Equation chain root -> ``eqn``, as package-relative sites."""
+        from jax import core as jcore
+
+        chain = [eqn]
+        cur = eqn
+        for _ in range(_FLOW_DEPTH):
+            best, best_mag = None, -1
+            for v in cur.invars:
+                if isinstance(v, jcore.Var) and v in self.prov:
+                    av = self.env.get(v)
+                    mag = av.iv.mag if av is not None and av.iv is not None else 0
+                    if mag > best_mag:
+                        best, best_mag = v, mag
+            if best is None:
+                break
+            cur = self.prov[best]
+            chain.append(cur)
+        sites: List[Tuple[str, int, str]] = []
+        for e in reversed(chain):
+            s = _eqn_site(e)
+            if s is not None and (not sites or sites[-1] != s):
+                sites.append(s)
+        return tuple(sites) or None
+
+    # -- elementwise ------------------------------------------------------
+
+    def _pos_axis_of(self, avs: Sequence[AVal], out_shape: Tuple[int, ...]) -> Optional[int]:
+        """An axis along which at least one input has real info."""
+        for a in avs:
+            if a.pos is not None and a.ndim == len(out_shape):
+                return a.pos_axis
+        if out_shape and any(
+            a.const is not None and a.ndim == len(out_shape) for a in avs
+        ):
+            return len(out_shape) - 1
+        return None
+
+    def _ew(
+        self,
+        eqn: Any,
+        f: Callable[..., Optional[Interval]],
+        cf: Optional[Callable[..., Any]] = None,
+    ) -> None:
+        avs = [self.read(x) for x in eqn.invars]
+        out = eqn.outvars[0]
+        if any(a.iv is None for a in avs):
+            return self._set(eqn, out)
+        iv = f(*[a.iv for a in avs])
+        if iv is None:
+            return self._set(eqn, out)
+        out_shape = tuple(out.aval.shape)
+        const = None
+        if (
+            cf is not None
+            and all(a.const is not None for a in avs)
+            and _prod(out_shape) <= _CONST_CAP
+        ):
+            try:
+                const = np.asarray(cf(*[a.const for a in avs]), dtype=object)
+            except Exception:
+                const = None
+        pos = pos_axis = None
+        if const is None:
+            ax = self._pos_axis_of(avs, out_shape)
+            if ax is not None:
+                n = out_shape[ax]
+                cols = []
+                for a in avs:
+                    if a.ndim == 0:
+                        cols.append((a.iv,) * n)
+                    else:
+                        cols.append(a.pos_along(ax) or a.uniform(ax))
+                ps = []
+                ok = all(len(c) == n for c in cols)
+                for i in range(n if ok else 0):
+                    p = f(*[c[i] for c in cols])
+                    if p is None:
+                        ok = False
+                        break
+                    ps.append(p)
+                if ok:
+                    pos, pos_axis = ps, ax
+        self._set(eqn, out, iv, pos, pos_axis, const)
+
+    # each handler is `_p_<name>`; the dispatch table is built below
+
+    def _p_add(self, eqn):
+        self._ew(eqn, iv_add, lambda a, b: a + b)
+
+    def _p_sub(self, eqn):
+        self._ew(eqn, iv_sub, lambda a, b: a - b)
+
+    def _p_mul(self, eqn):
+        self._ew(eqn, iv_mul, lambda a, b: a * b)
+
+    def _p_neg(self, eqn):
+        self._ew(eqn, iv_neg, lambda a: -a)
+
+    def _p_abs(self, eqn):
+        self._ew(eqn, iv_abs)
+
+    def _p_sign(self, eqn):
+        def f(a):
+            lo = -1 if a.lo < 0 else (0 if a.lo == 0 else 1)
+            hi = 1 if a.hi > 0 else (0 if a.hi == 0 else -1)
+            return Interval(lo, hi)
+
+        self._ew(eqn, f)
+
+    def _p_min(self, eqn):
+        self._ew(eqn, iv_min, np.minimum)
+
+    def _p_max(self, eqn):
+        self._ew(eqn, iv_max, np.maximum)
+
+    def _p_and(self, eqn):
+        def f(a, b):
+            if a.lo >= 0 and b.lo >= 0:
+                return Interval(0, min(a.hi, b.hi))
+            if b.lo >= 0:
+                return Interval(0, b.hi)
+            if a.lo >= 0:
+                return Interval(0, a.hi)
+            return None
+
+        self._ew(eqn, f, lambda a, b: a & b)
+
+    def _p_or(self, eqn):
+        def f(a, b):
+            if a.lo >= 0 and b.lo >= 0:
+                bits = max(a.hi.bit_length(), b.hi.bit_length())
+                return Interval(max(a.lo, b.lo), (1 << bits) - 1)
+            return None
+
+        self._ew(eqn, f, lambda a, b: a | b)
+
+    def _p_xor(self, eqn):
+        def f(a, b):
+            if a.lo >= 0 and b.lo >= 0:
+                bits = max(a.hi.bit_length(), b.hi.bit_length())
+                return Interval(0, (1 << bits) - 1)
+            return None
+
+        self._ew(eqn, f, lambda a, b: a ^ b)
+
+    def _p_not(self, eqn):
+        self._ew(eqn, lambda a: Interval(-a.hi - 1, -a.lo - 1))
+
+    def _p_shift_left(self, eqn):
+        self._ew(eqn, iv_shl, lambda a, b: a << b)
+
+    def _p_shift_right_arithmetic(self, eqn):
+        self._ew(eqn, iv_shr, lambda a, b: a >> b)
+
+    def _p_shift_right_logical(self, eqn):
+        out = eqn.outvars[0]
+        _tracked, _signed, bits = _dtype_kind(out.aval.dtype)
+
+        def f(a, s):
+            if a.lo >= 0:
+                return iv_shr(a, s)
+            # a negative operand reinterprets as a huge unsigned value
+            top = (1 << bits) - 1 if bits else a.hi
+            return iv_join(Interval(min(a.lo, 0), max(a.hi, 0)), Interval(0, top >> max(s.lo, 0)))
+
+        self._ew(eqn, f)
+
+    def _p_div(self, eqn):
+        self._ew(eqn, iv_div)
+
+    def _p_rem(self, eqn):
+        self._ew(eqn, iv_rem)
+
+    def _p_integer_pow(self, eqn):
+        y = int(eqn.params["y"])
+        self._ew(eqn, lambda a: iv_pow(a, y))
+
+    def _p_clamp(self, eqn):
+        def f(mn, x, mx):
+            lo = max(mn.lo, min(x.lo, mx.hi))
+            hi = min(mx.hi, max(x.hi, mn.lo))
+            return Interval(min(lo, hi), max(lo, hi))
+
+        self._ew(eqn, f)
+
+    def _p_cmp(self, eqn):
+        self._set(eqn, eqn.outvars[0], Interval(0, 1))
+
+    def _p_select_n(self, eqn):
+        cases = [self.read(v) for v in eqn.invars[1:]]
+        out = eqn.outvars[0]
+        if any(c.iv is None for c in cases):
+            return self._set(eqn, out)
+        iv = iv_union([c.iv for c in cases])
+        out_shape = tuple(out.aval.shape)
+        pos = pos_axis = None
+        ax = self._pos_axis_of(cases, out_shape)
+        if ax is not None:
+            n = out_shape[ax]
+            cols = [
+                ((c.iv,) * n if c.ndim == 0 else (c.pos_along(ax) or c.uniform(ax)))
+                for c in cases
+            ]
+            if all(len(col) == n for col in cols):
+                pos = [iv_union([col[i] for col in cols]) for i in range(n)]
+                pos_axis = ax
+        self._set(eqn, out, iv, pos, pos_axis)
+
+    def _p_convert(self, eqn):
+        a = self.read(eqn.invars[0])
+        self._copy_out(eqn, eqn.outvars[0], a)
+
+    def _p_identity(self, eqn):
+        for ov, v in zip(eqn.outvars, eqn.invars):
+            self._copy_out(eqn, ov, self.read(v))
+
+    def _p_threefry(self, eqn):
+        for ov in eqn.outvars:
+            tracked, _, _ = _dtype_kind(ov.aval.dtype)
+            self._set(eqn, ov, dtype_range(ov.aval.dtype) if tracked else None)
+
+    # -- structural -------------------------------------------------------
+
+    def _p_broadcast_in_dim(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        shape = tuple(int(s) for s in eqn.params["shape"])
+        bd = tuple(int(d) for d in eqn.params["broadcast_dimensions"])
+        const = None
+        if a.const is not None and _prod(shape) <= _CONST_CAP:
+            tmp = [1] * len(shape)
+            for i, d in enumerate(bd):
+                tmp[d] = a.shape[i]
+            const = np.broadcast_to(a.const.reshape(tmp), shape)
+        pos = pos_axis = None
+        if const is None and a.pos is not None:
+            d_out = bd[a.pos_axis]
+            if shape[d_out] == a.shape[a.pos_axis]:
+                pos, pos_axis = a.pos, d_out
+        self._set(eqn, out, a.iv, pos, pos_axis, const)
+
+    def _p_reshape(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        shape = tuple(out.aval.shape)
+        if eqn.params.get("dimensions") is not None:
+            return self._set(eqn, out, a.iv)
+        const = None
+        if a.const is not None:
+            const = a.const.reshape(shape)
+        pos = pos_axis = None
+        if const is None and a.pos is not None and shape:
+            # A reshape keeps last-axis positions iff the last dim is
+            # unchanged (row-major: flat % c indexes it either way),
+            # and axis-0 positions iff the first dim is unchanged.
+            if a.pos_axis == a.ndim - 1 and shape[-1] == a.shape[-1]:
+                pos, pos_axis = a.pos, len(shape) - 1
+            elif a.pos_axis == 0 and shape[0] == a.shape[0]:
+                pos, pos_axis = a.pos, 0
+        self._set(eqn, out, a.iv, pos, pos_axis, const)
+
+    def _p_transpose(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        perm = tuple(int(d) for d in eqn.params["permutation"])
+        const = np.transpose(a.const, perm) if a.const is not None else None
+        pos = pos_axis = None
+        if const is None and a.pos is not None:
+            pos, pos_axis = a.pos, perm.index(a.pos_axis)
+        self._set(eqn, out, a.iv, pos, pos_axis, const)
+
+    def _p_squeeze(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        dims = tuple(int(d) for d in eqn.params["dimensions"])
+        const = np.squeeze(a.const, axis=dims) if a.const is not None else None
+        pos = pos_axis = None
+        if const is None and a.pos is not None and a.pos_axis not in dims:
+            pos = a.pos
+            pos_axis = a.pos_axis - sum(1 for d in dims if d < a.pos_axis)
+        self._set(eqn, out, a.iv, pos, pos_axis, const)
+
+    def _p_slice(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        starts = tuple(int(s) for s in eqn.params["start_indices"])
+        limits = tuple(int(s) for s in eqn.params["limit_indices"])
+        strides = eqn.params.get("strides") or (1,) * len(starts)
+        strides = tuple(int(s) for s in strides)
+        const = None
+        if a.const is not None:
+            sl = tuple(slice(s, l, t) for s, l, t in zip(starts, limits, strides))
+            const = a.const[sl]
+        pos = pos_axis = None
+        iv = a.iv
+        if const is None and a.pos is not None:
+            d = a.pos_axis
+            pos = a.pos[starts[d] : limits[d] : strides[d]]
+            pos_axis = d
+            if pos:
+                iv = iv_union(pos)
+        self._set(eqn, out, iv, pos, pos_axis, const)
+
+    def _p_dynamic_slice(self, eqn):
+        a = self.read(eqn.invars[0])
+        self._set(eqn, eqn.outvars[0], a.iv)
+
+    def _p_dynamic_update_slice(self, eqn):
+        a = self.read(eqn.invars[0])
+        u = self.read(eqn.invars[1])
+        out = eqn.outvars[0]
+        if a.iv is None or u.iv is None:
+            return self._set(eqn, out)
+        pos = None
+        if a.pos is not None:
+            pos = [iv_join(p, u.iv) for p in a.pos]
+        self._set(eqn, out, iv_join(a.iv, u.iv), pos, a.pos_axis)
+
+    def _p_concatenate(self, eqn):
+        avs = [self.read(v) for v in eqn.invars]
+        out = eqn.outvars[0]
+        if any(a.iv is None for a in avs):
+            return self._set(eqn, out)
+        d = int(eqn.params["dimension"])
+        iv = iv_union([a.iv for a in avs])
+        const = None
+        if all(a.const is not None for a in avs) and _prod(out.aval.shape) <= _CONST_CAP:
+            const = np.concatenate([a.const for a in avs], axis=d)
+        pos = pos_axis = None
+        if const is None:
+            if any(a.pos_along(d) is not None for a in avs):
+                ps: List[Interval] = []
+                for a in avs:
+                    ps.extend(a.pos_along(d) or a.uniform(d))
+                pos, pos_axis = ps, d
+            else:
+                axes = {a.pos_axis for a in avs if a.pos is not None}
+                if len(axes) == 1:
+                    p = axes.pop()
+                    if p != d:
+                        cols = [a.pos_along(p) or a.uniform(p) for a in avs]
+                        pos = [
+                            iv_union([c[i] for c in cols]) for i in range(len(cols[0]))
+                        ]
+                        pos_axis = p
+        self._set(eqn, out, iv, pos, pos_axis, const)
+
+    def _p_pad(self, eqn):
+        x = self.read(eqn.invars[0])
+        pv = self.read(eqn.invars[1])
+        out = eqn.outvars[0]
+        if x.iv is None or pv.iv is None:
+            return self._set(eqn, out)
+        cfg = [tuple(int(v) for v in c) for c in eqn.params["padding_config"]]
+        padded = [d for d, (l, h, i) in enumerate(cfg) if l > 0 or h > 0 or i > 0]
+        ax = x.pos_axis if x.pos is not None else (padded[-1] if padded else None)
+        if ax is None:
+            iv = iv_join(x.iv, pv.iv) if padded else x.iv
+            return self._set(eqn, out, iv)
+        base = list(x.pos) if x.pos is not None else [x.iv] * x.shape[ax]
+        l, h, inter = cfg[ax]
+        if inter > 0:
+            woven: List[Interval] = []
+            for i, p in enumerate(base):
+                woven.append(p)
+                if i < len(base) - 1:
+                    woven.extend([pv.iv] * inter)
+            base = woven
+        base = [pv.iv] * l + base if l >= 0 else base[-l:]
+        base = base + [pv.iv] * h if h >= 0 else base[: len(base) + h]
+        if any(d != ax for d in padded):
+            base = [iv_join(p, pv.iv) for p in base]
+        iv = iv_union(base) if base else x.iv
+        self._set(eqn, out, iv, base, ax)
+
+    def _p_rev(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        dims = tuple(int(d) for d in eqn.params["dimensions"])
+        const = np.flip(a.const, axis=dims) if a.const is not None else None
+        pos, pos_axis = a.pos, a.pos_axis
+        if const is None and pos is not None and pos_axis in dims:
+            pos = tuple(reversed(pos))
+        self._set(eqn, out, a.iv, pos, pos_axis, const)
+
+    def _p_iota(self, eqn):
+        out = eqn.outvars[0]
+        shape = tuple(out.aval.shape)
+        d = int(eqn.params["dimension"])
+        n = shape[d]
+        iv = Interval(0, max(n - 1, 0))
+        const = None
+        if _prod(shape) <= _CONST_CAP:
+            tmp = [1] * len(shape)
+            tmp[d] = n
+            const = np.broadcast_to(
+                np.arange(n, dtype=object).reshape(tmp), shape
+            )
+        pos = None if const is not None else [iv_point(i) for i in range(n)]
+        self._set(eqn, out, iv, pos, None if const is not None else d, const)
+
+    def _p_gather(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        iv = a.iv
+        if "fill" in str(eqn.params.get("mode", "")).lower():
+            iv = iv_join(iv, Interval(0, 0))
+        self._set(eqn, out, iv)
+
+    def _p_reduce_sum(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        axes = tuple(int(d) for d in eqn.params["axes"])
+        count = _prod(a.shape[d] for d in axes)
+        const = None
+        if a.const is not None:
+            const = np.asarray(a.const.sum(axis=axes), dtype=object).reshape(
+                tuple(out.aval.shape)
+            )
+        if const is not None:
+            return self._set(eqn, out, const=const)
+        pos = pos_axis = None
+        if a.pos is not None and a.pos_axis in axes:
+            other = count // max(a.shape[a.pos_axis], 1)
+            total = Interval(0, 0)
+            for p in a.pos:
+                total = iv_add(total, p)
+            iv = iv_scale(total, other)
+        else:
+            iv = iv_scale(a.iv, count)
+            if a.pos is not None:
+                pos = [iv_scale(p, count) for p in a.pos]
+                pos_axis = a.pos_axis - sum(1 for d in axes if d < a.pos_axis)
+        self._set(eqn, out, iv, pos, pos_axis)
+
+    def _p_reduce_minmax(self, eqn):
+        a = self.read(eqn.invars[0])
+        self._set(eqn, eqn.outvars[0], a.iv)
+
+    def _p_reduce_bool(self, eqn):
+        self._set(eqn, eqn.outvars[0], Interval(0, 1))
+
+    def _p_reduce_prod(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        axes = tuple(int(d) for d in eqn.params["axes"])
+        count = _prod(a.shape[d] for d in axes)
+        if count > 64 and iv_abs(a.iv).hi > 1:
+            return self._set(eqn, out)
+        iv = iv_point(1)
+        for _ in range(count):
+            iv = iv_mul(iv, a.iv)
+        self._set(eqn, out, iv)
+
+    def _p_argminmax(self, eqn):
+        a = self.read(eqn.invars[0])
+        axes = tuple(int(d) for d in eqn.params["axes"])
+        n = _prod(a.shape[d] for d in axes)
+        self._set(eqn, eqn.outvars[0], Interval(0, max(n - 1, 0)))
+
+    def _p_cumsum(self, eqn):
+        a = self.read(eqn.invars[0])
+        out = eqn.outvars[0]
+        if a.iv is None:
+            return self._set(eqn, out)
+        n = a.shape[int(eqn.params["axis"])]
+        lo = a.iv.lo * n if a.iv.lo < 0 else a.iv.lo
+        hi = a.iv.hi * n if a.iv.hi > 0 else a.iv.hi
+        self._set(eqn, out, Interval(min(lo, 0) if n == 0 else lo, hi))
+
+    def _p_sort(self, eqn):
+        for ov, v in zip(eqn.outvars, eqn.invars):
+            self._set(eqn, ov, self.read(v).iv)
+
+    # -- contractions -----------------------------------------------------
+
+    def _p_dot_general(self, eqn):
+        a = self.read(eqn.invars[0])
+        b = self.read(eqn.invars[1])
+        out = eqn.outvars[0]
+        if a.iv is None or b.iv is None:
+            return self._set(eqn, out)
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        k = _prod(a.shape[d] for d in lc)
+        term = iv_mul(a.iv, b.iv)
+        iv = Interval(term.lo * k, term.hi * k) if k else Interval(0, 0)
+        pos = pos_axis = None
+        if (
+            b.const is not None
+            and b.ndim == 2
+            and len(lc) == 1
+            and tuple(rc) == (0,)
+            and not lb
+            and not rb
+        ):
+            # out[..., l] = sum_j a[..., j] * C[j, l]: exact per-column
+            # bounds — this is what proves the fr fold-table fixpoint.
+            pa = a.pos_along(lc[0]) or a.uniform(lc[0])
+            cols: List[Interval] = []
+            for l in range(b.shape[1]):
+                lo = hi = 0
+                for j in range(b.shape[0]):
+                    c = int(b.const[j, l])
+                    lo += min(pa[j].lo * c, pa[j].hi * c)
+                    hi += max(pa[j].lo * c, pa[j].hi * c)
+                cols.append(Interval(lo, hi))
+            out_shape = tuple(out.aval.shape)
+            if out_shape and out_shape[-1] == len(cols):
+                pos, pos_axis = cols, len(out_shape) - 1
+                iv = iv_union(cols)
+        self._set(eqn, out, iv, pos, pos_axis)
+
+    # -- scatter ----------------------------------------------------------
+
+    def _scatter_common(self, eqn, add: bool) -> None:
+        op = self.read(eqn.invars[0])
+        idx = self.read(eqn.invars[1])
+        upd = self.read(eqn.invars[2])
+        out = eqn.outvars[0]
+        if op.iv is None or upd.iv is None:
+            return self._set(eqn, out)
+        if not add:
+            self._set(eqn, out, iv_join(op.iv, upd.iv))
+            return
+        dn = eqn.params["dimension_numbers"]
+        sdims = tuple(int(d) for d in dn.scatter_dims_to_operand_dims)
+        start = idx.scalar_const() if idx.iv is not None else None
+        if start is not None and len(sdims) == 1:
+            d = sdims[0]
+            window_ops = [
+                i for i in range(op.ndim) if i not in dn.inserted_window_dims
+            ]
+            if d in window_ops:
+                uw = dn.update_window_dims[window_ops.index(d)]
+                w = upd.shape[uw]
+                start = max(0, min(int(start), op.shape[d] - w))
+                pu = upd.pos_along(uw) or upd.uniform(uw)
+                base = list(op.pos_along(d) or op.uniform(d))
+                for j in range(w):
+                    base[start + j] = iv_add(base[start + j], pu[j])
+                self._set(eqn, out, iv_union(base), base, d)
+                return
+        # fallback: every element gets zero or more updates added
+        n_rows = _prod(
+            s
+            for i, s in enumerate(upd.shape)
+            if i not in dn.update_window_dims
+        )
+        mult = 1 if eqn.params.get("unique_indices") else max(n_rows, 1)
+        lo = op.iv.lo + mult * min(upd.iv.lo, 0)
+        hi = op.iv.hi + mult * max(upd.iv.hi, 0)
+        self._set(eqn, out, Interval(lo, hi))
+
+    def _p_scatter_add(self, eqn):
+        self._scatter_common(eqn, add=True)
+
+    def _p_scatter(self, eqn):
+        self._scatter_common(eqn, add=False)
+
+    # -- calls ------------------------------------------------------------
+
+    def _sub_closed(self, eqn) -> Optional[Any]:
+        from jax import core as jcore
+
+        closed = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+        if closed is None:
+            return None
+        if isinstance(closed, jcore.Jaxpr):
+            closed = jcore.ClosedJaxpr(closed, ())
+        return closed
+
+    def _p_call(self, eqn):
+        closed = self._sub_closed(eqn)
+        if closed is None or len(closed.jaxpr.invars) != len(eqn.invars):
+            return self._unknown(eqn)
+        outs = self.interpret(closed, [self.read(v) for v in eqn.invars])
+        for ov, inner, av in zip(eqn.outvars, closed.jaxpr.outvars, outs):
+            self._copy_out(eqn, ov, av)
+            if self.record and inner in self.prov:
+                self.prov[ov] = self.prov[inner]
+
+    # -- loops ------------------------------------------------------------
+
+    def _run_body(self, closed, in_avals, record: bool) -> List[AVal]:
+        saved = self.record
+        self.record = record and saved
+        try:
+            return self.interpret(closed, in_avals)
+        finally:
+            self.record = saved
+
+    @staticmethod
+    def _join_avals(a: AVal, b: AVal) -> AVal:
+        if a.iv is None or b.iv is None:
+            return AVal(a.shape, a.dtype, None)
+        pos = pos_axis = None
+        if a.pos is not None and b.pos is not None and a.pos_axis == b.pos_axis:
+            pos = [iv_join(x, y) for x, y in zip(a.pos, b.pos)]
+            pos_axis = a.pos_axis
+        const = None
+        if (
+            a.const is not None
+            and b.const is not None
+            and np.array_equal(a.const, b.const)
+        ):
+            const = a.const
+        return make_aval(a.shape, a.dtype, iv_join(a.iv, b.iv), pos, pos_axis, const)
+
+    @staticmethod
+    def _aval_stable(prev: AVal, new: AVal) -> bool:
+        if prev.iv is None:
+            return True
+        if new.iv is None:
+            return False
+        if not (prev.iv.lo <= new.iv.lo and new.iv.hi <= prev.iv.hi):
+            return False
+        if prev.pos is not None:
+            if new.pos is None or new.pos_axis != prev.pos_axis:
+                return False
+            return all(
+                p.lo <= q.lo and q.hi <= p.hi for p, q in zip(prev.pos, new.pos)
+            )
+        return True
+
+    @staticmethod
+    def _widen(av: AVal) -> AVal:
+        tracked, _, _ = _dtype_kind(av.dtype)
+        if not tracked:
+            return av
+        return make_aval(av.shape, av.dtype, dtype_range(av.dtype))
+
+    @staticmethod
+    def _slice_leading(x: AVal) -> AVal:
+        shape = x.shape[1:]
+        if x.iv is None:
+            return AVal(shape, x.dtype, None)
+        pos = pos_axis = None
+        if x.const is not None and x.ndim >= 2:
+            pos, pos_axis = x.pos_along(x.ndim - 1), len(shape) - 1
+        elif x.pos is not None and x.pos_axis > 0:
+            pos, pos_axis = x.pos, x.pos_axis - 1
+        return make_aval(shape, x.dtype, x.iv, pos, pos_axis)
+
+    def _fixpoint(
+        self, closed, consts: List[AVal], carries: List[AVal], extra: List[AVal]
+    ) -> Tuple[List[AVal], List[AVal]]:
+        """Iterate a loop body to a stable carry; returns (carries, outs)."""
+        n = len(carries)
+        for it in range(_MAX_ITERS):
+            outs = self._run_body(closed, consts + carries + extra, record=False)
+            new = [self._join_avals(c, o) for c, o in zip(carries, outs[:n])]
+            stable = [self._aval_stable(c, o) for c, o in zip(carries, outs[:n])]
+            if all(stable):
+                carries = new
+                break
+            carries = new
+            if it >= _WIDEN_AT:
+                carries = [
+                    c if s else self._widen(c) for c, s in zip(carries, stable)
+                ]
+        outs = self._run_body(closed, consts + carries + extra, record=True)
+        return [self._join_avals(c, o) for c, o in zip(carries, outs[:n])], outs
+
+    def _p_scan(self, eqn):
+        p = eqn.params
+        closed = p["jaxpr"]
+        nc, ncar = int(p["num_consts"]), int(p["num_carry"])
+        length = int(p["length"])
+        avs = [self.read(v) for v in eqn.invars]
+        consts, carries0, xss = avs[:nc], avs[nc : nc + ncar], avs[nc + ncar :]
+        if self._carry_sweep(eqn, closed, nc, ncar, carries0, xss, length, p["reverse"]):
+            return
+        xs_slices = [self._slice_leading(x) for x in xss]
+        carries, outs = self._fixpoint(closed, consts, carries0, xs_slices)
+        for ov, av in zip(eqn.outvars[:ncar], carries):
+            self._copy_out(eqn, ov, av)
+        for ov, av in zip(eqn.outvars[ncar:], outs[ncar:]):
+            if av.iv is None:
+                self._set(eqn, ov)
+            else:
+                pos = av.pos
+                pos_axis = av.pos_axis + 1 if pos is not None else None
+                self._set(eqn, ov, av.iv, pos, pos_axis)
+
+    def _carry_sweep(
+        self, eqn, closed, nc, ncar, carries0, xss, length, reverse
+    ) -> bool:
+        """Recognize the base-2^S carry sweep and apply its exact value
+        bound: the scan digitizes V = c0 + sum_j d_j 2^(S j), so the
+        running total at step j never exceeds (prefix_j >> S j) + d_j —
+        the bound ``fr_jax`` argues in prose."""
+        from jax import core as jcore
+
+        if nc or ncar != 1 or len(xss) != 1 or reverse:
+            return False
+        jx = closed.jaxpr
+        if len(jx.invars) != 2 or len(jx.outvars) != 2:
+            return False
+        c_in, d_in = jx.invars
+        fwd: Dict[Any, Any] = {}
+
+        def res(v):
+            return fwd.get(v, v) if isinstance(v, jcore.Var) else v
+
+        add_eqn = shift_eqn = and_eqn = None
+        shift_s = mask_m = None
+        for e in jx.eqns:
+            n = e.primitive.name
+            if n == "convert_element_type":
+                src = e.invars[0]
+                fwd[e.outvars[0]] = res(src) if isinstance(src, jcore.Var) else src
+            elif n == "broadcast_in_dim" and isinstance(e.invars[0], jcore.Literal):
+                fwd[e.outvars[0]] = e.invars[0]
+            elif n == "add" and add_eqn is None:
+                srcs = {res(v) for v in e.invars}
+                if srcs == {c_in, d_in}:
+                    add_eqn = e
+                else:
+                    return False
+            elif n in ("shift_right_arithmetic", "shift_right_logical"):
+                if add_eqn is None or res(e.invars[0]) is not add_eqn.outvars[0]:
+                    return False
+                s = self.read(e.invars[1]).scalar_const()
+                if s is None or shift_eqn is not None:
+                    return False
+                shift_eqn, shift_s = e, int(s)
+            elif n == "and":
+                srcs = [res(v) for v in e.invars]
+                if add_eqn is None or and_eqn is not None:
+                    return False
+                if srcs[0] is add_eqn.outvars[0]:
+                    m = self.read(e.invars[1]).scalar_const()
+                elif srcs[1] is add_eqn.outvars[0]:
+                    m = self.read(e.invars[0]).scalar_const()
+                else:
+                    return False
+                if m is None:
+                    return False
+                and_eqn, mask_m = e, int(m)
+            else:
+                return False
+        if add_eqn is None or shift_eqn is None or and_eqn is None:
+            return False
+        if shift_s < 1 or mask_m != (1 << shift_s) - 1:
+            return False
+        o0, o1 = (res(v) for v in jx.outvars)
+        if o0 is not shift_eqn.outvars[0] or o1 is not and_eqn.outvars[0]:
+            return False
+        c0 = carries0[0]
+        xs = xss[0]
+        if c0.iv is None or xs.iv is None or c0.iv.lo < 0 or xs.iv.lo < 0:
+            return False
+        his = [p.hi for p in (xs.pos_along(0) or xs.uniform(0))]
+        s = shift_s
+        prefix = c0.iv.hi
+        peak = 0
+        for j, h in enumerate(his):
+            peak = max(peak, (prefix >> (s * j)) + h)
+            prefix += h << (s * j)
+        total = prefix  # == c0 + sum h_j 2^(S j)
+        self._note_peak(add_eqn.outvars[0].aval.dtype, peak, add_eqn)
+        n = len(his)
+        carry_iv = Interval(0, total >> (s * n))
+        digit_pos = [
+            Interval(0, min((1 << s) - 1, total >> (s * j))) for j in range(n)
+        ]
+        self._set(eqn, eqn.outvars[0], carry_iv)
+        self._set(eqn, eqn.outvars[1], iv_union(digit_pos), digit_pos, 0)
+        return True
+
+    def _p_while(self, eqn):
+        p = eqn.params
+        cn, bn = int(p["cond_nconsts"]), int(p["body_nconsts"])
+        avs = [self.read(v) for v in eqn.invars]
+        body_consts = avs[cn : cn + bn]
+        carries0 = avs[cn + bn :]
+        carries, _outs = self._fixpoint(p["body_jaxpr"], body_consts, carries0, [])
+        # also interpret the cond once so its eqns are covered
+        self._run_body(p["cond_jaxpr"], avs[:cn] + carries, record=False)
+        for ov, av in zip(eqn.outvars, carries):
+            self._copy_out(eqn, ov, av)
+
+
+def _build_handlers() -> Dict[str, str]:
+    h = {
+        "add": "_p_add",
+        "sub": "_p_sub",
+        "mul": "_p_mul",
+        "neg": "_p_neg",
+        "abs": "_p_abs",
+        "sign": "_p_sign",
+        "min": "_p_min",
+        "max": "_p_max",
+        "and": "_p_and",
+        "or": "_p_or",
+        "xor": "_p_xor",
+        "not": "_p_not",
+        "shift_left": "_p_shift_left",
+        "shift_right_arithmetic": "_p_shift_right_arithmetic",
+        "shift_right_logical": "_p_shift_right_logical",
+        "div": "_p_div",
+        "rem": "_p_rem",
+        "integer_pow": "_p_integer_pow",
+        "clamp": "_p_clamp",
+        "select_n": "_p_select_n",
+        "convert_element_type": "_p_convert",
+        "broadcast_in_dim": "_p_broadcast_in_dim",
+        "reshape": "_p_reshape",
+        "transpose": "_p_transpose",
+        "squeeze": "_p_squeeze",
+        "slice": "_p_slice",
+        "dynamic_slice": "_p_dynamic_slice",
+        "dynamic_update_slice": "_p_dynamic_update_slice",
+        "concatenate": "_p_concatenate",
+        "pad": "_p_pad",
+        "rev": "_p_rev",
+        "iota": "_p_iota",
+        "gather": "_p_gather",
+        "reduce_sum": "_p_reduce_sum",
+        "reduce_max": "_p_reduce_minmax",
+        "reduce_min": "_p_reduce_minmax",
+        "reduce_and": "_p_reduce_bool",
+        "reduce_or": "_p_reduce_bool",
+        "reduce_prod": "_p_reduce_prod",
+        "argmax": "_p_argminmax",
+        "argmin": "_p_argminmax",
+        "cumsum": "_p_cumsum",
+        "sort": "_p_sort",
+        "dot_general": "_p_dot_general",
+        "scatter-add": "_p_scatter_add",
+        "scatter": "_p_scatter",
+        "scan": "_p_scan",
+        "while": "_p_while",
+        "threefry2x32": "_p_threefry",
+        "random_bits": "_p_threefry",
+        "random_seed": "_p_threefry",
+        "random_wrap": "_p_threefry",
+        "random_unwrap": "_p_threefry",
+        "random_fold_in": "_p_threefry",
+    }
+    for name in ("lt", "le", "gt", "ge", "eq", "ne", "is_finite"):
+        h[name] = "_p_cmp"
+    for name in (
+        "pjit",
+        "closed_call",
+        "core_call",
+        "custom_jvp_call",
+        "custom_vjp_call",
+        "custom_vjp_call_jaxpr",
+        "remat",
+        "checkpoint",
+        "remat2",
+    ):
+        h[name] = "_p_call"
+    for name in (
+        "device_put",
+        "copy",
+        "stop_gradient",
+        "sharding_constraint",
+        "optimization_barrier",
+    ):
+        h[name] = "_p_identity"
+    return h
+
+
+_HANDLERS = _build_handlers()
+
+# --------------------------------------------------------------------------
+# per-kernel analysis
+
+
+def _final_slice_eqn(an: Analyzer, outvar: Any) -> Optional[Any]:
+    """Walk back through identity ops to the slice feeding an output."""
+    from jax import core as jcore
+
+    v = outvar
+    for _ in range(64):
+        e = an.prov.get(v)
+        if e is None:
+            return None
+        name = e.primitive.name
+        if name == "slice":
+            return e
+        if name in _IDENTITY_PRIMS:
+            src = e.invars[0]
+            if not isinstance(src, jcore.Var):
+                return None
+            v = src
+            continue
+        return None
+    return None
+
+
+def _slice_exact_obligation(an: Analyzer, closed: Any, kernel: str) -> Obligation:
+    """The final narrowing slice drops only provably-zero positions."""
+    peak = 0
+    site = None
+    flow = None
+    found = False
+    for ov in closed.jaxpr.outvars:
+        e = _final_slice_eqn(an, ov)
+        if e is None:
+            continue
+        found = True
+        op = an.env.get(e.invars[0])
+        starts = tuple(int(s) for s in e.params["start_indices"])
+        limits = tuple(int(s) for s in e.params["limit_indices"])
+        strides = e.params.get("strides") or (1,) * len(starts)
+        if site is None:
+            site = _eqn_site(e)
+        for d, (s, l, t) in enumerate(zip(starts, limits, strides)):
+            kept = set(range(s, l, int(t)))
+            if len(kept) == op.shape[d]:
+                continue
+            p = op.pos_along(d) if op is not None else None
+            if p is None:
+                worst = op.iv.mag if op is not None and op.iv is not None else 1
+            else:
+                worst = max(
+                    (p[i].mag for i in range(op.shape[d]) if i not in kept),
+                    default=0,
+                )
+            if worst > peak:
+                peak = worst
+                site = _eqn_site(e)
+                flow = an.flow(e)
+    if not found:
+        return Obligation(
+            kernel,
+            "slice-exact",
+            1,
+            0,
+            False,
+            message="no final narrowing slice found on any kernel output",
+        )
+    proved = peak == 0
+    return Obligation(
+        kernel, "slice-exact", peak, 0, proved, site, flow if not proved else None
+    )
+
+
+def analyze_spec(spec: KernelSpec) -> KernelReport:
+    import jax
+
+    rep = KernelReport(spec.name)
+    try:
+        sds = [
+            jax.ShapeDtypeStruct(s.shape, np.dtype(s.dtype)) for s in spec.args
+        ]
+        closed = jax.make_jaxpr(spec.fn)(*sds)
+    except Exception as e:  # noqa: BLE001 - a failed trace IS the finding
+        rep.obligations.append(
+            Obligation(
+                spec.name,
+                "trace-error",
+                1,
+                0,
+                False,
+                message=f"{type(e).__name__}: {e}",
+            )
+        )
+        return rep
+    an = Analyzer(spec.name)
+    outs = an.interpret(closed, [s.aval() for s in spec.args])
+    rep.n_eqns = an.n_eqns
+    for dname in sorted(an.peaks):
+        peak, eqn = an.peaks[dname]
+        cap = int(dtype_range(dname).hi)
+        proved = peak <= cap
+        rep.obligations.append(
+            Obligation(
+                spec.name,
+                f"cap-{dname}",
+                peak,
+                cap,
+                proved,
+                _eqn_site(eqn),
+                an.flow(eqn) if not proved else None,
+            )
+        )
+    if spec.out_lo is not None or spec.out_hi is not None:
+        lo = spec.out_lo if spec.out_lo is not None else 0
+        hi = spec.out_hi if spec.out_hi is not None else 0
+        cap = max(hi, -lo, 0)
+        peak = 0
+        bad_eqn = None
+        proved = True
+        for ov, av in zip(closed.jaxpr.outvars, outs):
+            if av.iv is None:
+                continue
+            peak = max(peak, av.iv.mag)
+            if av.iv.lo < lo or av.iv.hi > hi:
+                proved = False
+                bad_eqn = an.prov.get(ov, bad_eqn)
+        eqn = bad_eqn if bad_eqn is not None else next(
+            (an.prov.get(ov) for ov in closed.jaxpr.outvars if ov in an.prov), None
+        )
+        rep.obligations.append(
+            Obligation(
+                spec.name,
+                "out-invariant",
+                peak,
+                cap,
+                proved,
+                _eqn_site(eqn) if eqn is not None else None,
+                an.flow(eqn) if (not proved and eqn is not None) else None,
+            )
+        )
+    if spec.final_slice_exact:
+        rep.obligations.append(_slice_exact_obligation(an, closed, spec.name))
+    if an.unhandled:
+        names = sorted(an.unhandled)
+        first = an.unhandled[names[0]]
+        rep.obligations.append(
+            Obligation(
+                spec.name,
+                "unhandled-primitive",
+                len(names),
+                0,
+                False,
+                _eqn_site(first),
+                an.flow(first),
+                message="no interval transfer for: " + ", ".join(names),
+            )
+        )
+    return rep
+
+
+# --------------------------------------------------------------------------
+# registry
+
+
+_OPS_MODULES = (
+    "limbs",
+    "fr_jax",
+    "gf256_jax",
+    "sha256_jax",
+    "ec_jax",
+    "packed_msm",
+    "pallas_ec",
+)
+
+# prewarm-plan name family -> the limbprove kernel that covers it.
+# Longest prefixes first so e.g. unpack_g1c_v2 wins over unpack_g1.
+_PLAN_PREFIXES = (
+    ("unpack_g1c_v2", "packed.unpack_g1c_v2"),
+    ("unpack_g1c_v1", "packed.unpack_g1c_v1"),
+    ("unpack_g1_v2", "packed.unpack_g1_v2"),
+    ("unpack_g1_v1", "packed.unpack_g1_v1"),
+    ("unpack_g2_v1", "packed.unpack_g2_v1"),
+    ("mesh_prod_g1", "packed.prod_g1_xla"),
+    ("prod_g1_xla", "packed.prod_g1_xla"),
+    ("flat_g1_xla", "packed.flat_g1_xla"),
+    ("flat_g2_xla", "packed.flat_g2_xla"),
+    ("gtree_g1", "pallas.win_g1_core"),
+    ("win_g1", "pallas.win_g1_core"),
+    ("tree_g1", "pallas.win_g1_core"),
+    ("win_g2", "pallas.win_g2_core"),
+    ("tree_g2", "pallas.win_g2_core"),
+    ("scan_g1", "ec.g1_msm"),
+    ("scan_g2", "ec.g2_msm"),
+)
+
+
+def iter_range_specs() -> List[Tuple[str, Dict[str, Any]]]:
+    out = []
+    for m in _OPS_MODULES:
+        mod = importlib.import_module(f"hbbft_tpu.ops.{m}")
+        rs = getattr(mod, "RANGE_SPECS", None)
+        if rs is not None:
+            out.append((m, rs))
+    return out
+
+
+def covered_functions() -> Dict[str, frozenset]:
+    """path -> function names whose accumulator widths limbprove checks."""
+    return {
+        rs["module"]: frozenset(rs.get("covers", ()))
+        for _m, rs in iter_range_specs()
+    }
+
+
+def plan_coverage_obligations(spec_names: Iterable[str]) -> List[Obligation]:
+    """Every prewarm-plan entry must map to a verified kernel.
+
+    Live-only (never pinned): the plan reflects machine-local warm
+    state, so its contents differ per host and may be empty.
+    """
+    spec_names = set(spec_names)
+    try:
+        from ..ops import packed_msm
+
+        plan = list(packed_msm.prewarm_plan())
+    except Exception as e:  # noqa: BLE001 - absent/odd warm file is fine
+        return [
+            Obligation(
+                "plan",
+                "plan-coverage",
+                0,
+                0,
+                True,
+                message=f"prewarm plan unavailable ({type(e).__name__}); "
+                "direct-ops registry is the gate",
+            )
+        ]
+    out: List[Obligation] = []
+    n_ok = 0
+    for entry in plan:
+        name = entry[0] if isinstance(entry, (tuple, list)) else str(entry)
+        target = next((t for p, t in _PLAN_PREFIXES if name.startswith(p)), None)
+        if target is None:
+            out.append(
+                Obligation(
+                    f"plan.{name}",
+                    "plan-coverage",
+                    1,
+                    0,
+                    False,
+                    message=f"prewarm plan entry {name!r} matches no "
+                    "limbprove kernel family",
+                )
+            )
+        elif target not in spec_names:
+            out.append(
+                Obligation(
+                    f"plan.{name}",
+                    "plan-coverage",
+                    1,
+                    0,
+                    False,
+                    message=f"plan entry {name!r} maps to {target!r} which "
+                    "is not in the limbprove registry",
+                )
+            )
+        else:
+            n_ok += 1
+    out.append(
+        Obligation(
+            "plan",
+            "plan-coverage",
+            0,
+            0,
+            True,
+            message=f"{n_ok} prewarm plan entries covered",
+        )
+    )
+    return out
+
+
+@dataclass
+class RunResult:
+    reports: List[KernelReport]
+    plan: List[Obligation]
+    wall: float
+
+    @property
+    def obligations(self) -> List[Obligation]:
+        return [o for r in self.reports for o in r.obligations] + self.plan
+
+    @property
+    def proved(self) -> bool:
+        return all(o.proved for o in self.obligations)
+
+
+_VERIFY_CACHE: Optional[RunResult] = None
+
+# Disk cache for the jaxpr tracing pass (the ``.xla_cache`` precedent:
+# repo-local, git-ignored, machine-private).  The big EC kernels cost
+# minutes to ``make_jaxpr``; the proof result is a pure function of the
+# kernel sources, so it is keyed by a hash over every module the traced
+# code can come from and replayed instantly while the tree is
+# unchanged.  ``HBBFT_TPU_RANGE_CACHE=0`` disables; the plan-coverage
+# obligation is machine-local warm state and is always recomputed live.
+DISK_CACHE = os.path.join(os.path.dirname(__file__), ".range_cache.json")
+DISK_CACHE_ENV = "HBBFT_TPU_RANGE_CACHE"
+
+
+def _source_fingerprint() -> str:
+    import hashlib
+
+    import jax
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    h.update(jax.__version__.encode())
+    for sub in ("ops", "crypto"):
+        root = os.path.join(pkg, sub)
+        for name in sorted(os.listdir(root)):
+            if name.endswith(".py"):
+                path = os.path.join(root, name)
+                h.update(name.encode())
+                with open(path, "rb") as f:
+                    h.update(f.read())
+    me = os.path.abspath(__file__)
+    if me.endswith(".pyc"):
+        me = me[:-1]
+    with open(me, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def _obligation_to_json(o: Obligation) -> Dict[str, Any]:
+    return {
+        "kernel": o.kernel,
+        "kind": o.kind,
+        "peak": str(o.peak),
+        "capacity": str(o.capacity),
+        "proved": o.proved,
+        "site": list(o.site) if o.site else None,
+        "flow": [list(f) for f in o.flow] if o.flow else None,
+        "message": o.message,
+    }
+
+
+def _obligation_from_json(d: Dict[str, Any]) -> Obligation:
+    return Obligation(
+        d["kernel"],
+        d["kind"],
+        int(d["peak"]),
+        int(d["capacity"]),
+        d["proved"],
+        tuple(d["site"]) if d["site"] else None,
+        tuple(tuple(f) for f in d["flow"]) if d["flow"] else None,
+        d.get("message", ""),
+    )
+
+
+def _disk_cache_load(fingerprint: str) -> Optional[List[KernelReport]]:
+    if os.environ.get(DISK_CACHE_ENV, "1") == "0":
+        return None
+    try:
+        with open(DISK_CACHE, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("fingerprint") != fingerprint:
+            return None
+        return [
+            KernelReport(
+                r["kernel"],
+                [_obligation_from_json(o) for o in r["obligations"]],
+                r.get("n_eqns", 0),
+            )
+            for r in data["reports"]
+        ]
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _disk_cache_store(fingerprint: str, reports: List[KernelReport]) -> None:
+    if os.environ.get(DISK_CACHE_ENV, "1") == "0":
+        return
+    try:
+        with open(DISK_CACHE, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "fingerprint": fingerprint,
+                    "reports": [
+                        {
+                            "kernel": r.kernel,
+                            "n_eqns": r.n_eqns,
+                            "obligations": [
+                                _obligation_to_json(o) for o in r.obligations
+                            ],
+                        }
+                        for r in reports
+                    ],
+                },
+                f,
+            )
+            f.write("\n")
+    except OSError:
+        pass  # read-only checkout: the in-process memo still holds
+
+
+def verify_all(refresh: bool = False) -> RunResult:
+    """Analyze every registered kernel (memoized per process, replayed
+    from the source-hashed disk cache while the tree is unchanged)."""
+    global _VERIFY_CACHE
+    if _VERIFY_CACHE is not None and not refresh:
+        return _VERIFY_CACHE
+    import sys
+
+    t0 = time.monotonic()
+    fingerprint = _source_fingerprint()
+    reports = None if refresh else _disk_cache_load(fingerprint)
+    names: List[str] = []
+    me = sys.modules[__name__]
+    if reports is None:
+        reports = []
+        for _m, rs in iter_range_specs():
+            # ops modules may not import analysis (layering), so the
+            # spec builder receives this module as its toolbox argument.
+            for spec in rs["specs"](me):
+                names.append(spec.name)
+                reports.append(analyze_spec(spec))
+        _disk_cache_store(fingerprint, reports)
+    else:
+        for _m, rs in iter_range_specs():
+            names.extend(spec.name for spec in rs["specs"](me))
+    plan = plan_coverage_obligations(names)
+    _VERIFY_CACHE = RunResult(reports, plan, time.monotonic() - t0)
+    return _VERIFY_CACHE
+
+
+# --------------------------------------------------------------------------
+# manifest (wire-manifest mold: pinned append-only, regenerated explicitly)
+
+
+def build_manifest(result: RunResult) -> Dict[str, Any]:
+    entries = [
+        {
+            "key": o.key,
+            "peak": str(o.peak),
+            "capacity": str(o.capacity),
+            "proved": o.proved,
+            "site": f"{o.site[0]}:{o.site[1]}" if o.site else None,
+        }
+        for o in sorted(
+            (o for r in result.reports for o in r.obligations), key=lambda o: o.key
+        )
+    ]
+    return {"version": 1, "obligations": entries}
+
+
+def write_manifest(path: Optional[str] = None, result: Optional[RunResult] = None) -> str:
+    path = path or DEFAULT_MANIFEST
+    result = result or verify_all()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(build_manifest(result), f, indent=1, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def load_manifest(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    path = path or DEFAULT_MANIFEST
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def diff_manifest(
+    manifest: Optional[Dict[str, Any]], result: RunResult
+) -> List[Tuple[str, Optional[Obligation]]]:
+    """Pinned-vs-live diff.  Returns (message, obligation-or-None) pairs;
+    every entry is a violation for the limb-range rule."""
+    msgs: List[Tuple[str, Optional[Obligation]]] = []
+    for o in result.obligations:
+        if not o.proved:
+            detail = o.message or f"peak {o.peak} exceeds capacity {o.capacity}"
+            msgs.append((f"unproved obligation {o.key}: {detail}", o))
+    live = {o.key: o for r in result.reports for o in r.obligations}
+    pinned = {e["key"]: e for e in (manifest or {"obligations": []})["obligations"]}
+    for key in sorted(live):
+        o = live[key]
+        e = pinned.get(key)
+        if e is None:
+            msgs.append(
+                (
+                    f"obligation {key} (peak {o.peak}) is not pinned in "
+                    "range_manifest.json — regenerate with --write-range-manifest",
+                    o,
+                )
+            )
+            continue
+        ppeak = int(e["peak"])
+        if o.peak > ppeak:
+            msgs.append(
+                (
+                    f"obligation {key} weakened: peak grew {ppeak} -> {o.peak} "
+                    f"(capacity {o.capacity}); a kernel edit loosened a pinned "
+                    "bound",
+                    o,
+                )
+            )
+        elif o.peak < ppeak:
+            msgs.append(
+                (
+                    f"obligation {key} tightened: peak shrank {ppeak} -> "
+                    f"{o.peak} — regenerate with --write-range-manifest",
+                    o,
+                )
+            )
+    for key in sorted(set(pinned) - set(live)):
+        msgs.append(
+            (
+                f"pinned obligation {key} vanished from the live tree — "
+                "regenerate with --write-range-manifest",
+                None,
+            )
+        )
+    return msgs
+
+
+
+

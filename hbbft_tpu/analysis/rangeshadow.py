@@ -1,0 +1,617 @@
+"""Arbitrary-precision shadow sanitizer — limbprove's runtime dual.
+
+:mod:`rangecheck` proves the kernels' integer ranges statically from
+their jaxprs; this module covers the other half at runtime, in the
+racecheck/stallcheck mold: every shimmed device kernel re-executes a
+*sampled* slice of its work with arbitrary-precision Python ints and
+flags any divergence from the device result as a concrete overflow
+witness.  A wrapped int32 is invisible on device (no trap, no NaN —
+just a wrong residue); against an exact shadow it is a loud diff.
+
+Per-kernel oracles (all exact, all independent of the device path):
+
+- **fr.matmul / fr.add** — sampled output cells recomputed as Python
+  ints mod r from the decoded 8-bit limb inputs.
+- **sha.device** — the padded block stream is parsed back to the
+  original message (the padding is self-describing) and hashed with
+  :mod:`hashlib`; batches that are not standard SHA-256 padding are
+  skipped, never guessed at.
+- **gf.matmul / gf.matmul16** — sampled cells recomputed with the
+  host tower (``crypto.rs.gf_mul`` / ``gf16_mul``).
+- **ec.g1/g2 msm + the pallas point kernels** — every sampled output
+  point is checked against the projective curve identity
+  ``Y²Z ≡ X³ + b·Z³ (mod p)`` (b = 4 on G1, 4(1+u) on G2; the
+  identity (0:1:0) satisfies it trivially).  A limb that wrapped
+  int32 lands off-curve with overwhelming probability.  Small
+  multi-scalar multiplications (k ≤ 16) are additionally recomputed
+  exactly on the host curve.
+
+Two front doors, shared with racecheck/stallcheck:
+
+- ``pytest --rangecheck`` (``tests/conftest.py``): every test runs
+  between :func:`enable` / :func:`disable`; divergences accumulate
+  into ``$HBBFT_TPU_RANGECHECK_OUT`` (JSONL) and fail the run.
+- ``python -m hbbft_tpu.analysis --rangecheck <test-expr>``: runs the
+  expression in a subprocess with the env wiring above and renders
+  the collected reports like any other lint violation.
+
+``$HBBFT_TPU_RANGECHECK_SAMPLE`` bounds the cells/points sampled per
+kernel call (default 4; sampling is deterministic — evenly strided —
+so a failing run replays bit-identically).
+
+:func:`wrap` is public: tests (and future kernels) can wrap any
+callable with their own shadow oracle and inherit the report plumbing
+— the planted-overflow fixture in ``tests/test_rangecheck.py`` uses
+exactly this seam.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .core import Violation
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_PKG_ROOT = os.path.join(_REPO_ROOT, "hbbft_tpu")
+_SELF = os.path.abspath(__file__)
+
+OUT_ENV = "HBBFT_TPU_RANGECHECK_OUT"
+SAMPLE_ENV = "HBBFT_TPU_RANGECHECK_SAMPLE"
+
+
+def _sample_budget() -> int:
+    try:
+        return max(1, int(os.environ.get(SAMPLE_ENV, "4")))
+    except ValueError:
+        return 4
+
+
+def _strides(n: int, k: int) -> List[int]:
+    """Up to ``k`` indices evenly strided over ``range(n)`` —
+    deterministic sampling, so a failure replays bit-identically."""
+    if n <= 0:
+        return []
+    k = min(k, n)
+    return sorted({(i * n) // k for i in range(k)})
+
+
+def _site() -> Tuple[str, int]:
+    """(path, line) of the kernel call site — the innermost frame
+    outside this module, package-relative like the static rules."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = os.path.abspath(f.f_code.co_filename)
+        if fn != _SELF:
+            if fn.startswith(_PKG_ROOT + os.sep):
+                return os.path.relpath(fn, _PKG_ROOT), f.f_lineno
+            if fn.startswith(_REPO_ROOT + os.sep):
+                return os.path.relpath(fn, _REPO_ROOT), f.f_lineno
+            return os.path.basename(fn), f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+@dataclass
+class ShadowReport:
+    """One device/shadow divergence — a concrete overflow witness."""
+
+    kernel: str
+    path: str
+    line: int
+    index: str
+    expected: str
+    actual: str
+
+    def message(self) -> str:
+        return (
+            f"shadow divergence in '{self.kernel}' at {self.index}: "
+            f"device={self.actual} exact-shadow={self.expected} — "
+            "an intermediate wrapped its accumulator dtype; re-run "
+            "`python -m hbbft_tpu.analysis --select limb-range` for "
+            "the failing obligation and flow"
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "kernel": self.kernel,
+            "path": self.path,
+            "line": self.line,
+            "index": self.index,
+            "expected": self.expected,
+            "actual": self.actual,
+            "message": self.message(),
+        }
+
+    def as_violation(self) -> Violation:
+        return Violation(
+            rule="rangecheck",
+            path=self.path,
+            line=self.line,
+            col=0,
+            message=self.message(),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Limb decoding (exact Python ints; no device math)
+# ---------------------------------------------------------------------------
+
+
+def _u8_int(vec: np.ndarray) -> int:
+    """Little-endian base-256 limb vector → int (fr's representation)."""
+    return int.from_bytes(np.asarray(vec, dtype=np.uint8).tobytes(), "little")
+
+
+def _limb_int(vec: np.ndarray) -> int:
+    """Little-endian base-2^LIMB_BITS int32 limb vector → int (may be
+    negative transiently; exact either way)."""
+    from ..ops import limbs as LB
+
+    acc = 0
+    shift = 0
+    for v in np.asarray(vec).tolist():
+        acc += int(v) << shift
+        shift += LB.LIMB_BITS
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Per-kernel shadow oracles.  Each takes (numpy args, numpy out) and
+# returns a list of (index, expected, actual) mismatches.
+# ---------------------------------------------------------------------------
+
+Mismatch = Tuple[str, str, str]
+
+
+def _shadow_fr_matmul(args: Sequence[np.ndarray], out: np.ndarray) -> List[Mismatch]:
+    from ..crypto import fields as F
+
+    a, b = args[0], args[1]
+    m, k, p = a.shape[0], a.shape[1], b.shape[1]
+    bad: List[Mismatch] = []
+    cells = [(i, j) for i in _strides(m, _sample_budget()) for j in _strides(p, 1)]
+    for i, j in cells[: _sample_budget()]:
+        want = (
+            sum(_u8_int(a[i, t]) * _u8_int(b[t, j]) for t in range(k)) % F.R
+        )
+        got = _u8_int(out[i, j]) % F.R
+        if want != got:
+            bad.append((f"[{i},{j}]", str(want), str(got)))
+    return bad
+
+
+def _shadow_fr_add(args: Sequence[np.ndarray], out: np.ndarray) -> List[Mismatch]:
+    from ..crypto import fields as F
+    from ..ops import fr_jax
+
+    a = np.asarray(args[0]).reshape(-1, fr_jax.FR_LIMBS)
+    b = np.asarray(args[1]).reshape(-1, fr_jax.FR_LIMBS)
+    o = np.asarray(out).reshape(-1, fr_jax.FR_LIMBS)
+    bad: List[Mismatch] = []
+    for i in _strides(o.shape[0], _sample_budget()):
+        want = (_u8_int(a[i]) + _u8_int(b[i])) % F.R
+        got = _u8_int(o[i]) % F.R
+        if want != got:
+            bad.append((f"[{i}]", str(want), str(got)))
+    return bad
+
+
+def _sha_unpad(words: np.ndarray) -> Optional[bytes]:
+    """[nblocks, 16] uint32 big-endian words → original message, or
+    None when the buffer is not standard SHA-256 padding (skip, never
+    guess)."""
+    raw = np.asarray(words, dtype=">u4").tobytes()
+    bitlen = int.from_bytes(raw[-8:], "big")
+    if bitlen % 8:
+        return None
+    n = bitlen // 8
+    if n > len(raw) - 9:
+        return None
+    msg, pad = raw[:n], raw[n:-8]
+    if not pad or pad[0] != 0x80 or any(pad[1:]):
+        return None
+    return msg
+
+
+def _shadow_sha(args: Sequence[np.ndarray], out: np.ndarray) -> List[Mismatch]:
+    blocks = np.asarray(args[0], dtype=np.uint32)
+    digests = np.asarray(out, dtype=np.uint32)
+    bad: List[Mismatch] = []
+    for i in _strides(blocks.shape[0], _sample_budget()):
+        msg = _sha_unpad(blocks[i])
+        if msg is None:
+            continue
+        want = hashlib.sha256(msg).hexdigest()
+        got = b"".join(
+            int(w).to_bytes(4, "big") for w in digests[i]
+        ).hex()
+        if want != got:
+            bad.append((f"[{i}]", want, got))
+    return bad
+
+
+def _shadow_gf_matmul(args: Sequence[np.ndarray], out: np.ndarray) -> List[Mismatch]:
+    from ..crypto import rs as host_rs
+
+    mat = np.asarray(args[0], dtype=np.uint8)
+    data = np.asarray(args[1], dtype=np.uint8)
+    o = np.asarray(out, dtype=np.uint8)
+    bad: List[Mismatch] = []
+    cells = [
+        (i, j)
+        for i in _strides(mat.shape[0], _sample_budget())
+        for j in _strides(data.shape[1], 1)
+    ]
+    for i, j in cells[: _sample_budget()]:
+        want = 0
+        for t in range(mat.shape[1]):
+            want ^= host_rs.gf_mul(int(mat[i, t]), int(data[t, j]))
+        if want != int(o[i, j]):
+            bad.append((f"[{i},{j}]", str(want), str(int(o[i, j]))))
+    return bad
+
+
+def _shadow_gf16_matmul(args: Sequence[np.ndarray], out: np.ndarray) -> List[Mismatch]:
+    from ..crypto import rs as host_rs
+
+    mat = np.asarray(args[0], dtype=np.uint16)
+    data = np.asarray(args[1], dtype=np.uint16)
+    o = np.asarray(out, dtype=np.uint16)
+    bad: List[Mismatch] = []
+    cells = [
+        (i, j)
+        for i in _strides(mat.shape[0], _sample_budget())
+        for j in _strides(data.shape[1], 1)
+    ]
+    for i, j in cells[: _sample_budget()]:
+        want = 0
+        for t in range(mat.shape[1]):
+            want ^= host_rs.gf16_mul(int(mat[i, t]), int(data[t, j]))
+        if want != int(o[i, j]):
+            bad.append((f"[{i},{j}]", str(want), str(int(o[i, j]))))
+    return bad
+
+
+# -- EC on-curve witness ------------------------------------------------------
+
+
+def _on_curve_g1(X: int, Y: int, Z: int) -> bool:
+    from ..crypto import fields as F
+
+    return (Y * Y * Z - X**3 - 4 * Z**3) % F.P == 0
+
+
+def _on_curve_g2(X, Y, Z) -> bool:
+    from ..crypto import fields as F
+
+    b2 = F.fq2_scalar(F.XI, 4)  # 4(1+u), the twist constant
+    lhs = F.fq2_mul(F.fq2_sq(Y), Z)
+    rhs = F.fq2_add(
+        F.fq2_mul(F.fq2_sq(X), X),
+        F.fq2_mul(b2, F.fq2_mul(F.fq2_sq(Z), Z)),
+    )
+    return F.fq2_sub(lhs, rhs) == (0, 0)
+
+
+def _point_mismatches(arr: np.ndarray, g2: bool, kernel_L: int) -> List[Mismatch]:
+    """On-curve check over every point layout the kernels emit:
+    ``[..., 3, L]`` / ``[..., 3, 2, L]`` (XLA) and their tile-major
+    transposes ``[..., 3, L, T]`` / ``[..., 3, 2, L, T]``."""
+    a = np.asarray(arr)
+    s = a.shape
+    L = kernel_L
+    if g2:
+        if len(s) >= 3 and s[-3:] == (3, 2, L):
+            pts = a.reshape(-1, 3, 2, L)
+        elif len(s) >= 4 and s[-4:-1] == (3, 2, L):
+            pts = np.moveaxis(a.reshape(-1, 3, 2, L, s[-1]), -1, 1).reshape(
+                -1, 3, 2, L
+            )
+        else:
+            return []
+    else:
+        if len(s) >= 2 and s[-2:] == (3, L):
+            pts = a.reshape(-1, 3, L)
+        elif len(s) >= 3 and s[-3:-1] == (3, L):
+            pts = np.moveaxis(a.reshape(-1, 3, L, s[-1]), -1, 1).reshape(
+                -1, 3, L
+            )
+        else:
+            return []
+    bad: List[Mismatch] = []
+    for i in _strides(pts.shape[0], _sample_budget()):
+        if g2:
+            X = (_limb_int(pts[i, 0, 0]), _limb_int(pts[i, 0, 1]))
+            Y = (_limb_int(pts[i, 1, 0]), _limb_int(pts[i, 1, 1]))
+            Z = (_limb_int(pts[i, 2, 0]), _limb_int(pts[i, 2, 1]))
+            ok = _on_curve_g2(X, Y, Z)
+        else:
+            X, Y, Z = (_limb_int(pts[i, j]) for j in range(3))
+            ok = _on_curve_g1(X, Y, Z)
+        if not ok:
+            bad.append(
+                (f"point[{i}]", "Y²Z ≡ X³ + b·Z³ (mod p)", "off-curve")
+            )
+    return bad
+
+
+def _shadow_msm(g2: bool, exact_k: int = 16):
+    """Shadow for the jitted msm entries: on-curve witness always;
+    exact host-curve recomputation when the problem is small."""
+
+    def shadow(args: Sequence[np.ndarray], out: np.ndarray) -> List[Mismatch]:
+        from ..ops import ec_jax, limbs as LB
+
+        L = LB.fq().L
+        bad = _point_mismatches(out, g2, L)
+        pts, bits = np.asarray(args[0]), np.asarray(args[1])
+        if pts.ndim >= 2 and pts.shape[0] <= exact_k and not bad:
+            from_l = ec_jax.g2_from_limbs if g2 else ec_jax.g1_from_limbs
+            try:
+                acc = None
+                for i in range(pts.shape[0]):
+                    s = 0
+                    for b in np.asarray(bits[i]).tolist():
+                        s = (s << 1) | int(b)
+                    term = from_l(pts[i]) * s
+                    acc = term if acc is None else acc + term
+                want = from_l(out)
+                if acc is not None and want != acc:
+                    bad.append(("msm", repr(acc), repr(want)))
+            except ValueError:
+                # inputs off-curve (synthetic test tensors): the
+                # witness above is the authority, not the recompute
+                pass
+        return bad
+
+    return shadow
+
+
+def _shadow_scalar_mul(args: Sequence[np.ndarray], out: np.ndarray) -> List[Mismatch]:
+    from ..ops import limbs as LB
+
+    return _point_mismatches(out, False, LB.fq().L)
+
+
+# pallas/cached_compiled programs, dispatched by cache name: which
+# output leaves carry point limbs, and on which curve
+_POINT_PROGRAMS: Tuple[Tuple[str, bool], ...] = (
+    ("win_g2", True),
+    ("tree_g2", True),
+    ("flat_g2", True),
+    ("unpack_g2", True),
+    ("win_g1", False),
+    ("tree_g1", False),
+    ("gtree_g1", False),
+    ("scan_g1", False),
+    ("flat_g1", False),
+    ("prod_g1", False),
+    ("mesh_prod", False),
+    ("unpack_g1", False),
+)
+
+
+# ---------------------------------------------------------------------------
+# The checker: report accumulation + shim installation
+# ---------------------------------------------------------------------------
+
+
+class RangeChecker:
+    """Holds the divergence reports and the installed shims.  Usable
+    standalone in tests or process-wide via :func:`enable` /
+    :func:`disable` (same switchboard shape as racecheck)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.reports: List[ShadowReport] = []
+        self._seen: set = set()
+        self.active = True
+        self._shims: List[Tuple[Any, str, Any]] = []
+
+    def record(
+        self, kernel: str, index: str, expected: str, actual: str
+    ) -> None:
+        if not self.active:
+            return
+        path, line = _site()
+        key = (kernel, path, line, index)
+        with self._mu:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self.reports.append(
+                ShadowReport(
+                    kernel=kernel,
+                    path=path,
+                    line=line,
+                    index=index,
+                    expected=expected,
+                    actual=actual,
+                )
+            )
+
+    def run_shadow(
+        self,
+        kernel: str,
+        shadow: Callable[[Sequence[np.ndarray], Any], List[Mismatch]],
+        args: Sequence[Any],
+        out: Any,
+    ) -> None:
+        try:
+            np_args = [np.asarray(a) for a in args]
+            np_out = (
+                tuple(np.asarray(o) for o in out)
+                if isinstance(out, (tuple, list))
+                else np.asarray(out)
+            )
+            for index, expected, actual in shadow(np_args, np_out):
+                self.record(kernel, index, expected, actual)
+        except Exception as exc:  # oracle bug ≠ product crash
+            self.record(kernel, "<shadow-error>", "<no exception>", repr(exc))
+
+    # -- shim installation ---------------------------------------------------
+
+    def _shim(self, obj: Any, attr: str, wrapped: Any) -> None:
+        self._shims.append((obj, attr, getattr(obj, attr)))
+        setattr(obj, attr, wrapped)
+
+    def install(self) -> None:
+        """Shim the device-kernel surface (module-global rebinding, the
+        racecheck pattern — the jitted callables cannot be patched in
+        place).  Imports lazily so a process that never touches the
+        ops layer pays nothing."""
+        from ..ops import ec_jax, fr_jax, gf256_jax, pallas_ec, sha256_jax
+
+        for mod, attr, kernel, shadow in (
+            (fr_jax, "fr_matmul_device", "fr.matmul", _shadow_fr_matmul),
+            (fr_jax, "fr_add_device", "fr.add", _shadow_fr_add),
+            (sha256_jax, "sha256_device", "sha.device", _shadow_sha),
+            (gf256_jax, "gf_matmul_device", "gf.matmul", _shadow_gf_matmul),
+            (gf256_jax, "gf16_matmul_device", "gf.matmul16", _shadow_gf16_matmul),
+            (ec_jax, "g1_msm_device", "ec.g1_msm", _shadow_msm(False)),
+            (ec_jax, "g2_msm_device", "ec.g2_msm", _shadow_msm(True)),
+            (ec_jax, "g1_scalar_mul_device", "ec.g1_scalar_mul", _shadow_scalar_mul),
+        ):
+            self._shim(mod, attr, wrap(kernel, getattr(mod, attr), shadow))
+
+        orig_cc = pallas_ec.cached_compiled
+
+        def cached_compiled(name, fn, *args, key_parts=None, donate=()):
+            out = orig_cc(name, fn, *args, key_parts=key_parts, donate=donate)
+            chk = active()
+            if chk is not None and chk.active:
+                for prefix, g2 in _POINT_PROGRAMS:
+                    if str(name).startswith(prefix):
+                        chk.run_shadow(
+                            f"pallas.{name}",
+                            lambda a, o, _g2=g2: _leaf_points(o, _g2),
+                            (),
+                            out,
+                        )
+                        break
+            return out
+
+        self._shim(pallas_ec, "cached_compiled", cached_compiled)
+
+    def uninstall(self) -> None:
+        self.active = False
+        for obj, attr, original in reversed(self._shims):
+            setattr(obj, attr, original)
+        self._shims.clear()
+
+
+def _leaf_points(out: Any, g2: bool) -> List[Mismatch]:
+    from ..ops import limbs as LB
+
+    L = LB.fq().L
+    leaves = out if isinstance(out, tuple) else (out,)
+    bad: List[Mismatch] = []
+    for leaf in leaves:
+        bad.extend(_point_mismatches(np.asarray(leaf), g2, L))
+    return bad
+
+
+def wrap(
+    kernel: str,
+    fn: Callable[..., Any],
+    shadow: Callable[[Sequence[np.ndarray], Any], List[Mismatch]],
+) -> Callable[..., Any]:
+    """Public seam: wrap any callable with an exact-shadow oracle.
+    When no checker is enabled the wrapper is a passthrough; when one
+    is, each call's (args, out) is handed to ``shadow`` and every
+    returned ``(index, expected, actual)`` mismatch becomes a report."""
+
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        chk = active()
+        if chk is not None and chk.active:
+            chk.run_shadow(kernel, shadow, args, out)
+        return out
+
+    wrapped.__name__ = getattr(fn, "__name__", kernel)
+    wrapped.__wrapped__ = fn
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Process-wide switchboard (refcounted, racecheck shape)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[RangeChecker] = None
+_DEPTH = 0
+_SWITCH = threading.Lock()
+
+
+def active() -> Optional[RangeChecker]:
+    return _ACTIVE
+
+
+def enable() -> RangeChecker:
+    """Install the process-wide checker (idempotent/refcounted)."""
+    global _ACTIVE, _DEPTH
+    with _SWITCH:
+        if _ACTIVE is None:
+            chk = RangeChecker()
+            chk.install()
+            _ACTIVE = chk
+            _DEPTH = 0
+        _DEPTH += 1
+        return _ACTIVE
+
+
+def disable() -> List[ShadowReport]:
+    """Drop one enable; on the last, uninstall every shim, append the
+    collected reports to ``$HBBFT_TPU_RANGECHECK_OUT`` (JSONL) when
+    set, and return them."""
+    global _ACTIVE, _DEPTH
+    with _SWITCH:
+        if _ACTIVE is None:
+            return []
+        _DEPTH -= 1
+        if _DEPTH > 0:
+            return list(_ACTIVE.reports)
+        chk = _ACTIVE
+        _ACTIVE = None
+    chk.uninstall()
+    out = os.environ.get(OUT_ENV)
+    if out and chk.reports:
+        with open(out, "a") as fh:
+            for r in chk.reports:
+                fh.write(json.dumps(r.as_dict(), sort_keys=True) + "\n")
+    return list(chk.reports)
+
+
+def load_reports(path: str) -> List[ShadowReport]:
+    """Parse a ``$HBBFT_TPU_RANGECHECK_OUT`` JSONL file back into
+    reports (the CLI renders them as violations)."""
+    reports: List[ShadowReport] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                reports.append(
+                    ShadowReport(
+                        kernel=d["kernel"],
+                        path=d["path"],
+                        line=int(d["line"]),
+                        index=d.get("index", ""),
+                        expected=d.get("expected", ""),
+                        actual=d.get("actual", ""),
+                    )
+                )
+    except FileNotFoundError:
+        pass
+    return reports

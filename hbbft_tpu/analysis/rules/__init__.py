@@ -45,6 +45,9 @@
                           no blocking call while holding an asyncio lock
 ``cancellation-safety``   ``CancelledError`` is never swallowed and
                           ``finally``-block awaits are ``shield()``\\ ed
+``limb-range``            limbprove: every ops/ kernel's integer ranges
+                          prove by abstract interpretation over its jaxpr
+                          and match the pinned ``range_manifest.json``
 ========================  ==================================================
 """
 
@@ -61,6 +64,7 @@ from .determinism import DeterminismRule
 from .device_sync import DeviceSyncRule
 from .dtype_width import DtypeWidthRule
 from .layering import LayeringRule
+from .limb_range import LimbRangeRule
 from .lock_order import LockOrderRule
 from .obs_schema import ObsSchemaRule
 from .ordering import OrderedIterRule
@@ -92,4 +96,5 @@ def all_rules() -> List[Rule]:
         TaskLeakRule(),
         AwaitHoldingLockRule(),
         CancellationSafetyRule(),
+        LimbRangeRule(),
     ]

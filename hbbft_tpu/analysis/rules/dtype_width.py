@@ -16,17 +16,36 @@ breaks on the next edit.  Concretely:
 - integer literals passed to an integer-dtype constructor
   (``np.int32(x)``, ``jnp.array(x, dtype=jnp.int8)``, ``jnp.full(...,
   fill, dtype=...)``) must fit the declared dtype.
+
+Where limbprove (:mod:`..rangecheck`) *proves* a function's
+accumulator ranges from its traced jaxpr, the AST
+``preferred_element_type`` heuristic is strictly weaker — the proof
+tracks the actual accumulated magnitudes, not just the declared
+width.  Those functions (``LIMBPROVE_COVERED``, kept consistent with
+``rangecheck.covered_functions()`` by a tier-1 test) are exempt from
+the matmul-accumulator check; the narrow-cast-product and
+constant-fits checks still apply everywhere, since they catch wraps
+*upstream* of anything a traced entry point reaches.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional
+from typing import Dict, FrozenSet, Iterable, List, Optional
 
 from ..core import FileContext, Rule, Violation
 from ._ast_util import dotted_name
 
 _MACC = {"jax.lax.dot_general", "lax.dot_general", "jnp.einsum", "jax.numpy.einsum"}
+
+# Functions whose multiply-accumulate widths limbprove verifies by
+# abstract interpretation (see rangecheck.RANGE_SPECS ``covers``
+# entries).  Static so a broken ops tree still lints; the
+# ``test_rangecheck`` consistency test pins this to the live registry.
+LIMBPROVE_COVERED: Dict[str, FrozenSet[str]] = {
+    "ops/limbs.py": frozenset({"_fold_high"}),
+    "ops/fr_jax.py": frozenset({"_fold_once", "_matmul_limbs"}),
+}
 
 _NARROW = {"int8", "uint8", "int16", "uint16"}
 
@@ -95,11 +114,24 @@ class DtypeWidthRule(Rule):
     )
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
+        covered = LIMBPROVE_COVERED.get(ctx.relpath, frozenset())
+        covered_spans = [
+            (fn.lineno, fn.end_lineno or fn.lineno)
+            for fn in ast.walk(ctx.tree)
+            if isinstance(fn, ast.FunctionDef) and fn.name in covered
+        ]
+
+        def _proved(node: ast.AST) -> bool:
+            line = getattr(node, "lineno", 0)
+            return any(lo <= line <= hi for lo, hi in covered_spans)
+
         out: List[Violation] = []
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call):
                 name = dotted_name(node.func)
                 if name in _MACC:
+                    if _proved(node):
+                        continue  # limbprove verifies this accumulator
                     kwargs = {kw.arg for kw in node.keywords}
                     if "preferred_element_type" not in kwargs and None not in kwargs:
                         out.append(

@@ -1,0 +1,111 @@
+"""``limb-range`` — the limbprove obligations hold and stay pinned.
+
+The crypto kernels' correctness rests on integer range invariants
+(schoolbook convolutions staying under int32, the redundant-limb
+``< 2^12`` bound after ``_carry_round``, the ``fr_jax`` fold fixed
+point).  :mod:`..rangecheck` proves them by abstract interpretation
+over each kernel's jaxpr; this rule is the lint-framework face of that
+engine, in the wire-stability mold:
+
+- every registered kernel must *prove* — an unproved obligation (a
+  reachable int32/int64 wrap, a violated output invariant, an
+  unhandled primitive) is a violation carrying the jaxpr equation
+  flow from the kernel arguments to the overflowing op;
+- every live obligation must be *pinned* in
+  ``analysis/range_manifest.json`` with its exact peak — a kernel
+  edit that grows a peak (weakens a proven bound) or adds an
+  unpinned obligation is a loud diff, fixed by an explicit
+  ``python -m hbbft_tpu.analysis --write-range-manifest``;
+- every ``packed_msm.prewarm_plan()`` entry must map to a verified
+  kernel family (plan coverage), so a new flush-path program cannot
+  ship unproved.
+
+Unlike the pure-AST rules this one *executes* (it traces kernels with
+``jax.make_jaxpr``), so all work happens in :meth:`finish_run` behind
+a lazy import: ``--select`` runs that exclude ``limb-range`` never pay
+the tracing cost, and a tree whose ops layer fails to import reports
+that failure as a violation instead of crashing the linter.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..core import FileContext, Rule, Violation
+
+
+class LimbRangeRule(Rule):
+    name = "limb-range"
+    description = (
+        "limbprove: every ops/ kernel's integer ranges prove and match "
+        "the pinned range_manifest.json (regenerate with "
+        "--write-range-manifest)"
+    )
+    whole_project = True
+    scope = ("ops/", "analysis/")
+
+    def __init__(self) -> None:
+        self._saw_ops = False
+
+    def begin_run(self) -> None:
+        self._saw_ops = False
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        # Per-file facts are irrelevant: the kernels are verified from
+        # their traced jaxprs, not their source text.  We only note
+        # whether the ops layer is in this run's scan set, so a
+        # tests-only lint invocation doesn't trace kernels.
+        if ctx.relpath.startswith("ops/"):
+            self._saw_ops = True
+        return ()
+
+    def finish_run(self) -> Iterable[Violation]:
+        if not self._saw_ops:
+            return
+        try:
+            from .. import rangecheck
+        except Exception as exc:  # noqa: BLE001 - broken tree still lints
+            yield Violation(
+                rule=self.name,
+                path="analysis/rangecheck.py",
+                line=1,
+                col=0,
+                message=f"limbprove engine failed to import: {exc!r}",
+            )
+            return
+        try:
+            result = rangecheck.verify_all()
+            manifest = rangecheck.load_manifest()
+        except Exception as exc:  # noqa: BLE001
+            yield Violation(
+                rule=self.name,
+                path="analysis/rangecheck.py",
+                line=1,
+                col=0,
+                message=f"limbprove verification crashed: {exc!r}",
+            )
+            return
+        if manifest is None:
+            yield Violation(
+                rule=self.name,
+                path="analysis/" + rangecheck.MANIFEST_NAME,
+                line=1,
+                col=0,
+                message=(
+                    "range_manifest.json missing — generate it with "
+                    "--write-range-manifest"
+                ),
+            )
+        for message, ob in rangecheck.diff_manifest(manifest, result):
+            if ob is not None and ob.site:
+                path, line = ob.site[0], ob.site[1]
+            else:
+                path, line = "analysis/" + rangecheck.MANIFEST_NAME, 1
+            yield Violation(
+                rule=self.name,
+                path=path,
+                line=line,
+                col=0,
+                message=message,
+                flow=ob.flow if ob is not None else None,
+            )

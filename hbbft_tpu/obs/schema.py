@@ -108,6 +108,10 @@ EVENTS: Dict[str, EventSpec] = {
         {"rules", "violations", "wall"},
         {"baselined", "errors", "counts", "paths", "changed"},
     ),
+    # limbprove (additive): one row per kernel-range verification run —
+    # proof obligations checked, how many proved, and the wall cost of
+    # the jaxpr abstract interpretation
+    "range_check": _spec({"obligations", "proved", "wall"}),
     # serving gateway (additive): admission decisions, the client-side
     # commit-latency arc, and periodic queue-depth snapshots
     "gateway_admit": _spec({"tenant", "depth"}, {"client", "seq"}),

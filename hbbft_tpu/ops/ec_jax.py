@@ -399,3 +399,44 @@ def g2_msm(
     pts = jnp.asarray(g2_to_limbs(points))
     bits = jnp.asarray(LB.scalars_to_bits(scalars, w))
     return g2_from_limbs(g2_msm_device(pts, bits))
+
+
+# ---------------------------------------------------------------------------
+# limbprove registry (see ops/limbs.py for the convention).  One scan
+# body of the bit-serial MSM is the inductive step: inputs within the
+# redundant-limb bound come out within it, so the whole ladder stays
+# bounded.  The engine verifies the scan via carry-join fixpoint.
+
+
+def _range_specs(rc):
+    bound = (1 << (LB.LIMB_BITS + 1)) - 1
+    L = LB.FQ_LIMBS
+    inv = dict(out_lo=-bound, out_hi=bound)
+    bits = rc.arg((2, 16), "int32", 0, 1)
+    return [
+        rc.KernelSpec(
+            "ec.g1_msm",
+            lambda p, b: g1_kernel().msm(p, b),
+            (rc.arg((2, 3, L), "int32", -bound, bound), bits),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "ec.g2_msm",
+            lambda p, b: g2_kernel().msm(p, b),
+            (rc.arg((2, 3, 2, L), "int32", -bound, bound), bits),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "ec.g1_scalar_mul",
+            lambda p, b: g1_kernel().scalar_mul(p, b),
+            (rc.arg((2, 3, L), "int32", -bound, bound), bits),
+            **inv,
+        ),
+    ]
+
+
+RANGE_SPECS = dict(
+    module="ops/ec_jax.py",
+    covers=(),
+    specs=_range_specs,
+)

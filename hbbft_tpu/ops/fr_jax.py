@@ -238,3 +238,53 @@ def _sample_jit():
 
 def sample_fr_device(key, shape) -> jnp.ndarray:
     return _sample_jit()(key, tuple(shape))
+
+
+# ---------------------------------------------------------------------------
+# limbprove registry (see ops/limbs.py for the convention).  The
+# carry-sweep peak here is the "~2.6% under the ceiling" comment made
+# checkable: the engine re-derives it from _MAX_K and the fold table.
+
+
+def _range_specs(rc):
+    k = _MAX_K
+    byte = (0, 255)
+    return [
+        rc.KernelSpec(
+            "fr.matmul",
+            lambda a, b: _matmul_limbs(a, b),
+            (
+                rc.arg((2, k, FR_LIMBS), "uint8", *byte),
+                rc.arg((k, 2, FR_LIMBS), "uint8", *byte),
+            ),
+            out_lo=0,
+            out_hi=255,
+            final_slice_exact=True,
+        ),
+        rc.KernelSpec(
+            "fr.add",
+            lambda a, b: _add_limbs(a, b),
+            (
+                rc.arg((4, FR_LIMBS), "uint8", *byte),
+                rc.arg((4, FR_LIMBS), "uint8", *byte),
+            ),
+            out_lo=0,
+            out_hi=255,
+            final_slice_exact=True,
+        ),
+        rc.KernelSpec(
+            "fr.sample",
+            lambda key: _sample_limbs(key, (3,)),
+            (rc.arg((2,), "uint32", 0, (1 << 32) - 1),),
+            out_lo=0,
+            out_hi=255,
+            final_slice_exact=True,
+        ),
+    ]
+
+
+RANGE_SPECS = dict(
+    module="ops/fr_jax.py",
+    covers=("_fold_once", "_matmul_limbs"),
+    specs=_range_specs,
+)

@@ -298,3 +298,37 @@ def _delegate_decode_matrix(cls):
 
 _delegate_decode_matrix(ReedSolomonDevice)
 _delegate_decode_matrix(ReedSolomonDevice16)
+
+
+# ---------------------------------------------------------------------------
+# limbprove registry (see ops/limbs.py for the convention).  The
+# bitsliced matmuls accumulate 0/1 products in int32: the peak is the
+# contraction length, which the engine bounds exactly.
+
+
+def _range_specs(rc):
+    bit8 = rc.arg((32, 48), "int8", 0, 1)  # [8m, 8k] binary planes
+    bit16 = rc.arg((32, 48), "int8", 0, 1)  # [16m, 16k] binary planes
+    return [
+        rc.KernelSpec(
+            "gf.matmul",
+            lambda m, d: _bitsliced_matmul(m, d),
+            (bit8, rc.arg((6, 7), "uint8", 0, 255)),
+            out_lo=0,
+            out_hi=255,
+        ),
+        rc.KernelSpec(
+            "gf.matmul16",
+            lambda m, d: _bitsliced_matmul16(m, d),
+            (bit16, rc.arg((3, 5), "uint16", 0, (1 << 16) - 1)),
+            out_lo=0,
+            out_hi=(1 << 16) - 1,
+        ),
+    ]
+
+
+RANGE_SPECS = dict(
+    module="ops/gf256_jax.py",
+    covers=(),
+    specs=_range_specs,
+)

@@ -326,3 +326,50 @@ def ints_to_limbs_batch(xs: Sequence[int], nlimbs: int) -> np.ndarray:
     return (
         bits.reshape(n, nlimbs, LIMB_BITS).astype(np.int32) @ w
     ).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# limbprove registry.  analysis/rangecheck.py traces these entry points
+# and proves the redundant-limb invariant (|limb| <= 2**(LIMB_BITS+1)-1
+# on every boundary, no int32 intermediate overflow).  ops must not
+# import analysis (layering), so the builder receives the rangecheck
+# module as its toolbox argument.
+
+
+def _range_specs(rc):
+    f = fq()
+    L = FQ_LIMBS
+    # Magnitude bound on limbs at op boundaries.  sub() goes through a
+    # transiently-negative representation, so the invariant is
+    # symmetric: |limb| <= 2**(LIMB_BITS+1) - 1.  Expressed through
+    # LIMB_BITS so a width change re-derives every obligation.
+    bound = (1 << (LIMB_BITS + 1)) - 1
+    el = rc.arg((2, L), "int32", -bound, bound)
+    inv = dict(out_lo=-bound, out_hi=bound)
+    return [
+        rc.KernelSpec("limbs.add", lambda a, b: f.add(a, b), (el, el), **inv),
+        rc.KernelSpec("limbs.sub", lambda a, b: f.sub(a, b), (el, el), **inv),
+        rc.KernelSpec("limbs.neg", lambda a: f.neg(a), (el,), **inv),
+        rc.KernelSpec("limbs.mul", lambda a, b: f.mul(a, b), (el, el), **inv),
+        rc.KernelSpec("limbs.sq", lambda a: f.sq(a), (el,), **inv),
+        rc.KernelSpec(
+            "limbs.mul_small",
+            lambda a: f.mul_small(a, (1 << 17) - 1),
+            (el,),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "limbs.canon",
+            lambda a: f.canon(a),
+            (rc.arg((2, L), "int32", 0, bound),),
+            out_lo=0,
+            out_hi=LIMB_MASK,
+        ),
+    ]
+
+
+RANGE_SPECS = dict(
+    module="ops/limbs.py",
+    covers=("_fold_high",),
+    specs=_range_specs,
+)

@@ -2243,3 +2243,85 @@ def g1_msm_product_async(
     # keep serializing the next round's obligations until the drain
     # completes instead of blocking inside the finalizer
     return ProductFinalizer(finalize, probe=lambda: not th.is_alive())
+
+
+# ---------------------------------------------------------------------------
+# limbprove registry (see ops/limbs.py for the convention).  These are
+# the same entry points prewarm_plan() enumerates: the unpack family,
+# the fused XLA product/flat programs (the Mosaic win_*/tree_*
+# families are covered by the pallas_ec core specs, the scan_* family
+# by the ec_jax specs — see rangecheck._PLAN_PREFIXES).
+
+
+def _range_specs(rc):
+    bound = (1 << (LB.LIMB_BITS + 1)) - 1
+    nb = _S_BITS // 8
+    kp = _bucket_rows(1)  # the smallest tile bucket (128 rows)
+    kd = 4  # v2 entry points pad to the bucket on device
+    byte = (0, 255)
+    inv = dict(out_lo=-bound, out_hi=bound)
+    return [
+        rc.KernelSpec(
+            "packed.unpack_g1_v1",
+            _unpack_fn,
+            (rc.arg((kp, 96), "uint8", *byte), rc.arg((kp, nb), "uint8", *byte)),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "packed.unpack_g1_v2",
+            _unpack_fn_v2,
+            (rc.arg((kd, 96), "uint8", *byte), rc.arg((kd, nb), "uint8", *byte)),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "packed.unpack_g1c_v1",
+            _unpack_fn_compressed,
+            (
+                rc.arg((kp, 48), "uint8", *byte),
+                rc.arg((2, kp // 8), "uint8", *byte),
+                rc.arg((kp, nb), "uint8", *byte),
+            ),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "packed.unpack_g1c_v2",
+            _unpack_fn_compressed_v2,
+            (
+                rc.arg((kd, 48), "uint8", *byte),
+                rc.arg((kd,), "uint8", *byte),
+                rc.arg((kd, nb), "uint8", *byte),
+            ),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "packed.unpack_g2_v1",
+            _unpack_fn_g2,
+            (rc.arg((kp, 192), "uint8", *byte), rc.arg((kp, nb), "uint8", *byte)),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "packed.prod_g1_xla",
+            _prod_xla_fn(2),
+            (rc.arg((kd, 96), "uint8", *byte), rc.arg((kd, nb), "uint8", *byte)),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "packed.flat_g1_xla",
+            _flat_xla_fn(False),
+            (rc.arg((kd, 96), "uint8", *byte), rc.arg((kd, nb), "uint8", *byte)),
+            **inv,
+        ),
+        rc.KernelSpec(
+            "packed.flat_g2_xla",
+            _flat_xla_fn(True),
+            (rc.arg((kd, 192), "uint8", *byte), rc.arg((kd, nb), "uint8", *byte)),
+            **inv,
+        ),
+    ]
+
+
+RANGE_SPECS = dict(
+    module="ops/packed_msm.py",
+    covers=(),
+    specs=_range_specs,
+)

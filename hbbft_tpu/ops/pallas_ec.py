@@ -937,3 +937,49 @@ def ring_permute(x: jnp.ndarray, axis: str, n_dev: int) -> jnp.ndarray:
         grid_spec=grid_spec,
         compiler_params=params_cls(collective_id=0),
     )(x)
+
+
+# ---------------------------------------------------------------------------
+# limbprove registry (see ops/limbs.py for the convention).  The
+# windowed Mosaic kernels cannot be traced to a jaxpr directly, so the
+# registered kernel is one complete-addition step of the in-kernel
+# field ([L,T] limb planes, fold table and sub pad as const inputs) —
+# the inductive step every win_*/tree_* program iterates.
+
+
+def _range_specs(rc):
+    f = _field()
+    bound = (1 << (LB.LIMB_BITS + 1)) - 1
+    tile = 8  # lane count is irrelevant to per-lane ranges; keep it small
+    el = rc.arg((f.L, tile), "int32", -bound, bound)
+    fold = rc.const_arg(np.asarray(f.fold, dtype=np.int32))
+    pad = rc.const_arg(np.asarray(f.sub_pad, dtype=np.int32).reshape(-1, 1))
+    inv = dict(out_lo=-bound, out_hi=bound)
+
+    def g1_core(px, py, pz, qx, qy, qz, fold_a, pad_a):
+        fq = _KernelField(fold_a, pad_a)
+        return _point_add(fq, (px, py, pz), (qx, qy, qz))
+
+    def g2_core(*a):
+        fq = _KernelField(a[12], a[13])
+        f2 = _KernelField2(fq)
+        p = ((a[0], a[1]), (a[2], a[3]), (a[4], a[5]))
+        q = ((a[6], a[7]), (a[8], a[9]), (a[10], a[11]))
+        x3, y3, z3 = _point_add(f2, p, q)
+        return x3 + y3 + z3  # flatten the tuple-of-tuples output
+
+    return [
+        rc.KernelSpec(
+            "pallas.win_g1_core", g1_core, (el,) * 6 + (fold, pad), **inv
+        ),
+        rc.KernelSpec(
+            "pallas.win_g2_core", g2_core, (el,) * 12 + (fold, pad), **inv
+        ),
+    ]
+
+
+RANGE_SPECS = dict(
+    module="ops/pallas_ec.py",
+    covers=(),
+    specs=_range_specs,
+)

@@ -170,3 +170,30 @@ def merkle_levels_device(leaves: Sequence[bytes]) -> List[List[bytes]]:
         level = sha256_many(pairs)
         levels.append(level)
     return levels
+
+
+# ---------------------------------------------------------------------------
+# limbprove registry (see ops/limbs.py for the convention).  SHA-256
+# wraps uint32 *by design*; the proof obligation here is that nothing
+# ever lands in a signed accumulator (the engine's unsigned-wrap
+# policy stays silent, a signed intermediate would not).
+
+
+def _range_specs(rc):
+    word = (0, (1 << 32) - 1)
+    return [
+        rc.KernelSpec(
+            "sha.device",
+            sha256_device,
+            (rc.arg((2, 2, 16), "uint32", *word),),
+            out_lo=0,
+            out_hi=(1 << 32) - 1,
+        ),
+    ]
+
+
+RANGE_SPECS = dict(
+    module="ops/sha256_jax.py",
+    covers=(),
+    specs=_range_specs,
+)

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One-shot verification gate, in dependency order:
 #
-#   1. badgerlint — all 17 static rules over the package tree
+#   1. badgerlint — all 18 static rules over the package tree
 #   2. racecheck smoke — the lockset-checker test module under
 #      `pytest --racecheck` (runtime thread-safety)
 #   3. wire-manifest verification — the @wire registry still matches
@@ -28,6 +28,12 @@
 #      under the event-loop stall sanitizer with a pinned 0.5 s
 #      budget: no callback on any serving loop may park the thread
 #      (the runtime dual of the static async-blocking rule)
+#   8. limbprove — the jaxpr range verifier re-proves every registered
+#      crypto kernel against the pinned range_manifest.json (the
+#      limb-range rule), then the exact-shadow overflow sanitizer
+#      re-runs the fr device tests and the G1 product-flush
+#      byte-identity plane with sampled arbitrary-precision
+#      recomputation (the runtime dual of the static proof)
 #
 # Each stage runs even if an earlier one failed (you want the full
 # report, not the first stopper), but the exit code is non-zero if ANY
@@ -49,23 +55,23 @@ log() {
 
 rc=0
 
-echo "== [1/7] badgerlint (all rules) ==" | log
+echo "== [1/8] badgerlint (all rules) ==" | log
 python -m hbbft_tpu.analysis 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [2/7] racecheck smoke ==" | log
+echo "== [2/8] racecheck smoke ==" | log
 env JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
   -p no:cacheprovider --racecheck 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [3/7] wire manifest ==" | log
+echo "== [3/8] wire manifest ==" | log
 python -m hbbft_tpu.analysis --select wire-stability 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [4/7] scenarios smoke ==" | log
+echo "== [4/8] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
   --only bad-share --only equivocate --only hostile-clients \
   --only geo-partition-heal --only flash-crowd \
@@ -74,12 +80,12 @@ env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [5/7] gateway smoke ==" | log
+echo "== [5/8] gateway smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.serve.loadgen --smoke 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [6/7] fleet telemetry (timeline + health rules) ==" | log
+echo "== [6/8] fleet telemetry (timeline + health rules) ==" | log
 fleet_dir=$(mktemp -d)
 env JAX_PLATFORMS=cpu HBBFT_FLEET_DIR="$fleet_dir" \
   python -m hbbft_tpu.harness.scenarios --only fleet-telemetry 2>&1 | log
@@ -92,9 +98,18 @@ stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 rm -rf "$fleet_dir"
 
-echo "== [7/7] stallcheck smoke (fleet-telemetry under the sanitizer) ==" | log
+echo "== [7/8] stallcheck smoke (fleet-telemetry under the sanitizer) ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
   --only fleet-telemetry --stallcheck --stall-budget 0.5 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+
+echo "== [8/8] limbprove (range proofs + overflow shadow smoke) ==" | log
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --select limb-range 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --rangecheck \
+  "tests/test_fr_jax.py tests/test_mesh_flush.py::TestG1ProductByteIdentity" 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 

@@ -8,6 +8,10 @@
 #   scripts/lint.sh --select async-blocking,task-leak,await-holding-lock,cancellation-safety
 #   scripts/lint.sh --racecheck tests/test_racecheck.py   # runtime lockset checker
 #   scripts/lint.sh --stallcheck tests/ --stall-budget 0.25   # event-loop stall sanitizer
+#   scripts/lint.sh --select limb-range      # limbprove: re-prove kernel ranges
+#                                            # against range_manifest.json
+#   scripts/lint.sh --write-range-manifest   # re-pin the proved range bounds
+#   scripts/lint.sh --rangecheck tests/test_fr_jax.py   # exact-shadow overflow sanitizer
 #   scripts/lint.sh --changed            # git-diff scope (pre-commit);
 #                                        # the CLI widens to a full run when
 #                                        # a changed file is in a

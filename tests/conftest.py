@@ -53,6 +53,15 @@ def pytest_addoption(parser):
         "and append to $HBBFT_TPU_RACECHECK_OUT when set",
     )
     parser.addoption(
+        "--rangecheck",
+        action="store_true",
+        default=False,
+        help="run every test under the arbitrary-precision shadow "
+        "sanitizer (hbbft_tpu.analysis.rangeshadow); device/shadow "
+        "divergences (overflow witnesses) fail the test and append to "
+        "$HBBFT_TPU_RANGECHECK_OUT when set",
+    )
+    parser.addoption(
         "--stallcheck",
         action="store_true",
         default=False,
@@ -80,6 +89,30 @@ def _racecheck_guard(request):
     if reports:
         pytest.fail(
             "racecheck: "
+            + "; ".join(
+                f"{r.path}:{r.line}: {r.message()}" for r in reports
+            ),
+            pytrace=False,
+        )
+
+
+@pytest.fixture(autouse=True)
+def _rangecheck_guard(request):
+    """With ``--rangecheck``, bracket every test with the exact-shadow
+    overflow sanitizer.  Reports surface twice: as a test failure here
+    and as JSONL in ``$HBBFT_TPU_RANGECHECK_OUT`` for the
+    ``python -m hbbft_tpu.analysis --rangecheck`` driver."""
+    if not request.config.getoption("--rangecheck"):
+        yield
+        return
+    from hbbft_tpu.analysis import rangeshadow
+
+    rangeshadow.enable()
+    yield
+    reports = rangeshadow.disable()
+    if reports:
+        pytest.fail(
+            "rangecheck: "
             + "; ".join(
                 f"{r.path}:{r.line}: {r.message()}" for r in reports
             ),

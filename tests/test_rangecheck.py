@@ -1,0 +1,354 @@
+"""limbprove (:mod:`hbbft_tpu.analysis.rangecheck`) + the exact-shadow
+sanitizer (:mod:`hbbft_tpu.analysis.rangeshadow`).
+
+Three layers:
+
+- per-primitive transfer functions — tiny lambdas traced to jaxprs,
+  exact interval propagation asserted per primitive;
+- the clean-tree gate — every registered crypto kernel proves every
+  obligation, and the live obligations match the pinned
+  ``range_manifest.json`` byte-for-byte (this is the same check the
+  ``limb-range`` badgerlint rule runs tree-wide);
+- the runtime dual — the shadow sanitizer catches a planted int32 wrap
+  through the public ``wrap()`` seam and stays silent on the real
+  device kernels.
+
+``verify_all()`` is memoized per process, so the clean-tree gate and
+the manifest gate pay the jaxpr tracing cost once between them (and
+share it with the lint-clean tests when run in the same process).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hbbft_tpu
+from hbbft_tpu.analysis import rangecheck as rc
+from hbbft_tpu.analysis import rangeshadow as rsh
+from hbbft_tpu.analysis.rules.dtype_width import LIMBPROVE_COVERED
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(hbbft_tpu.__file__))
+
+
+def _interp(fn, *specs):
+    """Trace ``fn`` over symbolic args and abstract-interpret it."""
+    closed = jax.make_jaxpr(fn)(
+        *[jax.ShapeDtypeStruct(s.shape, np.dtype(s.dtype)) for s in specs]
+    )
+    an = rc.Analyzer("unit")
+    outs = an.interpret(closed, [s.aval() for s in specs])
+    return outs, an
+
+
+def _iv(outs):
+    iv = outs[0].iv
+    assert iv is not None
+    return (iv.lo, iv.hi)
+
+
+# ---------------------------------------------------------------------------
+# per-primitive transfer functions
+# ---------------------------------------------------------------------------
+
+
+class TestTransfer:
+    def test_add(self):
+        outs, _ = _interp(
+            lambda x, y: x + y,
+            rc.arg((4,), "int32", 0, 10),
+            rc.arg((4,), "int32", -3, 5),
+        )
+        assert _iv(outs) == (-3, 15)
+
+    def test_sub(self):
+        outs, _ = _interp(
+            lambda x, y: x - y,
+            rc.arg((4,), "int32", 0, 10),
+            rc.arg((4,), "int32", -3, 5),
+        )
+        assert _iv(outs) == (-5, 13)
+
+    def test_mul_signed_corners(self):
+        outs, _ = _interp(
+            lambda x, y: x * y,
+            rc.arg((4,), "int32", -4, 3),
+            rc.arg((4,), "int32", 2, 5),
+        )
+        assert _iv(outs) == (-20, 15)
+
+    def test_dot_general_accumulates_contraction(self):
+        """u8×u8 over k=3: the peak is k·255², attributed to int32."""
+        outs, an = _interp(
+            lambda A, B: jax.lax.dot_general(
+                A, B, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ),
+            rc.arg((2, 3), "uint8", 0, 255),
+            rc.arg((3, 2), "uint8", 0, 255),
+        )
+        assert _iv(outs) == (0, 3 * 255 * 255)
+        peak, _eqn = an.peaks["int32"]
+        assert peak == 3 * 255 * 255
+
+    def test_shift_left(self):
+        outs, _ = _interp(lambda x: x << 4, rc.arg((4,), "int32", 0, 7))
+        assert _iv(outs) == (0, 112)
+
+    def test_shift_right_logical(self):
+        outs, _ = _interp(
+            lambda x: jax.lax.shift_right_logical(x, jnp.int32(4)),
+            rc.arg((4,), "int32", 0, 255),
+        )
+        assert _iv(outs) == (0, 15)
+
+    def test_and_mask_bounds(self):
+        outs, _ = _interp(
+            lambda x: x & 0xFF, rc.arg((4,), "int32", 0, 100000)
+        )
+        assert _iv(outs) == (0, 255)
+
+    def test_select_n_unions_branches(self):
+        outs, _ = _interp(
+            lambda c, x, y: jnp.where(c, x, y),
+            rc.arg((4,), "bool", 0, 1),
+            rc.arg((4,), "int32", 0, 10),
+            rc.arg((4,), "int32", -7, 3),
+        )
+        assert _iv(outs) == (-7, 10)
+
+    def test_concatenate_unions_pieces(self):
+        outs, _ = _interp(
+            lambda x, y: jnp.concatenate([x, y]),
+            rc.arg((4,), "int32", 0, 10),
+            rc.arg((4,), "int32", -7, 3),
+        )
+        assert _iv(outs) == (-7, 10)
+
+    def test_rem_bounds_by_divisor(self):
+        outs, _ = _interp(lambda x: x % 13, rc.arg((4,), "int32", 0, 1000))
+        lo, hi = _iv(outs)
+        assert lo == 0 and 12 <= hi <= 25  # sound; conservatively ≤ 2·|d|−1
+
+    def test_convert_keeps_fitting_interval(self):
+        outs, _ = _interp(
+            lambda x: x.astype(jnp.uint8), rc.arg((4,), "int32", 0, 200)
+        )
+        assert _iv(outs) == (0, 200)
+
+    def test_scan_clamped_carry_converges(self):
+        """A masked carry reaches a tight fixpoint (no widening):
+        out ≤ 15, intermediate peak = 15 + 3 before the mask."""
+
+        def body(c, x):
+            c = (c + x) & 0xF
+            return c, c
+
+        outs, an = _interp(
+            lambda xs: jax.lax.scan(body, jnp.int32(0), xs)[1],
+            rc.arg((5,), "int32", 0, 3),
+        )
+        assert _iv(outs) == (0, 15)
+        peak, _eqn = an.peaks["int32"]
+        assert peak == 18
+
+    def test_scan_growing_carry_widens_soundly(self):
+        """An unbounded carry widens to the dtype range — conservative,
+        never unsound."""
+
+        def body(c, x):
+            return c + x, c
+
+        outs, _ = _interp(
+            lambda xs: jax.lax.scan(body, jnp.int32(0), xs)[0],
+            rc.arg((5,), "int32", 0, 3),
+        )
+        lo, hi = _iv(outs)
+        assert lo <= 0 and hi >= 15  # must contain the concrete range
+
+    def test_const_gather_is_exact(self):
+        """Indexing a known table propagates the exact element, not the
+        table-wide bound."""
+        tbl = rc.const_arg(np.arange(8, dtype=np.int32))
+        outs, _ = _interp(
+            lambda t, i: t[2] * i, tbl, rc.arg((), "int32", 0, 10)
+        )
+        assert _iv(outs) == (0, 20)
+
+
+# ---------------------------------------------------------------------------
+# clean-tree gate + manifest pin
+# ---------------------------------------------------------------------------
+
+
+def test_every_kernel_proves_every_obligation():
+    result = rc.verify_all()
+    bad = [o for o in result.obligations if not o.proved]
+    assert not bad, "; ".join(
+        f"{o.key}: peak {o.peak} vs capacity {o.capacity} "
+        f"({o.message or 'bound exceeded'})"
+        for o in bad
+    )
+    # Every registered module contributed at least one kernel report.
+    assert {r.kernel.split(".")[0] for r in result.reports} >= {
+        "limbs", "fr", "gf", "sha", "ec", "packed", "pallas",
+    }
+
+
+def test_manifest_matches_live_tree():
+    manifest = rc.load_manifest()
+    assert manifest is not None, "range_manifest.json missing"
+    diffs = rc.diff_manifest(manifest, rc.verify_all())
+    assert not diffs, "; ".join(msg for msg, _ob in diffs)
+
+
+def test_manifest_file_is_sorted_and_stringly():
+    """The pinned file stays diffable: sorted keys, decimal-string
+    peaks (peaks exceed 2^53 — JSON numbers would lose digits)."""
+    path = os.path.join(PACKAGE_DIR, "analysis", rc.MANIFEST_NAME)
+    with open(path) as fh:
+        manifest = json.load(fh)
+    keys = [e["key"] for e in manifest["obligations"]]
+    assert keys == sorted(keys)
+    for e in manifest["obligations"]:
+        assert isinstance(e["peak"], str) and e["peak"].isdigit()
+        assert isinstance(e["capacity"], str)
+        assert e["proved"] is True
+
+
+def test_disk_cache_roundtrips_obligations(tmp_path, monkeypatch):
+    """The source-hashed disk cache replays byte-identical obligations
+    (peaks > 2^53 survive as decimal strings, sites and flows intact)
+    and refuses a stale fingerprint."""
+    result = rc.verify_all()
+    monkeypatch.setattr(rc, "DISK_CACHE", str(tmp_path / "cache.json"))
+    rc._disk_cache_store("fp-1", result.reports)
+    replayed = rc._disk_cache_load("fp-1")
+    assert replayed is not None
+    live = {o.key: o for r in result.reports for o in r.obligations}
+    back = {o.key: o for r in replayed for o in r.obligations}
+    assert live.keys() == back.keys()
+    for key, o in live.items():
+        b = back[key]
+        assert (o.peak, o.capacity, o.proved, o.site, o.flow) == (
+            b.peak, b.capacity, b.proved, b.site, b.flow,
+        ), key
+    assert rc._disk_cache_load("fp-other") is None
+    monkeypatch.setenv(rc.DISK_CACHE_ENV, "0")
+    assert rc._disk_cache_load("fp-1") is None
+
+
+def test_source_fingerprint_tracks_kernel_sources():
+    fp = rc._source_fingerprint()
+    assert fp == rc._source_fingerprint()  # deterministic
+    assert len(fp) == 64
+
+
+def test_dtype_width_deferral_matches_registry():
+    """The lint-side LIMBPROVE_COVERED table must mirror the live
+    ``covers`` declarations, or the dtype-width rule would exempt
+    functions limbprove no longer proves."""
+    live = {k: v for k, v in rc.covered_functions().items() if v}
+    assert LIMBPROVE_COVERED == live
+
+
+def test_baseline_carries_no_range_debt():
+    """limb-range starts (and stays) baseline-free: pinned bounds are
+    regenerated, never grandfathered."""
+    path = os.path.join(PACKAGE_DIR, "analysis", "baseline.json")
+    with open(path) as fh:
+        baseline = json.load(fh)
+    assert not [
+        e
+        for e in baseline["entries"]
+        if e.get("rule") in ("limb-range", "dtype-width")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# shadow sanitizer: planted overflow + real kernels clean
+# ---------------------------------------------------------------------------
+
+
+def _square_shadow(args, out):
+    """Exact oracle for the planted fixture: (x²)·65536 in Python ints."""
+    x = np.asarray(args[0]).astype(object)
+    want = (x * x) * 65536
+    got = np.asarray(out).astype(object)
+    return [
+        ((int(i),), int(want[i]), int(got[i]))
+        for i in range(x.shape[0])
+        if want[i] != got[i]
+    ]
+
+
+def test_shadow_catches_planted_int32_wrap():
+    @jax.jit
+    def square_scaled(x):
+        y = x.astype(jnp.int32)
+        return (y * y) * 65536  # wraps for |x| ≥ 2^7.5·...; 70000² ≫ 2³¹
+
+    wrapped = rsh.wrap("fixture.square", square_scaled, _square_shadow)
+    x = np.array([3, 70000], dtype=np.int64)
+    rsh.enable()
+    try:
+        wrapped(x)
+    finally:
+        reports = rsh.disable()
+    assert len(reports) == 1
+    rep = reports[0]
+    assert rep.kernel == "fixture.square"
+    assert rep.index == (1,)
+    assert rep.expected != rep.actual
+    assert rep.path.endswith("test_rangecheck.py")
+    v = rep.as_violation()
+    assert v.rule == "rangecheck"
+    assert "fixture.square" in v.message
+
+
+def test_shadow_oracle_error_is_reported_not_raised():
+    """A crashing oracle must degrade to a <shadow-error> report, never
+    take the product call down with it."""
+
+    def bad_oracle(args, out):
+        raise RuntimeError("oracle exploded")
+
+    wrapped = rsh.wrap("fixture.bad", lambda x: x, bad_oracle)
+    rsh.enable()
+    try:
+        wrapped(np.zeros(2, dtype=np.int32))
+    finally:
+        reports = rsh.disable()
+    assert len(reports) == 1
+    assert "<shadow-error>" in reports[0].message()
+    assert "oracle exploded" in reports[0].message()
+
+
+def test_shadow_clean_on_real_kernels(rng):
+    """fr matmul/add, SHA-256, and GF(2⁸) RS encode run shadowed with
+    zero divergence — the kernels really do stay inside their proved
+    ranges."""
+    from hbbft_tpu.ops import fr_jax, gf256_jax, sha256_jax
+
+    rsh.enable()
+    try:
+        # fr matmul on random scalars
+        vals = [rng.randrange(1 << 252) for _ in range(6)]
+        a = fr_jax.fr_to_limbs(vals[:4]).reshape(2, 2, fr_jax.FR_LIMBS)
+        b = fr_jax.fr_to_limbs(vals[2:]).reshape(2, 2, fr_jax.FR_LIMBS)
+        np.asarray(fr_jax.fr_matmul_device(a, b))
+        # sha256 on uniform-length messages
+        msgs = [bytes(rng.randrange(256) for _ in range(55)) for _ in range(3)]
+        np.asarray(sha256_jax.sha256_device(jnp.asarray(
+            sha256_jax.pad_messages(msgs)
+        )))
+        # GF(2^8) Reed-Solomon encode
+        dev = gf256_jax.ReedSolomonDevice(4, 2)
+        data = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(4)]
+        dev.encode(data)
+    finally:
+        reports = rsh.disable()
+    assert reports == [], "; ".join(r.message() for r in reports)

@@ -1,0 +1,253 @@
+"""Differential suite for limbprove (:mod:`hbbft_tpu.analysis.rangecheck`).
+
+Each test copies the package tree into a fixture, textually reverts one
+of the arithmetic safeguards the pinned ``range_manifest.json`` bounds
+depend on, re-analyzes only the affected kernels in a subprocess (the
+fixture's ``hbbft_tpu`` on ``PYTHONPATH``), and asserts limbprove
+re-detects the exact obligation — right key, right direction (unproved
+vs loosened pin), and a SARIF-able flow path through the right
+function.  The analysis is targeted (``limbs.mul`` + ``fr.matmul``
+re-prove in well under a second) so the whole suite stays tier-1.
+
+The perturbations mirror real editing accidents:
+
+- drop one carry round in ``Limb.normalize``       → every obligation
+  still *proves*, but the ``limbs.mul:out-invariant`` peak grows past
+  its pinned value — the manifest diff is the only thing that notices;
+- ``LIMB_BITS`` 11 → 12                            → the ``_conv``
+  convolution peak exceeds int32 (``limbs.mul:cap-int32`` unproved);
+- ``_MAX_K`` 971 → 2000                            → the fr matmul
+  accumulator exceeds int32 (``fr.matmul:cap-int32`` unproved);
+- fr fold ``range(3)`` → ``range(1)``              → digits survive
+  above the canonical slice (``fr.matmul:slice-exact`` unproved, flow
+  through ``_fold_once``).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+import hbbft_tpu
+from hbbft_tpu.analysis import rangecheck as rc
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(hbbft_tpu.__file__))
+
+KERNELS = ("limbs.mul", "fr.matmul")
+
+# Subprocess driver: analyze only the named kernels against whatever
+# ``hbbft_tpu`` resolves first on PYTHONPATH, dump obligations as JSON.
+_DRIVER = """\
+import json, sys
+import hbbft_tpu
+import hbbft_tpu.analysis.rangecheck as rc
+names = set(sys.argv[1:])
+out = {"pkg": hbbft_tpu.__file__, "obs": []}
+for _module, rs in rc.iter_range_specs():
+    for spec in rs["specs"](rc):
+        if spec.name in names:
+            rep = rc.analyze_spec(spec)
+            for o in rep.obligations:
+                out["obs"].append({
+                    "kernel": o.kernel, "kind": o.kind, "key": o.key,
+                    "proved": o.proved, "peak": str(o.peak),
+                    "capacity": str(o.capacity),
+                    "site": list(o.site) if o.site else None,
+                    "flow": [list(f) for f in (o.flow or [])],
+                })
+print(json.dumps(out))
+"""
+
+
+def _copy_pkg(tmp_path):
+    """Copy the package tree into an importable fixture root."""
+    root = tmp_path / "fixture"
+    shutil.copytree(
+        PACKAGE_DIR,
+        root / "hbbft_tpu",
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
+    )
+    return root
+
+
+def _perturb(root, relpath, old, new):
+    path = root / "hbbft_tpu" / relpath
+    text = path.read_text()
+    assert old in text, (
+        f"perturbation anchor {old!r} vanished from {relpath} — "
+        "update the differential suite alongside the kernel edit"
+    )
+    path.write_text(text.replace(old, new))
+
+
+def _analyze(root, *kernels):
+    """Run the targeted driver against the fixture; key → entry dict."""
+    driver = root / "rc_driver.py"
+    driver.write_text(_DRIVER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(root)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(driver), *kernels],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    # The subprocess must have analyzed the *fixture*, not the repo.
+    assert out["pkg"].startswith(str(root)), out["pkg"]
+    entries = {e["key"]: e for e in out["obs"]}
+    # Targeted analysis still yields the full obligation set per kernel.
+    for kernel in kernels:
+        assert any(e["kernel"] == kernel for e in entries.values())
+    return entries
+
+
+def _pinned():
+    manifest = rc.load_manifest()
+    assert manifest is not None
+    return {e["key"]: e for e in manifest["obligations"]}
+
+
+def _flow_functions(entry):
+    return {fn for (_path, _line, fn) in map(tuple, entry["flow"] or [])}
+
+
+def _as_result(entries):
+    """Rebuild a RunResult from driver JSON so diff_manifest (the exact
+    code path behind the ``limb-range`` rule) renders the findings."""
+    by_kernel = {}
+    for e in entries.values():
+        by_kernel.setdefault(e["kernel"], []).append(
+            rc.Obligation(
+                kernel=e["kernel"],
+                kind=e["kind"],
+                peak=int(e["peak"]),
+                capacity=int(e["capacity"]),
+                proved=e["proved"],
+                site=tuple(e["site"]) if e["site"] else None,
+                flow=tuple(tuple(f) for f in e["flow"]) or None,
+            )
+        )
+    reports = [
+        rc.KernelReport(kernel=k, obligations=obs)
+        for k, obs in sorted(by_kernel.items())
+    ]
+    return rc.RunResult(reports=reports, plan=[], wall=0.0)
+
+
+def _restricted_manifest(keys):
+    """Pinned manifest cut down to the analyzed keys, so diff_manifest
+    does not report every unanalyzed kernel as vanished."""
+    pinned = _pinned()
+    return {
+        "version": 1,
+        "obligations": [pinned[k] for k in sorted(keys) if k in pinned],
+    }
+
+
+@pytest.fixture
+def fixture_root(tmp_path):
+    return _copy_pkg(tmp_path)
+
+
+def test_unperturbed_fixture_matches_manifest(fixture_root):
+    """The copy machinery itself introduces no drift: every obligation
+    proves and every peak equals its pinned value."""
+    entries = _analyze(fixture_root, *KERNELS)
+    pinned = _pinned()
+    for key, entry in entries.items():
+        assert entry["proved"], key
+        assert key in pinned, key
+        assert entry["peak"] == pinned[key]["peak"], key
+    assert not rc.diff_manifest(
+        _restricted_manifest(entries), _as_result(entries)
+    )
+
+
+def test_dropped_carry_round_loosens_pinned_bound(fixture_root):
+    """One fewer carry round still proves (peak 4056 ≤ 4095) — only the
+    manifest pin catches the silently loosened bound."""
+    _perturb(
+        fixture_root,
+        "ops/limbs.py",
+        "        x = _carry_round(_carry_round(x))\n"
+        "        return x[..., : self.L]",
+        "        x = _carry_round(x)\n"
+        "        return x[..., : self.L]",
+    )
+    entries = _analyze(fixture_root, *KERNELS)
+    entry = entries["limbs.mul:out-invariant"]
+    assert entry["proved"]  # within ±4095 — capacity alone can't see it
+    pinned_peak = int(_pinned()["limbs.mul:out-invariant"]["peak"])
+    assert int(entry["peak"]) > pinned_peak
+    diffs = rc.diff_manifest(
+        _restricted_manifest(entries), _as_result(entries)
+    )
+    weakened = [
+        msg
+        for msg, ob in diffs
+        if ob is not None and ob.key == "limbs.mul:out-invariant"
+    ]
+    assert len(weakened) == 1
+    assert "weakened" in weakened[0]
+    assert f"{pinned_peak} -> {entry['peak']}" in weakened[0]
+
+
+def test_limb_bits_overflows_int32_conv(fixture_root):
+    """Widening the limb radix breaks the 38·(2¹²−1)² < 2³¹ headroom:
+    the convolution obligation must go unproved with a flow into
+    ``_conv``."""
+    _perturb(fixture_root, "ops/limbs.py", "LIMB_BITS = 11", "LIMB_BITS = 12")
+    entries = _analyze(fixture_root, "limbs.mul")
+    entry = entries["limbs.mul:cap-int32"]
+    assert not entry["proved"]
+    assert int(entry["peak"]) > 2**31 - 1
+    assert entry["site"][0] == "ops/limbs.py"
+    assert entry["site"][2] == "_conv"
+    assert "_conv" in _flow_functions(entry)
+    diffs = rc.diff_manifest(
+        _restricted_manifest(entries), _as_result(entries)
+    )
+    assert any(
+        msg.startswith("unproved obligation limbs.mul:cap-int32")
+        for msg, _ob in diffs
+    )
+
+
+def test_max_k_overflows_fr_accumulator(fixture_root):
+    """Raising the batched-matmul K cap past the proved 255²·k·33 < 2³¹
+    budget must surface as an unproved fr accumulator obligation."""
+    _perturb(fixture_root, "ops/fr_jax.py", "_MAX_K = 971", "_MAX_K = 2000")
+    entries = _analyze(fixture_root, "fr.matmul")
+    entry = entries["fr.matmul:cap-int32"]
+    assert not entry["proved"]
+    assert int(entry["peak"]) > 2**31 - 1
+    assert entry["site"][0] == "ops/fr_jax.py"
+    assert "_matmul_limbs" in _flow_functions(entry)
+
+
+def test_fewer_folds_breaks_canonical_slice(fixture_root):
+    """Shrinking the fold loop leaves nonzero digits above the canonical
+    width: the slice-exact obligation fails with a flow through
+    ``_fold_once``."""
+    _perturb(
+        fixture_root,
+        "ops/fr_jax.py",
+        "    for _ in range(3):\n        d = _fold_once(d)",
+        "    for _ in range(1):\n        d = _fold_once(d)",
+    )
+    entries = _analyze(fixture_root, "fr.matmul")
+    entry = entries["fr.matmul:slice-exact"]
+    assert not entry["proved"]
+    assert int(entry["peak"]) > 0
+    assert entry["site"][0] == "ops/fr_jax.py"
+    assert "_fold_once" in _flow_functions(entry)
+    # The untouched limb kernel must not start failing collaterally.
+    limb_entries = _analyze(fixture_root, "limbs.mul")
+    assert all(e["proved"] for e in limb_entries.values())
