@@ -48,6 +48,11 @@
 ``limb-range``            limbprove: every ops/ kernel's integer ranges
                           prove by abstract interpretation over its jaxpr
                           and match the pinned ``range_manifest.json``
+``no-early-decrypt``      threshold-decryption sinks appear only in the
+                          allowlisted post-ACS HoneyBadger methods, and
+                          those methods are called only from the
+                          commit/reveal path (order-then-reveal's
+                          censorship-resistance invariant)
 ========================  ==================================================
 """
 
@@ -66,6 +71,7 @@ from .dtype_width import DtypeWidthRule
 from .layering import LayeringRule
 from .limb_range import LimbRangeRule
 from .lock_order import LockOrderRule
+from .no_early_decrypt import NoEarlyDecryptRule
 from .obs_schema import ObsSchemaRule
 from .ordering import OrderedIterRule
 from .pallas_shape import PallasShapeRule
@@ -97,4 +103,5 @@ def all_rules() -> List[Rule]:
         AwaitHoldingLockRule(),
         CancellationSafetyRule(),
         LimbRangeRule(),
+        NoEarlyDecryptRule(),
     ]

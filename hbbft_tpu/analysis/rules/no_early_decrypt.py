@@ -1,0 +1,180 @@
+"""Rule ``no-early-decrypt`` — threshold decryption never starts
+before common-subset output pins the epoch's order.
+
+The order-then-reveal pipeline's censorship-resistance argument (PR 19,
+after arXiv:2407.12172) rests on one invariant: a contribution's
+*position in the committed log* is fixed while it is still ciphertext.
+Decrypting — or even emitting one's own decryption share — before the
+common subset has output for that epoch would let an adversarial
+replica peek at plaintexts and bias what it withholds or proposes
+next.  The dynamic twin of this gate is the ``ordered-reveal``
+scenario (``harness/scenarios.py``); this rule is the static one.
+
+Statically, the invariant decomposes into two checks over
+``protocols/``:
+
+1. **Sink containment** — the threshold-decryption primitives
+   (``decrypt_share_no_verify`` / ``decrypt_shares_no_verify_batch``
+   on a secret key share; every ``combine*_decryption_shares*``
+   variant on a public key set) may be *called* only inside the
+   allowlisted post-ACS methods of the HoneyBadger state machine:
+   share emission in ``_send_decryption_share`` (reached from the
+   common-subset output funnel) and combining in
+   ``_try_decrypt_proposer_contribution`` /
+   ``_try_decrypt_speculative`` (reached from the batch-output /
+   reveal drivers, which require ``self.ciphertexts[epoch]`` — a dict
+   that only ``_send_decryption_shares`` fills, at ACS output).
+   ``getattr(pk_set, "combine...", ...)`` probes count as sink
+   references too.
+
+2. **Caller map** — those allowlisted methods must themselves be
+   invoked only from their unique post-ACS call sites:
+
+   - ``_send_decryption_shares`` ← ``_process_output`` (the CS output
+     handler) only;
+   - ``_send_decryption_share`` ← ``_send_decryption_shares`` only;
+   - ``_try_decrypt_proposer_contribution`` ← ``_try_output_batch`` /
+     ``_try_reveal_batch`` only;
+   - ``_try_decrypt_speculative`` ←
+     ``_try_decrypt_proposer_contribution`` only.
+
+   A new ``self._try_decrypt_...`` call from, say,
+   ``_handle_decryption_share_message`` (eager decryption at share
+   arrival — *before* ACS output exists for the epoch) is exactly the
+   regression this catches; the revert-and-re-detect differential
+   suite is ``tests/test_no_early_decrypt_diff.py``.
+
+Verification primitives (``verify_dec_share``,
+``verify_decryption_share``) are NOT sinks: checking a share against a
+public key reveals nothing about the plaintext and legitimately
+happens at message arrival.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import FileContext, Rule, Violation
+
+#: share-emission sinks: produce this node's decryption share
+_SHARE_SINKS = {
+    "decrypt_share_no_verify",
+    "decrypt_shares_no_verify_batch",
+}
+
+#: combine sinks: turn t+1 shares into plaintext
+_COMBINE_SINKS = {
+    "combine_decryption_shares",
+    "combine_decryption_shares_many",
+    "combine_and_check_decryption_shares",
+    "combine_and_check_decryption_shares_many",
+}
+
+_SINKS = _SHARE_SINKS | _COMBINE_SINKS
+
+#: sink kind → methods a sink call may appear in
+_SINK_HOMES: Dict[str, Set[str]] = {
+    **{s: {"_send_decryption_share"} for s in _SHARE_SINKS},
+    **{
+        s: {"_try_decrypt_proposer_contribution", "_try_decrypt_speculative"}
+        for s in _COMBINE_SINKS
+    },
+}
+
+#: protected method → its only allowed intra-class callers
+_ALLOWED_CALLERS: Dict[str, Set[str]] = {
+    "_send_decryption_shares": {"_process_output"},
+    "_send_decryption_share": {"_send_decryption_shares"},
+    "_try_decrypt_proposer_contribution": {
+        "_try_output_batch",
+        "_try_reveal_batch",
+    },
+    "_try_decrypt_speculative": {"_try_decrypt_proposer_contribution"},
+}
+
+
+def _sink_of(node: ast.Call) -> Optional[str]:
+    """The sink a call references, or None.  Covers direct attribute
+    calls (``x.combine_decryption_shares(...)``) and getattr probes
+    (``getattr(x, "combine_and_check_decryption_shares", None)``)."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _SINKS:
+        return func.attr
+    if (
+        isinstance(func, ast.Name)
+        and func.id == "getattr"
+        and len(node.args) >= 2
+        and isinstance(node.args[1], ast.Constant)
+        and node.args[1].value in _SINKS
+    ):
+        return node.args[1].value
+    return None
+
+
+class NoEarlyDecryptRule(Rule):
+    name = "no-early-decrypt"
+    description = (
+        "threshold-decryption sinks only in the allowlisted post-ACS "
+        "methods, and those methods only called from the commit/reveal "
+        "path"
+    )
+    scope = ("protocols/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        # one pass with an enclosing-function stack: sink containment
+        # and the self._method() caller map come from the same walk
+        stack: List[str] = []
+
+        def visit(node: ast.AST) -> None:
+            is_fn = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_fn:
+                stack.append(node.name)
+            if isinstance(node, ast.Call):
+                sink = _sink_of(node)
+                if sink is not None:
+                    fn = stack[-1] if stack else "<module>"
+                    if fn not in _SINK_HOMES[sink]:
+                        out.append(
+                            self.violation(
+                                ctx,
+                                node,
+                                f"threshold-decryption sink {sink}() in "
+                                f"{fn} — decryption may only run in "
+                                + "/".join(sorted(_SINK_HOMES[sink]))
+                                + ", after common-subset output pins "
+                                "the epoch's order",
+                            )
+                        )
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "self"
+                    and func.attr in _ALLOWED_CALLERS
+                ):
+                    fn = stack[-1] if stack else "<module>"
+                    if fn not in _ALLOWED_CALLERS[func.attr]:
+                        out.append(
+                            self.violation(
+                                ctx,
+                                node,
+                                f"decryption entry point self.{func.attr}"
+                                f"() called from {fn} — only "
+                                + "/".join(
+                                    sorted(_ALLOWED_CALLERS[func.attr])
+                                )
+                                + " may reach it (the post-ACS "
+                                "order-then-reveal path)",
+                            )
+                        )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+
+        visit(ctx.tree)
+        return out

@@ -261,6 +261,66 @@ class BatchingBackend:
             rec.observe("flush.shipped", shipped)
             rec.count("flush.count")
 
+    # -- reveal plane (order-then-reveal cross-epoch decryption) -----------
+
+    def reveal_combine(
+        self,
+        pk_set,
+        rows: List[Dict[int, Any]],
+        cts: List[Any],
+        epochs: Optional[List[int]] = None,
+    ) -> List[Optional[bytes]]:
+        """Cross-epoch RLC-batched combine-and-check: ALL pending
+        reveals' speculative share subsets — rows from *several* epochs
+        accumulated while ordering ran ahead — go through ONE
+        ``combine_and_check_decryption_shares_many`` call (two pairings
+        total for real BLS, regardless of epoch count; the coefficients
+        are per-row Fiat–Shamir, so cross-epoch batching is row-wise
+        identical to per-epoch calls — on an aggregate mismatch the
+        per-row recheck isolates exactly the bad rows).  Returns one
+        plaintext-or-None per row, row order preserved.
+
+        Emits a ``flush`` event with ``plane="reveal"`` and ``groups``
+        = the number of distinct epochs served, so traces show how much
+        decryption work one fused reveal flush amortized."""
+        rec = _obs.ACTIVE
+        t0 = _time.perf_counter() if rec is not None else 0.0
+        results: List[Optional[bytes]]
+        many = getattr(
+            pk_set, "combine_and_check_decryption_shares_many", None
+        )
+        if many is not None:
+            try:
+                results = many(rows, cts)
+            except Exception:
+                results = [None] * len(rows)
+        else:
+            one = getattr(
+                pk_set, "combine_and_check_decryption_shares", None
+            )
+            results = []
+            for row, ct in zip(rows, cts):
+                try:
+                    pt = one(row, ct) if one is not None else None
+                except Exception:
+                    pt = None
+                results.append(pt)
+        self.stats.flushes += 1
+        if rec is not None and rows:
+            hits = sum(1 for r in results if r is not None)
+            rec.event(
+                "flush",
+                queued=len(rows),
+                shipped=len(rows),
+                real=hits,
+                inline=len(rows) - hits,
+                groups=len(set(epochs)) if epochs else 1,
+                dur=round(_time.perf_counter() - t0, 9),
+                plane="reveal",
+            )
+            rec.observe("reveal.combine_rows", len(rows))
+        return results
+
     @staticmethod
     def _is_real_bls(ob: Obligation) -> bool:
         if not isinstance(ob.pk_share, T.PublicKeyShare):

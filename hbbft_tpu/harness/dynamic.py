@@ -131,6 +131,10 @@ class VectorizedDynamicSim:
             verify_honest=self.verify_honest,
             emit_minimal=self.emit_minimal,
             hw=self.hw,
+            # the dynamic layer consumes each epoch's batch (votes,
+            # era changes) synchronously — pin inline regardless of
+            # HBBFT_TPU_ORDERED_COMMIT
+            reveal_mode="inline",
         )
         self.sim.epoch = self.epoch
         self.counter = VoteCounter(

@@ -73,7 +73,7 @@ from ..crypto.merkle import MerkleTree as _PyMerkleTree
 from ..protocols.common_coin import make_nonce
 from ..protocols.honey_badger import Batch
 from .batching import BatchingBackend
-from .vectorized import decrypt_round
+from .vectorized import RevealRequest, decrypt_round, decrypt_rounds_deferred
 
 
 # ---------------------------------------------------------------------------
@@ -1209,10 +1209,33 @@ class VirtualEpochTime:
 
 
 @dataclasses.dataclass
-class EpochResult:
-    """One full co-simulated HoneyBadger epoch."""
+class _PendingReveal:
+    """One ordered-but-unrevealed epoch queued by the order-then-reveal
+    driver: everything :func:`~hbbft_tpu.harness.vectorized.
+    decrypt_rounds_deferred` needs to reveal it later, plus the
+    :class:`EpochResult` (already returned to the caller) that the
+    flush fills in place."""
 
-    batch: Batch  # identical at every correct node
+    epoch: int
+    seq: int
+    cts: Dict[Any, Any]
+    dead: Set[Any]
+    forged_dec: Dict[Any, Dict[Any, Any]]
+    result: "EpochResult"
+    t_ordered: float  # perf_counter at ordered-commit (reveal-lag base)
+
+
+@dataclasses.dataclass
+class EpochResult:
+    """One full co-simulated HoneyBadger epoch.
+
+    Under ``reveal_mode="ordered"`` the result is returned at
+    *ordered-commit* time with ``batch=None``; the next
+    ``flush_reveals()`` (automatic at the backpressure bound and at the
+    end of ``run_epochs``) fills ``batch``, merges decryption faults
+    into ``fault_log`` and stamps the reveal phases — in place."""
+
+    batch: Optional[Batch]  # identical at every correct node
     accepted: List[Any]  # proposers in the common subset
     fault_log: FaultLog
     coin_flips: int
@@ -1270,12 +1293,22 @@ class VectorizedHoneyBadgerSim:
         emit_minimal: bool = False,
         hw: Any = None,
         speculative: Optional[bool] = None,
+        reveal_mode: Optional[str] = None,
+        max_outstanding_reveals: int = 4,
     ):
         netinfos = NetworkInfo.generate_map(
             list(range(n)), rng, mock=mock, ops=ops
         )
         self._bind(
-            netinfos, rng, mock, verify_honest, emit_minimal, hw, speculative
+            netinfos,
+            rng,
+            mock,
+            verify_honest,
+            emit_minimal,
+            hw,
+            speculative,
+            reveal_mode,
+            max_outstanding_reveals,
         )
 
     @classmethod
@@ -1288,6 +1321,8 @@ class VectorizedHoneyBadgerSim:
         emit_minimal: bool = False,
         hw: Any = None,
         speculative: Optional[bool] = None,
+        reveal_mode: Optional[str] = None,
+        max_outstanding_reveals: int = 4,
     ) -> "VectorizedHoneyBadgerSim":
         """Build over an existing keyed validator set — the era-restart
         path of the dynamic layer (``harness/dynamic.py``), where keys
@@ -1301,6 +1336,8 @@ class VectorizedHoneyBadgerSim:
             emit_minimal,
             hw,
             speculative,
+            reveal_mode,
+            max_outstanding_reveals,
         )
         return sim
 
@@ -1313,6 +1350,8 @@ class VectorizedHoneyBadgerSim:
         emit_minimal,
         hw=None,
         speculative=None,
+        reveal_mode=None,
+        max_outstanding_reveals=4,
     ):
         self.n = len(netinfos)
         self.rng = rng
@@ -1328,6 +1367,24 @@ class VectorizedHoneyBadgerSim:
                 os.environ.get("HBBFT_TPU_SPEC_COMBINE", "0") == "1"
             )
         self.speculative = speculative
+        # order-then-reveal (PR 19): "ordered" decouples the commit
+        # critical path (ACS + ciphertext pinning) from threshold
+        # decryption — run_epoch returns at ordered-commit with
+        # batch=None and the reveal happens on a later cross-epoch
+        # fused flush (``flush_reveals``).  HBBFT_TPU_ORDERED_COMMIT=1
+        # flips the default for a whole process.
+        if reveal_mode is None:
+            reveal_mode = (
+                "ordered"
+                if os.environ.get("HBBFT_TPU_ORDERED_COMMIT", "0") == "1"
+                else "inline"
+            )
+        if reveal_mode not in ("inline", "ordered"):
+            raise ValueError(f"unknown reveal_mode {reveal_mode!r}")
+        self.reveal_mode = reveal_mode
+        self.max_outstanding_reveals = max(1, int(max_outstanding_reveals))
+        self._pending_reveals: List[_PendingReveal] = []
+        self._ordered_seq = 0
         self.hw = hw  # Optional[simulation.HwQuality]: virtual time
         self.netinfos = netinfos
         ref = netinfos[sorted(netinfos)[0]]
@@ -1519,6 +1576,30 @@ class VectorizedHoneyBadgerSim:
         forged_dec = forged_dec or {}
         import time as _time
 
+        if self.reveal_mode == "ordered":
+            if observe:
+                raise ValueError(
+                    "reveal_mode='ordered' does not support the "
+                    "observer lane (the observer derives its batch "
+                    "from decryption shares, which have not been "
+                    "emitted at ordered-commit time)"
+                )
+            if self.hw is not None:
+                raise ValueError(
+                    "reveal_mode='ordered' is incompatible with "
+                    "virtual-time accounting (hw=): the deferred "
+                    "decrypt wall belongs to a later flush"
+                )
+            # backpressure: the ordering plane stalls — by revealing —
+            # once max_outstanding_reveals epochs are ordered but
+            # unrevealed.  The stall IS the flush, so the bound also
+            # caps the deferred-decryption memory footprint.
+            if len(self._pending_reveals) >= self.max_outstanding_reveals:
+                rec = _obs.ACTIVE
+                if rec is not None:
+                    rec.count("hb.order_stalled")
+                self.flush_reveals()
+
         _t_rbc = _time.perf_counter()
         if len(delivered) < self.ref.num_correct:
             hint = (
@@ -1585,6 +1666,70 @@ class VectorizedHoneyBadgerSim:
                 faults.add(pid, FaultKind.INVALID_CIPHERTEXT)
                 continue
             cts[pid] = ct
+
+        if self.reveal_mode == "ordered":
+            # ORDERED-COMMIT: the epoch's ciphertext batch is pinned
+            # (sequence-numbered, content-addressed by the accepted
+            # set) the moment ACS finishes — decryption is queued for a
+            # later cross-epoch fused flush and the next epoch's ACS
+            # starts immediately.  The commit interval therefore ends
+            # HERE, off the decryption critical path.
+            _t_ordered = _time.perf_counter()
+            phases = dict(walls_head or {})
+            phases["agreement"] = _t_agree - _t_rbc
+            commit_latency = None
+            if commit_t0 is not None:
+                commit_latency = _t_ordered - commit_t0
+                phases["commit_latency"] = commit_latency
+            seq = self._ordered_seq
+            self._ordered_seq += 1
+            result = EpochResult(
+                batch=None,
+                accepted=accepted,
+                fault_log=faults,
+                coin_flips=res.coin_flips,
+                shares_verified=0,
+                agreement_epochs=res.epochs_used,
+                phases=phases,
+            )
+            self._pending_reveals.append(
+                _PendingReveal(
+                    epoch=self.epoch,
+                    seq=seq,
+                    cts=cts,
+                    dead=set(dead),
+                    forged_dec=forged_dec,
+                    result=result,
+                    t_ordered=_t_ordered,
+                )
+            )
+            rec = _obs.ACTIVE
+            if rec is not None:
+                if commit_latency is not None:
+                    rec.event(
+                        "commit_latency",
+                        epoch=self.epoch,
+                        latency_s=round(commit_latency, 6),
+                        mode=pipeline_mode,
+                    )
+                rec.event(
+                    "ordered_commit",
+                    node="sim",
+                    epoch=self.epoch,
+                    seq=seq,
+                    outstanding=len(self._pending_reveals),
+                    proposers=len(cts),
+                )
+                rec.event(
+                    "epoch_phases",
+                    epoch=self.epoch,
+                    phases={k: round(v, 6) for k, v in phases.items()},
+                    shares=0,
+                    coin_flips=res.coin_flips,
+                    faults=len(faults),
+                )
+            self.epoch += 1
+            return result
 
         # 5. decryption phase — grouped RLC flush (vectorized.decrypt_round).
         # With an observer attached, honest-share checks are no longer
@@ -1692,6 +1837,90 @@ class VectorizedHoneyBadgerSim:
             virtual=virtual,
             phases=phases,
         )
+
+    # -- order-then-reveal: the deferred reveal plane -----------------------
+
+    def flush_reveals(self) -> List["EpochResult"]:
+        """Reveal every ordered-but-unrevealed epoch in ONE cross-epoch
+        fused decryption flush (``vectorized.decrypt_rounds_deferred``:
+        all pending epochs' share verifications ride a single RLC
+        batch, all combines one native call).
+
+        Each queued epoch's :class:`EpochResult` — already returned to
+        the caller at ordered-commit time — is filled IN PLACE:
+        ``batch``, merged decryption faults, ``shares_verified`` and
+        the reveal-side phase walls.  Called automatically at the
+        backpressure bound and at the end of ``run_epochs``; idempotent
+        when nothing is pending.  Byte-identity of the filled batches
+        with ``reveal_mode="inline"`` is asserted in
+        ``tests/test_ordered_commit.py``."""
+        import time as _time
+
+        if not self._pending_reveals:
+            return []
+        pending, self._pending_reveals = self._pending_reveals, []
+        decs = decrypt_rounds_deferred(
+            self.netinfos,
+            [
+                RevealRequest(
+                    epoch=p.epoch,
+                    ciphertexts=p.cts,
+                    dead=p.dead,
+                    forged=p.forged_dec,
+                )
+                for p in pending
+            ],
+            be=self.be,
+            verify_honest=self.verify_honest,
+            emit_minimal=self.emit_minimal,
+            speculative=self.speculative,
+        )
+        _t_done = _time.perf_counter()
+        rec = _obs.ACTIVE
+        out: List[EpochResult] = []
+        for p, dec in zip(pending, decs):
+            p.result.fault_log.merge(dec.fault_log)
+            contribs: Dict[Any, Any] = {}
+            for pid in sorted(dec.contributions):
+                try:
+                    contribs[pid] = loads(dec.contributions[pid])
+                except Exception:  # malformed plaintext ⇒ proposer's fault
+                    p.result.fault_log.add(
+                        pid, FaultKind.BATCH_DESERIALIZATION_FAILED
+                    )
+            p.result.batch = Batch(p.epoch, contribs)
+            p.result.shares_verified = dec.shares_verified
+            lag = _t_done - p.t_ordered
+            phases = p.result.phases
+            if phases is not None:
+                phases["reveal_lag"] = lag
+                for k, v in (dec.phases or {}).items():
+                    phases["dec_" + k] = v
+                if dec.spec:
+                    phases["spec_hits"] = float(dec.spec.get("hits", 0))
+                    phases["spec_misses"] = float(dec.spec.get("misses", 0))
+                for k, v in (
+                    getattr(self.be, "last_flush_phases", None) or {}
+                ).items():
+                    phases["flush_" + k] = v
+            if rec is not None:
+                if dec.spec:
+                    rec.event(
+                        "spec_combine",
+                        hits=dec.spec.get("hits", 0),
+                        misses=dec.spec.get("misses", 0),
+                        epoch=p.epoch,
+                    )
+                rec.event(
+                    "reveal_lag",
+                    epoch=p.epoch,
+                    lag_s=round(lag, 6),
+                    lag_epochs=self.epoch - p.epoch,
+                    mode="sim",
+                )
+                rec.observe("reveal.lag_s", lag)
+            out.append(p.result)
+        return out
 
     # -- epoch phases -------------------------------------------------------
 
@@ -1819,9 +2048,12 @@ class VectorizedHoneyBadgerSim:
         seq = list(contributions_seq)
         dead = set(dead or set())
         if not pipeline or len(seq) <= 1 or self.hw is not None:
-            return [
+            results = [
                 self.run_epoch(c, dead=dead, **epoch_kwargs) for c in seq
             ]
+            if self.reveal_mode == "ordered":
+                self.flush_reveals()  # results are filled in place
+            return results
         if pipeline == "deep":
             return self._run_epochs_staged(seq, dead, epoch_kwargs)
         from concurrent.futures import ThreadPoolExecutor
@@ -1884,6 +2116,8 @@ class VectorizedHoneyBadgerSim:
                     )
                 )
                 _commit_t0 = _time.perf_counter()
+        if self.reveal_mode == "ordered":
+            self.flush_reveals()  # results are filled in place
         return results
 
     #: staged-driver lookahead: how many future epochs may sit on the
@@ -1981,6 +2215,8 @@ class VectorizedHoneyBadgerSim:
             )
             _commit_t0 = _time.perf_counter()
             lease.retire()
+        if self.reveal_mode == "ordered":
+            self.flush_reveals()  # results are filled in place
         return results
 
     # -- virtual-time accounting -------------------------------------------
@@ -2537,6 +2773,10 @@ class VectorizedQueueingSim(TransactionQueueMixin):
             verify_honest=verify_honest,
             emit_minimal=emit_minimal,
             hw=hw,
+            # the queue drains each epoch's committed txs immediately,
+            # so the batch must exist at run_epoch return — pin inline
+            # regardless of HBBFT_TPU_ORDERED_COMMIT
+            reveal_mode="inline",
         )
         self.rng = rng
         self.batch_size = batch_size

@@ -709,6 +709,8 @@ def fuzz_gateway(
         _sp.validate_hello_ack,
         _sp.validate_submit_ack,
         _sp.validate_commit_ack,
+        _sp.validate_ordered_ack,
+        _sp.validate_reveal_note,
     )
 
     async def read_one(stream: bytes) -> Any:
@@ -762,6 +764,22 @@ def fuzz_gateway(
             except Exception as exc:
                 report.failures.append(
                     f"GatewayCore.on_committed({message!r:.120}) raised "
+                    f"{type(exc).__name__}: {exc}"
+                )
+            try:
+                core.on_ordered(
+                    message, rng.choice([0, -1, "s", None]), message, now
+                )
+            except Exception as exc:
+                report.failures.append(
+                    f"GatewayCore.on_ordered({message!r:.120}) raised "
+                    f"{type(exc).__name__}: {exc}"
+                )
+            try:
+                core.on_revealed(message, now)
+            except Exception as exc:
+                report.failures.append(
+                    f"GatewayCore.on_revealed({message!r:.120}) raised "
                     f"{type(exc).__name__}: {exc}"
                 )
             for v in validators:

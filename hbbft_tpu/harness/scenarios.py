@@ -11,6 +11,11 @@ module packages them as a named, seeded, CLI-drivable matrix (reference
 - **bad-share**: a live validator multicasts forged threshold-decryption
   shares; the batch must be bit-identical to the fault-free twin and
   the forger must be the only node attributed in the ``FaultLog``.
+- **ordered-reveal**: order-then-reveal under a share-withholder —
+  every epoch-0 decryption share is delayed, ordering keeps running to
+  exactly the ``max_outstanding_reveals`` backpressure bound with zero
+  plaintext out, and once the shares land every epoch reveals in log
+  order, bit-identical to a fault-free same-seed twin.
 - **corrupt-echo**: a broadcast relay tampers its echoed shard; the
   erasure decode recovers, the batch matches the fault-free twin, the
   tamperer is attributed.
@@ -198,11 +203,15 @@ def _run_bad_share(cfg: ScenarioConfig) -> ScenarioResult:
         n, random.Random(cfg.seed), mock=True
     )
     faults = 0
+    all_contribs: List[Dict[int, List[bytes]]] = []
+    ref_contribs: List[Dict[int, List[bytes]]] = []
     for e in range(cfg.epochs):
         contribs = _contribs(n, b"bs%d" % e)
+        all_contribs.append(contribs)
         forged = {forger: {p: bogus for p in range(n)}}
         res = sim.run_epoch(contribs, forged_dec=forged)
         ref = twin.run_epoch(contribs)
+        ref_contribs.append(ref.batch.contributions)
         _check(
             res.batch.contributions == ref.batch.contributions,
             f"epoch {e}: batch diverges from fault-free twin",
@@ -238,11 +247,240 @@ def _run_bad_share(cfg: ScenarioConfig) -> ScenarioResult:
             f"epoch {e}: in-window fallback attribution differs",
         )
         faults += len(list(res.fault_log))
+    # ordered legs (PR 19): the same forged-share schedule through the
+    # order-then-reveal path — every epoch orders first, the reveals
+    # run as one cross-epoch batched decryption at the flush, and
+    # neither the plaintext batches nor the attribution may move
+    forged = {forger: {p: bogus for p in range(n)}}
+    for spec_leg in (False, True):
+        osim = VectorizedHoneyBadgerSim(
+            n, random.Random(cfg.seed), mock=True, speculative=spec_leg,
+            reveal_mode="ordered",
+            max_outstanding_reveals=max(2, cfg.epochs),
+        )
+        ores = osim.run_epochs(
+            all_contribs, pipeline=False, forged_dec=forged
+        )
+        leg = "spec×ordered" if spec_leg else "eager×ordered"
+        for e, orow in enumerate(ores):
+            _check(
+                orow.batch is not None,
+                f"epoch {e}: {leg} flush left the batch unrevealed",
+            )
+            _check(
+                orow.batch.contributions == ref_contribs[e],
+                f"epoch {e}: {leg} deferred-reveal batch diverges "
+                "from the fault-free twin",
+            )
+            _check(
+                {fl.node_id for fl in orow.fault_log} == {forger},
+                f"epoch {e}: {leg} deferred-reveal attribution "
+                f"{sorted({fl.node_id for fl in orow.fault_log})} != "
+                f"{{{forger}}}",
+            )
     return ScenarioResult(
         "bad-share", True, n, cfg.epochs, cfg.seed, faults,
-        f"forger {forger} attributed (eager + speculative audit), "
-        f"in-window forger {in_forger} via fallback, batches "
-        "bit-identical to twin",
+        f"forger {forger} attributed (eager + speculative audit + "
+        f"both ordered-reveal legs), in-window forger {in_forger} via "
+        "fallback, batches bit-identical to twin",
+    )
+
+
+def _run_ordered_reveal(cfg: ScenarioConfig) -> ScenarioResult:
+    """Order-then-reveal under a share-withholder (PR 19): every
+    decryption share for epoch 0 is held by the scheduler, so no epoch
+    can reveal (reveals are delivered in log order).  Ordering must
+    keep running to exactly the ``max_outstanding_reveals`` bound —
+    never stall below it, never run past it — with zero plaintext
+    out.  Once the shares land, every epoch reveals in order and the
+    plaintext batches are bit-identical to a fault-free same-seed
+    twin.  The static twin of this gate is the ``no-early-decrypt``
+    lint rule."""
+    from ..protocols.honey_badger import (
+        Batch,
+        HbDecryptionShare,
+        HoneyBadger,
+        HoneyBadgerMessage,
+        OrderedBatch,
+    )
+
+    n = max(4, min(cfg.n, 5))
+    bound = 2
+    total_epochs = bound + 2
+
+    def share_filter(sender, recipient, message):
+        return not (
+            isinstance(message, HoneyBadgerMessage)
+            and message.epoch == 0
+            and isinstance(message.content, HbDecryptionShare)
+        )
+
+    def build(withhold: bool) -> TestNetwork:
+        rng = random.Random(cfg.seed)
+
+        def new_algo(ni):
+            return HoneyBadger(
+                ni,
+                rng=random.Random(f"or-{ni.our_id}-{cfg.seed}"),
+                reveal_mode="ordered",
+                max_outstanding_reveals=bound,
+            )
+
+        return TestNetwork(
+            n,
+            0,
+            lambda adv: SilentAdversary(
+                MessageScheduler(MessageScheduler.RANDOM, rng)
+            ),
+            new_algo,
+            rng,
+            mock_crypto=True,
+            message_filter=share_filter if withhold else None,
+        )
+
+    def pump(net: TestNetwork) -> bool:
+        """Propose for each node's current epoch; returns whether any
+        node made a proposal."""
+        proposed = False
+        for nid in sorted(net.nodes):
+            node = net.nodes[nid]
+            algo = node.instance
+            if algo.epoch < total_epochs and not algo.has_input():
+                node.handle_input([b"or-%d-%03d" % (algo.epoch, nid)])
+                msgs = list(node.messages)
+                node.messages.clear()
+                net.dispatch_messages(nid, msgs)
+                proposed = True
+        return proposed
+
+    def plain(node) -> List[Any]:
+        return [o for o in node.outputs if isinstance(o, Batch)]
+
+    def ordered(node) -> List[Any]:
+        return [o for o in node.outputs if isinstance(o, OrderedBatch)]
+
+    def drive_to_completion(net: TestNetwork, what: str) -> None:
+        guard = 0
+        while not all(
+            len(plain(nd)) == total_epochs for nd in net.nodes.values()
+        ):
+            guard += 1
+            _check(guard < 200_000, f"ordered-reveal: {what} diverged")
+            moved = pump(net)
+            if net.any_busy():
+                net.step()
+            else:
+                _check(
+                    moved,
+                    f"ordered-reveal: {what} quiesced before all "
+                    f"{total_epochs} epochs revealed",
+                )
+
+    rec = _obs.ACTIVE
+    own_rec = rec is None
+    if own_rec:
+        rec = _obs.enable()
+    try:
+        stalled0 = rec.counters_snapshot().get("hb.order_stalled", 0)
+        ev0 = len(rec.events)
+
+        # -- phase 1: shares withheld — order to the bound, reveal
+        #    nothing -------------------------------------------------
+        net = build(True)
+        guard = 0
+        while True:
+            guard += 1
+            _check(
+                guard < 200_000, "ordered-reveal: withheld phase diverged"
+            )
+            moved = pump(net)
+            if net.any_busy():
+                net.step()
+            elif not moved:
+                break  # quiesced at the backpressure bound
+        _check(net.held_messages != [], "no decryption share was held")
+        for nid, nd in sorted(net.nodes.items()):
+            epochs = [o.epoch for o in ordered(nd)]
+            _check(
+                epochs == list(range(bound)),
+                f"node {nid}: ordered epochs {epochs} while reveals "
+                f"withheld; backpressure bound is {bound}",
+            )
+            _check(
+                [o.seq for o in ordered(nd)] == list(range(bound)),
+                f"node {nid}: commit sequence numbers not contiguous",
+            )
+            _check(
+                plain(nd) == [],
+                f"node {nid}: plaintext escaped while epoch 0's "
+                "shares were withheld",
+            )
+        for e in range(bound):
+            digests = {
+                next(o for o in ordered(nd) if o.epoch == e).digest
+                for nd in net.nodes.values()
+            }
+            _check(
+                len(digests) == 1, f"epoch {e}: ordered digests diverge"
+            )
+        stalls = (
+            rec.counters_snapshot().get("hb.order_stalled", 0) - stalled0
+        )
+        _check(
+            stalls > 0,
+            "epoch %d never hit the backpressure stall" % bound,
+        )
+
+        # -- phase 2: shares land — reveals cascade in log order -----
+        net.message_filter = None
+        net.release_held()
+        drive_to_completion(net, "release phase")
+        for nid, nd in sorted(net.nodes.items()):
+            _check(
+                [o.epoch for o in plain(nd)] == list(range(total_epochs)),
+                f"node {nid}: reveals out of log order",
+            )
+            outs = nd.outputs
+            _check(
+                outs.index(plain(nd)[0])
+                > outs.index(ordered(nd)[bound - 1]),
+                f"node {nid}: epoch 0 revealed before ordering reached "
+                "the bound — the withhold never delayed it",
+            )
+            _check(
+                not nd.faults,
+                f"node {nid}: scheduler-only delay attributed faults",
+            )
+        lag_rows = [
+            r
+            for r in rec.events[ev0:]
+            if r["ev"] == "reveal_lag" and r["epoch"] == 0
+        ]
+        _check(
+            any(r["lag_epochs"] >= bound for r in lag_rows),
+            f"no reveal_lag event shows epoch 0 lagging >= {bound} "
+            f"epochs: {lag_rows}",
+        )
+
+        # -- fault-free twin: bit-identical plaintext ----------------
+        twin = build(False)
+        drive_to_completion(twin, "fault-free twin")
+        for nid in sorted(net.nodes):
+            keys = [_hb_batch_key(o) for o in plain(net.nodes[nid])]
+            tkeys = [_hb_batch_key(o) for o in plain(twin.nodes[nid])]
+            _check(
+                keys == tkeys,
+                f"node {nid}: post-reveal batches diverge from the "
+                "fault-free twin",
+            )
+    finally:
+        if own_rec:
+            _obs.disable()
+    return ScenarioResult(
+        "ordered-reveal", True, n, total_epochs, cfg.seed, 0,
+        f"ordering held at the bound ({bound} epochs, {stalls} stalls) "
+        "under share withholding; reveals in log order, bit-identical "
+        "to twin",
     )
 
 
@@ -1788,6 +2026,7 @@ def _run_fuzz(cfg: ScenarioConfig) -> ScenarioResult:
 SCENARIOS: Dict[str, Callable[[ScenarioConfig], ScenarioResult]] = {
     "silent": _run_silent,
     "bad-share": _run_bad_share,
+    "ordered-reveal": _run_ordered_reveal,
     "corrupt-echo": _run_corrupt_echo,
     "equivocate": _run_equivocate,
     "delay": _run_delay,

@@ -706,6 +706,253 @@ def decrypt_round(
     )
 
 
+@dataclasses.dataclass
+class RevealRequest:
+    """One ordered-but-unrevealed epoch's decryption inputs, queued by
+    the order-then-reveal driver (``epoch.py``) until the fused reveal
+    flush."""
+
+    epoch: int
+    ciphertexts: Dict[Any, Any]
+    dead: Set[Any]
+    forged: Dict[Any, Dict[Any, Any]]
+
+
+def decrypt_rounds_deferred(
+    netinfos: Dict[Any, NetworkInfo],
+    requests: List[RevealRequest],
+    be: Optional[BatchingBackend] = None,
+    verify_honest: bool = True,
+    emit_minimal: bool = False,
+    speculative: bool = False,
+) -> List[DecryptionRound]:
+    """Cross-epoch batched reveal: run :func:`decrypt_round` semantics
+    for SEVERAL pending epochs at once, with the expensive crypto
+    fused across epochs (order-then-reveal tentpole):
+
+    - the speculative combine-and-check subsets of *all* epochs go
+      through ONE :meth:`BatchingBackend.reveal_combine` call — two
+      pairings total for real BLS regardless of epoch count (the RLC
+      coefficients are per-row, so batching across epochs is row-wise
+      identical to per-epoch calls);
+    - every remaining share-verification obligation of all epochs
+      ships in ONE ``prefetch`` flush (one product-pairing check);
+    - the final combines of all epochs collapse into one
+      ``combine_decryption_shares_many`` call.
+
+    Outcome parity: each returned :class:`DecryptionRound` is
+    **byte-identical** to calling ``decrypt_round`` on that epoch alone
+    — same plaintexts, same valid/invalid partitions, and the same
+    per-epoch fault attribution in the same order (each forging sender
+    is flagged once *per epoch*, exactly as the per-epoch path flags
+    it; misses fall back to per-share verification inside their own
+    epoch).  Asserted in ``tests/test_ordered_commit.py`` across
+    {mock, real BLS} × {clean, forged}.
+
+    Phase walls: the fused stages are shared across epochs, so each
+    request's ``phases`` carries the full shared wall (callers treat
+    them as flush-level, not per-epoch, attribution)."""
+    if not requests:
+        return []
+    dead_sets = [set(r.dead or set()) for r in requests]
+    forged_maps = [dict(r.forged or {}) for r in requests]
+    ref = netinfos[sorted(netinfos)[0]]
+    num_faulty = ref.num_faulty
+    pk_set = ref.public_key_set
+    if be is None:
+        be = BatchingBackend(inner=ref.ops)
+
+    import time as _time
+
+    phases: Dict[str, float] = {}
+
+    # 1. per-epoch staging + share emission (exactly decrypt_round's
+    # phase 1, per request)
+    _t0 = _time.perf_counter()
+    per_sorted_cts: List[List] = []
+    per_entries: List[List] = []  # (proposer, sender, DecObligation)
+    per_emitted: List[Dict[Any, Dict[Any, Any]]] = []
+    per_valid: List[Dict[Any, Dict[Any, Any]]] = []
+    for req, req_dead, req_forged in zip(requests, dead_sets, forged_maps):
+        emit_senders: Optional[Set[Any]] = None
+        if emit_minimal:
+            honest_live = [
+                nid
+                for nid in sorted(netinfos)
+                if nid not in req_dead and nid not in req_forged
+            ]
+            emit_senders = set(honest_live[: num_faulty + 1])
+        sorted_cts = sorted(req.ciphertexts.items())
+        shares = _stage_real_shares(
+            netinfos, sorted_cts, req_dead, req_forged, emit_senders
+        )
+        emitted: Dict[Any, Dict[Any, Any]] = {}
+        valid: Dict[Any, Dict[Any, Any]] = {}
+        entries: List = []
+        for nid, ni in sorted(netinfos.items()):
+            if nid in req_dead:
+                continue
+            if (
+                emit_senders is not None
+                and nid not in emit_senders
+                and nid not in req_forged
+            ):
+                continue
+            pk = ni.public_key_share(nid)
+            pre = (shares or {}).get(nid, {})
+            node_forged = req_forged.get(nid, {})
+            gen_pids = [
+                pid
+                for pid, _ in sorted_cts
+                if node_forged.get(pid) is None and pre.get(pid) is None
+            ]
+            if gen_pids:
+                generated = (
+                    ni.secret_key_share.decrypt_shares_no_verify_batch(
+                        [req.ciphertexts[pid] for pid in gen_pids]
+                    )
+                )
+                pre = dict(pre)
+                pre.update(zip(gen_pids, generated))
+            for pid, ct in sorted_cts:
+                share = node_forged.get(pid)
+                if share is None:
+                    share = pre[pid]
+                    emitted.setdefault(pid, {})[nid] = share
+                    if not verify_honest:
+                        valid.setdefault(pid, {})[nid] = share
+                        continue
+                else:
+                    emitted.setdefault(pid, {})[nid] = share
+                entries.append((pid, nid, DecObligation(pk, share, ct)))
+        per_sorted_cts.append(sorted_cts)
+        per_entries.append(entries)
+        per_emitted.append(emitted)
+        per_valid.append(valid)
+    phases["staging"] = _time.perf_counter() - _t0
+
+    # 1b. speculative combine-first, fused across epochs: all epochs'
+    # lowest-t+1 subsets in one reveal_combine call
+    _t0 = _time.perf_counter()
+    per_spec_out: List[Dict[Any, bytes]] = [dict() for _ in requests]
+    per_spec_stats: List[Dict[str, int]] = [dict() for _ in requests]
+    if speculative:
+        all_rows: List[Dict[int, Any]] = []
+        all_cts: List[Any] = []
+        all_epochs: List[int] = []
+        row_meta: List = []  # (request index, proposer, sender subset)
+        for ri, (req, sorted_cts, emitted) in enumerate(
+            zip(requests, per_sorted_cts, per_emitted)
+        ):
+            for pid, ct in sorted_cts:
+                by_idx = {
+                    ref.node_index(nid): (nid, s)
+                    for nid, s in emitted.get(pid, {}).items()
+                }
+                if len(by_idx) <= num_faulty:
+                    continue
+                idxs = sorted(by_idx)[: num_faulty + 1]
+                all_rows.append({i: by_idx[i][1] for i in idxs})
+                all_cts.append(ct)
+                all_epochs.append(req.epoch)
+                row_meta.append((ri, pid, {by_idx[i][0] for i in idxs}))
+        results: List[Optional[bytes]] = []
+        if all_rows:
+            results = be.reveal_combine(
+                pk_set, all_rows, all_cts, epochs=all_epochs
+            )
+        per_consumed: List[Set] = [set() for _ in requests]
+        per_hits = [0] * len(requests)
+        per_misses = [0] * len(requests)
+        for (ri, pid, senders_sub), pt in zip(row_meta, results):
+            if pt is not None:
+                per_hits[ri] += 1
+                per_spec_out[ri][pid] = pt
+                per_consumed[ri].update((pid, nid) for nid in senders_sub)
+            else:
+                per_misses[ri] += 1
+        for ri in range(len(requests)):
+            if per_consumed[ri]:
+                per_entries[ri] = [
+                    e
+                    for e in per_entries[ri]
+                    if (e[0], e[1]) not in per_consumed[ri]
+                ]
+            per_spec_stats[ri] = {
+                "hits": per_hits[ri],
+                "misses": per_misses[ri],
+            }
+    phases["spec"] = _time.perf_counter() - _t0
+
+    # 2. ONE grouped verification flush for every epoch's remaining
+    # obligations (the cross-epoch fused flush), then per-epoch lookup
+    # so fault attribution stays per-epoch, in decrypt_round's order
+    _t0 = _time.perf_counter()
+    be.prefetch(
+        ob for entries in per_entries for _, _, ob in entries
+    )
+    phases["flush"] = _time.perf_counter() - _t0
+    _t0 = _time.perf_counter()
+    per_faults: List[FaultLog] = []
+    for ri, entries in enumerate(per_entries):
+        faults = FaultLog()
+        flagged: Set[Any] = set()
+        valid = per_valid[ri]
+        for pid, nid, ob in entries:
+            if be.verify_dec_share(ob.pk_share, ob.share, ob.ciphertext):
+                valid.setdefault(pid, {})[nid] = ob.share
+            elif nid not in flagged:
+                flagged.add(nid)
+                faults.add(nid, FaultKind.INVALID_DECRYPTION_SHARE)
+        per_faults.append(faults)
+    phases["lookup"] = _time.perf_counter() - _t0
+
+    # 3. per-proposer combine, all epochs in one many() call (row-wise
+    # independent — grouping across epochs changes nothing)
+    _t0 = _time.perf_counter()
+    per_out: List[Dict[Any, bytes]] = [dict() for _ in requests]
+    rows, row_cts, row_keys = [], [], []
+    for ri, sorted_cts in enumerate(per_sorted_cts):
+        valid = per_valid[ri]
+        for pid, ct in sorted_cts:
+            if pid in per_spec_out[ri]:
+                per_out[ri][pid] = per_spec_out[ri][pid]
+                continue
+            by_idx = {
+                ref.node_index(nid): s
+                for nid, s in valid.get(pid, {}).items()
+            }
+            if len(by_idx) <= num_faulty:
+                per_faults[ri].add(pid, FaultKind.SHARE_DECRYPTION_FAILED)
+                continue
+            rows.append(by_idx)
+            row_cts.append(ct)
+            row_keys.append((ri, pid))
+    if rows:
+        many = getattr(pk_set, "combine_decryption_shares_many", None)
+        if many is not None:
+            for (ri, pid), pt in zip(row_keys, many(rows, row_cts)):
+                per_out[ri][pid] = pt
+        else:  # mock key sets: per-row combine, same semantics
+            for (ri, pid), by_idx, ct in zip(row_keys, rows, row_cts):
+                per_out[ri][pid] = pk_set.combine_decryption_shares(
+                    by_idx, ct
+                )
+    phases["combine"] = _time.perf_counter() - _t0
+
+    return [
+        DecryptionRound(
+            contributions=per_out[ri],
+            fault_log=per_faults[ri],
+            shares_verified=len(per_entries[ri]),
+            emitted=per_emitted[ri],
+            phases=dict(phases),
+            spec=per_spec_stats[ri],
+        )
+        for ri in range(len(requests))
+    ]
+
+
 def packed_decrypt_attribution(
     accepted: List[Any],
     forged: Dict[Any, Dict[Any, Any]],

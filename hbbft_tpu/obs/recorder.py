@@ -295,7 +295,8 @@ class Recorder:
 
     def hists_summary(self) -> Dict[str, Dict[str, float]]:
         """Live histogram summaries keyed by name — same statistics the
-        ``hist`` close-time rows carry (count/min/p50/p90/max/sum)."""
+        ``hist`` close-time rows carry (count/min/p50/p90/p99/max/
+        sum)."""
         with self._lock:
             hists = {k: list(v) for k, v in self._hists.items()}
         out: Dict[str, Dict[str, float]] = {}
@@ -306,6 +307,7 @@ class Recorder:
                 "min": vals[0],
                 "p50": _pct(vals, 0.50),
                 "p90": _pct(vals, 0.90),
+                "p99": _pct(vals, 0.99),
                 "max": vals[-1],
                 "sum": sum(vals),
             }
@@ -362,6 +364,7 @@ class Recorder:
                 min=round(vals[0], 9),
                 p50=round(_pct(vals, 0.50), 9),
                 p90=round(_pct(vals, 0.90), 9),
+                p99=round(_pct(vals, 0.99), 9),
                 max=round(vals[-1], 9),
                 sum=round(sum(vals), 9),
             )

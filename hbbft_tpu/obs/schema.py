@@ -54,7 +54,9 @@ EVENTS: Dict[str, EventSpec] = {
     "trace_start": _spec({"schema", "wall_unix"}),
     "trace_end": _spec({"events", "dur"}),
     "counter": _spec({"name", "value"}),
-    "hist": _spec({"name", "count", "min", "p50", "p90", "max", "sum"}),
+    "hist": _spec(
+        {"name", "count", "min", "p50", "p90", "max", "sum"}, {"p99"}
+    ),
     # spans carry caller attributes — open by design
     "span": _spec({"name", "dur", "depth"}, open=True),
     # simulator message plane
@@ -74,6 +76,19 @@ EVENTS: Dict[str, EventSpec] = {
     # latency the pipelined driver measures
     "spec_combine": _spec({"hits", "misses"}, {"epoch", "fallback_items"}),
     "commit_latency": _spec({"epoch", "latency_s"}, {"mode"}),
+    # order-then-reveal (additive): the two observable commit events.
+    # ``ordered_commit`` fires the moment ACS output pins the epoch's
+    # ciphertext batch (seq = node-local commit sequence, outstanding =
+    # ordered-but-unrevealed epochs incl. this one); ``reveal_lag``
+    # fires when the plaintext batch finally reveals — ``lag_epochs``
+    # is the deterministic epoch distance, ``lag_s`` the wall lag where
+    # a driver can measure it
+    "ordered_commit": _spec(
+        {"node", "epoch"}, {"seq", "outstanding", "proposers"}
+    ),
+    "reveal_lag": _spec(
+        {"epoch"}, {"lag_s", "lag_epochs", "node", "outstanding", "mode"}
+    ),
     # crypto batching / device routing
     "flush": _spec(
         {"queued", "shipped", "real", "inline"},

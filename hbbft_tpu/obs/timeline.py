@@ -19,7 +19,10 @@ file's rows are aligned onto one wall clock via its ``trace_start``
   committing its epoch, and the ack leaving — the admit→ack arc.
 - **per-epoch hops** — admit → gossip (``gossip_relay``) → ACS
   (``acs_done``) → decrypt/commit (``node_commit``) → ack walls, one
-  line per epoch.
+  line per epoch.  Under order-then-reveal (``ordered_commit`` rows
+  present) the commit hop splits: ``acs_to_ordered_commit`` (the
+  commit critical path — agreement + digest only) and
+  ``ordered_commit_to_reveal`` (the off-path decryption lag).
 
 Alert rules are declarative ``name selector op threshold`` tuples
 (see :data:`DEFAULT_RULES`); selectors address merged counters
@@ -53,6 +56,7 @@ DEFAULT_RULES: List[Tuple[str, str, str, float]] = [
     ("spec-combine-misses", "event_sum:spec_combine:misses", "<=", 0),
     ("gateway-rejects", "counter:gateway.rejected", "<=", 0),
     ("reveal-lag-p90", "hist:reveal.lag_s:p90", "<=", 1.0),
+    ("reveal-lag-p99", "hist:reveal.lag_s:p99", "<=", 2.0),
     ("chain-complete", "chain:complete_frac", ">=", 0.99),
     ("trace-joins", "join:frac", ">=", 0.99),
 ]
@@ -209,6 +213,7 @@ def epoch_timeline(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     admits: Dict[Tuple[str, int], float] = {}
     gossip_walls: List[float] = []
     acs: Dict[int, List[float]] = defaultdict(list)
+    ordered: Dict[int, List[float]] = defaultdict(list)
     commits: Dict[int, List[Dict[str, Any]]] = defaultdict(list)
     acks: Dict[int, List[Dict[str, Any]]] = defaultdict(list)
     for e in rows:
@@ -219,6 +224,8 @@ def epoch_timeline(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             gossip_walls.append(e["_wall"])
         elif ev == "acs_done" and isinstance(e.get("epoch"), int):
             acs[e["epoch"]].append(e["_wall"])
+        elif ev == "ordered_commit" and isinstance(e.get("epoch"), int):
+            ordered[e["epoch"]].append(e["_wall"])
         elif ev == "node_commit" and isinstance(e.get("epoch"), int):
             commits[e["epoch"]].append(e)
         elif ev == "client_commit_latency" and isinstance(e.get("epoch"), int):
@@ -256,6 +263,17 @@ def epoch_timeline(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         )
         if t_commit is not None and t_acs is not None:
             hops["acs_to_commit"] = max(0.0, t_commit - t_acs)
+        # order-then-reveal: the commit hop splits at the ordered
+        # commit — agreement+digest on the critical path, decryption
+        # as observable reveal lag behind it
+        t_ordered = max(ordered[epoch]) if ordered.get(epoch) else None
+        if t_ordered is not None:
+            if t_acs is not None:
+                hops["acs_to_ordered_commit"] = max(0.0, t_ordered - t_acs)
+            if t_commit is not None:
+                hops["ordered_commit_to_reveal"] = max(
+                    0.0, t_commit - t_ordered
+                )
         if ack_rows and t_commit is not None:
             hops["commit_to_ack"] = max(
                 0.0, max(a["_wall"] for a in ack_rows) - t_commit
@@ -291,7 +309,7 @@ def _merged_hists(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
             continue
         name = str(e.get("name"))
         cur = out.setdefault(name, defaultdict(float))
-        for stat in ("min", "p50", "p90", "max"):
+        for stat in ("min", "p50", "p90", "p99", "max"):
             if stat in e:
                 cur[stat] = max(cur.get(stat, float("-inf")), float(e[stat]))
         for stat in ("count", "sum"):

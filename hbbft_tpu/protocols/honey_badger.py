@@ -18,11 +18,26 @@ Deviations from the reference (deliberate, documented):
   messages, ``honey_badger.rs:68-77`` — a liveness hazard fixed in later
   upstream versions); beyond-window messages are queued, past ones
   dropped.
+- ``reveal_mode="ordered"`` splits commit into two observable events
+  (arXiv:2407.12172: threshold decryption is the residual critical-path
+  cost).  **Ordered-commit**: the moment the common subset decides, the
+  ciphertext batch is sequence-numbered and digest-pinned in an
+  :class:`OrderedBatch` output, and the next epoch's ACS starts
+  immediately.  **Reveal**: the plaintext :class:`Batch` follows
+  asynchronously once enough decryption shares arrive.  Censorship
+  resistance only needs order fixed *before* decryption — shares for
+  epoch ``e`` still go out only after ``e``'s subset output is fixed
+  (the ``no-early-decrypt`` lint pins this) — so deferring the reveal
+  changes no adversarial power.  Reveal lag is bounded by
+  ``max_outstanding_reveals``: at the bound, ordering stalls until the
+  oldest pending epoch reveals (backpressure), keeping memory and lag
+  finite under share-withholding peers.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 from typing import Any, Dict, List, Optional, Set
 
@@ -31,6 +46,7 @@ from ..core.fault import FaultKind, FaultLog
 from ..core.network_info import NetworkInfo
 from ..core.serialize import SerializationError, dumps, loads, wire
 from ..core.step import Step
+from ..crypto.hashing import sha256
 from ..obs import recorder as _obs
 from .common_subset import CommonSubset
 
@@ -66,6 +82,45 @@ class Batch:
         return all(len(c) == 0 for c in self.contributions.values())
 
 
+@wire("HbOrderedBatch")
+@dataclasses.dataclass(frozen=True)
+class OrderedBatch:
+    """The ordered-commit record (``reveal_mode="ordered"``): emitted
+    the moment epoch ``epoch``'s common subset decides.  ``seq`` is the
+    node-local monotonic commit sequence number, ``digest`` pins the
+    agreed ciphertext batch (canonical serialization, so every correct
+    node derives the same digest), ``proposers`` the accepted subset.
+    The plaintext :class:`Batch` for the same epoch follows once
+    decryption shares arrive."""
+
+    epoch: int
+    seq: int
+    digest: bytes
+    proposers: Any  # tuple of accepted proposer ids, canonical order
+
+
+def ordered_batch_digest(epoch: int, ciphertexts: Dict[Any, Any]) -> bytes:
+    """The digest an :class:`OrderedBatch` pins: a hash over the epoch
+    and the canonical serialization of each accepted ciphertext, in
+    proposer order.  Deterministic across nodes — the common subset
+    fixed exactly these bytes."""
+    parts = [dumps(epoch)]
+    for pid in sorted(ciphertexts, key=str):
+        parts.append(dumps(pid))
+        parts.append(dumps(ciphertexts[pid]))
+    return sha256(b"hbbft_tpu ordered batch v1" + b"".join(parts))
+
+
+def default_reveal_mode() -> str:
+    """Process-wide default: ``HBBFT_TPU_ORDERED_COMMIT=1`` flips every
+    builder-constructed instance to order-then-reveal."""
+    return (
+        "ordered"
+        if os.environ.get("HBBFT_TPU_ORDERED_COMMIT") == "1"
+        else "inline"
+    )
+
+
 @wire("HbCs")
 @dataclasses.dataclass(frozen=True)
 class HbCommonSubset:
@@ -95,6 +150,8 @@ class HoneyBadger(DistAlgorithm):
         max_future_epochs: int = 3,
         rng: Optional[random.Random] = None,
         speculative: bool = False,
+        reveal_mode: Optional[str] = None,
+        max_outstanding_reveals: int = 4,
     ):
         self.netinfo = netinfo
         self.epoch = 0
@@ -104,9 +161,23 @@ class HoneyBadger(DistAlgorithm):
         self.incoming_queue: Dict[int, List] = {}
         # epoch -> proposer -> sender -> share
         self.received_shares: Dict[int, Dict[Any, Dict[Any, Any]]] = {}
-        self.decrypted_contributions: Dict[Any, bytes] = {}
+        # epoch -> proposer -> decrypted contribution bytes
+        self.decrypted_contributions: Dict[int, Dict[Any, bytes]] = {}
         # epoch -> proposer -> ciphertext
         self.ciphertexts: Dict[int, Dict[Any, Any]] = {}
+        # order-then-reveal (see module doc): "inline" reproduces the
+        # reference (decrypt before the batch outputs); "ordered" emits
+        # an OrderedBatch at ACS completion and reveals asynchronously.
+        if reveal_mode is None:
+            reveal_mode = default_reveal_mode()
+        if reveal_mode not in ("inline", "ordered"):
+            raise ValueError(f"unknown reveal_mode {reveal_mode!r}")
+        self.reveal_mode = reveal_mode
+        self.max_outstanding_reveals = max(1, int(max_outstanding_reveals))
+        # epoch -> ordered seq, for ordered-but-unrevealed epochs; their
+        # ciphertexts/received_shares stay pinned until the reveal
+        self._pending_reveals: Dict[int, int] = {}
+        self._ordered_seq = 0
         # speculative combine-first decryption (arXiv:2407.12172):
         # store shares unverified, combine the lowest f+1 at decrypt
         # time and validate the combined result once; per-share
@@ -156,6 +227,12 @@ class HoneyBadger(DistAlgorithm):
             )
             return Step()
         if epoch < self.epoch:
+            if epoch in self._pending_reveals:
+                # ordered-but-unrevealed epoch: late decryption shares
+                # (and subset stragglers) must still flow to the reveal
+                return self._handle_message_content(
+                    sender_id, epoch, message.content
+                )
             return Step()  # obsolete
         return self._handle_message_content(sender_id, epoch, message.content)
 
@@ -266,7 +343,7 @@ class HoneyBadger(DistAlgorithm):
         self.received_shares.setdefault(epoch, {}).setdefault(
             proposer_id, {}
         )[sender_id] = share
-        if epoch == self.epoch:
+        if epoch == self.epoch or epoch in self._pending_reveals:
             return self._try_output_batches()
         return Step()
 
@@ -375,10 +452,21 @@ class HoneyBadger(DistAlgorithm):
     def _try_output_batches(self) -> Step:
         step: Step = Step()
         while True:
-            new_step = self._try_output_batch()
-            if new_step is None:
-                break
-            step.extend(new_step)
+            progressed = False
+            while True:
+                new_step = self._try_output_batch()
+                if new_step is None:
+                    break
+                progressed = True
+                step.extend(new_step)
+            if self.reveal_mode == "ordered":
+                revealed = self._try_reveal_batches(step)
+                if revealed:
+                    # a completed reveal may have unstalled backpressured
+                    # ordering — retry the commit loop
+                    progressed = True
+                    continue
+            break
         if not self._pending_faults.is_empty():
             # faults found by the speculative-combine fallback: surface
             # them on whichever Step leaves the instance next (the eager
@@ -391,21 +479,109 @@ class HoneyBadger(DistAlgorithm):
         cts = self.ciphertexts.get(self.epoch)
         if cts is None:
             return None
+        if self.reveal_mode == "ordered":
+            return self._try_ordered_commit(cts)
         if not all(
-            self._try_decrypt_proposer_contribution(pid) for pid in sorted(cts)
+            self._try_decrypt_proposer_contribution(pid, self.epoch)
+            for pid in sorted(cts)
         ):
             return None
+        step = self._assemble_batch(self.epoch)
+        step.extend(self._update_epoch())
+        return step
+
+    def _try_ordered_commit(self, cts) -> Optional[Step]:
+        """Ordered-commit: seal the epoch's agreed ciphertext batch the
+        moment ACS output lands and advance to the next epoch without
+        waiting for decryption.  Per-epoch state stays pinned until the
+        reveal.  At ``max_outstanding_reveals`` pending epochs, ordering
+        stalls (backpressure) until the oldest reveal completes."""
+        if len(self._pending_reveals) >= self.max_outstanding_reveals:
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.count("hb.order_stalled")
+            return None
+        epoch = self.epoch
+        seq = self._ordered_seq
+        self._ordered_seq += 1
+        self._pending_reveals[epoch] = seq
+        step: Step = Step()
+        step.output.append(
+            OrderedBatch(
+                epoch=epoch,
+                seq=seq,
+                digest=ordered_batch_digest(epoch, cts),
+                proposers=tuple(sorted(cts, key=str)),
+            )
+        )
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "ordered_commit",
+                node=str(self.netinfo.our_id),
+                epoch=epoch,
+                seq=seq,
+                outstanding=len(self._pending_reveals),
+                proposers=len(cts),
+            )
+        step.extend(self._update_epoch(retain=True))
+        return step
+
+    def _try_reveal_batches(self, step: Step) -> bool:
+        """Reveal pending ordered epochs, oldest first, extending
+        ``step`` in place.  Reveals are delivered in epoch order (the
+        ordered log's order), so the loop stops at the first epoch
+        still short of decryption shares.  Returns whether any epoch
+        revealed."""
+        revealed = False
+        for epoch in sorted(self._pending_reveals):
+            new_step = self._try_reveal_batch(epoch)
+            if new_step is None:
+                break
+            revealed = True
+            step.extend(new_step)
+        return revealed
+
+    def _try_reveal_batch(self, epoch: int) -> Optional[Step]:
+        cts = self.ciphertexts.get(epoch)
+        if cts is None:  # state-transfer jumped past it
+            self._pending_reveals.pop(epoch, None)
+            return None
+        if not all(
+            self._try_decrypt_proposer_contribution(pid, epoch)
+            for pid in sorted(cts)
+        ):
+            return None
+        step = self._assemble_batch(epoch)
+        self._pending_reveals.pop(epoch, None)
+        self.ciphertexts.pop(epoch, None)
+        self.received_shares.pop(epoch, None)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "reveal_lag",
+                epoch=epoch,
+                lag_epochs=self.epoch - epoch,
+                node=str(self.netinfo.our_id),
+                outstanding=len(self._pending_reveals),
+            )
+        return step
+
+    def _assemble_batch(self, epoch: int) -> Step:
+        """Deserialize epoch ``epoch``'s decrypted contributions into
+        its plaintext :class:`Batch` (shared by the inline commit and
+        the deferred reveal — byte-identical output by construction)."""
         step: Step = Step()
         contributions: Dict[Any, Any] = {}
-        for proposer_id, ser in sorted(self.decrypted_contributions.items(), key=lambda kv: str(kv[0])):
+        decrypted = self.decrypted_contributions.pop(epoch, {})
+        for proposer_id, ser in sorted(decrypted.items(), key=lambda kv: str(kv[0])):
             try:
                 contributions[proposer_id] = loads(ser)
             except (SerializationError, Exception):
                 step.add_fault(
                     proposer_id, FaultKind.BATCH_DESERIALIZATION_FAILED
                 )
-        self.decrypted_contributions = {}
-        batch = Batch(self.epoch, contributions)
+        batch = Batch(epoch, contributions)
         step.output.append(batch)
         if self.speculative:
             rec = _obs.ACTIVE
@@ -418,19 +594,18 @@ class HoneyBadger(DistAlgorithm):
                 )
             self._spec_hits = 0
             self._spec_misses = 0
-        step.extend(self._update_epoch())
         return step
 
-    def _try_decrypt_proposer_contribution(self, proposer_id) -> bool:
-        if proposer_id in self.decrypted_contributions:
+    def _try_decrypt_proposer_contribution(self, proposer_id, epoch) -> bool:
+        if proposer_id in self.decrypted_contributions.get(epoch, {}):
             return True
-        shares = self.received_shares.get(self.epoch, {}).get(proposer_id)
+        shares = self.received_shares.get(epoch, {}).get(proposer_id)
         if not shares or len(shares) <= self.netinfo.num_faulty:
             return False
-        ciphertext = self.ciphertexts[self.epoch][proposer_id]
+        ciphertext = self.ciphertexts[epoch][proposer_id]
         if self.speculative:
             return self._try_decrypt_speculative(
-                proposer_id, ciphertext, shares
+                proposer_id, ciphertext, shares, epoch
             )
         shares_by_idx = {
             self.netinfo.node_index(nid): share
@@ -440,7 +615,9 @@ class HoneyBadger(DistAlgorithm):
             contrib = self.netinfo.public_key_set.combine_decryption_shares(
                 shares_by_idx, ciphertext
             )
-            self.decrypted_contributions[proposer_id] = contrib
+            self.decrypted_contributions.setdefault(epoch, {})[
+                proposer_id
+            ] = contrib
         except Exception:
             # All shares were verified; failure here means the proposer's
             # ciphertext was malformed in a way verify() missed.  The
@@ -450,7 +627,7 @@ class HoneyBadger(DistAlgorithm):
         return True
 
     def _try_decrypt_speculative(
-        self, proposer_id, ciphertext, shares
+        self, proposer_id, ciphertext, shares, epoch
     ) -> bool:
         """Combine-first decryption: combine the lowest f+1 received
         shares *unverified* and validate the combined result with one
@@ -478,19 +655,21 @@ class HoneyBadger(DistAlgorithm):
                 contrib = None
             if contrib is not None:
                 self._spec_hits += 1
-                self.decrypted_contributions[proposer_id] = contrib
+                self.decrypted_contributions.setdefault(epoch, {})[
+                    proposer_id
+                ] = contrib
                 return True
             self._spec_misses += 1
         # fallback: the eager path, verbatim — verify every pending
         # share, fault + drop the bad ones, recombine from the rest
         incorrect, faults = self._verify_pending_decryption_shares(
-            proposer_id, ciphertext, self.epoch
+            proposer_id, ciphertext, epoch
         )
         self._remove_incorrect_decryption_shares(
-            proposer_id, incorrect, self.epoch
+            proposer_id, incorrect, epoch
         )
         self._pending_faults.merge(faults)
-        shares = self.received_shares.get(self.epoch, {}).get(proposer_id)
+        shares = self.received_shares.get(epoch, {}).get(proposer_id)
         if not shares or len(shares) <= self.netinfo.num_faulty:
             return False
         shares_by_idx = {
@@ -501,14 +680,17 @@ class HoneyBadger(DistAlgorithm):
             contrib = self.netinfo.public_key_set.combine_decryption_shares(
                 shares_by_idx, ciphertext
             )
-            self.decrypted_contributions[proposer_id] = contrib
+            self.decrypted_contributions.setdefault(epoch, {})[
+                proposer_id
+            ] = contrib
         except Exception:
             pass  # see the eager branch above
         return True
 
-    def _update_epoch(self) -> Step:
-        self.ciphertexts.pop(self.epoch, None)
-        self.received_shares.pop(self.epoch, None)
+    def _update_epoch(self, retain: bool = False) -> Step:
+        if not retain:
+            self.ciphertexts.pop(self.epoch, None)
+            self.received_shares.pop(self.epoch, None)
         self.epoch += 1
         self.has_input_flag = False
         max_epoch = self.epoch + self.max_future_epochs
@@ -561,6 +743,10 @@ class HoneyBadger(DistAlgorithm):
             for ep in [e for e in d if e <= upto_epoch]:
                 del d[ep]
         self.decrypted_contributions = {}
+        # ordered-but-unrevealed epochs inside the jump are decided by
+        # the transferred batches — the pending reveals are moot
+        for ep in [e for e in self._pending_reveals if e <= upto_epoch]:
+            del self._pending_reveals[ep]
         self._pending_faults = FaultLog()
         self.epoch = upto_epoch + 1
         self.has_input_flag = False
@@ -585,7 +771,11 @@ class HoneyBadger(DistAlgorithm):
         instances; this also reclaims ones wedged by a faulty peer.)"""
         dropped = 0
         for d in (self.common_subsets, self.received_shares, self.ciphertexts):
-            for ep in [e for e in d if e < self.epoch]:
+            for ep in [
+                e
+                for e in d
+                if e < self.epoch and e not in self._pending_reveals
+            ]:
                 del d[ep]
                 dropped += 1
         for ep in [e for e in self.incoming_queue if e < self.epoch]:
@@ -604,6 +794,8 @@ class HoneyBadgerBuilder:
         self._max_future_epochs = 3
         self._rng: Optional[random.Random] = None
         self._speculative = False
+        self._reveal_mode: Optional[str] = None  # None → env default
+        self._max_outstanding_reveals = 4
 
     def max_future_epochs(self, value: int) -> "HoneyBadgerBuilder":
         self._max_future_epochs = value
@@ -620,10 +812,30 @@ class HoneyBadgerBuilder:
         self._speculative = value
         return self
 
+    def reveal_mode(self, value: str) -> "HoneyBadgerBuilder":
+        """``"inline"`` (reference semantics) or ``"ordered"``
+        (order-then-reveal: OrderedBatch at ACS completion, plaintext
+        Batch asynchronously)."""
+        self._reveal_mode = value
+        return self
+
+    def ordered(self, value: bool = True) -> "HoneyBadgerBuilder":
+        """Shorthand for ``reveal_mode("ordered")``."""
+        self._reveal_mode = "ordered" if value else "inline"
+        return self
+
+    def max_outstanding_reveals(self, value: int) -> "HoneyBadgerBuilder":
+        """Backpressure bound for ``reveal_mode="ordered"``: ordering
+        stalls once this many epochs are ordered but unrevealed."""
+        self._max_outstanding_reveals = value
+        return self
+
     def build(self) -> HoneyBadger:
         return HoneyBadger(
             self.netinfo,
             max_future_epochs=self._max_future_epochs,
             rng=self._rng,
             speculative=self._speculative,
+            reveal_mode=self._reveal_mode,
+            max_outstanding_reveals=self._max_outstanding_reveals,
         )

@@ -37,6 +37,7 @@ from ..core.fault import FaultKind
 from ..core.serialize import SerializationError
 from ..core.step import Step
 from ..obs import recorder as _obs
+from ..protocols.honey_badger import OrderedBatch
 from ..protocols.queueing_honey_badger import QueueingHoneyBadger
 from ..transport.tcp import TcpNode
 from .protocol import (
@@ -44,7 +45,9 @@ from .protocol import (
     MAX_PAYLOAD,
     CommitAck,
     HelloAck,
+    OrderedAck,
     ProtocolError,
+    RevealNote,
     SubmitAck,
     TxGossip,
     encode_tx,
@@ -185,6 +188,12 @@ class GatewayCore:
         # current high-water so GC still ages them out eventually)
         self.acked: Dict[bytes, int] = {}
         self._max_epoch = -1
+        # order-then-reveal (PR 19): epoch → (order_seq, t_ordered)
+        # once the mesh emits the epoch's OrderedBatch, plus the
+        # connections notified with an OrderedAck (popped — exactly
+        # once — when the epoch's plaintext lands as a RevealNote)
+        self.ordered_log: Dict[int, Tuple[int, float]] = {}
+        self._ordered_notified: Dict[int, List[str]] = {}
         self.drops: List[Tuple[str, str]] = []
         self.admitted = 0
         self.rejected = 0
@@ -362,6 +371,67 @@ class GatewayCore:
             rec.observe("gateway.commit_latency_s", latency)
         return p.conn_id, CommitAck(p.seq, ep), latency
 
+    def on_ordered(
+        self, epoch: Any, order_seq: Any, digest: Any, now: float
+    ) -> List[Tuple[str, OrderedAck]]:
+        """An :class:`~hbbft_tpu.protocols.honey_badger.OrderedBatch`
+        from the mesh → at most one ``OrderedAck`` per connection
+        currently holding pending transactions (the batch is still
+        ciphertext, so the ack is epoch-scoped — see the wire type's
+        doc).  Total over wire values; duplicate epochs are ignored."""
+        if (
+            type(epoch) is not int
+            or epoch < 0
+            or type(order_seq) is not int
+            or order_seq < 0
+            or not isinstance(digest, bytes)
+        ):
+            return []
+        if epoch in self.ordered_log:
+            return []
+        self.ordered_log[epoch] = (order_seq, now)
+        conns = sorted({p.conn_id for p in self.pending.values()})
+        self._ordered_notified[epoch] = conns
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "ordered_commit",
+                node="gateway",
+                epoch=epoch,
+                seq=order_seq,
+                outstanding=len(self._ordered_notified),
+            )
+        return [(c, OrderedAck(epoch, order_seq, digest)) for c in conns]
+
+    def on_revealed(
+        self, epoch: Any, now: float
+    ) -> List[Tuple[str, RevealNote]]:
+        """The plaintext batch for an *ordered* epoch arrived → one
+        ``RevealNote`` per connection that received the epoch's
+        OrderedAck, exactly once (the notified list is popped).
+        Returns ``[]`` for epochs never seen ordered — the inline
+        pipeline, where commit and reveal are one event."""
+        info = self.ordered_log.get(epoch) if type(epoch) is int else None
+        if info is None:
+            return []
+        conns = self._ordered_notified.pop(epoch, [])
+        order_seq, t_ordered = info
+        lag = max(0.0, now - t_ordered)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event(
+                "reveal_lag",
+                epoch=epoch,
+                lag_s=lag,
+                mode="gateway",
+                outstanding=len(self._ordered_notified),
+            )
+            rec.observe("reveal.lag_s", lag)
+        return [
+            (c, RevealNote(epoch, order_seq, int(lag * 1000.0)))
+            for c in conns
+        ]
+
     def gc_epochs(self, upto_epoch: int, keep: int = 8) -> int:
         """Age the exactly-once ledger: drop acked entries whose commit
         epoch is at least ``keep`` epochs behind ``upto_epoch`` →
@@ -375,6 +445,10 @@ class GatewayCore:
         stale = [tx for tx, ep in self.acked.items() if ep <= cut]
         for tx in stale:
             del self.acked[tx]
+        # the ordered→revealed window ages on the same horizon
+        for ep in [e for e in self.ordered_log if e <= cut]:
+            del self.ordered_log[ep]
+            self._ordered_notified.pop(ep, None)
         if stale:
             rec = _obs.ACTIVE
             if rec is not None:
@@ -617,12 +691,22 @@ class Gateway:
 
     def _on_batch(self, batch: Any) -> None:
         """Commit watcher (TcpNode ``on_output``): ack every first-seen
-        pending transaction of a committed batch."""
+        pending transaction of a committed batch.  Under
+        order-then-reveal the mesh emits two outputs per epoch — the
+        :class:`OrderedBatch` fans out as epoch-scoped ``OrderedAck``
+        frames the moment the order is pinned, and the plaintext batch
+        closes the window with per-tx ``CommitAck`` + an epoch-scoped
+        ``RevealNote``."""
+        now = self._now()
+        if isinstance(batch, OrderedBatch):
+            self._fan_out(
+                self.core.on_ordered(batch.epoch, batch.seq, batch.digest, now)
+            )
+            return
         tx_iter = getattr(batch, "tx_iter", None)
         if tx_iter is None:
             return
         epoch = getattr(batch, "epoch", -1)
-        now = self._now()
         for tx in tx_iter():
             res = self.core.on_committed(tx, epoch, now)
             if res is None:
@@ -634,5 +718,15 @@ class Gateway:
                     w.write(frame(ack))
                 except (ConnectionError, OSError):
                     pass
+        self._fan_out(self.core.on_revealed(epoch, now))
         if type(epoch) is int:
             self.core.gc_epochs(epoch)
+
+    def _fan_out(self, acks: List[Tuple[str, Any]]) -> None:
+        for conn_id, msg in acks:
+            w = self._clients.get(conn_id)
+            if w is not None:
+                try:
+                    w.write(frame(msg))
+                except (ConnectionError, OSError):
+                    pass

@@ -126,6 +126,37 @@ class CommitAck:
     epoch: Any
 
 
+@wire("SrvOrderedAck")
+@dataclasses.dataclass(frozen=True)
+class OrderedAck:
+    """Order-then-reveal (PR 19): the committed log advanced — epoch
+    ``epoch`` is ordered at commit sequence ``order_seq`` with
+    ciphertext-batch digest ``digest``.  Epoch-scoped, NOT tx-scoped:
+    the batch is still ciphertext, so no one (the gateway included)
+    can yet say which transactions it holds — that opacity is the
+    censorship-resistance argument.  Sent at most once per
+    (connection, epoch) to clients with pending transactions; per-tx
+    membership follows as the usual exactly-once :class:`CommitAck`
+    at reveal time."""
+
+    epoch: Any
+    order_seq: Any
+    digest: Any
+
+
+@wire("SrvRevealNote")
+@dataclasses.dataclass(frozen=True)
+class RevealNote:
+    """The plaintext for ordered epoch ``epoch`` is available,
+    ``lag_ms`` after its :class:`OrderedAck`.  Closes the epoch's
+    ordered→revealed window for clients tracking log progress; sent
+    exactly once per (connection, epoch) that saw the OrderedAck."""
+
+    epoch: Any
+    order_seq: Any
+    lag_ms: Any
+
+
 @wire("SrvGossip")
 @dataclasses.dataclass(frozen=True)
 class TxGossip:
@@ -232,6 +263,30 @@ def validate_commit_ack(msg: Any) -> bool:
         and _seq_ok(msg.seq)
         and type(msg.epoch) is int
         and msg.epoch >= 0
+    )
+
+
+def validate_ordered_ack(msg: Any) -> bool:
+    return (
+        isinstance(msg, OrderedAck)
+        and type(msg.epoch) is int
+        and msg.epoch >= 0
+        and type(msg.order_seq) is int
+        and msg.order_seq >= 0
+        and isinstance(msg.digest, bytes)
+        and len(msg.digest) == 32
+    )
+
+
+def validate_reveal_note(msg: Any) -> bool:
+    return (
+        isinstance(msg, RevealNote)
+        and type(msg.epoch) is int
+        and msg.epoch >= 0
+        and type(msg.order_seq) is int
+        and msg.order_seq >= 0
+        and type(msg.lag_ms) is int
+        and 0 <= msg.lag_ms < 2**31
     )
 
 

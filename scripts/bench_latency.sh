@@ -8,10 +8,16 @@
 # eager/serial verify-before-combine baseline, same seed,
 # byte-identical batches) — the PR-10 acceptance gate is >= 1.5x.
 #
+# The third section (PR 19) is the order-then-reveal matrix: {eager,
+# spec} × {inline, ordered} pipelined legs, each ordered row with its
+# `acs_only_wall` floor + ratio and a `reveal_lag_p50_s` companion,
+# then the `ordered_commit_vs_acs_wall` headline (the ≤1.2× gate).
+#
 # Examples:
 #   scripts/bench_latency.sh                 # n=13 protocol net, 5 epochs
 #   LAT_NODES=16 scripts/bench_latency.sh    # bigger protocol net
 #   LAT_EPOCHS=8 scripts/bench_latency.sh    # more latency samples
+#   LAT_REVEAL=ordered scripts/bench_latency.sh  # ordered legs only
 #   LAT_OUT=latency.json scripts/bench_latency.sh  # also write a file
 #
 # Output: one `commit_latency_p50_s` JSON row per leg, the
@@ -22,13 +28,14 @@ cd "$(dirname "$0")/.."
 
 nodes="${LAT_NODES:-13}"
 epochs="${LAT_EPOCHS:-5}"
+reveal="${LAT_REVEAL:-both}"
 out="${LAT_OUT:-}"
 
 log="$(mktemp)"
 trap 'rm -f "$log"' EXIT
 
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --latency \
-  --k "$nodes" --epochs "$epochs" 2>&1 | tee "$log"
+  --k "$nodes" --epochs "$epochs" --reveal-mode "$reveal" 2>&1 | tee "$log"
 rc=${PIPESTATUS[0]}
 
 if [ -n "$out" ] && [ "$rc" = 0 ]; then

@@ -1,13 +1,17 @@
 #!/usr/bin/env bash
 # One-shot verification gate, in dependency order:
 #
-#   1. badgerlint — all 18 static rules over the package tree
+#   1. badgerlint — all 19 static rules over the package tree
 #   2. racecheck smoke — the lockset-checker test module under
 #      `pytest --racecheck` (runtime thread-safety)
 #   3. wire-manifest verification — the @wire registry still matches
 #      the checked-in golden manifest (serialization stability)
 #   4. scenarios smoke — bad-share (the speculative-combine fallback
-#      and leftover-audit attribution gate) + equivocate +
+#      and leftover-audit attribution gate, plus both ordered-reveal
+#      legs of the forged-share schedule) + ordered-reveal (ordering
+#      holds at the backpressure bound under share withholding;
+#      post-reveal batches bit-identical to the fault-free twin) +
+#      equivocate +
 #      hostile-clients (gateway attribution and twin bit-identity) +
 #      geo-partition-heal and flash-crowd (WAN models over both sim
 #      planes, packed co-sim byte-identical to the dict plane) +
@@ -21,9 +25,10 @@
 #      and acked, zero spurious attributions
 #   6. fleet telemetry — the fleet-telemetry scenario produces trace +
 #      fleet + flight artifacts from a real-TCP run under load, then
-#      the post-mortem timeline CLI re-merges them: exit non-zero on
-#      any health-rule violation or if <99% of the wire-send trace
-#      contexts join to their receive on the far node
+#      the post-mortem timeline CLI re-merges them under the pinned
+#      scripts/fleet_slo.rules (reveal-lag p90/p99 bounds included):
+#      exit non-zero on any health-rule violation or if <99% of the
+#      wire-send trace contexts join to their receive on the far node
 #   7. stallcheck smoke — the same fleet-telemetry scenario re-run
 #      under the event-loop stall sanitizer with a pinned 0.5 s
 #      budget: no callback on any serving loop may park the thread
@@ -73,7 +78,8 @@ stage=${PIPESTATUS[0]}
 
 echo "== [4/8] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
-  --only bad-share --only equivocate --only hostile-clients \
+  --only bad-share --only ordered-reveal --only equivocate \
+  --only hostile-clients \
   --only geo-partition-heal --only flash-crowd \
   --only crash-restart --only link-flap \
   --only dark-peer-catchup --only byzantine-snapshot 2>&1 | log
@@ -93,7 +99,8 @@ stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.obs.timeline \
   "$fleet_dir/trace.jsonl" "$fleet_dir/fleet.jsonl" \
-  "$fleet_dir/flight.jsonl" --min-join 0.99 2>&1 | log
+  "$fleet_dir/flight.jsonl" --min-join 0.99 \
+  --rules scripts/fleet_slo.rules 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 rm -rf "$fleet_dir"

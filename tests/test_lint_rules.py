@@ -2403,4 +2403,4 @@ def test_async_rules_registered():
         "await-holding-lock",
         "cancellation-safety",
     } <= names
-    assert len(RULES) == 18
+    assert len(RULES) == 19

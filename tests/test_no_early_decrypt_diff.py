@@ -1,0 +1,161 @@
+"""Revert-and-re-detect differential suite for ``no-early-decrypt``.
+
+The order-then-reveal pipeline (PR 19) holds its censorship-resistance
+argument on one invariant: threshold decryption starts only after
+common-subset output pins the epoch's order.  Each test copies
+``protocols/`` into a fixture, edits exactly one early-decryption
+regression into the HoneyBadger state machine by text substitution,
+runs the static gate over the edited tree, and asserts the rule
+reports that precise hole.  The unedited copy is asserted clean once
+up front, so a failure here means the edit (and only the edit)
+re-opened it.
+
+The dynamic twin of this gate is the ``ordered-reveal`` scenario
+(``harness/scenarios.py``).
+"""
+
+import os
+import shutil
+
+from hbbft_tpu.analysis import lint_paths
+from hbbft_tpu.analysis.rules.no_early_decrypt import NoEarlyDecryptRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "hbbft_tpu")
+
+HB = "protocols/honey_badger.py"
+
+
+def _copy_scope(tmp_path):
+    dst = tmp_path / "hbbft_tpu"
+    shutil.copytree(
+        os.path.join(PKG, "protocols"),
+        dst / "protocols",
+        ignore=shutil.ignore_patterns("__pycache__"),
+    )
+    return dst
+
+
+def _edit_and_lint(tmp_path, relpath, old, new):
+    root = _copy_scope(tmp_path)
+    target = root / relpath
+    text = target.read_text()
+    assert old in text, (
+        f"anchor text not found in {relpath} — the differential edit "
+        "needs updating alongside the protocol code"
+    )
+    target.write_text(text.replace(old, new))
+    violations, errors = lint_paths([str(root)], [NoEarlyDecryptRule()])
+    assert not errors
+    return [v for v in violations if v.path == relpath]
+
+
+def test_unedited_scope_copy_is_clean(tmp_path):
+    root = _copy_scope(tmp_path)
+    violations, errors = lint_paths([str(root)], [NoEarlyDecryptRule()])
+    assert not errors
+    assert violations == []
+
+
+def test_eager_decrypt_at_share_arrival_redetected(tmp_path):
+    # the canonical regression: decrypting the moment f+1 shares are in,
+    # from the share-arrival handler — BEFORE any ACS output exists for
+    # the epoch on slow nodes
+    hits = _edit_and_lint(
+        tmp_path,
+        HB,
+        "        if epoch == self.epoch or epoch in self._pending_reveals:\n"
+        "            return self._try_output_batches()",
+        "        if epoch == self.epoch or epoch in self._pending_reveals:\n"
+        "            self._try_decrypt_proposer_contribution(proposer_id, epoch)\n"
+        "            return self._try_output_batches()",
+    )
+    assert any(
+        "_try_decrypt_proposer_contribution" in v.message
+        and "_handle_decryption_share_message" in v.message
+        for v in hits
+    ), hits
+
+
+def test_inline_combine_sink_in_handler_redetected(tmp_path):
+    # a combine sink spliced straight into the message handler
+    hits = _edit_and_lint(
+        tmp_path,
+        HB,
+        "        self.received_shares.setdefault(epoch, {}).setdefault(\n"
+        "            proposer_id, {}\n"
+        "        )[sender_id] = share",
+        "        self.received_shares.setdefault(epoch, {}).setdefault(\n"
+        "            proposer_id, {}\n"
+        "        )[sender_id] = share\n"
+        "        if ciphertext is not None:\n"
+        "            try:\n"
+        "                self.netinfo.public_key_set."
+        "combine_decryption_shares(\n"
+        "                    {0: share}, ciphertext\n"
+        "                )\n"
+        "            except Exception:\n"
+        "                pass",
+    )
+    assert any(
+        "combine_decryption_shares()" in v.message
+        and "_handle_decryption_share_message" in v.message
+        for v in hits
+    ), hits
+
+
+def test_share_emission_before_acs_redetected(tmp_path):
+    # emitting our decryption share from the CS message pump (i.e. on
+    # every CS round, not at CS output) — caller-map violation
+    hits = _edit_and_lint(
+        tmp_path,
+        HB,
+        "        cs = self._common_subset(epoch)\n"
+        "        cs_step = cs.handle_message(sender_id, cs_msg)",
+        "        cs = self._common_subset(epoch)\n"
+        "        for _pid, _ct in self.ciphertexts.get(epoch, {}).items():\n"
+        "            self._send_decryption_share(_pid, _ct, epoch)\n"
+        "        cs_step = cs.handle_message(sender_id, cs_msg)",
+    )
+    assert any(
+        "_send_decryption_share" in v.message
+        and "_handle_common_subset_message" in v.message
+        for v in hits
+    ), hits
+
+
+def test_raw_decrypt_share_sink_outside_home_redetected(tmp_path):
+    # the raw share-emission primitive used anywhere but its home
+    hits = _edit_and_lint(
+        tmp_path,
+        HB,
+        "        ciphertext = self.ciphertexts.get(epoch, {}).get(proposer_id)",
+        "        ciphertext = self.ciphertexts.get(epoch, {}).get(proposer_id)\n"
+        "        if ciphertext is not None:\n"
+        "            self.netinfo.secret_key_share.decrypt_share_no_verify(\n"
+        "                ciphertext\n"
+        "            )",
+    )
+    assert any(
+        "decrypt_share_no_verify()" in v.message for v in hits
+    ), hits
+
+
+def test_getattr_probe_outside_home_redetected(tmp_path):
+    # getattr-probing the batch combine API from a handler counts as a
+    # sink reference too (the speculative path's own idiom, misplaced)
+    hits = _edit_and_lint(
+        tmp_path,
+        HB,
+        "        ciphertext = self.ciphertexts.get(epoch, {}).get(proposer_id)",
+        "        ciphertext = self.ciphertexts.get(epoch, {}).get(proposer_id)\n"
+        "        combine = getattr(\n"
+        "            self.netinfo.public_key_set,\n"
+        '            "combine_and_check_decryption_shares",\n'
+        "            None,\n"
+        "        )\n"
+        "        del combine",
+    )
+    assert any(
+        "combine_and_check_decryption_shares()" in v.message for v in hits
+    ), hits

@@ -1,0 +1,275 @@
+"""Order-then-reveal commit pipeline (PR 19).
+
+Covers the split at every layer:
+
+- protocol: ``reveal_mode="ordered"`` emits an :class:`OrderedBatch`
+  at ACS completion (contiguous seqs, cross-node digest agreement)
+  and the plaintext :class:`Batch` afterwards, byte-identical to the
+  inline pipeline's
+- vectorized harness: ordered runs produce bit-identical batches and
+  identical fault attribution (deferred to the reveal) vs inline
+- gateway: the epoch-scoped ``OrderedAck`` / ``RevealNote`` fan-out is
+  at-most-once / exactly-once per (connection, epoch), ages under GC,
+  and its wire validators are total
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.mock import MockDecryptionShare
+from hbbft_tpu.harness.epoch import VectorizedHoneyBadgerSim
+from hbbft_tpu.harness.network import (
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    HoneyBadger,
+    HoneyBadgerBuilder,
+    OrderedBatch,
+    default_reveal_mode,
+    ordered_batch_digest,
+)
+from hbbft_tpu.serve.gateway import GatewayCore
+from hbbft_tpu.serve.protocol import (
+    ClientHello,
+    OrderedAck,
+    RevealNote,
+    SubmitTx,
+    frame,
+    loads,
+    validate_ordered_ack,
+    validate_reveal_note,
+)
+
+# -- protocol plane ----------------------------------------------------------
+
+
+def _run_net(reveal_mode, seed=7, n=4, epochs=3):
+    """Drive an n-node mock-crypto TestNetwork for ``epochs`` proposals
+    per node → per-node output lists."""
+    rng = random.Random(seed)
+    net = TestNetwork(
+        n,
+        0,
+        lambda adv: SilentAdversary(
+            MessageScheduler(MessageScheduler.RANDOM, rng)
+        ),
+        lambda ni: HoneyBadger(
+            ni,
+            rng=random.Random(f"oc-{ni.our_id}-{seed}"),
+            reveal_mode=reveal_mode,
+        ),
+        rng,
+        mock_crypto=True,
+    )
+    guard = 0
+    while True:
+        guard += 1
+        assert guard < 100_000, "network failed to quiesce"
+        proposed = False
+        for nid in sorted(net.nodes):
+            node = net.nodes[nid]
+            algo = node.instance
+            if algo.epoch < epochs and not algo.has_input():
+                node.handle_input([b"oc-%d-%03d" % (algo.epoch, nid)])
+                msgs = list(node.messages)
+                node.messages.clear()
+                net.dispatch_messages(nid, msgs)
+                proposed = True
+        if net.any_busy():
+            net.step()
+        elif not proposed:
+            break
+    return {nid: list(node.outputs) for nid, node in net.nodes.items()}
+
+
+def test_ordered_protocol_interleaves_order_and_reveal():
+    epochs = 3
+    ordered_out = _run_net("ordered", epochs=epochs)
+    inline_out = _run_net("inline", epochs=epochs)
+    for nid, outs in ordered_out.items():
+        obs = [o for o in outs if isinstance(o, OrderedBatch)]
+        batches = [o for o in outs if isinstance(o, Batch)]
+        assert len(obs) == epochs and len(batches) == epochs
+        # contiguous node-local commit sequence, epochs in log order
+        assert [o.seq for o in obs] == list(range(epochs))
+        assert [o.epoch for o in obs] == list(range(epochs))
+        assert [b.epoch for b in batches] == list(range(epochs))
+        # the order is pinned before the plaintext exists
+        for e in range(epochs):
+            assert outs.index(obs[e]) < outs.index(batches[e])
+        # plaintext identical to the inline pipeline's
+        inline_batches = [o for o in inline_out[nid] if isinstance(o, Batch)]
+        assert [(b.epoch, b.contributions) for b in batches] == [
+            (b.epoch, b.contributions) for b in inline_batches
+        ]
+    # every correct node pins the same digest per epoch
+    for e in range(epochs):
+        digests = {
+            next(
+                o for o in outs if isinstance(o, OrderedBatch) and o.epoch == e
+            ).digest
+            for outs in ordered_out.values()
+        }
+        assert len(digests) == 1
+
+
+def test_ordered_batch_digest_canonical():
+    cts = {1: b"ct-one", 0: b"ct-zero", 2: b"ct-two"}
+    permuted = {2: b"ct-two", 0: b"ct-zero", 1: b"ct-one"}
+    assert ordered_batch_digest(5, cts) == ordered_batch_digest(5, permuted)
+    assert ordered_batch_digest(5, cts) != ordered_batch_digest(6, cts)
+    assert ordered_batch_digest(5, cts) != ordered_batch_digest(
+        5, {**cts, 2: b"ct-other"}
+    )
+    assert len(ordered_batch_digest(5, cts)) == 32
+
+
+def test_reveal_mode_validation_and_env_default(monkeypatch):
+    rng = random.Random(11)
+    from hbbft_tpu.core.network_info import NetworkInfo
+
+    netinfos = NetworkInfo.generate_map(list(range(4)), rng, mock=True)
+    ni = netinfos[0]
+    with pytest.raises(ValueError):
+        HoneyBadger(ni, reveal_mode="weird")
+    # the backpressure bound clamps to >= 1
+    hb = HoneyBadger(ni, reveal_mode="ordered", max_outstanding_reveals=0)
+    assert hb.max_outstanding_reveals == 1
+    assert hb._pending_reveals == {}
+    monkeypatch.delenv("HBBFT_TPU_ORDERED_COMMIT", raising=False)
+    assert default_reveal_mode() == "inline"
+    monkeypatch.setenv("HBBFT_TPU_ORDERED_COMMIT", "1")
+    assert default_reveal_mode() == "ordered"
+    assert HoneyBadgerBuilder(ni).build().reveal_mode == "ordered"
+
+
+# -- vectorized harness ------------------------------------------------------
+
+
+def _contribs(n, tag):
+    return {i: [b"%s-%03d" % (tag, i)] for i in range(n)}
+
+
+def test_vectorized_ordered_byte_identical_to_inline():
+    n, epochs = 4, 3
+    seq = [_contribs(n, b"vo%d" % e) for e in range(epochs)]
+    inline = VectorizedHoneyBadgerSim(n, random.Random(0x0C), mock=True)
+    ordered = VectorizedHoneyBadgerSim(
+        n, random.Random(0x0C), mock=True, reveal_mode="ordered"
+    )
+    rows_in = inline.run_epochs(seq, pipeline=False)
+    rows_or = ordered.run_epochs(seq, pipeline=False)
+    for e, (ri, ro) in enumerate(zip(rows_in, rows_or)):
+        # run_epochs flushed the ordered reveals in place
+        assert ro.batch is not None, f"epoch {e} never revealed"
+        assert ro.batch.contributions == ri.batch.contributions
+        assert ro.fault_log.is_empty()
+
+
+def test_vectorized_ordered_defers_bad_share_attribution():
+    n, epochs, forger = 4, 3, 1
+    bogus = MockDecryptionShare(b"\xab" * 32, b"\xcd" * 32)
+    forged = {forger: {p: bogus for p in range(n)}}
+    seq = [_contribs(n, b"vb%d" % e) for e in range(epochs)]
+    twin = VectorizedHoneyBadgerSim(n, random.Random(0x0D), mock=True)
+    ordered = VectorizedHoneyBadgerSim(
+        n,
+        random.Random(0x0D),
+        mock=True,
+        reveal_mode="ordered",
+        max_outstanding_reveals=epochs,
+    )
+    rows_ref = twin.run_epochs(seq, pipeline=False)
+    rows_or = ordered.run_epochs(seq, pipeline=False, forged_dec=forged)
+    for rr, ro in zip(rows_ref, rows_or):
+        assert ro.batch is not None
+        assert ro.batch.contributions == rr.batch.contributions
+        # decryption faults surface at reveal time, same attribution
+        assert {fl.node_id for fl in ro.fault_log} == {forger}
+
+
+# -- gateway ack split -------------------------------------------------------
+
+
+def _core_with_pending(conns=("ca", "cb")):
+    core = GatewayCore()
+    for i, conn in enumerate(conns):
+        replies, dropped = core.on_hello(
+            conn, ClientHello(1, "t%d" % i, "c%d" % i)
+        )
+        assert not dropped and replies[0].ok
+        replies, dropped = core.on_submit(
+            conn, SubmitTx(0, b"payload-%d" % i), 1.0
+        )
+        assert not dropped and replies[0].admitted
+    return core
+
+
+def test_gateway_ordered_ack_fanout_at_most_once():
+    core = _core_with_pending()
+    digest = b"\x11" * 32
+    acks = core.on_ordered(4, 2, digest, 2.0)
+    assert [c for c, _ in acks] == ["ca", "cb"]
+    assert all(a == OrderedAck(4, 2, digest) for _, a in acks)
+    assert all(validate_ordered_ack(a) for _, a in acks)
+    # duplicate epoch → nothing; hostile values → nothing, no throw
+    assert core.on_ordered(4, 3, digest, 2.5) == []
+    assert core.on_ordered(-1, 0, digest, 2.5) == []
+    assert core.on_ordered("e", 0, digest, 2.5) == []
+    assert core.on_ordered(5, 0, "not-bytes", 2.5) == []
+
+
+def test_gateway_reveal_note_exactly_once():
+    core = _core_with_pending()
+    core.on_ordered(4, 2, b"\x22" * 32, 2.0)
+    notes = core.on_revealed(4, 2.75)
+    assert [c for c, _ in notes] == ["ca", "cb"]
+    assert all(n == RevealNote(4, 2, 750) for _, n in notes)
+    assert all(validate_reveal_note(n) for _, n in notes)
+    # exactly once: the notified list was popped
+    assert core.on_revealed(4, 3.0) == []
+    # inline-pipeline epochs (never ordered) produce no notes
+    assert core.on_revealed(5, 3.0) == []
+    assert core.on_revealed(None, 3.0) == []
+
+
+def test_gateway_gc_ages_ordered_window():
+    core = _core_with_pending(conns=("ca",))
+    for e in range(6):
+        core.on_ordered(e, e, b"\x33" * 32, float(e))
+    core.gc_epochs(20, keep=8)
+    assert core.ordered_log == {}
+    assert core.on_revealed(3, 21.0) == []
+
+
+def test_ordered_wire_validators_total_and_roundtrip():
+    good_ack = OrderedAck(3, 2, b"\x44" * 32)
+    good_note = RevealNote(3, 2, 150)
+    assert validate_ordered_ack(good_ack)
+    assert validate_reveal_note(good_note)
+    assert loads(frame(good_ack)[4:]) == good_ack
+    assert loads(frame(good_note)[4:]) == good_note
+    for bad in (
+        None,
+        good_note,
+        OrderedAck(True, 2, b"\x44" * 32),
+        OrderedAck(-1, 2, b"\x44" * 32),
+        OrderedAck(3, "2", b"\x44" * 32),
+        OrderedAck(3, 2, b"\x44" * 31),
+        OrderedAck(3, 2, "digest"),
+    ):
+        assert validate_ordered_ack(bad) is False
+    for bad in (
+        None,
+        good_ack,
+        RevealNote(True, 2, 150),
+        RevealNote(3, -1, 150),
+        RevealNote(3, 2, -5),
+        RevealNote(3, 2, 2**31),
+        RevealNote(3, 2, 1.5),
+    ):
+        assert validate_reveal_note(bad) is False

@@ -149,6 +149,9 @@ def _samples():
         "CsBc": cls["CsBc"]("node-1", cls["BcReady"](b"\x33" * 32)),
         "CsAba": cls["CsAba"]("node-1", cls["AbaMsg"](0, cls["AbaTerm"](True))),
         "HbBatch": cls["HbBatch"](3, {"node-1": b"contrib"}),
+        "HbOrderedBatch": cls["HbOrderedBatch"](
+            3, 2, b"\x44" * 32, ("node-0", "node-1")
+        ),
         "HbCs": cls["HbCs"](cls["CsBc"]("node-1", cls["BcReady"](b"\x33" * 32))),
         "HbDec": cls["HbDec"]("node-2", cls["MockDecShare"](b"t", b"k")),
         "HbMsg": cls["HbMsg"](3, cls["HbDec"]("n", cls["MockDecShare"](b"t", b"k"))),
@@ -185,6 +188,8 @@ def _samples():
         "SrvSubmit": cls["SrvSubmit"](42, b"tx-payload"),
         "SrvSubmitAck": cls["SrvSubmitAck"](42, False, 50, "tenant-full"),
         "SrvCommitAck": cls["SrvCommitAck"](42, 3),
+        "SrvOrderedAck": cls["SrvOrderedAck"](3, 2, b"\x44" * 32),
+        "SrvRevealNote": cls["SrvRevealNote"](3, 2, 150),
         "SrvGossip": cls["SrvGossip"]((b"tx-a", b"tx-b")),
         # transport (session resumption + state transfer + telemetry)
         "RsHello": cls["RsHello"]("127.0.0.1:7001", 5),
