@@ -14,6 +14,8 @@
                                   # event-loop stall sanitizer over pytest
     python -m hbbft_tpu.analysis --rangecheck tests/test_fused_flush.py
                                   # exact-shadow overflow sanitizer over pytest
+    python -m hbbft_tpu.analysis --mc --mc-config agreement --mc-depth 5
+                                  # badgermc: schedule-space model checking
 
 Exit codes: 0 clean (baselined violations allowed), 1 new violations
 or parse errors, 2 usage error.
@@ -141,9 +143,95 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="stallcheck budget in seconds (default: "
         "$HBBFT_TPU_STALLCHECK_BUDGET or 0.25)",
     )
+    parser.add_argument(
+        "--mc",
+        action="store_true",
+        help="run badgermc (hbbft_tpu.analysis.modelcheck): bounded "
+        "schedule-space model checking of the protocol state machines, "
+        "rendering any violated invariant like a lint violation with "
+        "the minimized counterexample trace as its flow",
+    )
+    parser.add_argument(
+        "--mc-config",
+        default="honey_badger",
+        metavar="PROTOCOL",
+        help="protocol stack to check (honey_badger, common_subset, "
+        "agreement, sbv_broadcast, common_coin; default honey_badger)",
+    )
+    parser.add_argument(
+        "--mc-depth", type=int, default=None, help="DFS delivery-depth bound"
+    )
+    parser.add_argument(
+        "--mc-states",
+        type=int,
+        default=None,
+        help="explored-state cap (the run reports truncated=True when hit)",
+    )
+    parser.add_argument(
+        "--mc-corrupt",
+        type=int,
+        default=None,
+        help="number of Byzantine nodes (highest ids; enables "
+        "drop/dup/forge choice points)",
+    )
+    parser.add_argument(
+        "--mc-seed", type=int, default=None, help="exploration seed"
+    )
+    parser.add_argument(
+        "--mc-epochs", type=int, default=None, help="honey_badger epochs"
+    )
+    parser.add_argument(
+        "--mc-reveal",
+        choices=("inline", "ordered"),
+        default=None,
+        help="honey_badger reveal mode",
+    )
+    parser.add_argument(
+        "--mc-probes",
+        type=int,
+        default=None,
+        help="full-delivery liveness/deep-safety probes (odd-indexed "
+        "probes bias against a random partition cut)",
+    )
+    parser.add_argument(
+        "--mc-probe-steps",
+        type=int,
+        default=None,
+        help="per-probe delivery bound",
+    )
+    parser.add_argument(
+        "--mc-prefix",
+        type=int,
+        default=None,
+        help="seeded random warm-up deliveries before the DFS (reaches "
+        "deeper protocol phases at the cost of exhaustiveness)",
+    )
+    parser.add_argument(
+        "--mc-byz-budget",
+        type=int,
+        default=None,
+        help="adversarial actions allowed per explored schedule",
+    )
+    parser.add_argument(
+        "--mc-repro",
+        metavar="PATH",
+        default=None,
+        help="write a replayable counterexample file here on violation "
+        "(replay: python -m hbbft_tpu.harness.scenarios --replay-trace PATH)",
+    )
+    parser.add_argument(
+        "--mc-min-states",
+        type=int,
+        default=0,
+        metavar="N",
+        help="fail the run if fewer than N states were explored (guards "
+        "the CI smoke against a silently degenerate search)",
+    )
     args = parser.parse_args(argv)
     fmt = args.format or ("json" if args.json else "human")
 
+    if args.mc:
+        return _run_mc(args, fmt)
     if args.racecheck is not None:
         return _run_racecheck(args.racecheck, fmt)
     if args.stallcheck is not None:
@@ -483,6 +571,166 @@ def _run_rangecheck(test_expr: str, fmt: str) -> int:
         else:
             print("rangecheck clean")
     return 1 if (violations or proc.returncode) else 0
+
+
+def _mc_step_label(i: int, act) -> str:
+    kind = act[0]
+    if kind == "forge":
+        return f"step {i}: corrupt {act[1]} forges {act[3]!r} to {act[2]}"
+    if kind == "drop":
+        return f"step {i}: drop {act[1]}->{act[2]} (seq {act[3]})"
+    if kind == "dup":
+        return f"step {i}: duplicate {act[1]}->{act[2]} (seq {act[3]})"
+    if kind == "reorder":
+        return f"step {i}: reorder {act[1]}->{act[2]} (seq {act[3]})"
+    return f"step {i}: deliver {act[1]}->{act[2]} (seq {act[3]})"
+
+
+def _mc_violation(result) -> Violation:
+    """Render a model-checking violation like a lint violation: anchored
+    at the checked stack's source file, with the minimized
+    counterexample trace as the flow (SARIF codeFlows)."""
+    v = result.violation
+    cfg = result.config
+    path = os.path.join(
+        os.path.dirname(_HERE), "protocols", f"{cfg.protocol}.py"
+    )
+    trace = v.get("trace", [])
+    flow = tuple(
+        (path, 1, _mc_step_label(i, act)) for i, act in enumerate(trace)
+    )
+    node = v.get("node")
+    where = f" at node {node}" if node is not None else ""
+    msg = (
+        f"{v['kind']}{where} in {cfg.protocol} "
+        f"(n={cfg.n}, corrupt={cfg.corrupt}): {v['detail']} "
+        f"[counterexample: {v.get('prefix_len', 0)} prefix + "
+        f"{len(trace)} shown action(s)]"
+    )
+    return Violation(
+        rule="modelcheck",
+        path=path,
+        line=1,
+        col=0,
+        message=msg,
+        flow=flow or None,
+    )
+
+
+def _run_mc(args, fmt: str) -> int:
+    """Run badgermc in-process and render the result with the usual
+    formatters."""
+    from ..harness.mc_net import PROTOCOLS, MCConfig
+    from .modelcheck import run_modelcheck
+
+    if args.mc_config not in PROTOCOLS:
+        print(
+            f"unknown --mc-config {args.mc_config!r} "
+            f"(choose from {', '.join(sorted(PROTOCOLS))})",
+            file=sys.stderr,
+        )
+        return 2
+    kw = {"protocol": args.mc_config}
+    for attr, field_name in (
+        ("mc_depth", "depth"),
+        ("mc_states", "max_states"),
+        ("mc_corrupt", "corrupt"),
+        ("mc_seed", "seed"),
+        ("mc_epochs", "epochs"),
+        ("mc_reveal", "reveal_mode"),
+        ("mc_probes", "probes"),
+        ("mc_probe_steps", "probe_steps"),
+        ("mc_prefix", "prefix_steps"),
+        ("mc_byz_budget", "byz_budget"),
+    ):
+        value = getattr(args, attr)
+        if value is not None:
+            kw[field_name] = value
+    cfg = MCConfig(**kw)
+    result = run_modelcheck(cfg, repro_path=args.mc_repro)
+    d = result.to_dict()
+    violations = [] if result.clean else [_mc_violation(result)]
+    too_few = (
+        result.clean
+        and not result.truncated
+        and d["explored"] < args.mc_min_states
+    )
+
+    if args.trace:
+        from .. import obs
+
+        rec = obs.enable(args.trace)
+        rec.event(
+            "mc_run",
+            explored=d["explored"],
+            deduped=d["deduped"],
+            dpor_pruned=d["dpor_pruned"],
+            naive=d["naive"],
+            reduction=d["reduction"],
+            truncated=d["truncated"],
+            probe_runs=d["probe_runs"],
+            probe_actions=d["probe_actions"],
+            shrink_replays=d["shrink_replays"],
+            config=d["config"],
+            violation=(result.violation or {}).get("kind"),
+            repro_path=d["repro_path"],
+            wall=d["wall"],
+        )
+        obs.disable()
+
+    if fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "mc": d,
+                    "violations": [v.as_dict() for v in violations],
+                    "ok": result.clean and not too_few,
+                },
+                indent=2,
+            )
+        )
+    elif fmt == "sarif":
+
+        class _McRule:
+            name = "modelcheck"
+            description = (
+                "bounded schedule-space model checking: every "
+                "inequivalent delivery interleaving up to the depth "
+                "bound preserves the protocol safety invariants"
+            )
+
+        print(json.dumps(_sarif(violations, [], [_McRule()]), indent=2))
+    else:
+        print(
+            f"badgermc {cfg.protocol}: {d['explored']} state(s) explored "
+            f"(naive {d['naive']}, {d['reduction']:.1f}x reduction, "
+            f"{d['deduped']} deduped, {d['dpor_pruned']} DPOR-pruned"
+            f"{', TRUNCATED' if d['truncated'] else ''}), "
+            f"{d['probe_runs']} probe(s) / {d['probe_actions']} "
+            f"deliveries, {d['wall']:.1f}s"
+        )
+        for v in violations:
+            print(v.render())
+        if violations:
+            if d["repro_path"]:
+                print(
+                    f"repro written to {d['repro_path']} (replay: "
+                    f"python -m hbbft_tpu.harness.scenarios "
+                    f"--replay-trace {d['repro_path']})"
+                )
+        elif too_few:
+            pass
+        else:
+            print("modelcheck clean")
+    if too_few:
+        print(
+            f"modelcheck: only {d['explored']} state(s) explored "
+            f"(--mc-min-states {args.mc_min_states}) — degenerate search",
+            file=sys.stderr,
+        )
+        return 1
+    return 1 if violations else 0
 
 
 def _run_stallcheck(

@@ -1,0 +1,385 @@
+"""badgermc — bounded schedule-space model checking for the protocol
+state machines.
+
+Every other gate in this tree (the adversarial scenario matrix, the
+fuzzer, racecheck/stallcheck) executes exactly one delivery schedule
+per seed.  badgermc explores the schedule *space*: a DFS over the
+pending-message frontier of a small network (default n=4, f=1, mock
+crypto) that visits every inequivalent message-delivery interleaving up
+to a depth bound, asserting the safety invariants of
+:mod:`hbbft_tpu.harness.mc_net` at every state.
+
+Why this is sound exploration and not wishful replay: the
+``step-purity`` rule proves every ``DistAlgorithm.handle_*`` is a pure
+message→state→Step transition, and the ``determinism`` rule proves
+there is no ambient entropy — so a network state is *exactly* its
+canonical digest (``core.digest``), re-executing an action list is
+bit-reproducible, and snapshot/restore backtracking visits the same
+states a fresh run would.
+
+Reduction, in two layers:
+
+- **state-hash dedup** — schedules that converge to the same canonical
+  digest share their future; a revisited state with no more remaining
+  depth than before is cut off;
+- **sleep-set DPOR** — a commutativity oracle prunes one order of every
+  independent pair.  Two actions are independent iff they touch
+  different per-link queues *and* different recipient nodes: a delivery
+  mutates only its recipient's state, consumes only its own link's
+  head, and appends only to its recipient's outgoing links — so
+  same-recipient deliveries are ordered (both orders explored) and
+  everything else commutes.  Sleep sets are combined with state hashing
+  in the standard practical way; the cut is exact for the safety
+  predicates here (which read per-node state the oracle keys on).
+
+Byzantine choice points ride the same frontier: under a budget of
+``corrupt`` nodes (the highest ids) and ``byz_budget`` adversarial
+actions per schedule, the DFS also branches on drop/duplicate/reorder
+of corrupt-sender links, forged decryption shares, malformed payloads,
+and equivocating per-recipient forgeries.
+
+On violation the schedule is **shrunk**: the tail ``shrink_window``
+actions are delta-debugged (ddmin) against a fresh replay per
+candidate, with the known-good prefix frozen — the reported trace is
+always ≤ ``shrink_window`` actions and deterministically replayable via
+``harness/scenarios.py --replay-trace`` on the emitted repro file.
+
+Bounded liveness is probed separately: seeded full-delivery schedules
+must drive every honest node to commit within the probe bound
+(violations: ``liveness-stall`` — quiescent before the goal — and
+``liveness-bound``).  Odd-indexed probes bias delivery against a
+random partition cut (one side of the network races ahead) — these
+reach deep asymmetric-progress states that neither the depth-bounded
+DFS nor uniform random delivery visits, and the safety invariants are
+asserted along the way.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.digest import restore as _loads, snapshot as _dumps
+from ..harness.mc_net import (
+    Action,
+    MCConfig,
+    MCNet,
+    check_invariants,
+    live_done,
+    partition_lag,
+    random_schedule,
+    run_actions,
+    save_repro,
+    state_key,
+)
+
+__all__ = ["MCConfig", "MCResult", "ModelChecker", "run_modelcheck"]
+
+
+# -- the DPOR commutativity oracle ------------------------------------------
+
+
+def _footprint_link(act: Action) -> Tuple:
+    if act[0] == "forge":  # forges touch no queue: a private pseudo-link
+        return ("#forge", act[1], act[2], act[3])
+    return (act[1], act[2])
+
+
+def _footprint_recipient(act: Action) -> Optional[Any]:
+    if act[0] == "drop":  # drops mutate no node, only their link
+        return None
+    return act[2]
+
+
+def independent(a: Action, b: Action) -> bool:
+    """True iff ``a`` and ``b`` commute from any state where both are
+    enabled: different per-link queues and different recipient nodes."""
+    if _footprint_link(a) == _footprint_link(b):
+        return False
+    ra, rb = _footprint_recipient(a), _footprint_recipient(b)
+    return ra is None or rb is None or ra != rb
+
+
+# -- results ----------------------------------------------------------------
+
+
+@dataclass
+class MCStats:
+    explored: int = 0
+    dedup: int = 0
+    dpor_pruned: int = 0
+    naive: int = 0  # states a no-dedup/no-DPOR DFS would visit (>=)
+    probe_runs: int = 0
+    probe_actions: int = 0
+    shrink_replays: int = 0
+
+
+@dataclass
+class MCResult:
+    config: MCConfig
+    stats: MCStats
+    violation: Optional[Dict[str, Any]] = None
+    truncated: bool = False
+    wall: float = 0.0
+    repro_path: Optional[str] = None
+
+    @property
+    def reduction(self) -> float:
+        """Measured state reduction vs naive enumeration: the exact
+        number of tree nodes a DFS with no dedup and no DPOR would
+        visit to the same depth bound (memoized subtree counts; pruned
+        subtrees whose size is unknown count 1, so this is a lower
+        bound), divided by the states actually explored."""
+        return self.stats.naive / max(1, self.stats.explored)
+
+    @property
+    def clean(self) -> bool:
+        return self.violation is None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "config": self.config.to_dict(),
+            "explored": self.stats.explored,
+            "deduped": self.stats.dedup,
+            "dpor_pruned": self.stats.dpor_pruned,
+            "naive": self.stats.naive,
+            "probe_runs": self.stats.probe_runs,
+            "probe_actions": self.stats.probe_actions,
+            "shrink_replays": self.stats.shrink_replays,
+            "reduction": round(self.reduction, 3),
+            "truncated": self.truncated,
+            "wall": round(self.wall, 6),
+            "violation": self.violation,
+            "repro_path": self.repro_path,
+        }
+
+
+# -- delta debugging --------------------------------------------------------
+
+
+def ddmin(seq: List[Any], test) -> List[Any]:
+    """Zeller-style ddmin: the smallest complement-closed subsequence of
+    ``seq`` for which ``test`` still holds.  ``test(seq)`` must be
+    True on entry."""
+    n = 2
+    while len(seq) >= 2:
+        chunk = max(1, len(seq) // n)
+        reduced = False
+        for i in range(0, len(seq), chunk):
+            candidate = seq[:i] + seq[i + chunk :]
+            if candidate and test(candidate):
+                seq = candidate
+                n = max(n - 1, 2)
+                reduced = True
+                break
+        if not reduced:
+            if n >= len(seq):
+                break
+            n = min(len(seq), n * 2)
+    return seq
+
+
+# -- the checker ------------------------------------------------------------
+
+
+class ModelChecker:
+    def __init__(self, cfg: MCConfig, repro_path: Optional[str] = None):
+        self.cfg = cfg
+        self.repro_path = repro_path
+        self.stats = MCStats()
+        self.violation: Optional[Dict[str, Any]] = None
+        self.written_repro: Optional[str] = None
+        self.truncated = False
+        # (digest, remaining budget) -> naive subtree size.  Keying on
+        # the exact budget (not budget dominance) makes the memoized
+        # subtree size a pure function of the key, which is what lets
+        # the naive-enumeration count be computed exactly alongside the
+        # reduced search.
+        self._memo: Dict[Tuple[bytes, int], int] = {}
+        self._prefix: List[Action] = []
+        self._trace: List[Action] = []
+
+    def run(self) -> MCResult:
+        t0 = time.perf_counter()
+        cfg = self.cfg
+        mc = MCNet(cfg)
+        viols = check_invariants(mc)
+        if viols:
+            self._record([], viols)
+        if self.violation is None and cfg.prefix_steps:
+            rng = random.Random(cfg.prefix_seed)
+            trace, viols = random_schedule(mc, rng, cfg.prefix_steps)
+            self._prefix = trace
+            if viols:
+                self._record(trace, viols)
+        if self.violation is None:
+            self.stats.naive = self._dfs(mc, cfg.depth, frozenset())
+        if self.violation is None:
+            self._probes()
+        return MCResult(
+            config=cfg,
+            stats=self.stats,
+            violation=self.violation,
+            truncated=self.truncated,
+            wall=time.perf_counter() - t0,
+            repro_path=self.written_repro,
+        )
+
+    # -- DFS with dedup + sleep sets ------------------------------------
+
+    def _dfs(self, mc: MCNet, budget: int, sleep: frozenset) -> int:
+        """Explore below ``mc``; returns the naive subtree size (the
+        states an unreduced DFS would visit from here)."""
+        if self.violation is not None or self.truncated:
+            return 1
+        key = (state_key(mc), budget)
+        hit = self._memo.get(key)
+        if hit is not None:
+            self.stats.dedup += 1
+            return hit
+        self.stats.explored += 1
+        if self.stats.explored >= self.cfg.max_states:
+            self.truncated = True
+            return 1
+        if budget == 0:
+            self._memo[key] = 1
+            return 1
+        acts = mc.enabled_actions()
+        if not acts:
+            self._memo[key] = 1
+            return 1
+        snap = _dumps(mc)
+        done: List[Action] = []
+        naive = 1
+        for act in acts:
+            child = _loads(snap)
+            child.apply_action(act)
+            if act in sleep:
+                # pruned by the commutativity oracle: the commuted
+                # order already explored this subtree — charge its
+                # memoized size to the naive count (1 if unknown, so
+                # the reduction factor stays a lower bound)
+                self.stats.dpor_pruned += 1
+                naive += self._memo.get((state_key(child), budget - 1), 1)
+                continue
+            self._trace.append(act)
+            viols = check_invariants(child)
+            if viols:
+                self._record(list(self._prefix) + list(self._trace), viols)
+                self._trace.pop()
+                return naive
+            child_sleep = frozenset(
+                b for b in set(sleep) | set(done) if independent(act, b)
+            )
+            naive += self._dfs(child, budget - 1, child_sleep)
+            self._trace.pop()
+            if self.violation is not None or self.truncated:
+                return naive
+            done.append(act)
+        self._memo[key] = naive
+        return naive
+
+    # -- full-delivery probes (liveness + deep-state safety) -------------
+
+    def _probes(self) -> None:
+        cfg = self.cfg
+        for i in range(cfg.probes):
+            mc = MCNet(cfg)
+            rng = random.Random(f"badgermc-probe-{cfg.seed}-{i}")
+            # even probes: uniform full delivery; odd probes: full
+            # delivery with a lagging partition cut — uniform schedules
+            # converge all nodes together and cannot reach divergence
+            # bugs that need one side of the network racing ahead
+            lagged = partition_lag(rng, cfg.n) if i % 2 else None
+            trace, viols = random_schedule(
+                mc, rng, cfg.probe_steps, lagged=lagged
+            )
+            self.stats.probe_runs += 1
+            self.stats.probe_actions += len(trace)
+            if viols:
+                self._record(trace, viols)
+                return
+            if not live_done(mc):
+                kind = (
+                    "liveness-bound"
+                    if mc.enabled_actions()
+                    else "liveness-stall"
+                )
+                violation = {
+                    "kind": kind,
+                    "node": None,
+                    "detail": (
+                        f"probe {i}: full-delivery schedule did not reach "
+                        f"the commit goal within {len(trace)} deliveries"
+                        + (
+                            " (network quiescent)"
+                            if kind == "liveness-stall"
+                            else ""
+                        )
+                    ),
+                }
+                # liveness counterexamples are whole schedules — no
+                # window shrink, but still deterministically replayable
+                self._finish_violation(trace, violation, shrink=False)
+                return
+
+    # -- counterexample minimization + repro emission --------------------
+
+    def _record(self, full_trace: List[Action], viols) -> None:
+        self._finish_violation(full_trace, viols[0], shrink=True)
+
+    def _finish_violation(
+        self,
+        full_trace: List[Action],
+        violation: Dict[str, Any],
+        shrink: bool,
+    ) -> None:
+        cfg = self.cfg
+        if shrink and full_trace:
+            cut = max(0, len(full_trace) - cfg.shrink_window)
+            prefix, suffix = full_trace[:cut], full_trace[cut:]
+
+            def still_fails(candidate: List[Action]) -> bool:
+                self.stats.shrink_replays += 1
+                probe = MCNet(cfg)
+                res = run_actions(
+                    probe, prefix + candidate, check_from=len(prefix)
+                )
+                return res.feasible and bool(res.violations)
+
+            if still_fails(suffix):
+                suffix = ddmin(suffix, still_fails)
+            prefix, suffix = list(prefix), list(suffix)
+        else:
+            prefix, suffix = [], list(full_trace)
+        # pin the exact replay outcome the repro file promises
+        final = MCNet(cfg)
+        res = run_actions(final, prefix + suffix, check_from=len(prefix))
+        if res.violations:
+            violation = res.violations[0]
+        self.violation = {
+            **violation,
+            "trace": [list(a) for a in suffix],
+            "prefix_len": len(prefix),
+            "trace_len": len(suffix),
+        }
+        if self.repro_path:
+            save_repro(
+                self.repro_path,
+                cfg,
+                prefix,
+                suffix,
+                violation,
+                res.digest,
+            )
+            self.written_repro = self.repro_path
+
+
+def run_modelcheck(
+    cfg: MCConfig, repro_path: Optional[str] = None
+) -> MCResult:
+    """Run badgermc at ``cfg``; write a repro file on violation when
+    ``repro_path`` is given."""
+    return ModelChecker(cfg, repro_path=repro_path).run()

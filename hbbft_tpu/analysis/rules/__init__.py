@@ -53,6 +53,10 @@
                           those methods are called only from the
                           commit/reveal path (order-then-reveal's
                           censorship-resistance invariant)
+``bounded-state``         containers grown by wire-message handlers
+                          carry an eviction, bound-check, or
+                          validator-set-key witness (no remotely
+                          drivable unbounded growth)
 ========================  ==================================================
 """
 
@@ -64,6 +68,7 @@ from ..core import Rule
 from .async_blocking import AsyncBlockingRule
 from .atomic_cache import AtomicCacheRule
 from .await_holding_lock import AwaitHoldingLockRule
+from .bounded_state import BoundedStateRule
 from .cancellation_safety import CancellationSafetyRule
 from .determinism import DeterminismRule
 from .device_sync import DeviceSyncRule
@@ -104,4 +109,5 @@ def all_rules() -> List[Rule]:
         CancellationSafetyRule(),
         LimbRangeRule(),
         NoEarlyDecryptRule(),
+        BoundedStateRule(),
     ]

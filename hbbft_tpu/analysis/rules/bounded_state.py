@@ -1,0 +1,268 @@
+"""Rule ``bounded-state`` — wire-fed containers must have a bound or
+GC witness.
+
+Every ``DistAlgorithm`` keeps per-peer / per-epoch tables that grow as
+messages arrive (``received_shares``, ``incoming_queue``,
+``ciphertexts``, transport reassembly buffers).  A remote peer controls
+how often those grow — so any container a message handler grows is a
+memory-exhaustion vector unless the *class* visibly bounds it.  badgermc
+explores only small bounded networks and cannot see resource exhaustion;
+this rule is the static complement: the growth site must come with a
+witness that the container cannot grow without limit.
+
+A growth site is a statement in a wire-fed class (one that defines a
+``handle_message`` / ``handle_part`` / ``handle_ack`` entry point, or
+any class in ``transport/``, whose inbound frames are wire by
+definition) that enlarges a ``self``-attribute container:
+``self.x[k] = v``, ``self.x.setdefault(k, ...)``,
+``self.x.append/add/insert/extend/appendleft(...)``, or the nested
+``self.x[k].append/add(...)``.
+
+Accepted witnesses, checked over the whole class body:
+
+- **eviction** — ``self.x.pop/popitem/popleft/clear/remove/discard``,
+  ``del self.x[...]``, or re-assignment of ``self.x`` outside
+  ``__init__`` (epoch-roll resets like ``self.ciphertexts.pop`` /
+  ``self.received_conf = {...}``, including the swap-drain
+  ``queue, self.x = self.x, []``);
+- **bound guard** — ``len(self.x)`` compared anywhere in the class
+  (backpressure / cap checks);
+- **validator-set key** — the growth key is a node identity
+  (``sender_id``, ``proposer_id``, ``nid`` …): the key domain is the
+  validator set, so the table is bounded by ``n`` (the wire-taint rule
+  separately guarantees such ids are validated before keying state);
+  a ``.add`` whose *element* is a node identity counts the same way —
+  a set deduplicates, so ``self.x[b].add(sender_id)`` holds at most
+  ``n`` members per key;
+- ``# lint: ok(bounded-state)`` on or above the growth line, for
+  containers bounded by a protocol argument the AST cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Rule, Violation
+
+_ENTRY_POINTS = ("handle_message", "handle_part", "handle_ack")
+
+_GROW_METHODS = {
+    "append",
+    "appendleft",
+    "add",
+    "insert",
+    "extend",
+    "setdefault",
+}
+
+_EVICT_METHODS = {
+    "pop",
+    "popitem",
+    "popleft",
+    "clear",
+    "remove",
+    "discard",
+}
+
+# key names whose domain is the validator / peer set (bounded by n)
+_ID_KEY = re.compile(
+    r"(^|_)(sender|proposer|node|peer|our|client)_?(id|idx|index)$"
+    r"|^nid$|^pid$|^sid$|^(peer|sender|proposer|recipient)$"
+)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` → ``"x"``."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _growth_target(
+    node: ast.AST,
+) -> Optional[Tuple[str, Optional[ast.AST], Optional[ast.AST]]]:
+    """If ``node`` is a container-growth expression on a self attribute,
+    return ``(attr, key_expr_or_None, set_elem_or_None)``.
+
+    ``set_elem`` is the element of a ``.add`` call — a set deduplicates,
+    so ``self.x[b].add(sender_id)`` is bounded by the *element* domain
+    even when the subscript key is not an identity."""
+    # self.x[k] = v  (handled at the Assign level, target is Subscript)
+    if isinstance(node, ast.Subscript):
+        attr = _self_attr(node.value)
+        if attr is not None:
+            return attr, node.slice, None
+        return None
+    # self.x.append(v) / self.x[k].add(v) / self.x.setdefault(k, v)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr not in _GROW_METHODS:
+            return None
+        elem = None
+        if node.func.attr == "add" and node.args:
+            elem = node.args[0]
+        base = node.func.value
+        attr = _self_attr(base)
+        if attr is not None:
+            key = None
+            if node.func.attr == "setdefault" and node.args:
+                key = node.args[0]
+            return attr, key, elem
+        if isinstance(base, ast.Subscript):
+            attr = _self_attr(base.value)
+            if attr is not None:
+                return attr, base.slice, elem
+    return None
+
+
+def _is_id_key(key: Optional[ast.AST]) -> bool:
+    if key is None:
+        return False
+    if isinstance(key, ast.Name):
+        return bool(_ID_KEY.search(key.id))
+    if isinstance(key, ast.Attribute):  # self.netinfo.our_id etc.
+        return bool(_ID_KEY.search(key.attr))
+    if isinstance(key, ast.Tuple):
+        return all(_is_id_key(e) for e in key.elts)
+    return False
+
+
+class _ClassFacts(ast.NodeVisitor):
+    """One pass over a class body: growth sites + witness inventory."""
+
+    def __init__(self) -> None:
+        self.growth: List[Tuple[str, int, int, bool]] = []  # attr, line, col, id_key
+        self.evicted: Set[str] = set()
+        self.len_checked: Set[str] = set()
+        self._method: Optional[str] = None
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        prev, self._method = self._method, node.name
+        self.generic_visit(node)
+        self._method = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        flat: List[ast.AST] = []
+        for tgt in node.targets:
+            # `a, self.x = self.x, []` swap-drains count like plain
+            # re-assignment
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                flat.extend(tgt.elts)
+            else:
+                flat.append(tgt)
+        for tgt in flat:
+            if isinstance(tgt, ast.Subscript):
+                got = _growth_target(tgt)
+                if got is not None:
+                    attr, key, _ = got
+                    self.growth.append(
+                        (attr, tgt.lineno, tgt.col_offset, _is_id_key(key))
+                    )
+            else:
+                attr = _self_attr(tgt)
+                if attr is not None and self._method not in (None, "__init__"):
+                    # re-assignment outside __init__ resets the container
+                    self.evicted.add(attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            attr = _self_attr(base)
+            if attr is None and isinstance(base, ast.Subscript):
+                attr = _self_attr(base.value)
+            if attr is not None and node.func.attr in _EVICT_METHODS:
+                self.evicted.add(attr)
+        got = _growth_target(node)
+        if got is not None:
+            attr, key, elem = got
+            bounded = _is_id_key(key) or _is_id_key(elem)
+            self.growth.append(
+                (attr, node.lineno, node.col_offset, bounded)
+            )
+        # len(self.x) anywhere counts as a bound guard on x
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+            and node.args
+        ):
+            attr = _self_attr(node.args[0])
+            if attr is not None:
+                self.len_checked.add(attr)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                attr = _self_attr(tgt.value)
+                if attr is not None:
+                    self.evicted.add(attr)
+        self.generic_visit(node)
+
+
+def _is_wire_fed(node: ast.ClassDef, relpath: str) -> bool:
+    if relpath.startswith("transport/"):
+        return True
+    for stmt in node.body:
+        if (
+            isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and stmt.name in _ENTRY_POINTS
+        ):
+            return True
+    return False
+
+
+class BoundedStateRule(Rule):
+    name = "bounded-state"
+    description = (
+        "containers grown by wire-message handlers carry an eviction, "
+        "bound-check, or validator-set-key witness (no remotely "
+        "drivable unbounded growth)"
+    )
+    scope = ("protocols/", "transport/", "recover/")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not _is_wire_fed(node, ctx.relpath):
+                continue
+            facts = _ClassFacts()
+            for stmt in node.body:
+                facts.visit(stmt)
+            reported: Set[Tuple[str, int]] = set()
+            for attr, line, col, id_key in facts.growth:
+                if id_key:
+                    continue
+                if attr in facts.evicted or attr in facts.len_checked:
+                    continue
+                if (attr, line) in reported:
+                    continue
+                if ctx.suppressed(self.name, line):
+                    continue
+                reported.add((attr, line))
+                out.append(
+                    Violation(
+                        rule=self.name,
+                        path=ctx.relpath,
+                        line=line,
+                        col=col,
+                        message=(
+                            f"{node.name}.{attr} grows on a wire-fed "
+                            "path with no eviction "
+                            "(pop/del/clear/re-assign), len() bound "
+                            "check, or validator-set key in the class "
+                            "— remotely drivable unbounded growth"
+                        ),
+                    )
+                )
+        out.sort(key=lambda v: (v.path, v.line, v.col))
+        return out

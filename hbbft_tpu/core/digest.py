@@ -1,0 +1,200 @@
+"""Canonical state fingerprints for protocol state machines.
+
+``badgermc`` (``analysis/modelcheck.py``) deduplicates explored network
+states by hash, so the fingerprint must be *canonical*: two states that
+are behaviourally identical must encode to the same bytes even when
+they were built along different delivery schedules.  Pickle bytes are
+not canonical — the in-memory run shares sub-objects across containers
+while a replayed run deserializes every message independently (same
+values, different memo graph), and dict/set insertion order varies with
+arrival order.  This module walks the values instead:
+
+- primitives are tag + value framed encodings;
+- lists/tuples/deques keep their order (it is real state — a queue's
+  order is behaviour);
+- dict entries and set elements are sorted by their *encoded* bytes
+  (insertion order is an artifact of the schedule, and every
+  order-sensitive consumer in ``protocols/`` iterates in canonical
+  order — see the ``ordered-iter`` rule and the modelcheck regression
+  tests);
+- ``random.Random`` encodes its ``getstate()`` tuple;
+- arbitrary objects encode as qualified type name + their
+  ``__getstate__()`` (which ``NetworkInfo`` et al. already use to
+  exclude process-local backends), falling back to ``__dict__`` /
+  ``__slots__``.
+
+``snapshot()``/``restore()`` are the paired byte-serialization: plain
+pickle (protocol 5), suitable for checkpoint/clone of backend-free
+state.  Deployments holding a crypto backend go through
+``harness.checkpoint`` which re-injects ``ops`` on load.
+"""
+
+from __future__ import annotations
+
+import collections
+import enum
+import hashlib
+import pickle
+import random
+import struct
+from typing import Any
+
+_DEPTH_LIMIT = 200
+
+
+class DigestError(TypeError):
+    """State contains a value the canonical walk cannot encode."""
+
+
+def _frame(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack(">Q", len(payload)) + payload
+
+
+def _encode(obj: Any, depth: int, stack: set, memo: dict) -> bytes:
+    if depth > _DEPTH_LIMIT:
+        raise DigestError("state nesting exceeds the digest depth limit")
+    if obj is None:
+        return b"N"
+    if obj is True:
+        return b"T"
+    if obj is False:
+        return b"F"
+    t = type(obj)
+    if t is int:
+        mag = obj.to_bytes((obj.bit_length() + 8) // 8, "big", signed=True)
+        return _frame(b"i", mag)
+    if t is float:
+        return b"f" + struct.pack(">d", obj)
+    if t is str:
+        return _frame(b"s", obj.encode("utf-8"))
+    if t is bytes:
+        return _frame(b"b", obj)
+    if t is bytearray:
+        return _frame(b"b", bytes(obj))
+    # One walk never mutates state, so a sub-object appearing twice
+    # (protocol instances all share one NetworkInfo, queues share
+    # message objects) encodes to the same bytes — memoize by id for
+    # the duration of this walk.  The memo also keeps every visited
+    # object alive, so ids cannot be recycled mid-walk.
+    # the address never reaches the encoding — it only keys the
+    # per-walk memo, whose hits are byte-identical re-emissions
+    oid = id(obj)  # lint: ok(determinism)
+    hit = memo.get(oid)
+    if hit is not None:
+        return hit[0]
+    if t in (list, tuple) or t is collections.deque:
+        tag = {list: b"l", tuple: b"t"}.get(t, b"q")
+        parts = []
+        if oid in stack:
+            raise DigestError("cyclic state cannot be fingerprinted")
+        stack.add(oid)
+        try:
+            for item in obj:
+                parts.append(_encode(item, depth + 1, stack, memo))
+        finally:
+            stack.discard(oid)
+        enc = _frame(tag, b"".join(parts))
+        memo[oid] = (enc, obj)
+        return enc
+    if t is dict:
+        if oid in stack:
+            raise DigestError("cyclic state cannot be fingerprinted")
+        stack.add(oid)
+        try:
+            entries = sorted(
+                _frame(b"k", _encode(k, depth + 1, stack, memo))
+                + _encode(v, depth + 1, stack, memo)
+                for k, v in obj.items()
+            )
+        finally:
+            stack.discard(oid)
+        enc = _frame(b"d", b"".join(entries))
+        memo[oid] = (enc, obj)
+        return enc
+    if t in (set, frozenset):
+        elems = sorted(_encode(e, depth + 1, stack, memo) for e in obj)
+        enc = _frame(b"e", b"".join(elems))
+        memo[oid] = (enc, obj)
+        return enc
+    if isinstance(obj, enum.Enum):
+        # identity is (enum class, member name); the default
+        # __getstate__ walk would pull in the class mappingproxy
+        qual = f"{t.__module__}.{t.__qualname__}.{obj.name}"
+        return _frame(b"m", qual.encode("utf-8"))
+    if isinstance(obj, random.Random):
+        return _frame(b"r", _encode(obj.getstate(), depth + 1, stack, memo))
+    try:
+        import numpy as _np
+    except Exception:  # pragma: no cover - numpy is in the image
+        _np = None
+    if _np is not None and isinstance(obj, _np.ndarray):
+        head = f"{obj.dtype.str}|{obj.shape}".encode("ascii")
+        enc = _frame(b"a", _frame(b"h", head) + obj.tobytes())
+        memo[oid] = (enc, obj)
+        return enc
+    # Generic object: qualified type name + its state.  Python 3.11+
+    # gives every object a default __getstate__ (dict, or a
+    # (dict, slots) pair); classes with process-local members
+    # (NetworkInfo's ops) override it to exclude them — exactly the
+    # exclusion a canonical fingerprint wants.
+    qual = f"{t.__module__}.{t.__qualname__}"
+    getstate = getattr(obj, "__getstate__", None)
+    if getstate is not None:
+        try:
+            state = getstate()
+        except Exception as exc:
+            raise DigestError(f"{qual}.__getstate__() failed: {exc!r}")
+    else:  # pre-3.11 object without __getstate__
+        state = getattr(obj, "__dict__", None)
+        slots = []
+        for klass in t.__mro__:
+            s = getattr(klass, "__slots__", ())
+            slots.extend((s,) if isinstance(s, str) else s)
+        if slots:
+            state = (
+                state,
+                {s: getattr(obj, s) for s in slots if hasattr(obj, s)},
+            )
+        elif state is None:
+            raise DigestError(f"cannot fingerprint stateless {qual} object")
+    if oid in stack:
+        raise DigestError("cyclic state cannot be fingerprinted")
+    stack.add(oid)
+    try:
+        body = _encode(state, depth + 1, stack, memo)
+    finally:
+        stack.discard(oid)
+    enc = _frame(b"o", _frame(b"n", qual.encode("utf-8")) + body)
+    memo[oid] = (enc, obj)
+    return enc
+
+
+def canonical_bytes(obj: Any) -> bytes:
+    """The canonical encoding of ``obj`` (mainly for tests; prefer
+    :func:`fingerprint` — states are compared by hash)."""
+    return _encode(obj, 0, set(), {})
+
+
+def fingerprint(obj: Any) -> bytes:
+    """A 32-byte canonical digest of ``obj``'s state.  Equal for
+    behaviourally-equal states regardless of construction order or
+    object-graph sharing; different (up to hash collision) otherwise."""
+    return hashlib.sha256(_encode(obj, 0, set(), {})).digest()
+
+
+def state_eq(a: Any, b: Any) -> bool:
+    """Structural state equality via canonical fingerprints."""
+    return fingerprint(a) == fingerprint(b)
+
+
+def snapshot(obj: Any) -> bytes:
+    """Serialize state for later :func:`restore` (pickle protocol 5;
+    backends are excluded by the owning classes' ``__getstate__``)."""
+    return pickle.dumps(obj, protocol=5)
+
+
+def restore(blob: bytes) -> Any:
+    """Inverse of :func:`snapshot`.  Restored state is backend-free;
+    callers that need a live crypto backend re-inject it via
+    ``harness.checkpoint`` / ``crypto.backend.restore_backend``."""
+    return pickle.loads(blob)

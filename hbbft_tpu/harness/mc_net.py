@@ -1,0 +1,909 @@
+"""Explorer network state for badgermc (``analysis/modelcheck.py``).
+
+The model checker needs a network it can steer one delivery at a time,
+snapshot, restore, fingerprint, and replay.  This module builds that on
+the existing :class:`~.network.TestNetwork` machinery: a hold-everything
+``message_filter`` turns the harness into a *manual* network — every
+emitted message lands in ``held_messages`` instead of a node queue, and
+the explorer drains them into per-link FIFO queues keyed ``(sender,
+recipient)``.  Delivery order *within* a link is fixed (that is the
+transport's guarantee — see ``transport/``'s ordered streams); delivery
+order *across* links is the whole schedule space.
+
+An exploration step is an **action** — a JSON-serializable tuple:
+
+- ``("deliver", s, r, seq)`` — deliver the head of link ``(s, r)``
+  (``seq`` is the message's per-link emission index, pinned so replays
+  fail loudly instead of silently delivering a different message);
+- ``("drop", s, r, seq)`` / ``("dup", s, r, seq)`` /
+  ``("reorder", s, r, seq)`` — adversarial link actions, only on links
+  *from* a corrupt sender (the Byzantine budget is ``cfg.corrupt``
+  nodes, ids chosen as the highest ``corrupt`` ids);
+- ``("forge", c, r, kind)`` — corrupt node ``c`` injects a crafted
+  message to ``r``: a forged decryption share, a malformed (non-bool)
+  Term payload, or an equivocating BVal (conflicting ``bval-true`` /
+  ``bval-false`` forgeries to different recipients *are* equivocation).
+
+Invariants are executable predicates over the live state
+(:func:`check_invariants`), evaluated by the explorer after every
+action; :func:`state_key` is the canonical fingerprint dedup keys on
+(built on ``core.digest`` — dict/set order never leaks in).  Everything
+here is deterministic: same config + same action list ⇒ byte-identical
+end state (``step-purity`` and ``determinism`` lint rules guarantee the
+protocol side; this module keeps its own bookkeeping canonical).
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+import json
+import os
+import random
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.digest import fingerprint
+from ..core.serialize import _BY_CLASS, dumps, loads
+from .network import MessageScheduler, SilentAdversary, TestNetwork
+
+PROTOCOLS = (
+    "honey_badger",
+    "common_subset",
+    "agreement",
+    "sbv_broadcast",
+    "common_coin",
+)
+
+# crafted-injection kinds available per protocol stack (see _forge)
+FORGE_KINDS: Dict[str, Tuple[str, ...]] = {
+    "honey_badger": ("badshare", "nonbool-term"),
+    "common_subset": ("nonbool-term", "bval-true", "bval-false"),
+    "agreement": ("nonbool-term", "bval-true", "bval-false"),
+    "sbv_broadcast": ("bval-true", "bval-false"),
+    "common_coin": ("badcoinshare",),
+}
+
+Action = Tuple  # ("deliver"|"drop"|"dup"|"reorder", s, r, seq) | ("forge", c, r, kind)
+
+
+@dataclass
+class MCConfig:
+    """Pinned, JSON-round-trippable model-checking configuration."""
+
+    protocol: str = "honey_badger"
+    n: int = 4
+    corrupt: int = 0  # number of corrupt nodes (<= f), highest ids
+    depth: int = 6  # DFS depth bound (actions per schedule)
+    max_states: int = 20_000
+    byz_budget: int = 2  # adversarial actions per schedule
+    epochs: int = 1  # honey_badger epochs to drive
+    reveal_mode: str = "inline"
+    mock: bool = True  # mock crypto (real BLS opt-in)
+    seed: int = 0xBADC0DE  # network/crypto seed
+    prefix_steps: int = 0  # seeded full-delivery prefix before DFS
+    prefix_seed: int = 1
+    probes: int = 3  # seeded full-delivery liveness probes
+    probe_steps: int = 4000
+    shrink_window: int = 12  # ddmin suffix window (=> trace <= window)
+
+    def __post_init__(self):
+        if self.protocol not in PROTOCOLS:
+            raise ValueError(f"unknown protocol stack {self.protocol!r}")
+        f = (self.n - 1) // 3
+        if self.corrupt > f:
+            raise ValueError(f"corrupt={self.corrupt} exceeds f={f} at n={self.n}")
+        if self.reveal_mode not in ("inline", "ordered"):
+            raise ValueError(f"unknown reveal_mode {self.reveal_mode!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MCConfig":
+        return cls(**d)
+
+    @property
+    def corrupt_ids(self) -> Tuple[int, ...]:
+        return tuple(range(self.n - self.corrupt, self.n))
+
+    @property
+    def honest_ids(self) -> Tuple[int, ...]:
+        return tuple(range(self.n - self.corrupt))
+
+
+def _hold_all(sender, recipient, message) -> bool:
+    """message_filter that holds every message for manual delivery."""
+    return False
+
+
+def _new_algo_fn(cfg: MCConfig):
+    p = cfg.protocol
+    if p == "honey_badger":
+        from ..protocols.honey_badger import HoneyBadger
+
+        return lambda ni: HoneyBadger(ni, reveal_mode=cfg.reveal_mode)
+    if p == "common_subset":
+        from ..protocols.common_subset import CommonSubset
+
+        return lambda ni: CommonSubset(ni, 0)
+    if p == "agreement":
+        from ..protocols.agreement import Agreement
+
+        return lambda ni: Agreement(ni, 0, 0)
+    if p == "sbv_broadcast":
+        from ..protocols.sbv_broadcast import SbvBroadcast
+
+        return lambda ni: SbvBroadcast(ni)
+    from ..protocols.common_coin import CommonCoin
+
+    return lambda ni: CommonCoin(ni, b"badgermc-coin")
+
+
+def _input_for(cfg: MCConfig, nid: int) -> Any:
+    """Each node's protocol input.  Booleans are mixed (low half True)
+    so the agreement stacks explore disagreement resolution, not just
+    the unanimous fast path."""
+    p = cfg.protocol
+    if p == "common_subset":
+        return b"mc-contrib-%d" % nid
+    if p in ("agreement", "sbv_broadcast"):
+        return nid < (cfg.n + 1) // 2
+    if p == "common_coin":
+        return None
+    raise AssertionError(p)  # honey_badger inputs flow via _auto_input
+
+
+class MCNet:
+    """The mutable exploration state: network + per-link pending queues
+    + adversary ledgers + derived invariant trackers.  Picklable (the
+    explorer backtracks by snapshot/restore)."""
+
+    def __init__(self, cfg: MCConfig):
+        self.cfg = cfg
+        rng = random.Random(cfg.seed)
+        sched = MessageScheduler(MessageScheduler.FIRST, random.Random(cfg.seed ^ 1))
+        self.net = TestNetwork(
+            cfg.n,
+            0,
+            lambda adv: SilentAdversary(sched),
+            _new_algo_fn(cfg),
+            rng,
+            mock_crypto=cfg.mock,
+            message_filter=_hold_all,
+        )
+        # (sender, recipient) -> deque[(seq, message, fingerprint)];
+        # empty links are removed so the fingerprint stays canonical.
+        # Messages are immutable once emitted (frozen wire dataclasses),
+        # so each is fingerprinted once, at drain time.
+        self.pending: Dict[Tuple[Any, Any], collections.deque] = {}
+        self.sent: Dict[Tuple[Any, Any], int] = {}
+        self.duped: set = set()  # (s, r, seq) duplicated once each
+        self.injected: set = set()  # (c, r, kind) forged once each
+        self.adv_spent = 0
+        self.crashed: Optional[Tuple[Any, str]] = None
+        self.delivered = 0
+        # nid -> epochs whose ACS instance was seen decided (monotone —
+        # survives the protocol's own epoch GC, so the no-premature-
+        # commit predicate can always look the decision up)
+        self.acs_decided: Dict[Any, set] = {nid: set() for nid in self.net.nodes}
+        self.wire_errors: List[Dict[str, Any]] = []
+        # per-node fingerprint cache: a node's canonical digest changes
+        # only when that node handles a message/input, so state_key
+        # re-walks only the dirty nodes (None = dirty)
+        self._node_fp: Dict[Any, Optional[bytes]] = {
+            nid: None for nid in self.net.nodes
+        }
+        if cfg.protocol == "honey_badger":
+            for nid in sorted(self.net.nodes):
+                self._auto_input(nid)
+        else:
+            for nid in sorted(self.net.nodes):
+                self.net.input(nid, _input_for(cfg, nid))
+        self._drain()
+        for nid in sorted(self.net.nodes):
+            self._track(nid)
+
+    # -- internal plumbing ------------------------------------------------
+
+    def _auto_input(self, nid) -> None:
+        """Model an always-ready client: propose a deterministic
+        contribution whenever a HoneyBadger node enters an epoch below
+        the configured horizon without input."""
+        if self.cfg.protocol != "honey_badger":
+            return
+        node = self.net.nodes.get(nid)
+        if node is None:
+            return
+        algo = node.algo
+        while algo.epoch < self.cfg.epochs and not algo.has_input_flag:
+            self.net.input(nid, [b"mc-%d-%d" % (nid, algo.epoch)])
+
+    def _wire_check(self, sender, message) -> None:
+        """Every emitted message must be a registered wire type whose
+        canonical serialization round-trips (the executable form of
+        wire_manifest.json conformance; the manifest itself is checked
+        once per type in _manifest_ok)."""
+        try:
+            blob = dumps(message)
+            if dumps(loads(blob)) != blob:
+                self.wire_errors.append(
+                    _viol(
+                        "wire-form",
+                        sender,
+                        f"{type(message).__name__} does not round-trip "
+                        f"through the canonical codec",
+                    )
+                )
+                return
+        except Exception as exc:
+            self.wire_errors.append(
+                _viol(
+                    "wire-form",
+                    sender,
+                    f"{type(message).__name__} failed canonical "
+                    f"serialization: {exc!r}",
+                )
+            )
+            return
+        problem = _manifest_problem(type(message))
+        if problem is not None:
+            self.wire_errors.append(_viol("wire-form", sender, problem))
+
+    def _drain(self) -> None:
+        """Move everything the filter held into the per-link queues."""
+        held, self.net.held_messages = self.net.held_messages, []
+        for sender, recipient, message in held:
+            if recipient == TestNetwork.OBSERVER_ID:
+                continue  # observer path is exercised by the scenarios
+            if sender in self.cfg.honest_ids:
+                self._wire_check(sender, message)
+            link = (sender, recipient)
+            seq = self.sent.get(link, 0)
+            self.sent[link] = seq + 1
+            self.pending.setdefault(link, collections.deque()).append(
+                (seq, message, fingerprint(message))
+            )
+
+    def _track(self, nid) -> None:
+        node = self.net.nodes.get(nid)
+        if node is None:
+            return
+        p = self.cfg.protocol
+        if p == "honey_badger":
+            for ep, cs in node.algo.common_subsets.items():
+                if cs.decided:
+                    self.acs_decided[nid].add(ep)
+        elif p == "common_subset":
+            if node.algo.decided:
+                self.acs_decided[nid].add(0)
+
+    def _deliver_to(self, recipient, sender, message) -> None:
+        node = self.net.nodes[recipient]
+        self._node_fp[recipient] = None
+        node.queue.append((sender, message))
+        try:
+            node.handle_message()
+        except Exception as exc:  # a crash IS the finding — keep it
+            node.queue.clear()
+            node.messages.clear()
+            self.crashed = (recipient, f"{type(exc).__name__}: {exc}")
+            return
+        msgs = list(node.messages)
+        node.messages.clear()
+        self.net.dispatch_messages(recipient, msgs)
+        self.delivered += 1
+        self._auto_input(recipient)
+        self._drain()
+        self._track(recipient)
+
+    # -- the action interface ---------------------------------------------
+
+    def enabled_actions(self) -> List[Action]:
+        """All actions enabled in this state, in canonical order."""
+        if self.crashed is not None:
+            return []
+        cfg = self.cfg
+        corrupt = set(cfg.corrupt_ids)
+        acts: List[Action] = []
+        budget = self.adv_spent < cfg.byz_budget
+        for link in sorted(self.pending):
+            dq = self.pending[link]
+            s, r = link
+            head_seq = dq[0][0]
+            acts.append(("deliver", s, r, head_seq))
+            if s in corrupt and budget:
+                acts.append(("drop", s, r, head_seq))
+                if (s, r, head_seq) not in self.duped:
+                    acts.append(("dup", s, r, head_seq))
+                if len(dq) > 1:
+                    acts.append(("reorder", s, r, dq[1][0]))
+        if budget:
+            for c in sorted(corrupt):
+                for r in range(cfg.n):
+                    if r == c:
+                        continue
+                    for kind in FORGE_KINDS[cfg.protocol]:
+                        if (c, r, kind) not in self.injected:
+                            acts.append(("forge", c, r, kind))
+        return acts
+
+    def apply_action(self, act: Action) -> bool:
+        """Execute one action.  Returns False (state unchanged) when the
+        action is infeasible — replays/shrinks use this to reject
+        candidate schedules that broke a dependency."""
+        kind = act[0]
+        if kind == "forge":
+            _, c, r, fkind = act
+            if (
+                c not in self.cfg.corrupt_ids
+                or (c, r, fkind) in self.injected
+                or r not in self.net.nodes
+            ):
+                return False
+            message = _forge(self.cfg, fkind, c)
+            if message is None:
+                return False
+            self.injected.add((c, r, fkind))
+            self.adv_spent += 1
+            self._deliver_to(r, c, message)
+            return True
+        _, s, r, seq = act
+        dq = self.pending.get((s, r))
+        if dq is None:
+            return False
+        if kind == "deliver":
+            if dq[0][0] != seq:
+                return False
+            _, message, _fp = dq.popleft()
+            if not dq:
+                del self.pending[(s, r)]
+            self._deliver_to(r, s, message)
+            return True
+        if s not in self.cfg.corrupt_ids:
+            return False
+        if kind == "drop":
+            if dq[0][0] != seq:
+                return False
+            dq.popleft()
+            if not dq:
+                del self.pending[(s, r)]
+            self.adv_spent += 1
+            return True
+        if kind == "dup":
+            if dq[0][0] != seq or (s, r, seq) in self.duped:
+                return False
+            self.duped.add((s, r, seq))
+            self.adv_spent += 1
+            self._deliver_to(r, s, copy.deepcopy(dq[0][1]))
+            return True
+        if kind == "reorder":
+            if len(dq) < 2 or dq[1][0] != seq:
+                return False
+            _, message, _fp = dq[1]
+            del dq[1]
+            self.adv_spent += 1
+            self._deliver_to(r, s, message)
+            return True
+        return False
+
+
+# -- canonical state fingerprint -------------------------------------------
+
+
+def state_key(mc: MCNet) -> bytes:
+    """Canonical digest of the exploration state — nodes (algorithm
+    state, outputs, faults), per-link pending queues (order is real
+    state), and the adversary ledgers.  Two schedules that converge to
+    the same digest have behaviourally identical futures.  Node digests
+    are cached per node (only the delivery's recipient is re-walked)
+    and message digests were pinned at emission."""
+    parts = []
+    for nid, node in sorted(mc.net.nodes.items()):
+        fp = mc._node_fp.get(nid)
+        if fp is None:
+            fp = fingerprint(
+                (node.algo, tuple(node.queue), node.outputs, node.faults)
+            )
+            mc._node_fp[nid] = fp
+        parts.append((nid, fp))
+    view = (
+        "badgermc-state",
+        parts,
+        {
+            link: tuple((seq, fp) for seq, _msg, fp in dq)
+            for link, dq in mc.pending.items()
+        },
+        sorted(mc.duped),
+        sorted(mc.injected),
+        mc.adv_spent,
+        mc.crashed,
+        sorted((nid, tuple(sorted(eps))) for nid, eps in mc.acs_decided.items()),
+    )
+    return fingerprint(view)
+
+
+# -- crafted Byzantine messages ---------------------------------------------
+
+
+def _forge(cfg: MCConfig, kind: str, c) -> Any:
+    """Build corrupt node ``c``'s crafted injection.  Conflicting
+    ``bval-true``/``bval-false`` sends to different recipients model an
+    equivocating proposer; ``badshare`` is the forged decryption share;
+    ``nonbool-term`` the malformed Term payload the bool-validation
+    guard must fault (2, not 1: ``hash(1) == hash(True)`` would let an
+    unguarded bool-keyed table resolve it silently)."""
+    p = cfg.protocol
+    if kind == "badshare":
+        from ..crypto.mock import MockDecryptionShare
+        from ..protocols.honey_badger import HbDecryptionShare, HoneyBadgerMessage
+
+        share = MockDecryptionShare(b"\x00" * 32, b"\xff" * 32)
+        return HoneyBadgerMessage(0, HbDecryptionShare(c, share))
+    if kind == "badcoinshare":
+        from ..crypto.mock import MockSignatureShare
+        from ..protocols.common_coin import CommonCoinMessage
+
+        return CommonCoinMessage(MockSignatureShare(b"\x00" * 32, b"\x01" * 32))
+    if kind == "nonbool-term":
+        from ..protocols.agreement import AgreementMessage, TermContent
+
+        inner = AgreementMessage(0, TermContent(2))
+        return _wrap_agreement(cfg, inner, c)
+    if kind in ("bval-true", "bval-false"):
+        from ..protocols.agreement import AgreementMessage, SbvContent
+        from ..protocols.sbv_broadcast import BVal
+
+        bval = BVal(kind == "bval-true")
+        if p == "sbv_broadcast":
+            return bval
+        return _wrap_agreement(cfg, AgreementMessage(0, SbvContent(bval)), c)
+    return None
+
+
+def _wrap_agreement(cfg: MCConfig, msg, proposer) -> Any:
+    if cfg.protocol == "agreement":
+        return msg
+    from ..protocols.common_subset import CsAgreement
+
+    cs_msg = CsAgreement(proposer, msg)
+    if cfg.protocol == "common_subset":
+        return cs_msg
+    from ..protocols.honey_badger import HbCommonSubset, HoneyBadgerMessage
+
+    return HoneyBadgerMessage(0, HbCommonSubset(cs_msg))
+
+
+# -- wire-manifest conformance (static, cached per type) --------------------
+
+_MANIFEST_CACHE: Dict[type, Optional[str]] = {}
+_MANIFEST_TYPES: Optional[Dict[str, Any]] = None
+
+
+def _manifest_problem(t: type) -> Optional[str]:
+    if t in _MANIFEST_CACHE:
+        return _MANIFEST_CACHE[t]
+    global _MANIFEST_TYPES
+    if _MANIFEST_TYPES is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "analysis",
+            "wire_manifest.json",
+        )
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                _MANIFEST_TYPES = json.load(fh).get("types", {})
+        except OSError:  # manifest absent: registry check only
+            _MANIFEST_TYPES = {}
+    problem: Optional[str] = None
+    reg = _BY_CLASS.get(t)
+    if reg is None:
+        problem = f"{t.__name__} is not a registered wire type"
+    elif _MANIFEST_TYPES:
+        entry = _MANIFEST_TYPES.get(reg[0])
+        if entry is None:
+            problem = (
+                f"wire type {reg[0]!r} ({t.__name__}) missing from "
+                f"wire_manifest.json"
+            )
+    _MANIFEST_CACHE[t] = problem
+    return problem
+
+
+# -- invariants -------------------------------------------------------------
+
+
+def _viol(kind: str, node, detail: str) -> Dict[str, Any]:
+    return {"kind": kind, "node": node, "detail": detail}
+
+
+def check_invariants(mc: MCNet) -> List[Dict[str, Any]]:
+    """Evaluate every safety invariant against the live state.  Returns
+    violation records (empty list = state is safe)."""
+    cfg = mc.cfg
+    out: List[Dict[str, Any]] = list(mc.wire_errors)
+    honest = [h for h in cfg.honest_ids if h in mc.net.nodes]
+    corrupt = set(cfg.corrupt_ids)
+    if mc.crashed is not None and mc.crashed[0] in cfg.honest_ids:
+        out.append(
+            _viol(
+                "crash",
+                mc.crashed[0],
+                f"honest node raised instead of faulting: {mc.crashed[1]}",
+            )
+        )
+    # fault attribution: honest nodes may only accuse actually-corrupt
+    # peers (with corrupt=0, any fault is a misattribution)
+    for nid in honest:
+        for fault in mc.net.nodes[nid].faults:
+            if fault.node_id not in corrupt:
+                out.append(
+                    _viol(
+                        "fault-attribution",
+                        nid,
+                        f"accused non-faulty {fault.node_id!r} of "
+                        f"{fault.kind.name}",
+                    )
+                )
+    p = cfg.protocol
+    if p == "honey_badger":
+        out.extend(_check_honey_badger(mc, honest))
+    elif p == "common_subset":
+        out.extend(_check_common_subset_outputs(mc, honest))
+        for nid in honest:
+            out.extend(_check_acs_instance(mc.net.nodes[nid].algo, nid, 0))
+    elif p in ("agreement", "common_coin"):
+        out.extend(_check_single_value_agreement(mc, honest, p))
+    return out
+
+
+def _check_single_value_agreement(mc, honest, p) -> List[Dict[str, Any]]:
+    decisions = {
+        nid: mc.net.nodes[nid].outputs[0]
+        for nid in honest
+        if mc.net.nodes[nid].outputs
+    }
+    if len(set(decisions.values())) > 1:
+        return [
+            _viol(
+                "agreement" if p == "agreement" else "coin-agreement",
+                sorted(decisions)[0],
+                f"honest nodes decided differently: {decisions!r}",
+            )
+        ]
+    return []
+
+
+def _check_acs_instance(cs, nid, epoch) -> List[Dict[str, Any]]:
+    """ACS validity as a state predicate: once every per-proposer BA in
+    an instance has decided, fewer than N-f accepted proposers is a
+    dead state (nothing can raise the count) and a direct violation of
+    the >= N-f contributions guarantee."""
+    n = len(cs.netinfo.all_ids)
+    if len(cs.agreement_results) == n:
+        accepted = sum(1 for v in cs.agreement_results.values() if v)
+        if accepted < cs.netinfo.num_correct:
+            return [
+                _viol(
+                    "acs-validity",
+                    nid,
+                    f"epoch {epoch}: all {n} agreements decided but only "
+                    f"{accepted} proposers accepted "
+                    f"(< N-f = {cs.netinfo.num_correct})",
+                )
+            ]
+    return []
+
+
+def _check_common_subset_outputs(mc, honest) -> List[Dict[str, Any]]:
+    outs = {
+        nid: mc.net.nodes[nid].outputs[0]
+        for nid in honest
+        if mc.net.nodes[nid].outputs
+    }
+    digests = {nid: fingerprint(v) for nid, v in outs.items()}
+    if len(set(digests.values())) > 1:
+        return [
+            _viol(
+                "acs-agreement",
+                sorted(outs)[0],
+                f"honest ACS outputs disagree across nodes {sorted(outs)}",
+            )
+        ]
+    return []
+
+
+def _check_honey_badger(mc, honest) -> List[Dict[str, Any]]:
+    from ..protocols.honey_badger import Batch, OrderedBatch
+
+    out: List[Dict[str, Any]] = []
+    batches: Dict[int, Dict[Any, bytes]] = {}
+    ordered: Dict[Any, List[Any]] = {}
+    for nid in honest:
+        node = mc.net.nodes[nid]
+        algo = node.algo
+        quorum = algo.netinfo.num_correct
+        # ACS decisions witnessed by the monotone tracker — plus the
+        # commit records themselves: an ACS that never decided cannot
+        # have delivered >= N-f contributions, so a full commit record
+        # is its own decision witness.  (The tracker alone misses the
+        # single-step decide -> decrypt -> commit -> GC path, where the
+        # subset instance is removed inside the very step that emits
+        # the batch.)
+        decided = set(mc.acs_decided[nid])
+        for o in node.outputs:
+            if isinstance(o, Batch) and len(o.contributions) >= quorum:
+                decided.add(o.epoch)
+            elif isinstance(o, OrderedBatch) and len(o.proposers) >= quorum:
+                decided.add(o.epoch)
+        for o in node.outputs:
+            if isinstance(o, Batch):
+                if o.epoch not in decided:
+                    out.append(
+                        _viol(
+                            "premature-commit",
+                            nid,
+                            f"Batch for epoch {o.epoch} ("
+                            f"{len(o.contributions)} contributions) output "
+                            f"without a decided ACS",
+                        )
+                    )
+                batches.setdefault(o.epoch, {})[nid] = dumps(o)
+            elif isinstance(o, OrderedBatch):
+                if o.epoch not in decided:
+                    out.append(
+                        _viol(
+                            "premature-commit",
+                            nid,
+                            f"OrderedBatch for epoch {o.epoch} ("
+                            f"{len(o.proposers)} proposers) output "
+                            f"without a decided ACS",
+                        )
+                    )
+                ordered.setdefault(nid, []).append(o)
+        # no plaintext reveal before the ACS gate
+        for ep, contribs in algo.decrypted_contributions.items():
+            if contribs and ep not in decided:
+                out.append(
+                    _viol(
+                        "premature-reveal",
+                        nid,
+                        f"plaintext decrypted for epoch {ep} before its "
+                        f"ACS decided",
+                    )
+                )
+        for ep, cs in algo.common_subsets.items():
+            out.extend(_check_acs_instance(cs, nid, ep))
+    # all honest nodes that output a batch for epoch e output
+    # byte-identical batches
+    for ep, by_node in sorted(batches.items()):
+        if len(set(by_node.values())) > 1:
+            out.append(
+                _viol(
+                    "batch-identity",
+                    sorted(by_node)[0],
+                    f"epoch {ep} batches differ across honest nodes "
+                    f"{sorted(by_node)}",
+                )
+            )
+    # ordered-commit: per-node seqs contiguous from 0, and for each
+    # epoch all honest nodes agree on (seq, digest, proposers)
+    per_epoch: Dict[int, set] = {}
+    for nid, obs in sorted(ordered.items()):
+        seqs = [o.seq for o in obs]
+        if seqs != list(range(len(seqs))):
+            out.append(
+                _viol(
+                    "ordered-seq",
+                    nid,
+                    f"commit seqs not contiguous from 0: {seqs}",
+                )
+            )
+        for o in obs:
+            per_epoch.setdefault(o.epoch, set()).add(
+                (o.seq, o.digest, tuple(o.proposers))
+            )
+    for ep, records in sorted(per_epoch.items()):
+        if len(records) > 1:
+            out.append(
+                _viol(
+                    "ordered-agreement",
+                    None,
+                    f"epoch {ep} ordered commits disagree across honest "
+                    f"nodes: {sorted(records)!r}",
+                )
+            )
+    return out
+
+
+def live_done(mc: MCNet) -> bool:
+    """Bounded-liveness goal: every honest node has committed (for
+    HoneyBadger, one batch/ordered-commit per configured epoch)."""
+    cfg = mc.cfg
+    for nid in cfg.honest_ids:
+        node = mc.net.nodes.get(nid)
+        if node is None:
+            return False
+        if cfg.protocol == "honey_badger":
+            from ..protocols.honey_badger import Batch, OrderedBatch
+
+            want = Batch if cfg.reveal_mode == "inline" else OrderedBatch
+            epochs = {o.epoch for o in node.outputs if isinstance(o, want)}
+            if len(epochs) < cfg.epochs:
+                return False
+        elif not node.outputs:
+            return False
+    return True
+
+
+# -- schedules, replay, repro files -----------------------------------------
+
+
+def partition_lag(rng: random.Random, n: int) -> frozenset:
+    """A random network cut for :func:`random_schedule`'s ``lagged``
+    parameter: the set of directed links crossing a random half/half
+    node partition."""
+    ids = list(range(n))
+    grp = set(rng.sample(ids, n // 2))
+    return frozenset(
+        (s, r)
+        for s in ids
+        for r in ids
+        if s != r and ((s in grp) != (r in grp))
+    )
+
+
+def random_schedule(
+    mc: MCNet,
+    rng: random.Random,
+    steps: int,
+    deliver_only: bool = True,
+    lagged: Optional[frozenset] = None,
+    p_lagged: float = 0.1,
+) -> Tuple[List[Action], List[Dict[str, Any]]]:
+    """Drive a seeded random full-delivery schedule (every pending
+    message is eventually delivered — the premise of the bounded-
+    liveness claim).  Stops at the first violation, at quiescence, at
+    the liveness goal, or after ``steps`` actions.
+
+    ``lagged`` is an optional set of ``(sender, recipient)`` links to
+    deprioritize: a delivery on a lagged link is only picked with
+    probability ``p_lagged`` while non-lagged deliveries are enabled.
+    Uniform random schedules converge all nodes together and miss bugs
+    that need *asymmetric* progress (one side of a partition racing
+    ahead of the other); a lagged cut keeps full delivery — so the
+    liveness claim still applies — while exploring exactly those
+    schedules."""
+    trace: List[Action] = []
+    while len(trace) < steps:
+        acts = mc.enabled_actions()
+        if deliver_only:
+            acts = [a for a in acts if a[0] == "deliver"]
+        if not acts:
+            break
+        if lagged:
+            slow = [a for a in acts if (a[1], a[2]) in lagged]
+            fast = [a for a in acts if (a[1], a[2]) not in lagged]
+            if fast and not (slow and rng.random() < p_lagged):
+                acts = fast
+            elif slow:
+                acts = slow
+        act = acts[rng.randrange(len(acts))]
+        mc.apply_action(act)
+        trace.append(act)
+        viols = check_invariants(mc)
+        if viols:
+            return trace, viols
+        if live_done(mc):
+            break
+    return trace, []
+
+
+@dataclass
+class ReplayResult:
+    feasible: bool
+    applied: int
+    violations: List[Dict[str, Any]] = field(default_factory=list)
+    violation_index: Optional[int] = None
+    digest: str = ""
+    live: bool = False
+
+
+def run_actions(
+    mc: MCNet, actions: List[Action], check_from: int = 0
+) -> ReplayResult:
+    """Deterministically apply an action list.  Invariants are checked
+    from index ``check_from`` on (a shrink's frozen prefix is known
+    violation-free; skipping it keeps ddmin cheap).  Stops at the first
+    violation or infeasible action."""
+    for i, act in enumerate(actions):
+        if not mc.apply_action(tuple(act)):
+            return ReplayResult(False, i, digest=state_key(mc).hex())
+        if i >= check_from:
+            viols = check_invariants(mc)
+            if viols:
+                return ReplayResult(
+                    True,
+                    i + 1,
+                    violations=viols,
+                    violation_index=i,
+                    digest=state_key(mc).hex(),
+                )
+    return ReplayResult(
+        True,
+        len(actions),
+        digest=state_key(mc).hex(),
+        live=live_done(mc),
+    )
+
+
+def save_repro(
+    path: str,
+    cfg: MCConfig,
+    prefix: List[Action],
+    trace: List[Action],
+    violation: Dict[str, Any],
+    digest: str,
+) -> None:
+    """Write the seeded repro file ``harness/scenarios.py
+    --replay-trace`` re-executes."""
+    data = {
+        "version": 1,
+        "tool": "badgermc",
+        "config": cfg.to_dict(),
+        "prefix": [list(a) for a in prefix],
+        "trace": [list(a) for a in trace],
+        "violation": violation,
+        "final_digest": digest,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def replay_repro(path: str) -> Dict[str, Any]:
+    """Re-execute a repro file.  Returns a summary dict; ``reproduced``
+    is True when the recorded violation kind fires at the recorded
+    position and the end-state digest matches."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    cfg = MCConfig.from_dict(data["config"])
+    actions = [tuple(a) for a in data["prefix"]] + [
+        tuple(a) for a in data["trace"]
+    ]
+    mc = MCNet(cfg)
+    res = run_actions(mc, actions)
+    want = data.get("violation") or {}
+    want_kind = want.get("kind")
+    got_kinds = [v["kind"] for v in res.violations]
+    if want_kind is None or want_kind.startswith("liveness"):
+        # liveness repro: replay the whole schedule to the recorded
+        # (stalled / goal-missing) end state
+        reproduced = (
+            res.feasible
+            and not res.violations
+            and res.digest == data.get("final_digest")
+        )
+    else:
+        # A crash interrupts the handler mid-mutation at a point that
+        # depends on the ambient interpreter stack (RecursionError
+        # especially), so the partial end state is not byte-stable
+        # across processes — reproducing the crash kind at a feasible
+        # position IS the claim.  Every other violation kind must also
+        # land on the recorded end-state digest.
+        state_ok = (
+            want_kind == "crash"
+            or res.digest == data.get("final_digest")
+        )
+        reproduced = res.feasible and want_kind in got_kinds and state_ok
+    return {
+        "reproduced": reproduced,
+        "feasible": res.feasible,
+        "applied": res.applied,
+        "expected": want_kind,
+        "violations": res.violations,
+        "digest": res.digest,
+        "expected_digest": data.get("final_digest"),
+        "config": cfg.to_dict(),
+        "trace_len": len(data["trace"]),
+    }

@@ -1079,50 +1079,18 @@ def _run_flash_crowd(cfg: ScenarioConfig) -> ScenarioResult:
 # -- crash recovery -----------------------------------------------------------
 
 
-def _state_eq(a: Any, b: Any, depth: int = 0) -> bool:
-    """Deep structural equality over algorithm state.  Pickle *bytes*
-    cannot be compared directly: the in-memory run shares sub-objects
-    across containers (one proof's root bytes delivered to many
-    structures) while WAL replay deserializes every message
-    independently — same values, different sharing, different memo
-    graph.  This walks the values."""
-    if depth > 16:
-        return True  # deep tails (rng state etc.) compared by leaf ==
-    if type(a) is not type(b):
-        return False
-    if isinstance(a, (bool, int, float, str, bytes, type(None))):
-        return a == b
-    if isinstance(a, (list, tuple)):
-        return len(a) == len(b) and all(
-            _state_eq(x, y, depth + 1) for x, y in zip(a, b)
-        )
-    if isinstance(a, (set, frozenset)):
-        return a == b
-    if isinstance(a, dict):
-        if set(a) != set(b):
-            return False
-        return all(_state_eq(a[k], b[k], depth + 1) for k in a)
-    if isinstance(a, random.Random):
-        return a.getstate() == b.getstate()
-    import numpy as _np
+def _state_eq(a: Any, b: Any) -> bool:
+    """Deep structural equality over algorithm state, via the canonical
+    fingerprint (``core.digest``).  Pickle *bytes* cannot be compared
+    directly: the in-memory run shares sub-objects across containers
+    (one proof's root bytes delivered to many structures) while WAL
+    replay deserializes every message independently — same values,
+    different sharing, different memo graph.  The canonical walk is
+    sharing- and insertion-order-insensitive, and it is the same digest
+    badgermc keys its state-space dedup on (``DistAlgorithm.state_digest``)."""
+    from ..core.digest import state_eq
 
-    if isinstance(a, _np.ndarray):
-        return bool(_np.array_equal(a, b))
-    da = getattr(a, "__dict__", None)
-    if da is not None:
-        return _state_eq(da, getattr(b, "__dict__", {}), depth + 1)
-    slots: List[str] = []
-    for klass in type(a).__mro__:
-        s = getattr(klass, "__slots__", ())
-        slots.extend((s,) if isinstance(s, str) else s)
-    if slots:
-        return all(
-            _state_eq(
-                getattr(a, s, None), getattr(b, s, None), depth + 1
-            )
-            for s in slots
-        )
-    return a == b
+    return state_eq(a, b)
 
 
 def _hb_batch_key(b: Any) -> Any:
@@ -2086,6 +2054,27 @@ def run_matrix(
     return [run_scenario(nm, cfg) for nm in names]
 
 
+def _replay_trace(path: str, as_json: bool = False) -> int:
+    """Deterministically re-execute a badgermc repro file and check the
+    recorded violation (or final state digest) reproduces."""
+    from .mc_net import replay_repro
+
+    res = replay_repro(path)
+    if as_json:
+        print(json.dumps(res, sort_keys=True))
+    else:
+        cfg = res.get("config", {})
+        print(
+            f"replay {path}: protocol={cfg.get('protocol')} "
+            f"applied={res.get('applied')} action(s), "
+            f"expected={res.get('expected')!r}"
+        )
+        for v in res.get("violations", []):
+            print(f"  reproduced: {v['kind']} at node {v['node']}: {v['detail']}")
+        print("REPRODUCED" if res.get("reproduced") else "NOT REPRODUCED")
+    return 0 if res.get("reproduced") else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m hbbft_tpu.harness.scenarios",
@@ -2128,7 +2117,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="stallcheck budget in seconds (default: "
         "$HBBFT_TPU_STALLCHECK_BUDGET or 0.25)",
     )
+    parser.add_argument(
+        "--replay-trace",
+        metavar="REPRO_FILE",
+        default=None,
+        help="replay a badgermc counterexample file (written by "
+        "python -m hbbft_tpu.analysis --mc --mc-repro PATH) and exit 0 "
+        "iff the recorded violation reproduces bit-exactly",
+    )
     args = parser.parse_args(argv)
+
+    if args.replay_trace is not None:
+        return _replay_trace(args.replay_trace, as_json=args.json)
 
     if args.list:
         for nm in SCENARIOS:

@@ -127,6 +127,24 @@ EVENTS: Dict[str, EventSpec] = {
     # proof obligations checked, how many proved, and the wall cost of
     # the jaxpr abstract interpretation
     "range_check": _spec({"obligations", "proved", "wall"}),
+    # badgermc (additive): one row per bounded model-checking run —
+    # states explored / deduplicated / DPOR-pruned, the exact naive
+    # enumeration size the reduction is measured against, and the wall
+    # cost of the schedule-space search
+    "mc_run": _spec(
+        {"explored", "deduped", "dpor_pruned", "wall"},
+        {
+            "naive",
+            "reduction",
+            "truncated",
+            "probe_runs",
+            "probe_actions",
+            "shrink_replays",
+            "config",
+            "violation",
+            "repro_path",
+        },
+    ),
     # serving gateway (additive): admission decisions, the client-side
     # commit-latency arc, and periodic queue-depth snapshots
     "gateway_admit": _spec({"tenant", "depth"}, {"client", "seq"}),

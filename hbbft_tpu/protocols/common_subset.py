@@ -177,10 +177,13 @@ class CommonSubset(DistAlgorithm):
         delivered_1 = {
             pid for pid, v in self.agreement_results.items() if v
         }
+        # broadcast_results is keyed in arrival order; emit the decided
+        # set in canonical proposer order so the output dict (and the
+        # ciphertext-decrypt walk it seeds) is schedule-independent
         results = {
-            pid: v
-            for pid, v in self.broadcast_results.items()
-            if pid in delivered_1
+            pid: self.broadcast_results[pid]
+            for pid in sorted(delivered_1, key=repr)
+            if pid in self.broadcast_results
         }
         if len(results) == len(delivered_1):
             self.decided = True

@@ -434,7 +434,12 @@ class HoneyBadger(DistAlgorithm):
         incorrect: Set = set()
         faults = FaultLog()
         shares = self.received_shares.get(epoch, {}).get(proposer_id, {})
-        for sender_id, share in shares.items():
+        # dict order is share-arrival order, which differs per schedule
+        # — walk canonically so the fault log (and every downstream
+        # message emission) is schedule-independent
+        for sender_id, share in sorted(
+            shares.items(), key=lambda kv: repr(kv[0])
+        ):
             if not self._verify_decryption_share(
                 sender_id, share, ciphertext
             ):

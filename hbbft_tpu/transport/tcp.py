@@ -211,6 +211,12 @@ class ObTrace:
 
 _ST_TYPES = (SnapReq, SnapMeta, SnapChunk, SnapDone)
 
+# A malicious peer can mint one fault attribution per malformed frame,
+# so the fault log is the one list on the serving plane that grows at
+# attacker rate.  Keep the most recent window; older attributions have
+# already been counted in the obs counters.
+_FAULT_LOG_MAX = 4096
+
 
 def _seq_ok(v: Any) -> bool:
     """Total validator for wire sequence numbers (bool is an int —
@@ -643,6 +649,11 @@ class TcpNode:
                 self._replay_bytes.get(peer, 0) - len(frame)
             )
 
+    def _note_fault(self, peer: str, kind: FaultKind) -> None:
+        self.faults.append(Fault(peer, kind))
+        if len(self.faults) > _FAULT_LOG_MAX:
+            del self.faults[: len(self.faults) - _FAULT_LOG_MAX]
+
     async def _recv_loop(self, peer: str, reader: asyncio.StreamReader) -> None:
         while True:
             try:
@@ -694,9 +705,7 @@ class TcpNode:
                                 epoch=ep,
                             )
                 else:
-                    self.faults.append(
-                        Fault(peer, FaultKind.INVALID_MESSAGE)
-                    )
+                    self._note_fault(peer, FaultKind.INVALID_MESSAGE)
                     if rec is not None:
                         rec.count("wire.bad_obtrace")
                 continue
@@ -802,6 +811,9 @@ class TcpNode:
     async def _route(self, step: Step) -> None:
         rec = _obs.ACTIVE
         for out in step.output:
+            # grows one entry per *committed* batch — consensus-rate,
+            # behind a full agreement round, not attacker-rate — and is
+            # the return value of run()  # lint: ok(bounded-state)
             self.outputs.append(out)
             ep = getattr(out, "epoch", None)
             if type(ep) is int:
@@ -826,6 +838,8 @@ class TcpNode:
                     if rec is not None:
                         rec.count("wire.output_hook_errors")
         self.faults.extend(step.fault_log)
+        if len(self.faults) > _FAULT_LOG_MAX:
+            del self.faults[: len(self.faults) - _FAULT_LOG_MAX]
         touched = []
         for tm in step.messages:
             if tm.target.is_all:
@@ -924,9 +938,7 @@ class TcpNode:
                     # pump on remote input — but never drop it silently
                     # either: attribute it so the failure is visible in
                     # faults + obs counters.
-                    self.faults.append(
-                        Fault(sender, FaultKind.INVALID_MESSAGE)
-                    )
+                    self._note_fault(sender, FaultKind.INVALID_MESSAGE)
                     rec = _obs.ACTIVE
                     if rec is not None:
                         rec.count("wire.handler_errors")
@@ -951,6 +963,7 @@ class TcpNode:
         self._closing = True
         for t in self._tasks:
             t.cancel()
+        self._tasks.clear()
         for w in self._writers.values():
             w.close()
         if self._server is not None:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One-shot verification gate, in dependency order:
 #
-#   1. badgerlint — all 19 static rules over the package tree
+#   1. badgerlint — all 20 static rules over the package tree
 #   2. racecheck smoke — the lockset-checker test module under
 #      `pytest --racecheck` (runtime thread-safety)
 #   3. wire-manifest verification — the @wire registry still matches
@@ -39,6 +39,12 @@
 #      re-runs the fr device tests and the G1 product-flush
 #      byte-identity plane with sampled arbitrary-precision
 #      recomputation (the runtime dual of the static proof)
+#   9. badgermc smoke — bounded schedule-space model checking: the
+#      sbv_broadcast stack explored exhaustively to its depth bound
+#      (honest n=4, zero violations, a state floor guarding against a
+#      degenerate search) and the agreement stack under a Byzantine
+#      node (forged/equivocating/dropped messages), both asserting
+#      every safety invariant at every explored state
 #
 # Each stage runs even if an earlier one failed (you want the full
 # report, not the first stopper), but the exit code is non-zero if ANY
@@ -60,23 +66,23 @@ log() {
 
 rc=0
 
-echo "== [1/8] badgerlint (all rules) ==" | log
+echo "== [1/9] badgerlint (all rules) ==" | log
 python -m hbbft_tpu.analysis 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [2/8] racecheck smoke ==" | log
+echo "== [2/9] racecheck smoke ==" | log
 env JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
   -p no:cacheprovider --racecheck 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [3/8] wire manifest ==" | log
+echo "== [3/9] wire manifest ==" | log
 python -m hbbft_tpu.analysis --select wire-stability 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [4/8] scenarios smoke ==" | log
+echo "== [4/9] scenarios smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
   --only bad-share --only ordered-reveal --only equivocate \
   --only hostile-clients \
@@ -86,12 +92,12 @@ env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [5/8] gateway smoke ==" | log
+echo "== [5/9] gateway smoke ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.serve.loadgen --smoke 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [6/8] fleet telemetry (timeline + health rules) ==" | log
+echo "== [6/9] fleet telemetry (timeline + health rules) ==" | log
 fleet_dir=$(mktemp -d)
 env JAX_PLATFORMS=cpu HBBFT_FLEET_DIR="$fleet_dir" \
   python -m hbbft_tpu.harness.scenarios --only fleet-telemetry 2>&1 | log
@@ -105,18 +111,29 @@ stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 rm -rf "$fleet_dir"
 
-echo "== [7/8] stallcheck smoke (fleet-telemetry under the sanitizer) ==" | log
+echo "== [7/9] stallcheck smoke (fleet-telemetry under the sanitizer) ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.harness.scenarios \
   --only fleet-telemetry --stallcheck --stall-budget 0.5 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 
-echo "== [8/8] limbprove (range proofs + overflow shadow smoke) ==" | log
+echo "== [8/9] limbprove (range proofs + overflow shadow smoke) ==" | log
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --select limb-range 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --rangecheck \
   "tests/test_fr_jax.py tests/test_mesh_flush.py::TestG1ProductByteIdentity" 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+
+echo "== [9/9] badgermc smoke (schedule-space model checking) ==" | log
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --mc \
+  --mc-config sbv_broadcast --mc-depth 6 --mc-min-states 3000 2>&1 | log
+stage=${PIPESTATUS[0]}
+[ "$stage" -ne 0 ] && rc=1
+env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --mc \
+  --mc-config agreement --mc-depth 3 --mc-corrupt 1 --mc-probes 2 \
+  --mc-min-states 2000 2>&1 | log
 stage=${PIPESTATUS[0]}
 [ "$stage" -ne 0 ] && rc=1
 

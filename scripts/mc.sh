@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# badgermc — bounded schedule-space model checking of the protocol
+# state machines (hbbft_tpu.analysis.modelcheck): DFS over every
+# inequivalent message-delivery interleaving of an n=4 network up to a
+# depth bound, with canonical state-hash dedup, sleep-set DPOR, and
+# optional Byzantine choice points; safety invariants asserted at every
+# state, violations ddmin-shrunk to a replayable counterexample.
+#
+# Without arguments runs the full clean matrix (every protocol stack at
+# its pinned depth).  Any arguments are passed straight through to
+# `python -m hbbft_tpu.analysis --mc`:
+#
+#   scripts/mc.sh                                        # clean matrix
+#   scripts/mc.sh --mc-config agreement --mc-depth 5     # one stack
+#   scripts/mc.sh --mc-config honey_badger --mc-depth 4 \
+#                 --mc-corrupt 1 --mc-repro /tmp/cex.json
+#   MC_TRACE=/tmp/mc.jsonl scripts/mc.sh                 # obs mc_run rows
+#
+# Replay a written counterexample with:
+#   python -m hbbft_tpu.harness.scenarios --replay-trace /tmp/cex.json
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+trace_args=()
+if [ -n "${MC_TRACE:-}" ]; then
+  trace_args=(--trace "$MC_TRACE")
+fi
+
+if [ "$#" -gt 0 ]; then
+  exec env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --mc \
+    "${trace_args[@]}" "$@"
+fi
+
+# The pinned clean matrix: every stack, honest and corrupt=1, at depths
+# that keep the whole sweep around two minutes on one CPU core.
+rc=0
+run() {
+  echo "== badgermc $* =="
+  env JAX_PLATFORMS=cpu python -m hbbft_tpu.analysis --mc \
+    "${trace_args[@]}" "$@" || rc=1
+}
+run --mc-config sbv_broadcast --mc-depth 6 --mc-min-states 3000
+run --mc-config common_coin   --mc-depth 6 --mc-min-states 5000
+run --mc-config agreement     --mc-depth 5 --mc-min-states 1500
+run --mc-config common_subset --mc-depth 4 --mc-min-states 2500
+run --mc-config honey_badger  --mc-depth 4 --mc-min-states 2500
+run --mc-config sbv_broadcast --mc-depth 3 --mc-corrupt 1 --mc-min-states 1500
+run --mc-config agreement     --mc-depth 3 --mc-corrupt 1 --mc-min-states 2000
+exit "$rc"

@@ -87,3 +87,25 @@ def test_common_subset_real_bls():
     run_common_subset(
         rng, 4, {i: b"real-%d" % i for i in range(4)}, mock=False
     )
+
+
+def test_completion_output_order_is_arrival_independent():
+    """badgermc regression: the decided-subset dict must list proposers
+    in canonical order regardless of the order agreement/broadcast
+    results arrived in (``_try_agreement_completion``)."""
+    from hbbft_tpu.core.network_info import NetworkInfo
+
+    ni = NetworkInfo.generate_map(
+        list(range(4)), random.Random(7), mock=True
+    )[0]
+    outs = []
+    for order in ([0, 1, 2, 3], [3, 1, 0, 2]):
+        cs = CommonSubset(ni, 0)
+        for pid in order:  # insertion order == arrival order
+            cs.broadcast_results[pid] = bytes([pid])
+            cs.agreement_results[pid] = True
+        result = cs._try_agreement_completion()
+        assert result is not None
+        outs.append(result)
+    assert outs[0] == outs[1]
+    assert list(outs[0]) == list(outs[1]) == [0, 1, 2, 3]

@@ -188,3 +188,27 @@ def test_honey_badger_faulty_shares():
 def test_honey_badger_real_bls():
     rng = random.Random(43)
     run_honey_badger(rng, 4, txs_per_node=2, batch_contrib=2, mock=False)
+
+
+def test_share_verification_fault_order_is_arrival_independent():
+    """badgermc regression: the fault log emitted while auditing
+    pending decryption shares must not depend on share-arrival order
+    (the canonical walk in ``_verify_pending_decryption_shares``)."""
+    from hbbft_tpu.core.network_info import NetworkInfo
+
+    nis = NetworkInfo.generate_map(
+        list(range(4)), random.Random(0x5EED), mock=True
+    )
+    runs = []
+    for order in ([0, 1, 2, 3], [2, 0, 3, 1]):
+        hb = HoneyBadger(nis[0])
+        shares = {}
+        for sid in order:  # insertion order == arrival order
+            shares[sid] = b"bogus"
+        hb.received_shares[0] = {1: shares}
+        incorrect, faults = hb._verify_pending_decryption_shares(
+            1, b"ciphertext", 0
+        )
+        assert incorrect == {0, 1, 2, 3}
+        runs.append([f.node_id for f in faults])
+    assert runs[0] == runs[1] == [0, 1, 2, 3]

@@ -2403,4 +2403,87 @@ def test_async_rules_registered():
         "await-holding-lock",
         "cancellation-safety",
     } <= names
-    assert len(RULES) == 19
+    assert len(RULES) == 20
+
+
+# ---------------------------------------------------------------------------
+# bounded-state
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_state_flags_unbounded_wire_growth():
+    src = """
+        class Algo:
+            def __init__(self):
+                self.queue = []
+                self.table = {}
+
+            def handle_message(self, sender_id, msg):
+                self.queue.append(msg)
+                self.table[msg.epoch] = msg
+    """
+    vs = _lint(src, "protocols/fixture.py", select="bounded-state")
+    assert len(vs) == 2
+    assert {v.line for v in vs} == {8, 9}
+    assert "remotely drivable unbounded growth" in vs[0].message
+
+
+def test_bounded_state_witnesses_are_clean():
+    # one class per witness form: eviction, len() bound, validator-set
+    # key, swap-drain re-assignment, and a set-add of a node identity
+    src = """
+        class Evicts:
+            def handle_message(self, sender_id, msg):
+                self.table[msg.epoch] = msg
+                self.table.pop(msg.epoch - 2, None)
+
+        class Bounds:
+            def handle_message(self, sender_id, msg):
+                if len(self.queue) < 64:
+                    self.queue.append(msg)
+
+        class IdKeyed:
+            def handle_message(self, sender_id, msg):
+                self.shares[sender_id] = msg
+                self.parts[msg.sender_idx] = msg
+
+        class SwapDrains:
+            def handle_message(self, sender_id, msg):
+                self.queue.append(msg)
+
+            def _advance(self):
+                drained, self.queue = self.queue, []
+                return drained
+
+        class SetAddsId:
+            def handle_message(self, sender_id, msg):
+                self.votes[msg.value].add(sender_id)
+    """
+    assert _lint(src, "protocols/fixture.py", select="bounded-state") == []
+
+
+def test_bounded_state_scope_wire_fed_classes_only():
+    # no handle_* entry point in protocols/ -> not wire-fed, not flagged
+    src = """
+        class Helper:
+            def note(self, msg):
+                self.log.append(msg)
+    """
+    assert _lint(src, "protocols/fixture.py", select="bounded-state") == []
+    # the same class in transport/ IS wire-fed by definition
+    vs = _lint(src, "transport/fixture.py", select="bounded-state")
+    assert len(vs) == 1
+    assert "Helper.log" in vs[0].message
+    # and harness/ is out of scope entirely
+    assert _lint(src, "harness/fixture.py", select="bounded-state") == []
+
+
+def test_bounded_state_suppression():
+    src = """
+        class Algo:
+            def handle_message(self, sender_id, msg):
+                # capped by the protocol's batch bound, not visible
+                # to the AST  # lint: ok(bounded-state)
+                self.queue.append(msg)
+    """
+    assert _lint(src, "protocols/fixture.py", select="bounded-state") == []

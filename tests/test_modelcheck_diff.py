@@ -1,0 +1,130 @@
+"""Differential validation of badgermc: revert a safety guard in a
+fixture copy of the package and the model checker must (a) find a
+violation, (b) shrink it to a ≤12-action counterexample, and (c) write
+a repro file that replays deterministically inside the fixture — while
+the unreverted tree stays clean at the same pinned configs and fails
+to reproduce the fixture's counterexample.
+
+The fixture subprocesses run with ``cwd`` INSIDE the fixture root:
+``python -m`` prepends the cwd to ``sys.path``, which shadows any
+installed/parent copy of the package — ``PYTHONPATH`` alone does not
+(the launch directory wins), which silently re-runs the clean tree."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Each entry reverts one guard.  ``old`` must match exactly once — a
+# drifted anchor fails loudly instead of silently testing nothing.
+MUTATIONS = {
+    "ag-nonbool-term-guard": dict(
+        path="hbbft_tpu/protocols/agreement.py",
+        old="if not isinstance(content.value, bool):",
+        new="if False and not isinstance(content.value, bool):",
+        mc=["--mc-config", "agreement", "--mc-depth", "3",
+            "--mc-corrupt", "1", "--mc-probes", "2"],
+        kind="crash",  # forged non-bool Term indexes BoolMultimap
+    ),
+    "hb-missing-ciphertext-guard": dict(
+        path="hbbft_tpu/protocols/honey_badger.py",
+        old=(
+            "cts = self.ciphertexts.get(self.epoch)\n"
+            "        if cts is None:\n"
+            "            return None\n"
+        ),
+        new="cts = self.ciphertexts.get(self.epoch) or {}\n",
+        mc=["--mc-config", "honey_badger", "--mc-depth", "2",
+            "--mc-corrupt", "1", "--mc-probes", "2"],
+        kind="crash",  # forged share with no ciphertext to audit
+    ),
+    "ba-coin-match-guard": dict(
+        path="hbbft_tpu/protocols/agreement.py",
+        old="if def_bin is not None and def_bin == coin:",
+        new="if def_bin is not None:",
+        mc=["--mc-config", "agreement", "--mc-depth", "2",
+            "--mc-probes", "6"],
+        kind="agreement",  # honest nodes decide opposite values — needs
+        # the partition-biased liveness probes, not the DFS frontier
+    ),
+}
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _mc(cwd, args, repro=None):
+    cmd = [sys.executable, "-m", "hbbft_tpu.analysis", "--mc",
+           "--format", "json", *args]
+    if repro is not None:
+        cmd += ["--mc-repro", str(repro)]
+    return subprocess.run(
+        cmd, cwd=str(cwd), env=_env(),
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+def _replay(cwd, path):
+    return subprocess.run(
+        [sys.executable, "-m", "hbbft_tpu.harness.scenarios",
+         "--replay-trace", str(path)],
+        cwd=str(cwd), env=_env(),
+        capture_output=True, text=True, timeout=300,
+    )
+
+
+def _fixture(tmp_path, name):
+    root = tmp_path / name
+    shutil.copytree(
+        REPO / "hbbft_tpu", root / "hbbft_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"),
+    )
+    m = MUTATIONS[name]
+    target = root / m["path"]
+    src = target.read_text()
+    assert src.count(m["old"]) == 1, f"mutation anchor drifted in {m['path']}"
+    target.write_text(src.replace(m["old"], m["new"]))
+    return root
+
+
+@pytest.mark.parametrize("name", sorted(MUTATIONS))
+def test_revert_is_caught_shrunk_and_replayable(tmp_path, name):
+    m = MUTATIONS[name]
+    root = _fixture(tmp_path, name)
+    repro = root / "repro.json"
+
+    p = _mc(root, m["mc"], repro=repro)
+    assert p.returncode == 1, f"revert not caught:\n{p.stdout}\n{p.stderr}"
+    doc = json.loads(p.stdout)
+    assert not doc["ok"]
+    v = doc["mc"]["violation"]
+    assert v is not None and v["kind"] == m["kind"], v
+    assert len(v["trace"]) <= 12, "counterexample not shrunk"
+    assert repro.exists()
+
+    # the counterexample replays deterministically inside the fixture
+    r = _replay(root, repro)
+    assert r.returncode == 0, f"repro did not replay:\n{r.stdout}\n{r.stderr}"
+    assert "REPRODUCED" in r.stdout
+
+    # ... and does NOT reproduce on the unreverted tree
+    r = _replay(REPO, repro)
+    assert r.returncode == 1, r.stdout
+    assert "NOT REPRODUCED" in r.stdout
+
+
+@pytest.mark.parametrize("name", sorted(MUTATIONS))
+def test_unreverted_tree_is_clean_at_the_pinned_configs(name):
+    p = _mc(REPO, MUTATIONS[name]["mc"])
+    assert p.returncode == 0, f"clean tree flagged:\n{p.stdout}\n{p.stderr}"
+    doc = json.loads(p.stdout)
+    assert doc["ok"] and doc["mc"]["violation"] is None
